@@ -1,0 +1,22 @@
+"""kubernetes_tpu — a TPU-native cluster orchestration framework.
+
+A from-scratch rebuild of the capabilities of Kubernetes (reference:
+choury/kubernetes ~v1.21) designed TPU-first: the scheduler's Filter/Score
+hot path (reference: pkg/scheduler/framework/runtime/framework.go:723
+RunScorePlugins, a 16-goroutine per-node loop) is reformulated as a dense
+pod x node constraint-mask + score matrix evaluated in a single XLA
+dispatch, sharded over a jax.sharding.Mesh.
+
+Layout (mirrors SURVEY.md section 7 build plan):
+  api/        typed API objects, resource.Quantity math, label selectors
+  store/      revisioned ordered KV + watch (the etcd equivalent)
+  client/     informer-style caches, workqueues
+  scheduler/  queue, assume-cache, scheduling framework + plugins (CPU oracle)
+  models/     dense array encoding of cluster state for the TPU kernel
+  ops/        JAX/XLA kernels: feasibility masks, score matrices, selection
+  parallel/   device mesh, sharded dispatch, collectives
+  controllers/ control loops (replicaset, node lifecycle, ...)
+  utils/      serde, backoff, misc
+"""
+
+__version__ = "0.1.0"

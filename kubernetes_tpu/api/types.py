@@ -1,0 +1,305 @@
+"""Core API types (the v1 data model subset the control plane needs).
+
+Hand-written equivalents of the reference's generated API structs
+(reference: staging/src/k8s.io/api/core/v1/types.go). Resource maps are kept
+as {name: quantity-string} and parsed to exact int64 via api.quantity at the
+edges, mirroring how the reference carries resource.Quantity and converts to
+framework.Resource int64 milli-units inside the scheduler
+(pkg/scheduler/framework/types.go:318 Resource.Add).
+
+JSON round-trip uses utils.serde (camelCase keys, omitempty) so objects are
+wire-compatible in shape with the reference's REST API.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# meta/v1
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: Optional[bool] = None
+    block_owner_deletion: Optional[bool] = None
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    generation: int = 0
+    creation_timestamp: Optional[float] = None  # unix seconds
+    deletion_timestamp: Optional[float] = None
+    labels: Optional[Dict[str, str]] = None
+    annotations: Optional[Dict[str, str]] = None
+    owner_references: Optional[List[OwnerReference]] = None
+
+
+@dataclass
+class LabelSelectorRequirement:
+    key: str = ""
+    operator: str = ""  # In | NotIn | Exists | DoesNotExist
+    values: Optional[List[str]] = None
+
+
+@dataclass
+class LabelSelector:
+    match_labels: Optional[Dict[str, str]] = None
+    match_expressions: Optional[List[LabelSelectorRequirement]] = None
+
+
+# ---------------------------------------------------------------------------
+# Node
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = ""  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: Optional[List[Taint]] = None
+    pod_cidr: str = ""
+    provider_id: str = ""
+
+
+@dataclass
+class ContainerImage:
+    names: Optional[List[str]] = None
+    size_bytes: int = 0
+
+
+@dataclass
+class NodeCondition:
+    type: str = ""  # Ready | MemoryPressure | DiskPressure | PIDPressure | ...
+    status: str = ""  # True | False | Unknown
+    last_heartbeat_time: Optional[float] = None
+    last_transition_time: Optional[float] = None
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class NodeStatus:
+    capacity: Optional[Dict[str, str]] = None
+    allocatable: Optional[Dict[str, str]] = None
+    conditions: Optional[List[NodeCondition]] = None
+    images: Optional[List[ContainerImage]] = None
+    phase: str = ""
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+    kind: str = "Node"
+    api_version: str = "v1"
+
+
+# ---------------------------------------------------------------------------
+# Pod spec: affinity
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str = ""
+    operator: str = ""  # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: Optional[List[str]] = None
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: Optional[List[NodeSelectorRequirement]] = None
+    match_fields: Optional[List[NodeSelectorRequirement]] = None
+
+
+@dataclass
+class NodeSelector:
+    node_selector_terms: Optional[List[NodeSelectorTerm]] = None
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int = 0  # 1-100
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass
+class NodeAffinity:
+    required_during_scheduling_ignored_during_execution: Optional[NodeSelector] = None
+    preferred_during_scheduling_ignored_during_execution: Optional[
+        List[PreferredSchedulingTerm]
+    ] = None
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: Optional[LabelSelector] = None
+    namespaces: Optional[List[str]] = None
+    topology_key: str = ""
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int = 0  # 1-100
+    pod_affinity_term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
+class PodAffinity:
+    required_during_scheduling_ignored_during_execution: Optional[
+        List[PodAffinityTerm]
+    ] = None
+    preferred_during_scheduling_ignored_during_execution: Optional[
+        List[WeightedPodAffinityTerm]
+    ] = None
+
+
+@dataclass
+class PodAntiAffinity:
+    required_during_scheduling_ignored_during_execution: Optional[
+        List[PodAffinityTerm]
+    ] = None
+    preferred_during_scheduling_ignored_during_execution: Optional[
+        List[WeightedPodAffinityTerm]
+    ] = None
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = ""  # Exists | Equal (default Equal)
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+    toleration_seconds: Optional[int] = None
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = ""  # DoNotSchedule | ScheduleAnyway
+    label_selector: Optional[LabelSelector] = None
+
+
+# ---------------------------------------------------------------------------
+# Pod spec: containers
+
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    host_port: int = 0
+    container_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass
+class ResourceRequirements:
+    limits: Optional[Dict[str, str]] = None
+    requests: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    ports: Optional[List[ContainerPort]] = None
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    # volume sources are opaque to the scheduler core; carried as a dict
+    source: Optional[Dict[str, object]] = None
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: Optional[List[Container]] = None
+    node_name: str = ""
+    node_selector: Optional[Dict[str, str]] = None
+    affinity: Optional[Affinity] = None
+    tolerations: Optional[List[Toleration]] = None
+    topology_spread_constraints: Optional[List[TopologySpreadConstraint]] = None
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    scheduler_name: str = ""
+    overhead: Optional[Dict[str, str]] = None
+    host_network: bool = False
+    volumes: Optional[List[Volume]] = None
+    restart_policy: str = "Always"
+    termination_grace_period_seconds: Optional[int] = None
+
+
+@dataclass
+class PodCondition:
+    type: str = ""
+    status: str = ""
+    last_transition_time: Optional[float] = None
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class PodStatus:
+    phase: str = ""  # Pending | Running | Succeeded | Failed | Unknown
+    conditions: Optional[List[PodCondition]] = None
+    nominated_node_name: str = ""
+    start_time: Optional[float] = None
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+    kind: str = "Pod"
+    api_version: str = "v1"
+
+
+# Well-known labels (reference: staging/src/k8s.io/api/core/v1/well_known_labels.go)
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+LABEL_ZONE = "topology.kubernetes.io/zone"
+LABEL_REGION = "topology.kubernetes.io/region"
+LABEL_ZONE_LEGACY = "failure-domain.beta.kubernetes.io/zone"
+LABEL_REGION_LEGACY = "failure-domain.beta.kubernetes.io/region"
+
+TAINT_NODE_NOT_READY = "node.kubernetes.io/not-ready"
+TAINT_NODE_UNREACHABLE = "node.kubernetes.io/unreachable"
+TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+
+# Resource names (subset of v1.ResourceName)
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_EPHEMERAL_STORAGE = "ephemeral-storage"
+RESOURCE_PODS = "pods"
+
+
+def pod_key(pod: Pod) -> str:
+    """namespace/name cache key (reference: framework.GetPodKey)."""
+    return f"{pod.metadata.namespace}/{pod.metadata.name}"

@@ -1,0 +1,149 @@
+"""Default plugin registry and the default algorithm-provider profile.
+
+Reference: pkg/scheduler/framework/plugins/registry.go NewInTreeRegistry and
+pkg/scheduler/algorithmprovider/registry.go:71 getDefaultConfig (plugin sets
+and score weights of the default profile).
+
+Volume plugins (VolumeBinding/Restrictions/Zone/Limits) are registered as
+permissive placeholders until the volume subsystem lands; they occupy the
+same extension points so profiles stay shape-compatible.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..framework import interface as fwk
+from ..framework.runtime import Registry
+from . import interpodaffinity, nodebasic, noderesources, podtopologyspread
+
+
+class _NoopFilter(fwk.PreFilterPlugin, fwk.FilterPlugin, fwk.ReservePlugin, fwk.PreBindPlugin):
+    """Placeholder for not-yet-implemented plugins; passes at every point."""
+
+    def __init__(self, args=None, handle=None):
+        pass
+
+    def pre_filter(self, state, pod):
+        return None
+
+    def filter(self, state, pod, node_info):
+        return None
+
+    def reserve(self, state, pod, node_name):
+        return None
+
+    def pre_bind(self, state, pod, node_name):
+        return None
+
+
+def _noop(name: str):
+    cls = type(name, (_NoopFilter,), {"name": name})
+    return lambda args, handle: cls(args, handle)
+
+
+class _UnschedulablePostFilter(fwk.PostFilterPlugin):
+    """Stand-in until defaultpreemption lands (task: preemption)."""
+
+    name = "DefaultPreemption"
+
+    def __init__(self, args=None, handle=None):
+        pass
+
+    def post_filter(self, state, pod, filtered_node_status_map):
+        from ..framework.interface import Status
+
+        return None, Status.unschedulable("preemption not available")
+
+
+def new_in_tree_registry() -> Registry:
+    r = Registry()
+    r.register("PrioritySort", lambda a, h: nodebasic.PrioritySort(a, h))
+    r.register("NodeResourcesFit", lambda a, h: noderesources.Fit(a, h))
+    r.register("NodeResourcesBalancedAllocation", lambda a, h: noderesources.BalancedAllocation(a, h))
+    r.register("NodeResourcesLeastAllocated", lambda a, h: noderesources.LeastAllocated(a, h))
+    r.register("NodeResourcesMostAllocated", lambda a, h: noderesources.MostAllocated(a, h))
+    r.register("RequestedToCapacityRatio", lambda a, h: noderesources.RequestedToCapacityRatio(a, h))
+    r.register("NodeName", lambda a, h: nodebasic.NodeName(a, h))
+    r.register("NodePorts", lambda a, h: nodebasic.NodePorts(a, h))
+    r.register("NodeUnschedulable", lambda a, h: nodebasic.NodeUnschedulable(a, h))
+    r.register("TaintToleration", lambda a, h: nodebasic.TaintToleration(a, h))
+    r.register("NodeAffinity", lambda a, h: nodebasic.NodeAffinity(a, h))
+    r.register("ImageLocality", lambda a, h: nodebasic.ImageLocality(a, h))
+    r.register("NodePreferAvoidPods", lambda a, h: nodebasic.NodePreferAvoidPods(a, h))
+    r.register("PodTopologySpread", lambda a, h: podtopologyspread.PodTopologySpread(a, h))
+    r.register("InterPodAffinity", lambda a, h: interpodaffinity.InterPodAffinity(a, h))
+    r.register("DefaultBinder", lambda a, h: nodebasic.DefaultBinder(a, h))
+    r.register("DefaultPreemption", lambda a, h: _UnschedulablePostFilter(a, h))
+    # placeholders (volume subsystem pending)
+    for name in (
+        "VolumeBinding",
+        "VolumeRestrictions",
+        "VolumeZone",
+        "NodeVolumeLimits",
+        "EBSLimits",
+        "GCEPDLimits",
+        "AzureDiskLimits",
+    ):
+        r.register(name, _noop(name))
+    return r
+
+
+def default_plugins() -> dict:
+    """algorithmprovider/registry.go:71-148 getDefaultConfig, as the
+    framework's {extension point: [(name, weight)]} map."""
+    return {
+        "queueSort": [("PrioritySort", 1)],
+        "preFilter": [
+            ("NodeResourcesFit", 1),
+            ("NodePorts", 1),
+            ("PodTopologySpread", 1),
+            ("InterPodAffinity", 1),
+            ("VolumeBinding", 1),
+        ],
+        "filter": [
+            ("NodeUnschedulable", 1),
+            ("NodeName", 1),
+            ("TaintToleration", 1),
+            ("NodeAffinity", 1),
+            ("NodePorts", 1),
+            ("NodeResourcesFit", 1),
+            ("VolumeRestrictions", 1),
+            ("EBSLimits", 1),
+            ("GCEPDLimits", 1),
+            ("NodeVolumeLimits", 1),
+            ("AzureDiskLimits", 1),
+            ("VolumeBinding", 1),
+            ("VolumeZone", 1),
+            ("PodTopologySpread", 1),
+            ("InterPodAffinity", 1),
+        ],
+        "postFilter": [("DefaultPreemption", 1)],
+        "preScore": [
+            ("InterPodAffinity", 1),
+            ("PodTopologySpread", 1),
+            ("TaintToleration", 1),
+            ("NodeAffinity", 1),
+        ],
+        "score": [
+            ("NodeResourcesBalancedAllocation", 1),
+            ("ImageLocality", 1),
+            ("InterPodAffinity", 1),
+            ("NodeResourcesLeastAllocated", 1),
+            ("NodeAffinity", 1),
+            ("NodePreferAvoidPods", 10000),
+            ("PodTopologySpread", 2),
+            ("TaintToleration", 1),
+        ],
+        "reserve": [("VolumeBinding", 1)],
+        "preBind": [("VolumeBinding", 1)],
+        "bind": [("DefaultBinder", 1)],
+    }
+
+
+def default_plugins_without(*names: str) -> dict:
+    cfg = default_plugins()
+    return {
+        point: [(n, w) for n, w in plugins if n not in names]
+        for point, plugins in cfg.items()
+    }

@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax imports.
+
+Multi-chip shardings are validated on virtual CPU devices (the real
+environment has a single TPU chip); the driver's dryrun_multichip does the
+same. x64 is enabled because score math is int64 (framework.MaxNodeScore
+scale, reference pkg/scheduler/framework/interface.go:95) and resource math
+is int64 milli-units.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

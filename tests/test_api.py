@@ -1,0 +1,216 @@
+"""Unit tests for the api layer: quantity, labels, taints, serde.
+
+Case values mirror the reference's table tests
+(staging/src/k8s.io/apimachinery/pkg/api/resource/quantity_test.go,
+staging/src/k8s.io/apimachinery/pkg/labels/selector_test.go).
+"""
+
+import pytest
+
+from kubernetes_tpu.api.quantity import Quantity, parse_quantity
+from kubernetes_tpu.api.labels import (
+    Selector,
+    match_node_selector_terms,
+    pod_matches_node_selector_and_affinity,
+)
+from kubernetes_tpu.api.taints import (
+    find_matching_untolerated_taint,
+    toleration_tolerates_taint,
+)
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.utils import serde
+
+
+class TestQuantity:
+    @pytest.mark.parametrize(
+        "s,value",
+        [
+            ("0", 0),
+            ("100m", 1),  # ceil(0.1)
+            ("1", 1),
+            ("1500m", 2),  # ceil(1.5)
+            ("2k", 2000),
+            ("2Ki", 2048),
+            ("1Gi", 1073741824),
+            ("32Gi", 34359738368),
+            ("12e6", 12000000),
+            ("1.5Gi", 1610612736),
+            ("100M", 100000000),
+        ],
+    )
+    def test_value(self, s, value):
+        assert Quantity(s).value() == value
+
+    @pytest.mark.parametrize(
+        "s,milli",
+        [
+            ("100m", 100),
+            ("1", 1000),
+            ("4", 4000),
+            ("2500m", 2500),
+            ("1u", 1),  # ceil(0.001)
+            ("500n", 1),
+            ("0", 0),
+        ],
+    )
+    def test_milli_value(self, s, milli):
+        assert Quantity(s).milli_value() == milli
+
+    def test_invalid(self):
+        for bad in ["", "abc", "1.5.2", "--1", "1Kii"]:
+            with pytest.raises(ValueError):
+                parse_quantity(bad)
+
+    def test_compare(self):
+        assert Quantity("1000m") == Quantity("1")
+        assert Quantity("999m") < Quantity("1")
+
+
+class TestSelector:
+    def test_nil_matches_nothing(self):
+        assert not Selector.from_label_selector(None).matches({"a": "b"})
+        assert not Selector.from_label_selector(None).matches({})
+
+    def test_empty_matches_everything(self):
+        sel = Selector.from_label_selector(t.LabelSelector())
+        assert sel.matches({}) and sel.matches({"a": "b"})
+
+    def test_match_labels(self):
+        sel = Selector.from_label_selector(t.LabelSelector(match_labels={"a": "b"}))
+        assert sel.matches({"a": "b", "c": "d"})
+        assert not sel.matches({"a": "x"})
+        assert not sel.matches({})
+
+    def test_expressions(self):
+        sel = Selector.from_label_selector(
+            t.LabelSelector(
+                match_expressions=[
+                    t.LabelSelectorRequirement(key="env", operator="In", values=["p", "q"]),
+                    t.LabelSelectorRequirement(key="gone", operator="DoesNotExist"),
+                ]
+            )
+        )
+        assert sel.matches({"env": "p"})
+        assert not sel.matches({"env": "z"})
+        assert not sel.matches({"env": "p", "gone": "1"})
+
+    def test_not_in_absent_key_matches(self):
+        sel = Selector.from_label_selector(
+            t.LabelSelector(
+                match_expressions=[
+                    t.LabelSelectorRequirement(key="k", operator="NotIn", values=["v"])
+                ]
+            )
+        )
+        assert sel.matches({})
+        assert sel.matches({"k": "other"})
+        assert not sel.matches({"k": "v"})
+
+    def test_node_selector_terms_or_semantics(self):
+        terms = [
+            t.NodeSelectorTerm(
+                match_expressions=[
+                    t.NodeSelectorRequirement(key="zone", operator="In", values=["z1"])
+                ]
+            ),
+            t.NodeSelectorTerm(
+                match_expressions=[
+                    t.NodeSelectorRequirement(key="zone", operator="In", values=["z2"])
+                ]
+            ),
+        ]
+        assert match_node_selector_terms(terms, {"zone": "z2"}, {})
+        assert not match_node_selector_terms(terms, {"zone": "z3"}, {})
+        # empty term matches nothing
+        assert not match_node_selector_terms([t.NodeSelectorTerm()], {"a": "b"}, {})
+
+    def test_gt_lt(self):
+        terms = [
+            t.NodeSelectorTerm(
+                match_expressions=[
+                    t.NodeSelectorRequirement(key="cores", operator="Gt", values=["4"])
+                ]
+            )
+        ]
+        assert match_node_selector_terms(terms, {"cores": "8"}, {})
+        assert not match_node_selector_terms(terms, {"cores": "4"}, {})
+        assert not match_node_selector_terms(terms, {"cores": "abc"}, {})
+
+    def test_match_fields(self):
+        terms = [
+            t.NodeSelectorTerm(
+                match_fields=[
+                    t.NodeSelectorRequirement(
+                        key="metadata.name", operator="In", values=["node-1"]
+                    )
+                ]
+            )
+        ]
+        assert match_node_selector_terms(terms, {}, {"metadata.name": "node-1"})
+        assert not match_node_selector_terms(terms, {}, {"metadata.name": "node-2"})
+
+    def test_pod_node_selector(self):
+        pod = t.Pod(spec=t.PodSpec(node_selector={"disk": "ssd"}))
+        node = t.Node(metadata=t.ObjectMeta(name="n", labels={"disk": "ssd"}))
+        assert pod_matches_node_selector_and_affinity(pod, node)
+        node2 = t.Node(metadata=t.ObjectMeta(name="n2", labels={"disk": "hdd"}))
+        assert not pod_matches_node_selector_and_affinity(pod, node2)
+
+
+class TestTaints:
+    def test_exists_empty_key_matches_all(self):
+        tol = t.Toleration(operator="Exists")
+        assert toleration_tolerates_taint(tol, t.Taint(key="k", value="v", effect="NoSchedule"))
+
+    def test_effect_mismatch(self):
+        tol = t.Toleration(key="k", operator="Exists", effect="NoSchedule")
+        assert not toleration_tolerates_taint(tol, t.Taint(key="k", effect="NoExecute"))
+
+    def test_equal(self):
+        tol = t.Toleration(key="k", operator="Equal", value="v")
+        assert toleration_tolerates_taint(tol, t.Taint(key="k", value="v", effect="NoSchedule"))
+        assert not toleration_tolerates_taint(tol, t.Taint(key="k", value="w", effect="NoSchedule"))
+
+    def test_find_untolerated_with_filter(self):
+        taints = [
+            t.Taint(key="a", effect="PreferNoSchedule"),
+            t.Taint(key="b", effect="NoSchedule"),
+        ]
+        # filter only NoSchedule/NoExecute (the Filter plugin predicate)
+        pred = lambda taint: taint.effect in ("NoSchedule", "NoExecute")
+        taint, found = find_matching_untolerated_taint(taints, [], pred)
+        assert found and taint.key == "b"
+        tol = [t.Toleration(key="b", operator="Exists")]
+        _, found = find_matching_untolerated_taint(taints, tol, pred)
+        assert not found
+
+
+class TestSerde:
+    def test_pod_roundtrip(self):
+        pod = t.Pod(
+            metadata=t.ObjectMeta(name="p", namespace="ns", labels={"app": "web"}),
+            spec=t.PodSpec(
+                containers=[
+                    t.Container(
+                        name="c",
+                        resources=t.ResourceRequirements(
+                            requests={"cpu": "500m", "memory": "1Gi"}
+                        ),
+                        ports=[t.ContainerPort(host_port=8080, container_port=80)],
+                    )
+                ],
+                tolerations=[t.Toleration(key="k", operator="Exists")],
+                priority=100,
+            ),
+        )
+        d = serde.to_dict(pod)
+        assert d["metadata"]["name"] == "p"
+        assert d["spec"]["containers"][0]["resources"]["requests"]["cpu"] == "500m"
+        assert d["spec"]["containers"][0]["ports"][0]["hostPort"] == 8080
+        pod2 = serde.from_dict(t.Pod, d)
+        assert pod2 == pod
+
+    def test_omitempty(self):
+        d = serde.to_dict(t.Pod())
+        assert "nodeName" not in d["spec"]
+        assert "labels" not in d["metadata"]

@@ -1,0 +1,387 @@
+"""Oracle plugin unit tests.
+
+Values cross-checked against the reference plugin table tests
+(pkg/scheduler/framework/plugins/*/..._test.go).
+"""
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.scheduler.core import GenericScheduler
+from kubernetes_tpu.scheduler.framework.interface import Code, CycleState, NodeScore
+from kubernetes_tpu.scheduler.framework.runtime import Framework
+from kubernetes_tpu.scheduler.framework.snapshot import Snapshot
+from kubernetes_tpu.scheduler.plugins.registry import (
+    default_plugins,
+    default_plugins_without,
+    new_in_tree_registry,
+)
+
+from .util import (
+    anti_affinity,
+    make_node,
+    make_pod,
+    pod_affinity,
+    spread_constraint,
+)
+
+
+def build_framework(snapshot, plugins=None, plugin_config=None):
+    return Framework(
+        new_in_tree_registry(),
+        plugins=plugins or default_plugins(),
+        plugin_config=plugin_config,
+        snapshot_fn=lambda: snapshot,
+    )
+
+
+def run_filter(snapshot, pod, node_name, plugins=None):
+    fwk = build_framework(snapshot, plugins)
+    state = CycleState()
+    status = fwk.run_pre_filter_plugins(state, pod)
+    assert status is None, status
+    return fwk.run_filter_plugins(state, pod, snapshot.get(node_name))
+
+
+def run_scores(snapshot, pod, plugins=None, plugin_config=None):
+    """Returns {plugin: {node: weighted score}} over all nodes."""
+    fwk = build_framework(snapshot, plugins, plugin_config)
+    state = CycleState()
+    status = fwk.run_pre_filter_plugins(state, pod)
+    assert status is None, status
+    nodes = [ni.node for ni in snapshot.list()]
+    assert fwk.run_pre_score_plugins(state, pod, nodes) is None
+    scores_map, status = fwk.run_score_plugins(state, pod, nodes)
+    assert status is None, status
+    return {
+        plugin: {ns.name: ns.score for ns in scores}
+        for plugin, scores in scores_map.items()
+    }
+
+
+class TestNodeResourcesFit:
+    def test_insufficient_cpu(self):
+        node = make_node("n1", cpu="2")
+        existing = make_pod(cpu="1500m", node_name="n1")
+        snap = Snapshot.from_objects([existing], [node])
+        statuses = run_filter(snap, make_pod(cpu="1"), "n1")
+        assert statuses["NodeResourcesFit"].code == Code.UNSCHEDULABLE
+        assert "Insufficient cpu" in statuses["NodeResourcesFit"].reasons
+
+    def test_fits_exactly(self):
+        node = make_node("n1", cpu="2")
+        existing = make_pod(cpu="1", node_name="n1")
+        snap = Snapshot.from_objects([existing], [node])
+        assert run_filter(snap, make_pod(cpu="1"), "n1") == {}
+
+    def test_too_many_pods(self):
+        node = make_node("n1", pods=1)
+        existing = make_pod(node_name="n1")
+        snap = Snapshot.from_objects([existing], [node])
+        statuses = run_filter(snap, make_pod(), "n1")
+        assert "Too many pods" in statuses["NodeResourcesFit"].reasons
+
+    def test_extended_resource(self):
+        node = make_node("n1", extended={"nvidia.com/gpu": "2"})
+        existing = make_pod(node_name="n1", extended={"nvidia.com/gpu": "1"})
+        snap = Snapshot.from_objects([existing], [node])
+        assert run_filter(snap, make_pod(extended={"nvidia.com/gpu": "1"}), "n1") == {}
+        statuses = run_filter(snap, make_pod(extended={"nvidia.com/gpu": "2"}), "n1")
+        assert "Insufficient nvidia.com/gpu" in statuses["NodeResourcesFit"].reasons
+
+    def test_init_container_max(self):
+        node = make_node("n1", cpu="2")
+        pod = make_pod(cpu="1")
+        pod.spec.init_containers = [
+            v1.Container(name="init", resources=v1.ResourceRequirements(requests={"cpu": "1800m"}))
+        ]
+        snap = Snapshot.from_objects([make_pod(cpu="500m", node_name="n1")], [node])
+        # request = max(1000, 1800) = 1800m > 2000-500
+        statuses = run_filter(snap, pod, "n1")
+        assert "Insufficient cpu" in statuses["NodeResourcesFit"].reasons
+
+
+class TestResourceScorers:
+    def test_least_allocated(self):
+        # reference least_allocated_test.go "nothing scheduled, resources requested"
+        node = make_node("n1", cpu="4", memory="10Gi")
+        snap = Snapshot.from_objects([], [node])
+        pod = make_pod(cpu="1", memory="2560Mi")
+        scores = run_scores(snap, pod)
+        # cpu: (4000-1000)*100/4000 = 75 ; mem: (10240-2560)*100/10240 = 75
+        assert scores["NodeResourcesLeastAllocated"]["n1"] == 75
+
+    def test_balanced_allocation_perfect(self):
+        node = make_node("n1", cpu="4", memory="8Gi")
+        snap = Snapshot.from_objects([], [node])
+        pod = make_pod(cpu="2", memory="4Gi")
+        scores = run_scores(snap, pod)
+        # cpuFrac == memFrac -> 100
+        assert scores["NodeResourcesBalancedAllocation"]["n1"] == 100
+
+    def test_balanced_allocation_skewed(self):
+        node = make_node("n1", cpu="4", memory="8Gi")
+        snap = Snapshot.from_objects([], [node])
+        pod = make_pod(cpu="4", memory="2Gi")  # frac 1.0 vs 0.25
+        scores = run_scores(snap, pod)
+        assert scores["NodeResourcesBalancedAllocation"]["n1"] == 0  # cpuFrac >= 1
+
+    def test_nonzero_default_requests(self):
+        # pod with no requests uses 100m/200MB defaults in scoring
+        node = make_node("n1", cpu="1", memory="400Mi")
+        snap = Snapshot.from_objects([], [node])
+        scores = run_scores(snap, make_pod())
+        # cpu: (1000-100)*100/1000 = 90; mem: (419430400-209715200)*100/419430400 = 50
+        assert scores["NodeResourcesLeastAllocated"]["n1"] == (90 + 50) // 2
+
+
+class TestTaintToleration:
+    def test_filter_untolerated(self):
+        node = make_node("n1", taints=[v1.Taint(key="k", value="v", effect="NoSchedule")])
+        snap = Snapshot.from_objects([], [node])
+        statuses = run_filter(snap, make_pod(), "n1")
+        assert statuses["TaintToleration"].code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_filter_tolerated(self):
+        node = make_node("n1", taints=[v1.Taint(key="k", value="v", effect="NoSchedule")])
+        snap = Snapshot.from_objects([], [node])
+        pod = make_pod(tolerations=[v1.Toleration(key="k", operator="Equal", value="v", effect="NoSchedule")])
+        assert run_filter(snap, pod, "n1") == {}
+
+    def test_prefer_no_schedule_scoring(self):
+        n1 = make_node("n1", taints=[v1.Taint(key="k", value="v", effect="PreferNoSchedule")])
+        n2 = make_node("n2")
+        snap = Snapshot.from_objects([], [n1, n2])
+        scores = run_scores(snap, make_pod())
+        # n1 has 1 intolerable PreferNoSchedule taint -> normalized to 0; n2 -> 100
+        assert scores["TaintToleration"]["n1"] == 0
+        assert scores["TaintToleration"]["n2"] == 100
+
+
+class TestNodeBasics:
+    def test_node_name_mismatch(self):
+        snap = Snapshot.from_objects([], [make_node("n1"), make_node("n2")])
+        pod = make_pod()
+        pod.spec.node_name = "n2"
+        statuses = run_filter(snap, pod, "n1")
+        assert statuses["NodeName"].code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_unschedulable_node(self):
+        snap = Snapshot.from_objects([], [make_node("n1", unschedulable=True)])
+        statuses = run_filter(snap, make_pod(), "n1")
+        assert statuses["NodeUnschedulable"].code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_host_port_conflict(self):
+        node = make_node("n1")
+        existing = make_pod(node_name="n1", host_port=8080)
+        snap = Snapshot.from_objects([existing], [node])
+        statuses = run_filter(snap, make_pod(host_port=8080), "n1")
+        assert statuses["NodePorts"].code == Code.UNSCHEDULABLE
+        assert run_filter(snap, make_pod(host_port=8081), "n1") == {}
+
+    def test_node_affinity_required(self):
+        n1 = make_node("n1", labels={"zone": "z1"})
+        n2 = make_node("n2", labels={"zone": "z2"})
+        snap = Snapshot.from_objects([], [n1, n2])
+        pod = make_pod(node_selector={"zone": "z2"})
+        statuses = run_filter(snap, pod, "n1")
+        assert statuses["NodeAffinity"].code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+        assert run_filter(snap, pod, "n2") == {}
+
+    def test_node_affinity_preferred_score(self):
+        n1 = make_node("n1", labels={"tier": "gold"})
+        n2 = make_node("n2")
+        snap = Snapshot.from_objects([], [n1, n2])
+        pod = make_pod()
+        pod.spec.affinity = v1.Affinity(
+            node_affinity=v1.NodeAffinity(
+                preferred_during_scheduling_ignored_during_execution=[
+                    v1.PreferredSchedulingTerm(
+                        weight=80,
+                        preference=v1.NodeSelectorTerm(
+                            match_expressions=[
+                                v1.NodeSelectorRequirement(key="tier", operator="In", values=["gold"])
+                            ]
+                        ),
+                    )
+                ]
+            )
+        )
+        scores = run_scores(snap, pod)
+        assert scores["NodeAffinity"]["n1"] == 100
+        assert scores["NodeAffinity"]["n2"] == 0
+
+
+class TestImageLocality:
+    def test_image_present(self):
+        img = v1.ContainerImage(names=["registry.example/app:v1"], size_bytes=500 * 1024 * 1024)
+        n1 = make_node("n1", images=[img])
+        n2 = make_node("n2")
+        snap = Snapshot.from_objects([], [n1, n2])
+        scores = run_scores(snap, make_pod(image="registry.example/app:v1"))
+        # n1: sum = 500MB * (1/2 nodes) = 250MB -> (250-23)/(1000-23)*100 = 23
+        assert scores["ImageLocality"]["n1"] == 23
+        assert scores["ImageLocality"]["n2"] == 0
+
+    def test_untagged_normalized(self):
+        img = v1.ContainerImage(names=["repo/app:latest"], size_bytes=300 * 1024 * 1024)
+        n1 = make_node("n1", images=[img])
+        snap = Snapshot.from_objects([], [n1])
+        scores = run_scores(snap, make_pod(image="repo/app"))
+        assert scores["ImageLocality"]["n1"] > 0
+
+
+class TestPodTopologySpread:
+    def _cluster(self):
+        nodes = [
+            make_node("n1", labels={"zone": "z1", v1.LABEL_HOSTNAME: "n1"}),
+            make_node("n2", labels={"zone": "z1", v1.LABEL_HOSTNAME: "n2"}),
+            make_node("n3", labels={"zone": "z2", v1.LABEL_HOSTNAME: "n3"}),
+        ]
+        pods = [
+            make_pod(labels={"app": "web"}, node_name="n1"),
+            make_pod(labels={"app": "web"}, node_name="n1"),
+            make_pod(labels={"app": "web"}, node_name="n2"),
+        ]
+        return pods, nodes
+
+    def test_filter_max_skew(self):
+        pods, nodes = self._cluster()
+        snap = Snapshot.from_objects(pods, nodes)
+        pod = make_pod(
+            labels={"app": "web"},
+            constraints=[spread_constraint(1, "zone", "DoNotSchedule", {"app": "web"})],
+        )
+        # zone z1 has 3 matching pods, z2 has 0 -> min=0; placing in z1: 3+1-0 > 1
+        statuses = run_filter(snap, pod, "n1")
+        assert statuses["PodTopologySpread"].code == Code.UNSCHEDULABLE
+        assert run_filter(snap, pod, "n3") == {}
+
+    def test_filter_missing_topology_label(self):
+        pods, nodes = self._cluster()
+        nodes.append(make_node("n4"))  # no zone label
+        snap = Snapshot.from_objects(pods, nodes)
+        pod = make_pod(
+            labels={"app": "web"},
+            constraints=[spread_constraint(1, "zone", "DoNotSchedule", {"app": "web"})],
+        )
+        statuses = run_filter(snap, pod, "n4")
+        assert statuses["PodTopologySpread"].code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_score_prefers_empty_zone(self):
+        pods, nodes = self._cluster()
+        snap = Snapshot.from_objects(pods, nodes)
+        pod = make_pod(
+            labels={"app": "web"},
+            constraints=[spread_constraint(1, "zone", "ScheduleAnyway", {"app": "web"})],
+        )
+        scores = run_scores(snap, pod)
+        s = scores["PodTopologySpread"]
+        assert s["n3"] > s["n1"]
+        assert s["n1"] == s["n2"]
+
+
+class TestInterPodAffinity:
+    def test_required_anti_affinity_blocks(self):
+        nodes = [
+            make_node("n1", labels={v1.LABEL_HOSTNAME: "n1"}),
+            make_node("n2", labels={v1.LABEL_HOSTNAME: "n2"}),
+        ]
+        existing = make_pod(
+            labels={"app": "db"},
+            node_name="n1",
+            affinity=anti_affinity(v1.LABEL_HOSTNAME, {"app": "db"}),
+        )
+        snap = Snapshot.from_objects([existing], nodes)
+        pod = make_pod(labels={"app": "db"}, affinity=anti_affinity(v1.LABEL_HOSTNAME, {"app": "db"}))
+        statuses = run_filter(snap, pod, "n1")
+        assert statuses["InterPodAffinity"].code == Code.UNSCHEDULABLE
+        assert run_filter(snap, pod, "n2") == {}
+
+    def test_existing_anti_affinity_blocks_incoming(self):
+        nodes = [make_node("n1", labels={"zone": "z1"}), make_node("n2", labels={"zone": "z2"})]
+        existing = make_pod(
+            labels={"app": "db"},
+            node_name="n1",
+            affinity=anti_affinity("zone", {"app": "web"}),
+        )
+        snap = Snapshot.from_objects([existing], nodes)
+        pod = make_pod(labels={"app": "web"})
+        statuses = run_filter(snap, pod, "n1")
+        assert statuses["InterPodAffinity"].code == Code.UNSCHEDULABLE
+        assert run_filter(snap, pod, "n2") == {}
+
+    def test_required_affinity(self):
+        nodes = [make_node("n1", labels={"zone": "z1"}), make_node("n2", labels={"zone": "z2"})]
+        existing = make_pod(labels={"app": "db"}, node_name="n1")
+        snap = Snapshot.from_objects([existing], nodes)
+        pod = make_pod(affinity=pod_affinity("zone", {"app": "db"}))
+        assert run_filter(snap, pod, "n1") == {}
+        statuses = run_filter(snap, pod, "n2")
+        assert statuses["InterPodAffinity"].code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_self_affinity_first_pod_allowed(self):
+        nodes = [make_node("n1", labels={"zone": "z1"})]
+        snap = Snapshot.from_objects([], nodes)
+        pod = make_pod(labels={"app": "db"}, affinity=pod_affinity("zone", {"app": "db"}))
+        assert run_filter(snap, pod, "n1") == {}
+
+    def test_preferred_affinity_score(self):
+        nodes = [make_node("n1", labels={"zone": "z1"}), make_node("n2", labels={"zone": "z2"})]
+        existing = make_pod(labels={"app": "cache"}, node_name="n1")
+        snap = Snapshot.from_objects([existing], nodes)
+        pod = make_pod()
+        pod.spec.affinity = v1.Affinity(
+            pod_affinity=v1.PodAffinity(
+                preferred_during_scheduling_ignored_during_execution=[
+                    v1.WeightedPodAffinityTerm(
+                        weight=100,
+                        pod_affinity_term=v1.PodAffinityTerm(
+                            label_selector=v1.LabelSelector(match_labels={"app": "cache"}),
+                            topology_key="zone",
+                        ),
+                    )
+                ]
+            )
+        )
+        scores = run_scores(snap, pod)
+        assert scores["InterPodAffinity"]["n1"] == 100
+        assert scores["InterPodAffinity"]["n2"] == 0
+
+
+class TestGenericScheduler:
+    def test_schedules_to_least_allocated(self):
+        nodes = [make_node("n1"), make_node("n2")]
+        existing = make_pod(cpu="3", node_name="n1")
+        snap = Snapshot.from_objects([existing], nodes)
+        fwk = build_framework(snap, default_plugins_without("DefaultPreemption"))
+        sched = GenericScheduler(percentage_of_nodes_to_score=100)
+        result = sched.schedule(CycleState(), fwk, make_pod(cpu="1"), snap)
+        assert result.suggested_host == "n2"
+
+    def test_fit_error_collects_statuses(self):
+        from kubernetes_tpu.scheduler.framework.interface import FitError
+
+        snap = Snapshot.from_objects([], [make_node("n1", cpu="1")])
+        fwk = build_framework(snap, default_plugins_without("DefaultPreemption"))
+        sched = GenericScheduler()
+        with pytest.raises(FitError) as ei:
+            sched.schedule(CycleState(), fwk, make_pod(cpu="2"), snap)
+        assert "n1" in ei.value.filtered_nodes_statuses
+
+    def test_num_feasible_nodes_adaptive(self):
+        s = GenericScheduler()
+        assert s.num_feasible_nodes_to_find(50) == 50
+        assert s.num_feasible_nodes_to_find(5000) == 500  # (50-40)% of 5000
+        assert s.num_feasible_nodes_to_find(1000) == 420  # 42% of 1000
+        s2 = GenericScheduler(percentage_of_nodes_to_score=100)
+        assert s2.num_feasible_nodes_to_find(5000) == 5000
+
+    def test_select_host_reservoir(self):
+        import random
+
+        s = GenericScheduler(rng=random.Random(42))
+        scores = [NodeScore("a", 10), NodeScore("b", 10), NodeScore("c", 5)]
+        picks = {s.select_host(scores) for _ in range(50)}
+        assert picks <= {"a", "b"}
+        assert len(picks) == 2

@@ -1,0 +1,119 @@
+"""Pod/Node builders for tests (reference: pkg/scheduler/testing/wrappers.go)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api import types as v1
+
+
+def make_node(
+    name: str,
+    cpu: str = "4",
+    memory: str = "32Gi",
+    pods: int = 110,
+    labels: Optional[Dict[str, str]] = None,
+    taints: Optional[List[v1.Taint]] = None,
+    unschedulable: bool = False,
+    images: Optional[List[v1.ContainerImage]] = None,
+    extended: Optional[Dict[str, str]] = None,
+) -> v1.Node:
+    alloc = {"cpu": cpu, "memory": memory, "pods": str(pods)}
+    if extended:
+        alloc.update(extended)
+    return v1.Node(
+        metadata=v1.ObjectMeta(name=name, labels=dict(labels or {})),
+        spec=v1.NodeSpec(unschedulable=unschedulable, taints=taints),
+        status=v1.NodeStatus(capacity=dict(alloc), allocatable=alloc, images=images),
+    )
+
+
+_counter = [0]
+
+
+def make_pod(
+    name: Optional[str] = None,
+    namespace: str = "default",
+    cpu: Optional[str] = None,
+    memory: Optional[str] = None,
+    node_name: str = "",
+    labels: Optional[Dict[str, str]] = None,
+    priority: Optional[int] = None,
+    node_selector: Optional[Dict[str, str]] = None,
+    affinity: Optional[v1.Affinity] = None,
+    tolerations: Optional[List[v1.Toleration]] = None,
+    constraints: Optional[List[v1.TopologySpreadConstraint]] = None,
+    host_port: int = 0,
+    image: str = "registry.example/app:v1",
+    extended: Optional[Dict[str, str]] = None,
+    containers: int = 1,
+) -> v1.Pod:
+    if name is None:
+        _counter[0] += 1
+        name = f"pod-{_counter[0]}"
+    requests: Dict[str, str] = {}
+    if cpu is not None:
+        requests["cpu"] = cpu
+    if memory is not None:
+        requests["memory"] = memory
+    if extended:
+        requests.update(extended)
+    ports = [v1.ContainerPort(host_port=host_port, container_port=host_port)] if host_port else None
+    specs = [
+        v1.Container(
+            name=f"c{i}",
+            image=image,
+            resources=v1.ResourceRequirements(requests=dict(requests) or None),
+            ports=ports if i == 0 else None,
+        )
+        for i in range(containers)
+    ]
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name, namespace=namespace, labels=dict(labels or {})),
+        spec=v1.PodSpec(
+            containers=specs,
+            node_name=node_name,
+            priority=priority,
+            node_selector=node_selector,
+            affinity=affinity,
+            tolerations=tolerations,
+            topology_spread_constraints=constraints,
+        ),
+    )
+
+
+def anti_affinity(topology_key: str, match_labels: Dict[str, str]) -> v1.Affinity:
+    return v1.Affinity(
+        pod_anti_affinity=v1.PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                v1.PodAffinityTerm(
+                    label_selector=v1.LabelSelector(match_labels=match_labels),
+                    topology_key=topology_key,
+                )
+            ]
+        )
+    )
+
+
+def pod_affinity(topology_key: str, match_labels: Dict[str, str]) -> v1.Affinity:
+    return v1.Affinity(
+        pod_affinity=v1.PodAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                v1.PodAffinityTerm(
+                    label_selector=v1.LabelSelector(match_labels=match_labels),
+                    topology_key=topology_key,
+                )
+            ]
+        )
+    )
+
+
+def spread_constraint(
+    max_skew: int, topology_key: str, when: str, match_labels: Dict[str, str]
+) -> v1.TopologySpreadConstraint:
+    return v1.TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=topology_key,
+        when_unsatisfiable=when,
+        label_selector=v1.LabelSelector(match_labels=match_labels),
+    )
