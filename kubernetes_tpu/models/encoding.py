@@ -1,0 +1,705 @@
+"""Dense array encoding of cluster state for the TPU scheduling kernel.
+
+The reference scheduler walks Go object graphs per node inside its hot loop
+(reference: pkg/scheduler/framework/runtime/framework.go:723 RunScorePlugins,
+pkg/scheduler/core/generic_scheduler.go:235 findNodesThatPassFilters). The
+TPU build instead maintains the whole cluster as dense matrices over
+interned vocabularies, so one XLA dispatch evaluates every plugin for every
+node at once (ops/kernel.py). This module is the host side of that design:
+
+  ClusterEncoding  cluster state -> matrices, with incremental updates for
+                   the per-cycle events (assume/forget pod); the device dict
+                   is refreshed by uploading only dirty rows (SURVEY.md
+                   section 7 hard part (a): incremental array maintenance).
+  PodEncoder       one pending pod -> small fixed-shape arrays (requirement
+                   tables, tolerated-taint bitmaps, resource vectors),
+                   cached by spec fingerprint because benchmark workloads
+                   schedule thousands of identical pods.
+
+Integer exactness: resources are int64 milli-units/bytes matching
+framework.Resource (reference: pkg/scheduler/framework/types.go:318);
+scores stay int64 in [0,100] (interface.go:95). jax x64 must be enabled.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..api import types as v1
+from ..api.labels import Selector
+from ..api.quantity import Quantity
+from ..api.taints import (
+    TAINT_EFFECT_NO_EXECUTE,
+    TAINT_EFFECT_NO_SCHEDULE,
+    TAINT_EFFECT_PREFER_NO_SCHEDULE,
+    toleration_tolerates_taint,
+    tolerations_tolerate_taint,
+)
+from ..scheduler.framework.types import (
+    PodInfo,
+    calculate_resource,
+)
+from ..scheduler.plugins.nodebasic import (
+    PREFER_AVOID_PODS_ANNOTATION,
+    normalized_image_name,
+)
+from ..scheduler.plugins.noderesources import calculate_pod_resource_request
+from ..utils import serde
+from .selectors import (
+    FIELD_NAME_KEY,
+    ReqTable,
+    TermList,
+    compile_node_selector_terms,
+    compile_pod_node_constraints,
+    compile_selector,
+)
+from .vocab import Interner, bucket_capacity
+
+# Taint effect codes (device-side)
+EFFECT_NONE = 0
+EFFECT_NO_SCHEDULE = 1
+EFFECT_PREFER_NO_SCHEDULE = 2
+EFFECT_NO_EXECUTE = 3
+_EFFECT_CODE = {
+    TAINT_EFFECT_NO_SCHEDULE: EFFECT_NO_SCHEDULE,
+    TAINT_EFFECT_PREFER_NO_SCHEDULE: EFFECT_PREFER_NO_SCHEDULE,
+    TAINT_EFFECT_NO_EXECUTE: EFFECT_NO_EXECUTE,
+}
+
+# Existing-pod score-term kinds (InterPodAffinity PreScore,
+# reference: pkg/scheduler/framework/plugins/interpodaffinity/scoring.go:88
+# processExistingPod)
+ST_REQUIRED_AFFINITY = 0  # weight = hardPodAffinityWeight at kernel time
+ST_PREFERRED_AFFINITY = 1  # +weight
+ST_PREFERRED_ANTI = 2  # -weight
+
+_WILDCARD_IPS = ("", "0.0.0.0")
+
+
+def _is_wildcard(ip: str) -> bool:
+    return ip in _WILDCARD_IPS
+
+
+class _TermRows:
+    """Growable stacked term-table arrays (per existing-pod affinity terms)."""
+
+    def __init__(self, cap: int, n_reqs: int, n_vals: int, n_ns: int, scored: bool):
+        self.scored = scored
+        self.n_reqs = n_reqs
+        self.n_vals = n_vals
+        self.n_ns = n_ns
+        self.cap = cap
+        self.valid = np.zeros(cap, bool)
+        self.src = np.zeros(cap, np.int32)
+        self.key = np.zeros(cap, np.int32)
+        self.ns = np.zeros((cap, n_ns), np.int32)
+        self.op = np.zeros((cap, n_reqs), np.int8)
+        self.rkey = np.zeros((cap, n_reqs), np.int32)
+        self.pairs = np.zeros((cap, n_reqs, n_vals), np.int32)
+        if scored:
+            self.kind = np.zeros(cap, np.int8)
+            self.weight = np.zeros(cap, np.int32)
+        self.free: List[int] = list(range(cap - 1, -1, -1))
+        self.by_pod: Dict[int, List[int]] = {}
+
+    def needs_grow(self, table: ReqTable, n_ns: int) -> bool:
+        return (
+            not self.free
+            or table.n_reqs > self.n_reqs
+            or table.n_vals > self.n_vals
+            or n_ns > self.n_ns
+        )
+
+    def add(self, pod_idx: int, table: ReqTable, ns_ids: List[int], key_id: int,
+            kind: int = 0, weight: int = 0) -> int:
+        i = self.free.pop()
+        t = table.padded(self.n_reqs, self.n_vals)
+        self.valid[i] = True
+        self.src[i] = pod_idx
+        self.key[i] = key_id
+        self.ns[i] = 0
+        self.ns[i, : len(ns_ids)] = ns_ids
+        self.op[i] = t.op
+        self.rkey[i] = t.key
+        self.pairs[i] = t.pairs
+        if self.scored:
+            self.kind[i] = kind
+            self.weight[i] = weight
+        self.by_pod.setdefault(pod_idx, []).append(i)
+        return i
+
+    def remove_pod(self, pod_idx: int) -> List[int]:
+        rows = self.by_pod.pop(pod_idx, [])
+        for i in rows:
+            self.valid[i] = False
+            self.free.append(i)
+        return rows
+
+
+class ClusterEncoding:
+    """Dense, incrementally-maintained cluster state.
+
+    Mirrors the information content of the scheduler cache snapshot
+    (reference: pkg/scheduler/internal/cache/snapshot.go:29) as matrices.
+    """
+
+    def __init__(self, hard_pod_affinity_weight: int = 1):
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        # authoritative object state (for rebuilds)
+        self._nodes: Dict[str, v1.Node] = {}
+        self._node_order: List[str] = []
+        self._pods: Dict[str, Tuple[v1.Pod, str]] = {}  # key -> (pod, node name)
+        # vocabularies (shared; ids are permanent)
+        self.ns_vocab = Interner()
+        self.node_key_vocab = Interner()
+        self.node_pair_vocab = Interner()
+        self.pod_key_vocab = Interner()
+        self.pod_pair_vocab = Interner()
+        self.taint_vocab = Interner()  # (key, value, effect)
+        self.port_pair_vocab = Interner()  # (protocol, port)
+        self.port_triple_vocab = Interner()  # (ip, protocol, port)
+        self.scalar_vocab = Interner()  # scalar/extended resource names
+        self.image_vocab = Interner()
+        self.avoid_vocab = Interner()  # (controller kind, uid)
+        self._rebuild_needed = True
+        self._arrays: Dict[str, np.ndarray] = {}
+        self._device: Optional[dict] = None
+        self._dirty_nodes: Set[int] = set()
+        self._dirty_pods: Set[int] = set()
+        self._dirty_terms: bool = False
+        self.node_index: Dict[str, int] = {}
+        self.node_names: List[str] = []
+        self.pod_index: Dict[str, int] = {}
+        self._pod_free: List[int] = []
+        self._anti_terms: Optional[_TermRows] = None
+        self._score_terms: Optional[_TermRows] = None
+
+    # -- object-level API ---------------------------------------------------
+
+    def set_cluster(self, nodes: List[v1.Node], pods: List[v1.Pod]) -> None:
+        """Full state load (snapshot ingest)."""
+        self._nodes = {n.metadata.name: n for n in nodes}
+        self._node_order = [n.metadata.name for n in nodes]
+        self._pods = {}
+        for p in pods:
+            if p.spec.node_name and p.spec.node_name in self._nodes:
+                self._pods[v1.pod_key(p)] = (p, p.spec.node_name)
+        self._rebuild_needed = True
+
+    def add_node(self, node: v1.Node) -> None:
+        if node.metadata.name not in self._nodes:
+            self._node_order.append(node.metadata.name)
+        self._nodes[node.metadata.name] = node
+        self._rebuild_needed = True
+
+    def update_node(self, node: v1.Node) -> None:
+        self.add_node(node)
+
+    def remove_node(self, node_name: str) -> None:
+        self._nodes.pop(node_name, None)
+        self._node_order = [n for n in self._node_order if n != node_name]
+        self._rebuild_needed = True
+
+    def add_pod(self, pod: v1.Pod, node_name: Optional[str] = None) -> None:
+        """Assume/confirm a pod onto a node (cache AssumePod analog,
+        reference: pkg/scheduler/internal/cache/cache.go:361)."""
+        node_name = node_name or pod.spec.node_name
+        key = v1.pod_key(pod)
+        if key in self._pods:
+            self.remove_pod(pod)
+        self._pods[key] = (pod, node_name)
+        if self._rebuild_needed:
+            return
+        nidx = self.node_index.get(node_name)
+        if nidx is None:
+            self._rebuild_needed = True
+            return
+        if not self._try_add_pod_arrays(pod, key, nidx):
+            self._rebuild_needed = True
+
+    def remove_pod(self, pod: v1.Pod) -> None:
+        key = v1.pod_key(pod)
+        entry = self._pods.pop(key, None)
+        if entry is None or self._rebuild_needed:
+            return
+        pidx = self.pod_index.pop(key, None)
+        if pidx is None:
+            self._rebuild_needed = True
+            return
+        self._remove_pod_arrays(entry[0], entry[1], pidx)
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self._node_order)
+
+    # -- encoding internals -------------------------------------------------
+
+    def _intern_node_vocabs(self, node: v1.Node) -> None:
+        labels = node.metadata.labels or {}
+        for k, val in labels.items():
+            self.node_key_vocab.intern(k)
+            self.node_pair_vocab.intern((k, val))
+        self.node_key_vocab.intern(FIELD_NAME_KEY)
+        self.node_pair_vocab.intern((FIELD_NAME_KEY, node.metadata.name))
+        for t in node.spec.taints or []:
+            self.taint_vocab.intern((t.key, t.value, t.effect))
+        for name, q in ((node.status.allocatable or node.status.capacity) or {}).items():
+            from ..scheduler.framework.types import is_scalar_resource_name
+
+            if is_scalar_resource_name(name):
+                self.scalar_vocab.intern(name)
+        for image in node.status.images or []:
+            for n in image.names or []:
+                self.image_vocab.intern(normalized_image_name(n))
+        raw = (node.metadata.annotations or {}).get(PREFER_AVOID_PODS_ANNOTATION)
+        if raw:
+            try:
+                avoids = json.loads(raw)
+            except ValueError:
+                avoids = {}
+            for avoid in avoids.get("preferAvoidPods", []):
+                ctrl = avoid.get("podSignature", {}).get("podController", {})
+                if ctrl.get("kind") and ctrl.get("uid"):
+                    self.avoid_vocab.intern((ctrl["kind"], ctrl["uid"]))
+
+    def _intern_pod_vocabs(self, pod: v1.Pod) -> None:
+        self.ns_vocab.intern(pod.metadata.namespace)
+        for k, val in (pod.metadata.labels or {}).items():
+            self.pod_key_vocab.intern(k)
+            self.pod_pair_vocab.intern((k, val))
+        for c in pod.spec.containers:
+            for port in c.ports or []:
+                if port.host_port > 0:
+                    proto = port.protocol or "TCP"
+                    ip = "" if _is_wildcard(port.host_ip) else port.host_ip
+                    self.port_pair_vocab.intern((proto, port.host_port))
+                    self.port_triple_vocab.intern((ip, proto, port.host_port))
+            from ..scheduler.framework.types import is_scalar_resource_name
+
+            for name in (c.resources.requests or {}):
+                if is_scalar_resource_name(name):
+                    self.scalar_vocab.intern(name)
+
+    def _pod_term_tables(self, pod_info: PodInfo) -> List[Tuple[str, object, List[int], int, int, int]]:
+        """Compile an existing pod's affinity terms.
+
+        Returns rows of (which, table, ns_ids, key_id, kind, weight) where
+        which is 'anti' (required anti-affinity, used by the InterPodAffinity
+        Filter existing-anti map) or 'score' (PreScore processExistingPod).
+        """
+        rows = []
+        for term in pod_info.required_anti_affinity_terms:
+            table = compile_selector(term.selector, self.pod_key_vocab, self.pod_pair_vocab, intern=True)
+            ns_ids = [self.ns_vocab.intern(n) for n in sorted(term.namespaces)]
+            key_id = self.node_key_vocab.intern(term.topology_key)
+            rows.append(("anti", table, ns_ids, key_id, 0, 0))
+        for term in pod_info.required_affinity_terms:
+            table = compile_selector(term.selector, self.pod_key_vocab, self.pod_pair_vocab, intern=True)
+            ns_ids = [self.ns_vocab.intern(n) for n in sorted(term.namespaces)]
+            key_id = self.node_key_vocab.intern(term.topology_key)
+            rows.append(("score", table, ns_ids, key_id, ST_REQUIRED_AFFINITY, 0))
+        for term in pod_info.preferred_affinity_terms:
+            table = compile_selector(term.selector, self.pod_key_vocab, self.pod_pair_vocab, intern=True)
+            ns_ids = [self.ns_vocab.intern(n) for n in sorted(term.namespaces)]
+            key_id = self.node_key_vocab.intern(term.topology_key)
+            rows.append(("score", table, ns_ids, key_id, ST_PREFERRED_AFFINITY, term.weight))
+        for term in pod_info.preferred_anti_affinity_terms:
+            table = compile_selector(term.selector, self.pod_key_vocab, self.pod_pair_vocab, intern=True)
+            ns_ids = [self.ns_vocab.intern(n) for n in sorted(term.namespaces)]
+            key_id = self.node_key_vocab.intern(term.topology_key)
+            rows.append(("score", table, ns_ids, key_id, ST_PREFERRED_ANTI, term.weight))
+        return rows
+
+    # resource matrix layout: columns 0=cpu(milli) 1=memory 2=ephemeral,
+    # scalar resource id s -> column 2+s
+    def _res_width(self) -> int:
+        return 3 + self.scalar_vocab.capacity
+
+    def _res_vec(self, res) -> np.ndarray:
+        vec = np.zeros(self._res_width(), np.int64)
+        vec[0] = res.milli_cpu
+        vec[1] = res.memory
+        vec[2] = res.ephemeral_storage
+        for name, val in res.scalar_resources.items():
+            s = self.scalar_vocab.get(name)
+            if s:
+                vec[2 + s] = val
+        return vec
+
+    def rebuild(self) -> None:
+        """Full re-encode from object state (node changes, capacity growth)."""
+        for node_name in self._node_order:
+            self._intern_node_vocabs(self._nodes[node_name])
+        pod_infos: Dict[str, PodInfo] = {}
+        for key, (pod, _) in self._pods.items():
+            self._intern_pod_vocabs(pod)
+            pod_infos[key] = PodInfo(pod)
+
+        n = len(self._node_order)
+        ncap = bucket_capacity(max(n, 1))
+        pcap = bucket_capacity(max(len(self._pods), 1), minimum=64)
+        rw = self._res_width()
+        tcap = self.taint_vocab.capacity
+        p2cap = self.port_pair_vocab.capacity
+        p3cap = self.port_triple_vocab.capacity
+        nkcap = self.node_key_vocab.capacity
+        npcap = self.node_pair_vocab.capacity
+        pkcap = self.pod_key_vocab.capacity
+        ppcap = self.pod_pair_vocab.capacity
+        icap = self.image_vocab.capacity
+        acap = self.avoid_vocab.capacity
+
+        A = self._arrays = {}
+        A["valid"] = np.zeros(ncap, bool)
+        A["alloc"] = np.zeros((ncap, rw), np.int64)
+        A["requested"] = np.zeros((ncap, rw), np.int64)
+        A["nz_requested"] = np.zeros((ncap, 2), np.int64)
+        A["pod_count"] = np.zeros(ncap, np.int32)
+        A["allowed_pods"] = np.zeros(ncap, np.int64)
+        A["unschedulable"] = np.zeros(ncap, bool)
+        A["taints"] = np.zeros((ncap, tcap), bool)
+        A["taint_effect"] = np.zeros(tcap, np.int8)
+        A["ports_triple"] = np.zeros((ncap, p3cap), np.int16)
+        A["ports_pair_any"] = np.zeros((ncap, p2cap), np.int16)
+        A["ports_pair_wild"] = np.zeros((ncap, p2cap), np.int16)
+        A["npair"] = np.zeros((ncap, npcap), bool)
+        A["nkey"] = np.zeros((ncap, nkcap), bool)
+        A["pair_of_key"] = np.zeros((ncap, nkcap), np.int32)
+        A["nnum"] = np.zeros((ncap, nkcap), np.int64)
+        A["nnum_valid"] = np.zeros((ncap, nkcap), bool)
+        A["img_size"] = np.zeros((ncap, icap), np.int64)
+        A["img_nodes"] = np.zeros(icap, np.int32)
+        A["avoid"] = np.zeros((ncap, acap), bool)
+        A["ppair"] = np.zeros((pcap, ppcap), bool)
+        A["pkey"] = np.zeros((pcap, pkcap), bool)
+        A["pnode"] = np.zeros(pcap, np.int32)
+        A["pns"] = np.zeros(pcap, np.int32)
+        A["pterm"] = np.zeros(pcap, bool)
+        A["pvalid"] = np.zeros(pcap, bool)
+        A["n_nodes"] = np.array(n, np.int32)
+        A["hard_pod_affinity_weight"] = np.array(self.hard_pod_affinity_weight, np.int32)
+
+        for i, (key, val, effect) in enumerate(
+            self.taint_vocab._items, start=1
+        ):
+            A["taint_effect"][i] = _EFFECT_CODE.get(effect, EFFECT_NONE)
+
+        self.node_index = {}
+        self.node_names = []
+        for i, node_name in enumerate(self._node_order):
+            self.node_index[node_name] = i
+            self.node_names.append(node_name)
+            self._encode_node_row(i, self._nodes[node_name])
+
+        # image cluster-spread counts (snapshot.go createImageExistenceMap)
+        img_nodes: Dict[int, Set[int]] = {}
+        for i, node_name in enumerate(self._node_order):
+            node = self._nodes[node_name]
+            for image in node.status.images or []:
+                for nm in image.names or []:
+                    iid = self.image_vocab.get(normalized_image_name(nm))
+                    if iid:
+                        img_nodes.setdefault(iid, set()).add(i)
+        for iid, nodes in img_nodes.items():
+            A["img_nodes"][iid] = len(nodes)
+
+        # term tables: size from observed maxima
+        n_anti = sum(len(pi.required_anti_affinity_terms) for pi in pod_infos.values())
+        n_score = sum(
+            len(pi.required_affinity_terms)
+            + len(pi.preferred_affinity_terms)
+            + len(pi.preferred_anti_affinity_terms)
+            for pi in pod_infos.values()
+        )
+        max_r, max_v, max_ns = 1, 1, 1
+        for pi in pod_infos.values():
+            for terms in (
+                pi.required_anti_affinity_terms,
+                pi.required_affinity_terms,
+                pi.preferred_affinity_terms,
+                pi.preferred_anti_affinity_terms,
+            ):
+                for term in terms:
+                    t = compile_selector(term.selector, self.pod_key_vocab, self.pod_pair_vocab, intern=True)
+                    max_r = max(max_r, t.n_reqs)
+                    max_v = max(max_v, t.n_vals)
+                    max_ns = max(max_ns, len(term.namespaces))
+        self._anti_terms = _TermRows(
+            bucket_capacity(max(n_anti, 1), minimum=16), bucket_capacity(max_r, 2),
+            bucket_capacity(max_v, 2), bucket_capacity(max_ns, 2), scored=False,
+        )
+        self._score_terms = _TermRows(
+            bucket_capacity(max(n_score, 1), minimum=16), bucket_capacity(max_r, 2),
+            bucket_capacity(max_v, 2), bucket_capacity(max_ns, 2), scored=True,
+        )
+
+        self.pod_index = {}
+        self._pod_free = list(range(pcap - 1, -1, -1))
+        for key, (pod, node_name) in self._pods.items():
+            nidx = self.node_index[node_name]
+            pidx = self._pod_free.pop()
+            self.pod_index[key] = pidx
+            self._encode_pod_row(pidx, pod, nidx, pod_infos[key])
+
+        self._rebuild_needed = False
+        self._device = None
+        self._dirty_nodes = set()
+        self._dirty_pods = set()
+        self._dirty_terms = False
+
+    def _encode_node_row(self, i: int, node: v1.Node) -> None:
+        A = self._arrays
+        A["valid"][i] = True
+        from ..scheduler.framework.types import Resource
+
+        alloc = Resource()
+        alloc.add(node.status.allocatable or node.status.capacity)
+        A["alloc"][i] = self._res_vec(alloc)
+        A["allowed_pods"][i] = alloc.allowed_pod_number
+        A["requested"][i] = 0
+        A["nz_requested"][i] = 0
+        A["pod_count"][i] = 0
+        A["unschedulable"][i] = node.spec.unschedulable
+        A["taints"][i] = False
+        for t in node.spec.taints or []:
+            tid = self.taint_vocab.get((t.key, t.value, t.effect))
+            if tid:
+                A["taints"][i, tid] = True
+        A["ports_triple"][i] = 0
+        A["ports_pair_any"][i] = 0
+        A["ports_pair_wild"][i] = 0
+        A["npair"][i] = False
+        A["nkey"][i] = False
+        A["pair_of_key"][i] = 0
+        A["nnum"][i] = 0
+        A["nnum_valid"][i] = False
+        labels = dict(node.metadata.labels or {})
+        labels[FIELD_NAME_KEY] = node.metadata.name
+        from ..api.labels import _parse_int64
+
+        for k, val in labels.items():
+            kid = self.node_key_vocab.get(k)
+            pid = self.node_pair_vocab.get((k, val))
+            if kid:
+                A["nkey"][i, kid] = True
+                A["pair_of_key"][i, kid] = pid
+                num = _parse_int64(val)
+                if num is not None:
+                    A["nnum"][i, kid] = num
+                    A["nnum_valid"][i, kid] = True
+            if pid:
+                A["npair"][i, pid] = True
+        A["img_size"][i] = 0
+        for image in node.status.images or []:
+            for nm in image.names or []:
+                iid = self.image_vocab.get(normalized_image_name(nm))
+                if iid:
+                    A["img_size"][i, iid] = image.size_bytes
+        A["avoid"][i] = False
+        raw = (node.metadata.annotations or {}).get(PREFER_AVOID_PODS_ANNOTATION)
+        if raw:
+            try:
+                avoids = json.loads(raw)
+            except ValueError:
+                avoids = {}
+            for avoid in avoids.get("preferAvoidPods", []):
+                ctrl = avoid.get("podSignature", {}).get("podController", {})
+                aid = self.avoid_vocab.get((ctrl.get("kind"), ctrl.get("uid")))
+                if aid:
+                    A["avoid"][i, aid] = True
+
+    def _encode_pod_row(self, pidx: int, pod: v1.Pod, nidx: int, pod_info: Optional[PodInfo] = None) -> None:
+        A = self._arrays
+        pod_info = pod_info or PodInfo(pod)
+        A["pvalid"][pidx] = True
+        A["pnode"][pidx] = nidx
+        A["pns"][pidx] = self.ns_vocab.get(pod.metadata.namespace)
+        A["pterm"][pidx] = pod.metadata.deletion_timestamp is not None
+        A["ppair"][pidx] = False
+        A["pkey"][pidx] = False
+        for k, val in (pod.metadata.labels or {}).items():
+            kid = self.pod_key_vocab.get(k)
+            pid = self.pod_pair_vocab.get((k, val))
+            if kid:
+                A["pkey"][pidx, kid] = True
+            if pid:
+                A["ppair"][pidx, pid] = True
+        # node aggregates
+        res, non0_cpu, non0_mem = calculate_resource(pod)
+        A["requested"][nidx] += self._res_vec(res)
+        A["nz_requested"][nidx, 0] += non0_cpu
+        A["nz_requested"][nidx, 1] += non0_mem
+        A["pod_count"][nidx] += 1
+        self._apply_ports(nidx, pod, +1)
+        # affinity term rows
+        for which, table, ns_ids, key_id, kind, weight in self._pod_term_tables(pod_info):
+            rows = self._anti_terms if which == "anti" else self._score_terms
+            rows.add(pidx, table, ns_ids, key_id, kind, weight)
+        self._dirty_terms = True
+        self._dirty_nodes.add(nidx)
+        self._dirty_pods.add(pidx)
+
+    def _apply_ports(self, nidx: int, pod: v1.Pod, sign: int) -> None:
+        A = self._arrays
+        seen: Set[Tuple[str, str, int]] = set()
+        for c in pod.spec.containers:
+            for port in c.ports or []:
+                if port.host_port <= 0:
+                    continue
+                proto = port.protocol or "TCP"
+                ip = "" if _is_wildcard(port.host_ip) else port.host_ip
+                trip = (ip, proto, port.host_port)
+                if trip in seen:  # HostPortInfo is a set per (ip,proto,port)
+                    continue
+                seen.add(trip)
+                pid2 = self.port_pair_vocab.get((proto, port.host_port))
+                pid3 = self.port_triple_vocab.get(trip)
+                if pid3:
+                    A["ports_triple"][nidx, pid3] += sign
+                if pid2:
+                    A["ports_pair_any"][nidx, pid2] += sign
+                    if ip == "":
+                        A["ports_pair_wild"][nidx, pid2] += sign
+
+    def _try_add_pod_arrays(self, pod: v1.Pod, key: str, nidx: int) -> bool:
+        """Incremental add; False -> caller flags full rebuild."""
+        before = (
+            self.pod_pair_vocab.capacity, self.pod_key_vocab.capacity,
+            self.port_pair_vocab.capacity, self.port_triple_vocab.capacity,
+            self.scalar_vocab.capacity, self.ns_vocab.capacity,
+        )
+        self._intern_pod_vocabs(pod)
+        pod_info = PodInfo(pod)
+        # pre-compile terms to detect vocab/capacity growth before mutating
+        term_rows = self._pod_term_tables(pod_info)
+        after = (
+            self.pod_pair_vocab.capacity, self.pod_key_vocab.capacity,
+            self.port_pair_vocab.capacity, self.port_triple_vocab.capacity,
+            self.scalar_vocab.capacity, self.ns_vocab.capacity,
+        )
+        if (before != after or not self._pod_free
+                or self.node_key_vocab.capacity > self._arrays["nkey"].shape[1]):
+            return False
+        for which, table, ns_ids, _k, _kind, _w in term_rows:
+            rows = self._anti_terms if which == "anti" else self._score_terms
+            if rows.needs_grow(table, len(ns_ids)):
+                return False
+        pidx = self._pod_free.pop()
+        self.pod_index[key] = pidx
+        self._encode_pod_row(pidx, pod, nidx, pod_info)
+        return True
+
+    def _remove_pod_arrays(self, pod: v1.Pod, node_name: str, pidx: int) -> None:
+        A = self._arrays
+        nidx = self.node_index.get(node_name)
+        A["pvalid"][pidx] = False
+        self._pod_free.append(pidx)
+        self._dirty_pods.add(pidx)
+        if nidx is not None:
+            res, non0_cpu, non0_mem = calculate_resource(pod)
+            A["requested"][nidx] -= self._res_vec(res)
+            A["nz_requested"][nidx, 0] -= non0_cpu
+            A["nz_requested"][nidx, 1] -= non0_mem
+            A["pod_count"][nidx] -= 1
+            self._apply_ports(nidx, pod, -1)
+            self._dirty_nodes.add(nidx)
+        removed_anti = self._anti_terms.remove_pod(pidx)
+        removed_score = self._score_terms.remove_pod(pidx)
+        if removed_anti or removed_score:
+            self._dirty_terms = True
+
+    # -- device sync --------------------------------------------------------
+
+    _NODE_ROW_KEYS = (
+        "valid", "alloc", "requested", "nz_requested", "pod_count",
+        "allowed_pods", "unschedulable", "taints", "ports_triple",
+        "ports_pair_any", "ports_pair_wild", "npair", "nkey", "pair_of_key",
+        "nnum", "nnum_valid", "img_size", "avoid",
+    )
+    _POD_ROW_KEYS = ("ppair", "pkey", "pnode", "pns", "pterm", "pvalid")
+
+    def _term_arrays(self) -> Dict[str, np.ndarray]:
+        at, st = self._anti_terms, self._score_terms
+        return {
+            "at_valid": at.valid, "at_src": at.src, "at_key": at.key,
+            "at_ns": at.ns, "at_op": at.op, "at_rkey": at.rkey, "at_pairs": at.pairs,
+            "st_valid": st.valid, "st_src": st.src, "st_key": st.key,
+            "st_ns": st.ns, "st_kind": st.kind, "st_weight": st.weight,
+            "st_op": st.op, "st_rkey": st.rkey, "st_pairs": st.pairs,
+        }
+
+    def _caps_grew(self) -> bool:
+        """True if any vocab outgrew its array width. Compiled tables intern
+        ids eagerly, so a grown vocab can hold ids past the current column
+        count — gathers would clamp out-of-bounds and silently mis-match;
+        rebuild instead."""
+        A = self._arrays
+        if not A:
+            return True
+        return (
+            self._res_width() > A["alloc"].shape[1]
+            or self.taint_vocab.capacity > A["taints"].shape[1]
+            or self.port_pair_vocab.capacity > A["ports_pair_any"].shape[1]
+            or self.port_triple_vocab.capacity > A["ports_triple"].shape[1]
+            or self.node_key_vocab.capacity > A["nkey"].shape[1]
+            or self.node_pair_vocab.capacity > A["npair"].shape[1]
+            or self.pod_key_vocab.capacity > A["pkey"].shape[1]
+            or self.pod_pair_vocab.capacity > A["ppair"].shape[1]
+            or self.image_vocab.capacity > A["img_size"].shape[1]
+            or self.avoid_vocab.capacity > A["avoid"].shape[1]
+        )
+
+    def device_state(self) -> dict:
+        """Current cluster dict of jnp arrays; uploads only dirty rows when
+        the array shapes are unchanged since the last sync."""
+        import jax.numpy as jnp
+
+        if self._rebuild_needed or self._caps_grew():
+            self.rebuild()
+        host = dict(self._arrays)
+        host.update(self._term_arrays())
+        host["n_nodes"] = np.array(self.n_nodes, np.int32)
+        if self._device is None:
+            self._device = {k: jnp.asarray(a) for k, a in host.items()}
+            self._dirty_nodes = set()
+            self._dirty_pods = set()
+            self._dirty_terms = False
+            return self._device
+        dev = self._device
+        if self._dirty_nodes:
+            idx = np.fromiter(self._dirty_nodes, np.int32)
+            for k in self._NODE_ROW_KEYS:
+                dev[k] = dev[k].at[idx].set(host[k][idx])
+            self._dirty_nodes = set()
+        if self._dirty_pods:
+            idx = np.fromiter(self._dirty_pods, np.int32)
+            for k in self._POD_ROW_KEYS:
+                dev[k] = dev[k].at[idx].set(host[k][idx])
+            self._dirty_pods = set()
+        if self._dirty_terms:
+            for k, a in self._term_arrays().items():
+                dev[k] = jnp.asarray(a)
+            self._dirty_terms = False
+        dev["n_nodes"] = jnp.asarray(host["n_nodes"])
+        dev["img_nodes"] = jnp.asarray(host["img_nodes"])
+        return dev
+
+
+def _fingerprint(pod: v1.Pod) -> str:
+    """Spec-equivalence cache key: everything the kernel inputs depend on."""
+    ctrl = None
+    for ref in pod.metadata.owner_references or []:
+        if ref.controller:
+            ctrl = (ref.kind, ref.uid)
+            break
+    body = {
+        "ns": pod.metadata.namespace,
+        "labels": pod.metadata.labels,
+        "ctrl": ctrl,
+        "spec": serde.to_dict(pod.spec),
+    }
+    return json.dumps(body, sort_keys=True, default=str)

@@ -1,0 +1,93 @@
+"""Device-side evaluation of compiled requirement tables.
+
+The host compiles every selector to integer tables over interned vocabs
+(models/selectors.py); these primitives evaluate them against entity
+matrices (nodes or pods) with pure gathers — no string work on device.
+
+Semantics mirror api.labels.requirement_matches (reference:
+staging/src/k8s.io/apimachinery/pkg/labels/selector.go:194 Matches):
+  In            any listed (key,value) pair present
+  NotIn         no listed pair present (missing key matches)
+  Exists        key present
+  DoesNotExist  key absent
+  Gt / Lt       key present, integer-valued, compares to threshold
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..models.selectors import (
+    OP_EXISTS,
+    OP_FALSE,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_EXISTS,
+    OP_NOT_IN,
+)
+
+
+def eval_reqs(
+    op: jnp.ndarray,        # [..., R] int8
+    key: jnp.ndarray,       # [..., R] int32
+    pairs: jnp.ndarray,     # [..., R, V] int32
+    pair_bits: jnp.ndarray,  # [E, P] bool
+    key_bits: jnp.ndarray,   # [E, K] bool
+    threshold: Optional[jnp.ndarray] = None,  # [..., R] int64
+    num: Optional[jnp.ndarray] = None,        # [E, K] int64
+    num_valid: Optional[jnp.ndarray] = None,  # [E, K] bool
+) -> jnp.ndarray:
+    """AND over the R requirement rows -> match [E, ...].
+
+    Column 0 of every entity matrix is the never-present sentinel, so pad
+    ids (0) and unknown strings resolve to False without branching.
+    """
+    has_pair = pair_bits[:, pairs]            # [E, ..., R, V]
+    any_pair = jnp.any(has_pair, axis=-1)     # [E, ..., R]
+    has_key = key_bits[:, key]                # [E, ..., R]
+    res = jnp.ones_like(has_key)              # OP_PAD -> True
+    res = jnp.where(op == OP_IN, any_pair, res)
+    res = jnp.where(op == OP_NOT_IN, ~any_pair, res)
+    res = jnp.where(op == OP_EXISTS, has_key, res)
+    res = jnp.where(op == OP_NOT_EXISTS, ~has_key, res)
+    if num is not None:
+        val = num[:, key]                     # [E, ..., R]
+        ok = num_valid[:, key] & has_key
+        res = jnp.where(op == OP_GT, ok & (val > threshold), res)
+        res = jnp.where(op == OP_LT, ok & (val < threshold), res)
+    else:
+        # numeric ops over entities without numeric matrices never match
+        res = jnp.where((op == OP_GT) | (op == OP_LT), False, res)
+    res = jnp.where(op == OP_FALSE, False, res)
+    return jnp.all(res, axis=-1)              # [E, ...]
+
+
+def eval_reqs_single(
+    op, key, pairs, pair_vec: jnp.ndarray, key_vec: jnp.ndarray,
+) -> jnp.ndarray:
+    """Evaluate tables against ONE entity given as flat bit vectors.
+
+    pair_vec [P] bool, key_vec [K] bool -> match [...] (table lead dims).
+    Used for cluster-wide affinity term tables vs the incoming pod.
+    """
+    any_pair = jnp.any(pair_vec[pairs], axis=-1)   # [..., R]
+    has_key = key_vec[key]                         # [..., R]
+    res = jnp.ones_like(has_key)
+    res = jnp.where(op == OP_IN, any_pair, res)
+    res = jnp.where(op == OP_NOT_IN, ~any_pair, res)
+    res = jnp.where(op == OP_EXISTS, has_key, res)
+    res = jnp.where(op == OP_NOT_EXISTS, ~has_key, res)
+    res = jnp.where((op == OP_GT) | (op == OP_LT), False, res)
+    res = jnp.where(op == OP_FALSE, False, res)
+    return jnp.all(res, axis=-1)
+
+
+def ns_member(ns_sets: jnp.ndarray, ns_id: jnp.ndarray) -> jnp.ndarray:
+    """ns_sets [..., S] int32 (0-padded), ns_id scalar/broadcast int32 ->
+    bool [...]: is ns_id in the set? Mirrors the resolved namespaces check
+    of AffinityTerm.matches (reference: pkg/scheduler/framework/types.go
+    PodMatchesTermsNamespaceAndSelector via util/topologies.go:40)."""
+    return jnp.any((ns_sets == ns_id) & (ns_sets != 0), axis=-1)

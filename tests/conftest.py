@@ -9,10 +9,15 @@ is int64 milli-units.
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at a TPU
+os.environ["JAX_ENABLE_X64"] = "1"
 
 import jax  # noqa: E402
 
+# The axon TPU plugin prepends itself to jax_platforms regardless of the env
+# var; force the virtual CPU mesh after import.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

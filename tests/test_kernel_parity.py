@@ -1,0 +1,378 @@
+"""TPU kernel vs Go-semantics oracle: exact filter/score parity.
+
+The north-star requirement (BASELINE.md) is identical binding decisions at
+percentageOfNodesToScore=100. These tests fuzz randomized clusters and
+pending pods, then assert the fused kernel (ops/kernel.py) reproduces the
+oracle Framework's per-node feasibility mask and per-plugin weighted scores
+bit-for-bit — the reference's own strategy of table-driven plugin tests
+(pkg/scheduler/framework/plugins/*_test.go) generalized into an A/B fuzzer.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops.kernel import schedule_pod
+from kubernetes_tpu.scheduler.framework.interface import CycleState
+from kubernetes_tpu.scheduler.framework.runtime import Framework
+from kubernetes_tpu.scheduler.framework.snapshot import Snapshot
+from kubernetes_tpu.scheduler.plugins.registry import (
+    default_plugins,
+    new_in_tree_registry,
+)
+
+from .util import make_node, make_pod
+
+# oracle plugin name -> kernel score key
+SCORE_KEYS = {
+    "NodeResourcesBalancedAllocation": "score_balanced",
+    "ImageLocality": "score_image",
+    "InterPodAffinity": "score_ipa",
+    "NodeResourcesLeastAllocated": "score_least",
+    "NodeAffinity": "score_node_affinity",
+    "NodePreferAvoidPods": "score_prefer_avoid",
+    "PodTopologySpread": "score_pts",
+    "TaintToleration": "score_taint",
+}
+
+
+def oracle_eval(snapshot: Snapshot, pod: v1.Pod):
+    fwk = Framework(
+        new_in_tree_registry(), plugins=default_plugins(), snapshot_fn=lambda: snapshot
+    )
+    state = CycleState()
+    status = fwk.run_pre_filter_plugins(state, pod)
+    assert status is None, status
+    mask = {}
+    for ni in snapshot.list():
+        statuses = fwk.run_filter_plugins(state, pod, ni)
+        mask[ni.node.metadata.name] = not statuses
+    feasible = [ni.node for ni in snapshot.list() if mask[ni.node.metadata.name]]
+    scores = {}
+    if feasible:
+        st = fwk.run_pre_score_plugins(state, pod, feasible)
+        assert st is None, st
+        scores_map, st = fwk.run_score_plugins(state, pod, feasible)
+        assert st is None, st
+        for plugin, node_scores in scores_map.items():
+            scores[plugin] = {ns.name: ns.score for ns in node_scores}
+    return mask, scores
+
+
+def kernel_eval(nodes, pods, pod: v1.Pod):
+    enc = ClusterEncoding()
+    enc.set_cluster(nodes, pods)
+    cluster = enc.device_state()
+    pe = PodEncoder(enc)
+    # encode may grow vocab capacities; refresh the device state afterwards
+    parrays = pe.encode(pod)
+    cluster = enc.device_state()
+    out = schedule_pod(cluster, parrays)
+    return enc, {k: np.asarray(vv) for k, vv in out.items()}
+
+
+def assert_parity(nodes, pods, pending, label=""):
+    snapshot = Snapshot.from_objects(pods, nodes)
+    omask, oscores = oracle_eval(snapshot, pending)
+    enc, kout = kernel_eval(nodes, pods, pending)
+    for name, idx in enc.node_index.items():
+        assert bool(kout["feasible"][idx]) == omask[name], (
+            f"{label}: feasibility mismatch on {name}: "
+            f"kernel={bool(kout['feasible'][idx])} oracle={omask[name]}"
+        )
+    for plugin, key in SCORE_KEYS.items():
+        for name, score in oscores.get(plugin, {}).items():
+            idx = enc.node_index[name]
+            assert int(kout[key][idx]) == score, (
+                f"{label}: {plugin} score mismatch on {name}: "
+                f"kernel={int(kout[key][idx])} oracle={score}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# directed cases
+
+
+def test_fit_and_ports():
+    nodes = [
+        make_node("n0", cpu="4", memory="8Gi", pods=10),
+        make_node("n1", cpu="2", memory="8Gi", pods=10),
+        make_node("n2", cpu="4", memory="8Gi", pods=1),
+    ]
+    pods = [
+        make_pod(node_name="n2"),
+        make_pod(node_name="n0", cpu="1", host_port=8080),
+    ]
+    pending = make_pod(cpu="3", host_port=8080)
+    assert_parity(nodes, pods, pending, "fit/ports")
+
+
+def test_taints_and_unschedulable():
+    nodes = [
+        make_node("n0", taints=[v1.Taint("k1", "v1", "NoSchedule")]),
+        make_node("n1", taints=[v1.Taint("k2", "v2", "PreferNoSchedule")]),
+        make_node("n2", unschedulable=True),
+        make_node("n3"),
+    ]
+    pending = make_pod(
+        tolerations=[v1.Toleration(key="k1", operator="Equal", value="v1")]
+    )
+    assert_parity(nodes, [], pending, "taints")
+
+
+def test_topology_spread():
+    nodes = [
+        make_node(f"n{i}", labels={"zone": f"z{i % 3}", v1.LABEL_HOSTNAME: f"n{i}"})
+        for i in range(6)
+    ]
+    pods = [
+        make_pod(node_name="n0", labels={"app": "x"}),
+        make_pod(node_name="n0", labels={"app": "x"}),
+        make_pod(node_name="n1", labels={"app": "x"}),
+        make_pod(node_name="n3", labels={"app": "y"}),
+    ]
+    from .util import spread_constraint
+
+    pending = make_pod(
+        labels={"app": "x"},
+        constraints=[
+            spread_constraint(1, "zone", "DoNotSchedule", {"app": "x"}),
+            spread_constraint(2, v1.LABEL_HOSTNAME, "ScheduleAnyway", {"app": "x"}),
+        ],
+    )
+    assert_parity(nodes, pods, pending, "topology-spread")
+
+
+def test_inter_pod_affinity():
+    nodes = [
+        make_node(f"n{i}", labels={"zone": f"z{i % 2}", v1.LABEL_HOSTNAME: f"n{i}"})
+        for i in range(4)
+    ]
+    from .util import anti_affinity, pod_affinity
+
+    pods = [
+        make_pod(node_name="n0", labels={"app": "db"}),
+        make_pod(
+            node_name="n1", labels={"app": "web"},
+            affinity=anti_affinity("zone", {"app": "web"}),
+        ),
+    ]
+    pending = make_pod(labels={"app": "web"}, affinity=pod_affinity("zone", {"app": "db"}))
+    assert_parity(nodes, pods, pending, "ipa-affinity")
+    pending2 = make_pod(labels={"app": "web"})
+    assert_parity(nodes, pods, pending2, "ipa-existing-anti")
+
+
+# ---------------------------------------------------------------------------
+# randomized fuzz
+
+
+def _rand_affinity(rng: random.Random):
+    apps = ["a", "b", "c"]
+    kind = rng.random()
+    term = v1.PodAffinityTerm(
+        label_selector=v1.LabelSelector(match_labels={"app": rng.choice(apps)}),
+        topology_key=rng.choice(["zone", v1.LABEL_HOSTNAME]),
+        namespaces=rng.choice([None, ["default"], ["default", "other"]]),
+    )
+    wterm = v1.WeightedPodAffinityTerm(weight=rng.randint(1, 100), pod_affinity_term=term)
+    if kind < 0.3:
+        return v1.Affinity(
+            pod_affinity=v1.PodAffinity(
+                required_during_scheduling_ignored_during_execution=[term]
+            )
+        )
+    if kind < 0.6:
+        return v1.Affinity(
+            pod_anti_affinity=v1.PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[term]
+            )
+        )
+    if kind < 0.8:
+        return v1.Affinity(
+            pod_affinity=v1.PodAffinity(
+                preferred_during_scheduling_ignored_during_execution=[wterm]
+            )
+        )
+    return v1.Affinity(
+        pod_anti_affinity=v1.PodAntiAffinity(
+            preferred_during_scheduling_ignored_during_execution=[wterm]
+        )
+    )
+
+
+def _rand_node_affinity(rng: random.Random):
+    ops = [
+        v1.NodeSelectorRequirement(key="zone", operator="In", values=["z0", "z1"]),
+        v1.NodeSelectorRequirement(key="disk", operator="Exists"),
+        v1.NodeSelectorRequirement(key="disk", operator="DoesNotExist"),
+        v1.NodeSelectorRequirement(key="zone", operator="NotIn", values=["z2"]),
+        v1.NodeSelectorRequirement(key="cap", operator="Gt", values=["5"]),
+        v1.NodeSelectorRequirement(key="cap", operator="Lt", values=["3"]),
+    ]
+    terms = [
+        v1.NodeSelectorTerm(match_expressions=rng.sample(ops, rng.randint(1, 2)))
+        for _ in range(rng.randint(1, 2))
+    ]
+    required = v1.NodeSelector(node_selector_terms=terms) if rng.random() < 0.7 else None
+    preferred = None
+    if rng.random() < 0.5:
+        preferred = [
+            v1.PreferredSchedulingTerm(
+                weight=rng.randint(1, 100),
+                preference=v1.NodeSelectorTerm(match_expressions=[rng.choice(ops)]),
+            )
+            for _ in range(rng.randint(1, 2))
+        ]
+    if required is None and preferred is None:
+        return None
+    return v1.Affinity(
+        node_affinity=v1.NodeAffinity(
+            required_during_scheduling_ignored_during_execution=required,
+            preferred_during_scheduling_ignored_during_execution=preferred,
+        )
+    )
+
+
+def random_cluster(rng: random.Random):
+    n = rng.randint(4, 10)
+    nodes = []
+    taint_pool = [
+        v1.Taint("dedicated", "infra", "NoSchedule"),
+        v1.Taint("spot", "true", "PreferNoSchedule"),
+        v1.Taint("gpu", "yes", "NoExecute"),
+    ]
+    for i in range(n):
+        labels = {
+            "zone": f"z{i % 3}",
+            v1.LABEL_HOSTNAME: f"n{i}",
+            "cap": str(rng.randint(0, 9)),
+        }
+        if rng.random() < 0.4:
+            labels["disk"] = "ssd"
+        images = None
+        if rng.random() < 0.5:
+            images = [
+                v1.ContainerImage(
+                    names=[f"registry.example/app:v{rng.randint(1, 2)}"],
+                    size_bytes=rng.randint(10, 2000) * 1024 * 1024,
+                )
+            ]
+        node = make_node(
+            f"n{i}",
+            cpu=str(rng.randint(2, 8)),
+            memory=f"{rng.randint(4, 32)}Gi",
+            pods=rng.randint(2, 8),
+            labels=labels,
+            taints=rng.sample(taint_pool, rng.randint(0, 2)) or None,
+            unschedulable=rng.random() < 0.15,
+            images=images,
+            extended={"example.com/gpu": str(rng.randint(0, 4))}
+            if rng.random() < 0.3
+            else None,
+        )
+        if rng.random() < 0.2:
+            node.metadata.annotations = {
+                "scheduler.alpha.kubernetes.io/preferAvoidPods": (
+                    '{"preferAvoidPods":[{"podSignature":{"podController":'
+                    '{"kind":"ReplicaSet","uid":"rs-1"}}}]}'
+                )
+            }
+        nodes.append(node)
+    pods = []
+    for i in range(rng.randint(0, 3 * n)):
+        pod = make_pod(
+            name=f"existing-{i}",
+            namespace=rng.choice(["default", "other"]),
+            node_name=f"n{rng.randrange(n)}",
+            labels={"app": rng.choice(["a", "b", "c"])},
+            cpu=rng.choice([None, "100m", "500m", "1"]),
+            memory=rng.choice([None, "128Mi", "1Gi"]),
+            host_port=rng.choice([0, 0, 0, 8080, 9090]),
+            affinity=_rand_affinity(rng) if rng.random() < 0.4 else None,
+        )
+        if rng.random() < 0.1:
+            pod.metadata.deletion_timestamp = 1.0
+        pods.append(pod)
+    return nodes, pods
+
+
+def random_pending(rng: random.Random):
+    from .util import spread_constraint
+
+    constraints = None
+    if rng.random() < 0.5:
+        constraints = [
+            spread_constraint(
+                rng.randint(1, 2),
+                rng.choice(["zone", v1.LABEL_HOSTNAME]),
+                rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+                {"app": rng.choice(["a", "b"])},
+            )
+            for _ in range(rng.randint(1, 2))
+        ]
+    tolerations = None
+    if rng.random() < 0.5:
+        tolerations = [
+            v1.Toleration(
+                key=rng.choice(["dedicated", "spot", ""]),
+                operator=rng.choice(["Exists", "Equal"]),
+                value=rng.choice(["infra", "true", ""]),
+                effect=rng.choice(["", "NoSchedule", "PreferNoSchedule"]),
+            )
+        ]
+    pod = make_pod(
+        name="pending",
+        namespace=rng.choice(["default", "other"]),
+        labels={"app": rng.choice(["a", "b", "c"])},
+        cpu=rng.choice([None, "500m", "2"]),
+        memory=rng.choice([None, "512Mi", "4Gi"]),
+        host_port=rng.choice([0, 0, 8080]),
+        node_selector={"zone": "z0"} if rng.random() < 0.2 else None,
+        affinity=None,
+        tolerations=tolerations,
+        constraints=constraints,
+        image=f"registry.example/app:v{rng.randint(1, 2)}",
+        containers=rng.randint(1, 2),
+        extended={"example.com/gpu": "1"} if rng.random() < 0.2 else None,
+    )
+    affs = []
+    if rng.random() < 0.5:
+        affs.append(_rand_affinity(rng))
+    na = _rand_node_affinity(rng) if rng.random() < 0.5 else None
+    affinity = v1.Affinity()
+    used = False
+    for a in affs:
+        if a.pod_affinity:
+            affinity.pod_affinity = a.pod_affinity
+            used = True
+        if a.pod_anti_affinity:
+            affinity.pod_anti_affinity = a.pod_anti_affinity
+            used = True
+    if na is not None:
+        affinity.node_affinity = na.node_affinity
+        used = True
+    if used:
+        pod.spec.affinity = affinity
+    if rng.random() < 0.3:
+        pod.metadata.owner_references = [
+            v1.OwnerReference(kind="ReplicaSet", uid="rs-1", controller=True)
+        ]
+    if rng.random() < 0.2 and pod.spec.node_name == "":
+        pod.spec.node_name = ""  # keep unset; NodeName covered by directed test
+    return pod
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_fuzz_parity(seed):
+    rng = random.Random(seed)
+    nodes, pods = random_cluster(rng)
+    for trial in range(3):
+        pending = random_pending(rng)
+        assert_parity(nodes, pods, pending, f"seed={seed} trial={trial}")
