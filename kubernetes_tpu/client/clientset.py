@@ -1,0 +1,60 @@
+"""Typed clientset over the in-process APIServer.
+
+Mirrors client-go's generated clientset surface (reference:
+staging/src/k8s.io/client-go/kubernetes/clientset.go) narrowed to the
+resources the control plane uses. The transport is an in-proc call; the
+semantics (conflicts, not-found, list+watch revisions) are identical to
+the HTTP path, which is what the components depend on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from ..api import types as v1
+from ..api.labels import Selector
+from ..apiserver.server import APIServer, TypedWatch
+
+
+class _ResourceClient:
+    def __init__(self, api: APIServer, resource: str):
+        self._api = api
+        self._resource = resource
+
+    def create(self, obj: Any) -> Any:
+        return self._api.create(self._resource, obj)
+
+    def get(self, name: str, namespace: str = "") -> Any:
+        return self._api.get(self._resource, name, namespace)
+
+    def update(self, obj: Any) -> Any:
+        return self._api.update(self._resource, obj)
+
+    def update_status(self, obj: Any) -> Any:
+        return self._api.update_status(self._resource, obj)
+
+    def delete(self, name: str, namespace: str = "") -> None:
+        self._api.delete(self._resource, name, namespace)
+
+    def list(
+        self, namespace: Optional[str] = None, label_selector: Optional[Selector] = None
+    ) -> Tuple[List[Any], int]:
+        return self._api.list(self._resource, namespace, label_selector)
+
+    def watch(self, namespace: Optional[str] = None, since_revision: int = 0) -> TypedWatch:
+        return self._api.watch(self._resource, namespace, since_revision)
+
+
+class _PodClient(_ResourceClient):
+    def bind(self, namespace: str, pod_name: str, node_name: str) -> None:
+        self._api.bind_pod(namespace, pod_name, node_name)
+
+
+class Clientset:
+    def __init__(self, api: APIServer):
+        self.api = api
+        self.pods = _PodClient(api, "pods")
+        self.nodes = _ResourceClient(api, "nodes")
+
+    def resource(self, name: str) -> _ResourceClient:
+        return _ResourceClient(self.api, name)

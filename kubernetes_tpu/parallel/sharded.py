@@ -1,0 +1,143 @@
+"""Multi-chip dispatch of the scheduling kernel over a jax.sharding.Mesh.
+
+The reference parallelizes its filter/score hot loop over 16 goroutines
+chunked across nodes (reference: pkg/scheduler/internal/parallelize/
+parallelism.go:27,56 Until; used from core/generic_scheduler.go:295 and
+framework/runtime/framework.go:736). The TPU equivalent shards the *node
+axis* of the dense cluster encoding across chips: every per-node matrix is
+split along dim 0 over the mesh's "nodes" axis, per-pod/term/vocab state is
+replicated, and the fused kernel (ops/kernel.py) runs under jit with GSPMD
+propagating the shardings. Cross-shard reductions the kernel needs —
+normalization max/min over all nodes (helper/normalize_score.go:26
+DefaultNormalizeScore), topology-pair counts (segment-sums scattered from
+the replicated pod table onto node-sharded outputs) — become XLA
+collectives over ICI, replacing the reference's shared-memory access.
+
+The final argmax across shards rides the same mechanism: `select` reduces
+the node-sharded total-score vector to one (score, index) pair, which XLA
+lowers to an all-reduce over the mesh.
+
+This is data parallelism over cluster nodes — the analog of "DP over the
+batch" in an ML workload; the pod axis (batching many pending pods per
+dispatch) is the second axis, used by gang scheduling (parallel/gang.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.kernel import DEFAULT_WEIGHTS, schedule_pod
+
+NODE_AXIS = "nodes"
+
+# Cluster-dict arrays whose dim 0 is the node axis (ClusterEncoding node
+# rows). Everything else — pod rows, term tables, vocab-indexed vectors,
+# scalars — is replicated.
+NODE_DIM0_KEYS = frozenset(
+    {
+        "valid", "alloc", "requested", "nz_requested", "pod_count",
+        "allowed_pods", "unschedulable", "taints", "ports_triple",
+        "ports_pair_any", "ports_pair_wild", "npair", "nkey", "pair_of_key",
+        "nnum", "nnum_valid", "img_size", "avoid",
+    }
+)
+
+
+def make_mesh(devices=None, n_devices: Optional[int] = None) -> Mesh:
+    """1-D device mesh over the node axis."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (NODE_AXIS,))
+
+
+def node_capacity_multiple(mesh: Mesh) -> int:
+    return int(mesh.devices.size)
+
+
+def pad_node_axis(cluster: Dict, multiple: int) -> Dict:
+    """Pad node-axis arrays so dim 0 divides the shard count.
+
+    Padding rows are all-zero: `valid` stays False so padded nodes are
+    infeasible, and id columns hit the vocab null sentinel (id 0).
+    """
+    n = cluster["valid"].shape[0]
+    target = -(-n // multiple) * multiple
+    if target == n:
+        return cluster
+    out = dict(cluster)
+    for k in NODE_DIM0_KEYS:
+        v = cluster[k]
+        widths = [(0, target - n)] + [(0, 0)] * (v.ndim - 1)
+        out[k] = jnp.pad(v, widths)
+    return out
+
+
+def shard_cluster(cluster: Dict, mesh: Mesh) -> Dict:
+    """Place the cluster dict: node rows split over the mesh, rest replicated."""
+    cluster = pad_node_axis(cluster, node_capacity_multiple(mesh))
+    out = {}
+    for k, v in cluster.items():
+        if k in NODE_DIM0_KEYS:
+            spec = P(NODE_AXIS, *([None] * (np.ndim(v) - 1)))
+        else:
+            spec = P()
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out
+
+
+def replicate_pod(pod_arrays: Dict, mesh: Mesh) -> Dict:
+    """Replicate the pending pod's encoded arrays across the mesh."""
+    repl = NamedSharding(mesh, P())
+    return {
+        k: jax.device_put(np.asarray(v), repl)
+        for k, v in pod_arrays.items()
+        if not k.startswith("_")
+    }
+
+
+def select(out: Dict) -> Dict:
+    """Device-side reduction: best node (max total, lowest index wins ties)
+    plus the feasible count. Ties must be broken by reservoir sampling for
+    Go parity (core/generic_scheduler.go:152 selectHost) — callers needing
+    identical decisions pull `total` back and sample host-side; this
+    reduction is the fast path and the cross-shard collective."""
+    total = out["total"]
+    best_score = jnp.max(total)
+    best_idx = jnp.argmax(total)
+    return {
+        "best_score": best_score,
+        "best_idx": best_idx,
+        "n_feasible": jnp.sum(out["feasible"].astype(jnp.int32)),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("weights_key",))
+def _kernel_with_select(c, p, weights_key):
+    out = schedule_pod(c, p, dict(weights_key))
+    out.update(select(out))
+    return out
+
+
+class ShardedScheduler:
+    """Holds a mesh and dispatches scheduling cycles over it.
+
+    One instance per process; the jitted kernel is compiled per
+    (array-shape-bucket, weights) combination and cached by jax.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, weights: Optional[Dict[str, int]] = None):
+        self.mesh = mesh or make_mesh()
+        self.weights_key = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+
+    def schedule(self, cluster: Dict, pod_arrays: Dict) -> Dict:
+        c = shard_cluster(cluster, self.mesh)
+        p = replicate_pod(pod_arrays, self.mesh)
+        return _kernel_with_select(c, p, self.weights_key)

@@ -1,0 +1,262 @@
+"""Scheduler cache: authoritative in-scheduler cluster state.
+
+Reference: pkg/scheduler/internal/cache/cache.go — the assume/confirm/
+expire protocol for optimistic binding (:361 AssumePod, :415 ForgetPod,
+:443 AddPod confirms, :734 cleanupAssumedPods 30s TTL) and the
+generation-based incremental snapshot (:203 UpdateSnapshot: only NodeInfos
+whose generation advanced since the last snapshot are re-copied; nodes form
+a doubly-linked list, most-recently-updated first, so the scan stops at the
+first unchanged entry).
+
+Listeners: the TPU backend registers a CacheListener to mirror every
+mutation into its dense ClusterEncoding (models/encoding.py), keeping the
+device arrays in lock-step with the cache at O(changed rows) per cycle —
+SURVEY.md §7 hard part (a).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from ...api import types as v1
+from ..framework.snapshot import Snapshot
+from ..framework.types import ImageStateSummary, NodeInfo
+
+ASSUME_EXPIRATION_SECONDS = 30.0  # cache.go durationToExpireAssumedPod
+
+
+class CacheListener:
+    """Mutation hooks (all called with the cache lock held)."""
+
+    def on_add_pod(self, pod: v1.Pod, node_name: str) -> None: ...
+    def on_remove_pod(self, pod: v1.Pod, node_name: str) -> None: ...
+    def on_add_node(self, node: v1.Node) -> None: ...
+    def on_update_node(self, node: v1.Node) -> None: ...
+    def on_remove_node(self, node_name: str) -> None: ...
+
+
+class _PodState:
+    __slots__ = ("pod", "deadline", "binding_finished")
+
+    def __init__(self, pod: v1.Pod):
+        self.pod = pod
+        self.deadline: Optional[float] = None
+        self.binding_finished = False
+
+
+class SchedulerCache:
+    def __init__(self, ttl: float = ASSUME_EXPIRATION_SECONDS, now=time.monotonic):
+        self._lock = threading.RLock()
+        self._ttl = ttl
+        self._now = now
+        self._pod_states: Dict[str, _PodState] = {}  # key -> state (all known pods)
+        self._assumed_pods: Dict[str, bool] = {}  # key -> True
+        # most-recently-updated FIRST — an OrderedDict used as the cache.go
+        # doubly-linked node list (move_to_end(last=False) == moveToHead)
+        self._nodes: "OrderedDict[str, NodeInfo]" = OrderedDict()
+        self._listeners: List[CacheListener] = []
+        # snapshot bookkeeping
+        self._last_snapshot_generation: Dict[str, int] = {}
+
+    def add_listener(self, listener: CacheListener) -> None:
+        with self._lock:
+            self._listeners.append(listener)
+
+    # -- internal helpers --------------------------------------------------
+
+    def _node_info(self, name: str) -> NodeInfo:
+        ni = self._nodes.get(name)
+        if ni is None:
+            ni = NodeInfo()
+            self._nodes[name] = ni
+        return ni
+
+    def _touch(self, name: str) -> None:
+        """O(1) move-to-head (cache.go moveNodeInfoToHead)."""
+        if name in self._nodes:
+            self._nodes.move_to_end(name, last=False)
+
+    def _add_pod_locked(self, pod: v1.Pod, node_name: str) -> None:
+        ni = self._node_info(node_name)
+        ni.add_pod(pod)
+        self._touch(node_name)
+        for l in self._listeners:
+            l.on_add_pod(pod, node_name)
+
+    def _remove_pod_locked(self, pod: v1.Pod, node_name: str) -> None:
+        ni = self._nodes.get(node_name)
+        if ni is not None:
+            ni.remove_pod(pod)
+            self._touch(node_name)
+        for l in self._listeners:
+            l.on_remove_pod(pod, node_name)
+
+    # -- assume protocol (cache.go:361-441) --------------------------------
+
+    def assume_pod(self, pod: v1.Pod) -> None:
+        key = v1.pod_key(pod)
+        with self._lock:
+            if key in self._pod_states:
+                raise ValueError(f"pod {key} is in the cache, so can't be assumed")
+            self._add_pod_locked(pod, pod.spec.node_name)
+            ps = _PodState(pod)
+            self._pod_states[key] = ps
+            self._assumed_pods[key] = True
+
+    def finish_binding(self, pod: v1.Pod) -> None:
+        key = v1.pod_key(pod)
+        with self._lock:
+            ps = self._pod_states.get(key)
+            if ps is not None and self._assumed_pods.get(key):
+                ps.binding_finished = True
+                ps.deadline = self._now() + self._ttl
+
+    def forget_pod(self, pod: v1.Pod) -> None:
+        key = v1.pod_key(pod)
+        with self._lock:
+            ps = self._pod_states.get(key)
+            if ps is None:
+                return
+            if self._assumed_pods.get(key):
+                self._remove_pod_locked(ps.pod, ps.pod.spec.node_name)
+                del self._pod_states[key]
+                del self._assumed_pods[key]
+            else:
+                raise ValueError(f"pod {key} wasn't assumed so cannot be forgotten")
+
+    def is_assumed_pod(self, pod: v1.Pod) -> bool:
+        with self._lock:
+            return bool(self._assumed_pods.get(v1.pod_key(pod)))
+
+    # -- confirmed state from informers (cache.go:443-560) -----------------
+
+    def add_pod(self, pod: v1.Pod) -> None:
+        key = v1.pod_key(pod)
+        with self._lock:
+            ps = self._pod_states.get(key)
+            if ps is not None and self._assumed_pods.get(key):
+                if ps.pod.spec.node_name != pod.spec.node_name:
+                    # scheduler sent it elsewhere; informer wins (cache.go:455)
+                    self._remove_pod_locked(ps.pod, ps.pod.spec.node_name)
+                    self._add_pod_locked(pod, pod.spec.node_name)
+                self._assumed_pods.pop(key, None)
+                ps.deadline = None
+                ps.pod = pod
+            elif ps is None:
+                self._add_pod_locked(pod, pod.spec.node_name)
+                self._pod_states[key] = _PodState(pod)
+            # else: duplicate add; ignore
+
+    def update_pod(self, old: v1.Pod, new: v1.Pod) -> None:
+        key = v1.pod_key(old)
+        with self._lock:
+            ps = self._pod_states.get(key)
+            if ps is None or self._assumed_pods.get(key):
+                return
+            self._remove_pod_locked(ps.pod, ps.pod.spec.node_name)
+            self._add_pod_locked(new, new.spec.node_name)
+            ps.pod = new
+
+    def remove_pod(self, pod: v1.Pod) -> None:
+        key = v1.pod_key(pod)
+        with self._lock:
+            ps = self._pod_states.get(key)
+            if ps is None:
+                return
+            self._remove_pod_locked(ps.pod, ps.pod.spec.node_name)
+            del self._pod_states[key]
+            self._assumed_pods.pop(key, None)
+
+    def cleanup_expired_assumed_pods(self) -> None:
+        """cache.go:734 cleanupAssumedPods: expire assumed pods whose
+        binding finished but confirmation never arrived."""
+        now = self._now()
+        with self._lock:
+            for key in list(self._assumed_pods):
+                ps = self._pod_states[key]
+                if ps.binding_finished and ps.deadline is not None and now >= ps.deadline:
+                    self._remove_pod_locked(ps.pod, ps.pod.spec.node_name)
+                    del self._pod_states[key]
+                    del self._assumed_pods[key]
+
+    # -- nodes (cache.go:562-650) ------------------------------------------
+
+    def add_node(self, node: v1.Node) -> None:
+        with self._lock:
+            ni = self._node_info(node.metadata.name)
+            ni.set_node(node)
+            self._touch(node.metadata.name)
+            for l in self._listeners:
+                l.on_add_node(node)
+
+    def update_node(self, node: v1.Node) -> None:
+        with self._lock:
+            ni = self._node_info(node.metadata.name)
+            ni.set_node(node)
+            self._touch(node.metadata.name)
+            for l in self._listeners:
+                l.on_update_node(node)
+
+    def remove_node(self, node_name: str) -> None:
+        with self._lock:
+            ni = self._nodes.pop(node_name, None)
+            if ni is None:
+                return
+            self._last_snapshot_generation.pop(node_name, None)
+            for l in self._listeners:
+                l.on_remove_node(node_name)
+
+    def node_count(self) -> int:
+        with self._lock:
+            return len(self._nodes)
+
+    def pod_count(self) -> int:
+        with self._lock:
+            return len(self._pod_states)
+
+    # -- snapshot (cache.go:203 UpdateSnapshot) ----------------------------
+
+    def update_snapshot(self, snapshot: Snapshot) -> Snapshot:
+        """Incremental: only NodeInfos whose generation advanced since this
+        snapshot's last update are re-referenced; node list rebuilt only on
+        membership change. NodeInfos are shared references — the scheduling
+        cycle treats them as read-only for the cycle (the reference clones;
+        we rely on the cycle not mutating, enforced by convention+tests)."""
+        with self._lock:
+            changed = False
+            for name in self._nodes:
+                ni = self._nodes.get(name)
+                if ni is None or ni.node is None:
+                    continue
+                last = self._last_snapshot_generation.get(name)
+                if last is not None and last >= ni.generation:
+                    break  # list is MRU-first: the rest are unchanged
+                self._last_snapshot_generation[name] = ni.generation
+                changed = True
+            names_with_node = [
+                n for n, ni in self._nodes.items() if ni.node is not None
+            ]
+            if changed or len(snapshot.node_info_list) != len(names_with_node):
+                # rebuild image-spread index (snapshot.go createImageExistenceMap)
+                image_nodes: Dict[str, set] = {}
+                for name in names_with_node:
+                    node = self._nodes[name].node
+                    for image in node.status.images or []:
+                        for nm in image.names or []:
+                            image_nodes.setdefault(nm, set()).add(name)
+                for name in names_with_node:
+                    ni = self._nodes[name]
+                    states: Dict[str, ImageStateSummary] = {}
+                    for image in ni.node.status.images or []:
+                        for nm in image.names or []:
+                            states[nm] = ImageStateSummary(
+                                image.size_bytes, len(image_nodes[nm])
+                            )
+                    ni.image_states = states
+                new_snap = Snapshot([self._nodes[n] for n in names_with_node])
+                new_snap.generation = snapshot.generation + 1
+                return new_snap
+            return snapshot

@@ -1,0 +1,242 @@
+"""Revisioned, ordered, watchable in-process KV store — the etcd equivalent.
+
+The reference keeps all cluster state in etcd, reached only through the
+apiserver's storage.Interface (reference: staging/src/k8s.io/apiserver/pkg/
+storage/etcd3/store.go:143 Create, :286 GuaranteedUpdate, :816 Watch).
+This module reproduces the semantics that layer relies on:
+
+  * a single monotonically-increasing int64 revision over ALL keys (the
+    etcd store revision; object resourceVersion = mod revision);
+  * conditional writes — create-if-absent, update/delete guarded by the
+    expected mod revision (the transactional compare etcd3 store.go uses);
+  * prefix range reads returning (values, store revision);
+  * watches from a historical revision: replay from the event log, then
+    live delivery; asking for a compacted revision raises Compacted — the
+    equivalent of etcd's "410 Gone" that forces a client re-list
+    (client-go reflector.go ListAndWatch re-list path).
+
+Values are opaque Python objects; callers must treat returned values as
+immutable (the apiserver layer stores serialized dicts and deep-copies at
+its own boundary).
+"""
+
+from __future__ import annotations
+
+import bisect
+import queue
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+class StoreError(Exception):
+    pass
+
+
+class KeyExists(StoreError):
+    pass
+
+
+class KeyNotFound(StoreError):
+    pass
+
+
+class Conflict(StoreError):
+    """Mod-revision precondition failed (optimistic concurrency)."""
+
+
+class Compacted(StoreError):
+    """Requested watch revision predates the retained event log (410 Gone)."""
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str  # ADDED | MODIFIED | DELETED
+    key: str
+    value: Any  # current value (ADDED/MODIFIED) or last value (DELETED)
+    revision: int
+
+
+@dataclass(frozen=True)
+class KeyValue:
+    key: str
+    value: Any
+    create_revision: int
+    mod_revision: int
+
+
+class Watch:
+    """One watch stream: iterate for events; stop() ends the stream."""
+
+    _SENTINEL = object()
+
+    def __init__(self, store: "KVStore", prefix: str):
+        self._store = store
+        self._prefix = prefix
+        self._q: "queue.Queue" = queue.Queue()
+        self._stopped = False
+
+    def _deliver(self, ev: Event) -> None:
+        if not self._stopped and ev.key.startswith(self._prefix):
+            self._q.put(ev)
+
+    def stop(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            self._store._remove_watch(self)
+            self._q.put(self._SENTINEL)
+
+    def __iter__(self) -> Iterator[Event]:
+        while True:
+            ev = self._q.get()
+            if ev is self._SENTINEL:
+                return
+            yield ev
+
+    def poll(self, timeout: Optional[float] = None) -> Optional[Event]:
+        """Next event or None on timeout/stop."""
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return None if ev is self._SENTINEL else ev
+
+
+class KVStore:
+    def __init__(self, history_limit: int = 100_000):
+        self._lock = threading.RLock()
+        self._data: Dict[str, KeyValue] = {}
+        self._keys: List[str] = []  # sorted for range reads
+        self._rev = 0
+        self._history: deque = deque()  # Events, oldest first
+        self._history_limit = history_limit
+        self._compacted_rev = 0  # events <= this are gone
+        self._watches: List[Watch] = []
+
+    # -- reads -------------------------------------------------------------
+
+    @property
+    def revision(self) -> int:
+        with self._lock:
+            return self._rev
+
+    def get(self, key: str) -> KeyValue:
+        with self._lock:
+            kv = self._data.get(key)
+            if kv is None:
+                raise KeyNotFound(key)
+            return kv
+
+    def list(self, prefix: str) -> Tuple[List[KeyValue], int]:
+        """All KVs under prefix (key-ordered) + the store revision, the
+        consistent LIST the reflector's initial sync needs."""
+        with self._lock:
+            lo = bisect.bisect_left(self._keys, prefix)
+            out = []
+            for i in range(lo, len(self._keys)):
+                k = self._keys[i]
+                if not k.startswith(prefix):
+                    break
+                out.append(self._data[k])
+            return out, self._rev
+
+    # -- writes ------------------------------------------------------------
+
+    def create(self, key: str, value: Any) -> int:
+        with self._lock:
+            if key in self._data:
+                raise KeyExists(key)
+            self._rev += 1
+            kv = KeyValue(key, value, self._rev, self._rev)
+            self._data[key] = kv
+            bisect.insort(self._keys, key)
+            self._emit(Event(ADDED, key, value, self._rev))
+            return self._rev
+
+    def update(self, key: str, value: Any, expected_mod_revision: Optional[int] = None) -> int:
+        with self._lock:
+            kv = self._data.get(key)
+            if kv is None:
+                raise KeyNotFound(key)
+            if expected_mod_revision is not None and kv.mod_revision != expected_mod_revision:
+                raise Conflict(
+                    f"{key}: mod_revision {kv.mod_revision} != expected {expected_mod_revision}"
+                )
+            self._rev += 1
+            self._data[key] = KeyValue(key, value, kv.create_revision, self._rev)
+            self._emit(Event(MODIFIED, key, value, self._rev))
+            return self._rev
+
+    def delete(self, key: str, expected_mod_revision: Optional[int] = None) -> int:
+        with self._lock:
+            kv = self._data.get(key)
+            if kv is None:
+                raise KeyNotFound(key)
+            if expected_mod_revision is not None and kv.mod_revision != expected_mod_revision:
+                raise Conflict(
+                    f"{key}: mod_revision {kv.mod_revision} != expected {expected_mod_revision}"
+                )
+            self._rev += 1
+            del self._data[key]
+            i = bisect.bisect_left(self._keys, key)
+            del self._keys[i]
+            self._emit(Event(DELETED, key, kv.value, self._rev))
+            return self._rev
+
+    def guaranteed_update(self, key: str, fn, max_retries: int = 16) -> int:
+        """Read-modify-write with conflict retry (etcd3 store.go:286
+        GuaranteedUpdate's optimistic loop). fn(value) -> new value."""
+        for _ in range(max_retries):
+            kv = self.get(key)
+            new_value = fn(kv.value)
+            try:
+                return self.update(key, new_value, expected_mod_revision=kv.mod_revision)
+            except Conflict:
+                continue
+        raise Conflict(f"{key}: too many conflicts in guaranteed_update")
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, prefix: str = "", since_revision: int = 0) -> Watch:
+        """Events with revision > since_revision under prefix. since=0 means
+        'from now'. Raises Compacted if the backlog was trimmed past the
+        requested revision."""
+        with self._lock:
+            w = Watch(self, prefix)
+            if since_revision:
+                if since_revision < self._compacted_rev:
+                    raise Compacted(
+                        f"revision {since_revision} compacted (floor {self._compacted_rev})"
+                    )
+                for ev in self._history:
+                    if ev.revision > since_revision:
+                        w._deliver(ev)
+            self._watches.append(w)
+            return w
+
+    def _remove_watch(self, w: Watch) -> None:
+        with self._lock:
+            try:
+                self._watches.remove(w)
+            except ValueError:
+                pass
+
+    def _emit(self, ev: Event) -> None:
+        self._history.append(ev)
+        while len(self._history) > self._history_limit:
+            dropped = self._history.popleft()
+            self._compacted_rev = dropped.revision
+        for w in self._watches:
+            w._deliver(ev)
+
+    def compact(self, revision: int) -> None:
+        """Drop history up to revision (etcd compaction)."""
+        with self._lock:
+            while self._history and self._history[0].revision <= revision:
+                dropped = self._history.popleft()
+                self._compacted_rev = dropped.revision

@@ -1,0 +1,90 @@
+"""Phase-level profiling of the batched scheduling cycle (dev tool)."""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops.batch import CARRY_KEYS, _scan_batch, schedule_batch
+from kubernetes_tpu.ops.kernel import DEFAULT_WEIGHTS
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+import numpy as np
+import jax.numpy as jnp
+
+N_NODES = int(os.environ.get("BENCH_NODES", "500"))
+B = int(os.environ.get("BENCH_BATCH", "100"))
+
+nodes, init_pods = synth_cluster(N_NODES, pods_per_node=2)
+pending = synth_pending_pods(4 * B, spread=True)
+
+enc = ClusterEncoding()
+phantoms = []
+for i, p in enumerate(pending):
+    q = synth_pending_pods(1, spread=True)[0]
+    q.metadata.name = f"phantom-{i}"
+    q.metadata.labels = dict(p.metadata.labels or {})
+    q.spec.node_name = nodes[i % len(nodes)].metadata.name
+    phantoms.append(q)
+enc.set_cluster(nodes, init_pods + phantoms)
+pe = PodEncoder(enc)
+for p in pending[:8]:
+    pe.encode(p)
+enc.device_state()
+for q in phantoms:
+    enc.remove_pod(q)
+
+
+def run_batch(pods, label):
+    t0 = time.perf_counter()
+    arrays = [
+        {k: v for k, v in pe.encode(p).items() if not k.startswith("_")} for p in pods
+    ]
+    t1 = time.perf_counter()
+    c = enc.device_state()
+    jax.block_until_ready(c)
+    t2 = time.perf_counter()
+    stacked = {
+        k: jnp.asarray(np.stack([np.asarray(pa[k]) for pa in arrays]))
+        for k in arrays[0]
+    }
+    xs = {
+        "pod": stacked,
+        "pidx": jnp.asarray(
+            np.asarray([enc._pod_free[-1 - i] for i in range(len(pods))], np.int32)
+        ),
+        "valid": jnp.ones(len(pods), bool),
+    }
+    jax.block_until_ready(xs)
+    t3 = time.perf_counter()
+    static_c = {k: v for k, v in c.items() if k not in CARRY_KEYS}
+    carry = {k: c[k] for k in CARRY_KEYS}
+    key = tuple(sorted(DEFAULT_WEIGHTS.items()))
+    new_carry, ys = _scan_batch(static_c, carry, xs, key)
+    jax.block_until_ready((new_carry, ys))
+    t4 = time.perf_counter()
+    decisions = [int(v) for v in np.asarray(ys["best"])]
+    for pod, best in zip(pods, decisions):
+        if best < 0:
+            continue
+        pod.spec.node_name = enc.node_names[best]
+        enc.add_pod(pod, enc.node_names[best])
+    t5 = time.perf_counter()
+    print(
+        f"{label}: encode={t1-t0:.3f}s sync={t2-t1:.3f}s stack={t3-t2:.3f}s "
+        f"scan={t4-t3:.3f}s host_add={t5-t4:.3f}s total={t5-t0:.3f}s",
+        flush=True,
+    )
+
+
+for i in range(4):
+    run_batch(pending[i * B : (i + 1) * B], f"batch{i}")

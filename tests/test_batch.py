@@ -1,0 +1,106 @@
+"""Batched scan kernel vs sequential single-pod kernel: identical decisions.
+
+The batch path must see exactly the sequential assume semantics — pod i
+scored against the state including pods 0..i-1 (reference assume protocol:
+pkg/scheduler/internal/cache/cache.go:361) — so spread/affinity/resource
+pressure from earlier decisions shifts later ones identically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops.batch import pod_batchable, schedule_batch
+from kubernetes_tpu.ops.kernel import schedule_pod_jit
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+
+def sequential_decisions(nodes, init_pods, pending):
+    enc = ClusterEncoding()
+    enc.set_cluster(nodes, init_pods)
+    pe = PodEncoder(enc)
+    decisions = []
+    for pod in pending:
+        p = {k: v for k, v in pe.encode(pod).items() if not k.startswith("_")}
+        c = enc.device_state()
+        out = schedule_pod_jit(c, p)
+        total = np.asarray(out["total"])
+        best = int(total.argmax())
+        if total[best] < 0:
+            decisions.append(-1)
+            continue
+        decisions.append(best)
+        enc.add_pod(pod, enc.node_names[best])
+    return decisions
+
+
+def batch_decisions(nodes, init_pods, pending):
+    enc = ClusterEncoding()
+    enc.set_cluster(nodes, init_pods)
+    pe = PodEncoder(enc)
+    for pod in pending:  # intern pass: grow vocabs before the rebuild
+        pe.encode(pod)
+    c = enc.device_state()
+    arrays = [
+        {k: v for k, v in pe.encode(pod).items() if not k.startswith("_")}
+        for pod in pending
+    ]
+    assert all(pod_batchable(pa) for pa in arrays)
+    slots = [enc._pod_free[-1 - i] for i in range(len(pending))]
+    decisions, _ = schedule_batch(c, arrays, slots)
+    return decisions, enc
+
+
+def test_batch_matches_sequential_spread():
+    nodes, init_pods = synth_cluster(12, pods_per_node=1)
+    pending = synth_pending_pods(17, spread=True)
+    seq = sequential_decisions(nodes, init_pods, pending)
+    got, _ = batch_decisions(nodes, init_pods, pending)
+    assert got == seq
+
+
+def test_batch_matches_sequential_plain():
+    nodes, init_pods = synth_cluster(9, pods_per_node=2)
+    pending = synth_pending_pods(13, cpu="500m", memory="2Gi")
+    seq = sequential_decisions(nodes, init_pods, pending)
+    got, _ = batch_decisions(nodes, init_pods, pending)
+    assert got == seq
+
+
+def test_batch_exhausts_capacity():
+    """Pods overflow tiny cluster capacity; overflow pods must get -1 in
+    BOTH paths at the same positions (resource pressure is sequential)."""
+    nodes, _ = synth_cluster(2)
+    # node alloc is 4 CPU; 3 pods of 1500m fit two per... 2 nodes * 2 = 4+1 overflow
+    pending = synth_pending_pods(6, cpu="1500m", memory="1Gi")
+    seq = sequential_decisions(nodes, [], pending)
+    got, _ = batch_decisions(nodes, [], pending)
+    assert got == seq
+    assert -1 in got
+
+
+def test_unbatchable_detection():
+    from kubernetes_tpu.api import types as v1
+    from kubernetes_tpu.testing.synth import make_pod
+
+    nodes, _ = synth_cluster(4)
+    enc = ClusterEncoding()
+    enc.set_cluster(nodes, [])
+    pe = PodEncoder(enc)
+    aff = v1.Affinity(
+        pod_anti_affinity=v1.PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                v1.PodAffinityTerm(
+                    label_selector=v1.LabelSelector(match_labels={"app": "x"}),
+                    topology_key=v1.LABEL_HOSTNAME,
+                )
+            ]
+        )
+    )
+    pod = make_pod("p", labels={"app": "x"}, affinity=aff)
+    assert not pod_batchable(pe.encode(pod))
+    plain = make_pod("q", cpu="100m")
+    assert pod_batchable(pe.encode(plain))
