@@ -243,17 +243,28 @@ class PodInfo:
 class QueuedPodInfo:
     """PodInfo + queueing bookkeeping (types.go:45)."""
 
-    __slots__ = ("pod_info", "timestamp", "attempts", "initial_attempt_timestamp")
+    __slots__ = (
+        "pod_info",
+        "timestamp",
+        "attempts",
+        "initial_attempt_timestamp",
+        "last_failure_timestamp",
+    )
 
     def __init__(self, pod: v1.Pod, timestamp: Optional[float] = None):
         self.pod_info = PodInfo(pod)
         self.timestamp = timestamp if timestamp is not None else time.monotonic()
         self.attempts = 0
         self.initial_attempt_timestamp = self.timestamp
+        self.last_failure_timestamp = 0.0
 
     @property
     def pod(self) -> v1.Pod:
         return self.pod_info.pod
+
+    @pod.setter
+    def pod(self, pod: v1.Pod) -> None:
+        self.pod_info = PodInfo(pod)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,271 @@
+"""The scheduler: queue → scheduleOne → assume → async bind.
+
+Reference: pkg/scheduler/scheduler.go — New (:188), Run (:311,
+wait.UntilWithContext(scheduleOne)), scheduleOne (:427), assume (:359),
+bind (:381); event wiring pkg/scheduler/eventhandlers.go:364
+addAllEventHandlers.
+
+Pipeline shape preserved exactly: the SCHEDULING cycle is serial (one pod
+at a time against the assumed state), the BINDING cycle is asynchronous
+per pod (a worker thread doing the apiserver bind), bridged by the
+assume/forget protocol in the cache — plus the TPU twist: the scheduling
+cycle drains a RUN of pending pods from the queue and schedules them in
+one batched device dispatch (ops/batch.py) when their specs allow,
+preserving sequential assume semantics.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from ..api import types as v1
+from ..apiserver.server import APIError
+from ..client.clientset import Clientset
+from ..client.informer import EventHandler, SharedInformerFactory, meta_namespace_key
+from ..utils import serde
+from .core import GenericScheduler, ScheduleResult
+from .framework.interface import CycleState, FitError
+from .framework.runtime import Framework
+from .framework.snapshot import Snapshot
+from .internal.cache import SchedulerCache
+from .internal.queue import PriorityQueue
+from .tpu_backend import TPUBackend
+
+
+class Scheduler:
+    def __init__(
+        self,
+        clientset: Clientset,
+        informer_factory: SharedInformerFactory,
+        framework: Optional[Framework] = None,
+        backend: str = "tpu",  # "tpu" | "oracle"
+        tpu_backend: Optional[TPUBackend] = None,
+        percentage_of_nodes_to_score: int = 100,
+        max_batch: int = 128,
+        rng: Optional[random.Random] = None,
+    ):
+        self.client = clientset
+        self.informers = informer_factory
+        self.cache = SchedulerCache()
+        self.queue = PriorityQueue()
+        self.backend = backend
+        self.framework = framework
+        self.max_batch = max_batch
+        self.rng = rng or random.Random()
+        self.snapshot = Snapshot()
+        if backend == "tpu":
+            self.tpu = tpu_backend or TPUBackend(rng=self.rng)
+            self.cache.add_listener(self.tpu)
+        else:
+            self.tpu = None
+            self.algorithm = GenericScheduler(
+                percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+                rng=self.rng,
+            )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._binders = ThreadPoolExecutor(max_workers=8, thread_name_prefix="binder")
+        self._inflight = 0  # scheduling batches + binds not yet finished
+        self._inflight_lock = threading.Lock()
+        self._add_event_handlers()
+
+    # -- event wiring (eventhandlers.go:364) -------------------------------
+
+    def _add_event_handlers(self) -> None:
+        pods = self.informers.pods()
+        nodes = self.informers.nodes()
+
+        def assigned(pod: v1.Pod) -> bool:
+            return bool(pod.spec.node_name)
+
+        def on_pod_add(pod: v1.Pod) -> None:
+            if assigned(pod):
+                self.cache.add_pod(pod)  # may confirm an assumed pod
+            elif self._schedulable(pod):
+                self.queue.add(pod)
+
+        def on_pod_update(old: v1.Pod, new: v1.Pod) -> None:
+            if assigned(new):
+                if assigned(old):
+                    self.cache.update_pod(old, new)
+                else:
+                    self.cache.add_pod(new)
+            elif self._schedulable(new):
+                self.queue.update(old, new)
+
+        def on_pod_delete(pod: v1.Pod) -> None:
+            if assigned(pod):
+                self.cache.remove_pod(pod)
+                self.queue.move_all_to_active_or_backoff_queue("AssignedPodDelete")
+            else:
+                self.queue.delete(pod)
+
+        pods.add_event_handler(
+            EventHandler(on_add=on_pod_add, on_update=on_pod_update, on_delete=on_pod_delete)
+        )
+
+        def on_node_add(node: v1.Node) -> None:
+            self.cache.add_node(node)
+            self.queue.move_all_to_active_or_backoff_queue("NodeAdd")
+
+        def on_node_update(old: v1.Node, new: v1.Node) -> None:
+            self.cache.update_node(new)
+            self.queue.move_all_to_active_or_backoff_queue("NodeUpdate")
+
+        def on_node_delete(node: v1.Node) -> None:
+            self.cache.remove_node(node.metadata.name)
+
+        nodes.add_event_handler(
+            EventHandler(on_add=on_node_add, on_update=on_node_update, on_delete=on_node_delete)
+        )
+
+    @staticmethod
+    def _schedulable(pod: v1.Pod) -> bool:
+        return pod.metadata.deletion_timestamp is None
+
+    # -- run loop ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._binders.shutdown(wait=True)
+
+    def _run(self) -> None:
+        import time
+
+        last_cleanup = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                self.schedule_one(timeout=0.2)
+                now = time.monotonic()
+                if now - last_cleanup >= 1.0:  # cache.go:125 1s cleanup ticker
+                    last_cleanup = now
+                    self.cache.cleanup_expired_assumed_pods()
+            except Exception:  # keep the loop alive; scheduleOne logs errors
+                traceback.print_exc()
+
+    # -- scheduling cycle --------------------------------------------------
+
+    def schedule_one(self, timeout: Optional[float] = None) -> bool:
+        """One scheduling cycle; returns False on queue timeout. In TPU
+        mode, drains up to max_batch pods and schedules them in batched
+        dispatches with sequential assume semantics."""
+        info = self.queue.pop(timeout=timeout)
+        if info is None:
+            return False
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            if self.backend == "tpu":
+                infos = [info]
+                while len(infos) < self.max_batch:
+                    nxt = self.queue.pop(timeout=0)
+                    if nxt is None:
+                        break
+                    infos.append(nxt)
+                self._schedule_batch_tpu(infos)
+            else:
+                self._schedule_one_oracle(info)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+        return True
+
+    def _skip(self, pod: v1.Pod) -> bool:
+        """scheduler.go:620 skipPodSchedule: deleted or already assumed."""
+        current = self.informers.pods().get(meta_namespace_key(pod))
+        if current is not None and current.metadata.deletion_timestamp is not None:
+            return True
+        return self.cache.is_assumed_pod(pod)
+
+    def _schedule_batch_tpu(self, infos: List) -> None:
+        cycle = self.queue.scheduling_cycle
+        todo = [i for i in infos if not self._skip(i.pod)]
+        results = self.tpu.schedule_many([i.pod for i in todo])
+        by_key = {v1.pod_key(p): node for p, node in results}
+        for info in todo:
+            node = by_key.get(v1.pod_key(info.pod))
+            if node is None:
+                self._record_failure(info, cycle)
+            else:
+                self._assume_and_bind(info.pod, node)
+
+    def _schedule_one_oracle(self, info) -> None:
+        pod = info.pod
+        cycle = self.queue.scheduling_cycle
+        if self._skip(pod):
+            return
+        self.snapshot = self.cache.update_snapshot(self.snapshot)
+        state = CycleState()
+        try:
+            result = self.algorithm.schedule(
+                state, self.framework, pod, self.snapshot
+            )
+        except FitError:
+            self._record_failure(info, cycle)
+            return
+        self._assume_and_bind(pod, result.suggested_host)
+
+    def _record_failure(self, info, cycle: int) -> None:
+        self.queue.add_unschedulable_if_not_present(info, cycle)
+
+    # -- assume + binding cycle (scheduler.go:359,:540) --------------------
+
+    def _assume_and_bind(self, pod: v1.Pod, node_name: str) -> None:
+        # deep copy (scheduler.go:445 pod.DeepCopy before assume): the queue
+        # and informer cache must not see the assumed nodeName
+        assumed = serde.from_dict(v1.Pod, serde.to_dict(pod))
+        assumed.spec.node_name = node_name
+        try:
+            self.cache.assume_pod(assumed)
+        except ValueError:
+            return  # already in cache (informer raced us)
+        with self._inflight_lock:
+            self._inflight += 1
+        self._binders.submit(self._bind, assumed, node_name)
+
+    def _bind(self, assumed: v1.Pod, node_name: str) -> None:
+        try:
+            self.client.pods.bind(
+                assumed.metadata.namespace, assumed.metadata.name, node_name
+            )
+            self.cache.finish_binding(assumed)
+        except APIError:
+            self.cache.forget_pod(assumed)
+            # retry with the UNASSIGNED pod: keeping the failed nodeName
+            # would pin every retry to that node via the NodeName filter
+            retry = serde.from_dict(v1.Pod, serde.to_dict(assumed))
+            retry.spec.node_name = ""
+            self.queue.add(retry)
+        except Exception:
+            traceback.print_exc()
+            self.cache.forget_pod(assumed)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    # -- introspection -----------------------------------------------------
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Test helper: queue drained AND no batch/bind in flight."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                inflight = self._inflight
+            if inflight == 0 and not self.queue.pending_pods():
+                return True
+            time.sleep(0.05)
+        return False
