@@ -248,6 +248,7 @@ class PodSpec:
     topology_spread_constraints: Optional[List[TopologySpreadConstraint]] = None
     priority: Optional[int] = None
     priority_class_name: str = ""
+    preemption_policy: Optional[str] = None  # PreemptLowerPriority | Never
     scheduler_name: str = ""
     overhead: Optional[Dict[str, str]] = None
     host_network: bool = False
@@ -283,6 +284,57 @@ class Pod:
 
 
 # Well-known labels (reference: staging/src/k8s.io/api/core/v1/well_known_labels.go)
+# ---------------------------------------------------------------------------
+# coordination.k8s.io/v1 Lease (leader election + node heartbeats;
+# reference: staging/src/k8s.io/api/coordination/v1/types.go)
+
+
+@dataclass
+class LeaseSpec:
+    holder_identity: str = ""
+    lease_duration_seconds: int = 0
+    acquire_time: Optional[float] = None
+    renew_time: Optional[float] = None
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+    kind: str = "Lease"
+    api_version: str = "coordination.k8s.io/v1"
+
+
+# ---------------------------------------------------------------------------
+# policy/v1beta1 PodDisruptionBudget (subset preemption needs;
+# reference: staging/src/k8s.io/api/policy/v1beta1/types.go)
+
+
+@dataclass
+class PodDisruptionBudgetSpec:
+    min_available: Optional[str] = None  # int or percentage string
+    max_unavailable: Optional[str] = None
+    selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class PodDisruptionBudgetStatus:
+    disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodDisruptionBudgetSpec = field(default_factory=PodDisruptionBudgetSpec)
+    status: PodDisruptionBudgetStatus = field(default_factory=PodDisruptionBudgetStatus)
+    kind: str = "PodDisruptionBudget"
+    api_version: str = "policy/v1beta1"
+
+
 LABEL_HOSTNAME = "kubernetes.io/hostname"
 LABEL_ZONE = "topology.kubernetes.io/zone"
 LABEL_REGION = "topology.kubernetes.io/region"

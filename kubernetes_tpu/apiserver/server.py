@@ -62,10 +62,16 @@ class ResourceInfo:
     namespaced: bool
 
 
-DEFAULT_RESOURCES = (
-    ResourceInfo("pods", v1.Pod, True),
-    ResourceInfo("nodes", v1.Node, False),
-)
+def _default_resources() -> Tuple["ResourceInfo", ...]:
+    from ..client.events import Event
+
+    return (
+        ResourceInfo("pods", v1.Pod, True),
+        ResourceInfo("nodes", v1.Node, False),
+        ResourceInfo("poddisruptionbudgets", v1.PodDisruptionBudget, True),
+        ResourceInfo("events", Event, True),
+        ResourceInfo("leases", v1.Lease, True),
+    )
 
 
 @dataclass(frozen=True)
@@ -102,11 +108,13 @@ class APIServer:
     def __init__(
         self,
         store: Optional[kv.KVStore] = None,
-        resources: Tuple[ResourceInfo, ...] = DEFAULT_RESOURCES,
+        resources: Optional[Tuple[ResourceInfo, ...]] = None,
         mutating_admission: Optional[List[AdmissionFunc]] = None,
         validating_admission: Optional[List[AdmissionFunc]] = None,
     ):
         self.store = store or kv.KVStore()
+        if resources is None:
+            resources = _default_resources()
         self._resources: Dict[str, ResourceInfo] = {r.name: r for r in resources}
         self._mutating = mutating_admission or []
         self._validating = validating_admission or []

@@ -1,0 +1,89 @@
+"""Event recording (client-go tools/record equivalent).
+
+Reference: staging/src/k8s.io/client-go/tools/record/event.go — an
+EventRecorder stamps Events (reason, message, involved object) and a
+broadcaster sinks them to the apiserver; the scheduler emits "Scheduled" /
+"FailedScheduling" (pkg/scheduler/scheduler.go:423) and preemption events.
+
+Events aggregate by (involved object, reason, message): a repeat bumps
+count instead of creating a new object (event_aggregator semantics).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..api import types as v1
+
+
+@dataclass
+class ObjectReference:
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class Event:
+    metadata: v1.ObjectMeta = field(default_factory=v1.ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"  # Normal | Warning
+    count: int = 1
+    first_timestamp: Optional[float] = None
+    last_timestamp: Optional[float] = None
+    source_component: str = ""
+    kind: str = "Event"
+    api_version: str = "v1"
+
+
+class EventRecorder:
+    def __init__(self, clientset, component: str):
+        self._client = clientset.resource("events")
+        self._component = component
+        self._lock = threading.Lock()
+        self._known: Dict[tuple, str] = {}  # aggregation key -> event name
+
+    def event(self, obj, event_type: str, reason: str, message: str) -> None:
+        ref = ObjectReference(
+            kind=getattr(obj, "kind", ""),
+            namespace=obj.metadata.namespace,
+            name=obj.metadata.name,
+            uid=obj.metadata.uid,
+        )
+        key = (ref.kind, ref.namespace, ref.name, reason, message)
+        now = time.time()
+        with self._lock:
+            existing_name = self._known.get(key)
+        try:
+            if existing_name:
+                try:
+                    ev = self._client.get(existing_name, ref.namespace or "default")
+                    ev.count += 1
+                    ev.last_timestamp = now
+                    self._client.update(ev)
+                    return
+                except Exception:
+                    pass  # fall through to create
+            name = f"{ref.name}.{uuid.uuid4().hex[:10]}"
+            ev = Event(
+                metadata=v1.ObjectMeta(name=name, namespace=ref.namespace or "default"),
+                involved_object=ref,
+                reason=reason,
+                message=message,
+                type=event_type,
+                first_timestamp=now,
+                last_timestamp=now,
+                source_component=self._component,
+            )
+            self._client.create(ev)
+            with self._lock:
+                self._known[key] = name
+        except Exception:
+            pass  # events are best-effort (record never blocks callers)

@@ -1,0 +1,156 @@
+"""Lease-based leader election (client-go tools/leaderelection equivalent).
+
+Reference: staging/src/k8s.io/client-go/tools/leaderelection/
+leaderelection.go — Run (:196: acquire → renew loop → OnStoppedLeading),
+tryAcquireOrRenew (:317: read record, adopt if expired, update with
+optimistic concurrency), defaults LeaseDuration 15s / RenewDeadline 10s /
+RetryPeriod 2s; the lock is a coordination/v1 Lease object
+(resourcelock/leaselock.go). OnStoppedLeading in the components is fatal
+(crash-and-restart HA model, cmd/kube-scheduler/app/server.go:204) — here
+it's a callback the embedding process decides on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..api import types as v1
+from ..apiserver.server import APIError, Conflict, NotFound
+
+
+@dataclass
+class LeaderElectionConfig:
+    lock_name: str = "kube-scheduler"
+    lock_namespace: str = "kube-system"
+    identity: str = ""
+    lease_duration: float = 15.0
+    renew_deadline: float = 10.0
+    retry_period: float = 2.0
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        clientset,
+        config: LeaderElectionConfig,
+        on_started_leading: Callable[[], None],
+        on_stopped_leading: Callable[[], None],
+        now=time.time,
+    ):
+        if config.lease_duration <= config.renew_deadline:
+            raise ValueError("leaseDuration must be greater than renewDeadline")
+        if config.renew_deadline <= config.retry_period:
+            raise ValueError("renewDeadline must be greater than retryPeriod")
+        if not config.identity:
+            raise ValueError("identity is required")
+        self._leases = clientset.resource("leases")
+        self.cfg = config
+        self._on_started = on_started_leading
+        self._on_stopped = on_stopped_leading
+        self._now = now
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.is_leader = threading.Event()
+        self._observed_renew_time: float = 0.0
+        self._observed_holder: str = ""
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self.run, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def run(self) -> None:
+        """leaderelection.go:196 Run: acquire, then renew until lost."""
+        while not self._stop.is_set():
+            if not self._acquire():
+                return  # stopped
+            self._on_started()
+            self._renew_loop()
+            self.is_leader.clear()
+            self._on_stopped()
+            if self._stop.is_set():
+                return
+
+    # -- phases ------------------------------------------------------------
+
+    def _acquire(self) -> bool:
+        while not self._stop.is_set():
+            if self._try_acquire_or_renew():
+                self.is_leader.set()
+                return True
+            self._stop.wait(self.cfg.retry_period)
+        return False
+
+    def _renew_loop(self) -> None:
+        while not self._stop.is_set():
+            deadline = self._now() + self.cfg.renew_deadline
+            renewed = False
+            while self._now() < deadline and not self._stop.is_set():
+                if self._try_acquire_or_renew():
+                    renewed = True
+                    break
+                self._stop.wait(self.cfg.retry_period)
+            if not renewed:
+                return  # lost the lease
+            self._stop.wait(self.cfg.retry_period)
+
+    # -- the CAS (leaderelection.go:317 tryAcquireOrRenew) -----------------
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = self._now()
+        try:
+            lease = self._leases.get(self.cfg.lock_name, self.cfg.lock_namespace)
+        except NotFound:
+            lease = v1.Lease(
+                metadata=v1.ObjectMeta(
+                    name=self.cfg.lock_name, namespace=self.cfg.lock_namespace
+                ),
+                spec=v1.LeaseSpec(
+                    holder_identity=self.cfg.identity,
+                    lease_duration_seconds=int(self.cfg.lease_duration),
+                    acquire_time=now,
+                    renew_time=now,
+                ),
+            )
+            try:
+                self._leases.create(lease)
+                return True
+            except APIError:
+                return False
+        spec = lease.spec
+        if spec.holder_identity != self.cfg.identity:
+            expired = (
+                spec.renew_time is None
+                or spec.renew_time + self.cfg.lease_duration < now
+            )
+            if not expired:
+                self._observed_holder = spec.holder_identity
+                return False
+            spec.lease_transitions += 1
+            spec.acquire_time = now
+        spec.holder_identity = self.cfg.identity
+        spec.lease_duration_seconds = int(self.cfg.lease_duration)
+        spec.renew_time = now
+        try:
+            self._leases.update(lease)  # resourceVersion-guarded CAS
+            return True
+        except (Conflict, APIError):
+            return False
+
+    @property
+    def leader_identity(self) -> str:
+        try:
+            lease = self._leases.get(self.cfg.lock_name, self.cfg.lock_namespace)
+            return lease.spec.holder_identity
+        except APIError:
+            return ""

@@ -78,6 +78,66 @@ def _scan_batch(static_c: Dict, carry: Dict, xs: Dict, weights_key) -> Tuple[Dic
     return jax.lax.scan(step, carry, xs)
 
 
+# -- pod-array packing ------------------------------------------------------
+# Tunneled TPUs pay a round-trip per host->device transfer; a batch's ~50
+# stacked pod arrays are therefore packed host-side into one buffer per
+# dtype group (bool / int32-ish / int64) and sliced back apart on-device
+# inside the jit. 3 transfers per batch instead of ~50.
+
+_GROUP_OF_DTYPE = {
+    np.dtype(np.bool_): ("b", np.bool_),
+    np.dtype(np.int8): ("i4", np.int32),
+    np.dtype(np.int16): ("i4", np.int32),
+    np.dtype(np.int32): ("i4", np.int32),
+    np.dtype(np.int64): ("i8", np.int64),
+}
+
+
+def _pack_stacked(stacked: Dict[str, np.ndarray]):
+    """-> ({group: [B, W] array}, layout) with layout hashable/static."""
+    b = next(iter(stacked.values())).shape[0]
+    offsets = {"b": 0, "i4": 0, "i8": 0}
+    chunks = {"b": [], "i4": [], "i8": []}
+    layout = []
+    for key in sorted(stacked):
+        arr = stacked[key]
+        group, gdtype = _GROUP_OF_DTYPE[arr.dtype]
+        flat = np.ascontiguousarray(arr.reshape(b, -1), dtype=gdtype)
+        layout.append(
+            (key, group, offsets[group], flat.shape[1], arr.shape[1:], arr.dtype.str)
+        )
+        offsets[group] += flat.shape[1]
+        chunks[group].append(flat)
+    packed = {
+        g: (
+            np.concatenate(chunks[g], axis=1)
+            if chunks[g]
+            else np.zeros((b, 0), np.dtype(np.bool_ if g == "b" else np.int32))
+        )
+        for g in chunks
+    }
+    return packed, tuple(layout)
+
+
+def _unpack_stacked(packed: Dict, layout) -> Dict:
+    """Inverse of _pack_stacked, traceable (runs inside jit)."""
+    out = {}
+    for key, group, off, width, shape, dtype_str in layout:
+        b = packed[group].shape[0]
+        sl = jax.lax.slice_in_dim(packed[group], off, off + width, axis=1)
+        out[key] = sl.reshape((b,) + tuple(shape)).astype(jnp.dtype(dtype_str))
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("weights_key", "layout"))
+def _scan_batch_packed(
+    static_c: Dict, carry: Dict, packed: Dict, pidx, valid, weights_key, layout
+) -> Tuple[Dict, Dict]:
+    xs = {"pod": _unpack_stacked(packed, layout), "pidx": pidx, "valid": valid}
+    step = functools.partial(_step, static_c, dict(weights_key))
+    return jax.lax.scan(step, carry, xs)
+
+
 def pod_batchable(pod_arrays: Dict) -> bool:
     """True if the encoded pod leaves term/port tables untouched when
     assumed: no required/preferred (anti-)affinity terms, no host ports."""
@@ -114,19 +174,24 @@ def schedule_batch(
     sig0 = shape_signature(pod_arrays_list[0])
     for pa in pod_arrays_list[1:]:
         assert shape_signature(pa) == sig0, "batch pods must share shapes"
-    # stack host-side: ONE transfer per key, not one per (pod, key)
+    # stack host-side, then pack into 3 dtype-grouped buffers: transfers
+    # per batch drop from ~50 (one per key) to 3 — decisive on tunneled TPUs
     stacked = {
-        k: jnp.asarray(np.stack([np.asarray(pa[k]) for pa in pod_arrays_list]))
+        k: np.stack([np.asarray(pa[k]) for pa in pod_arrays_list])
         for k in pod_arrays_list[0]
         if not k.startswith("_")
     }
-    xs = {
-        "pod": stacked,
-        "pidx": jnp.asarray(np.asarray(free_slots[:b], np.int32)),
-        "valid": jnp.ones(b, bool),
-    }
+    packed, layout = _pack_stacked(stacked)
     static_c = {k: v for k, v in cluster.items() if k not in CARRY_KEYS}
     carry = {k: cluster[k] for k in CARRY_KEYS}
     key = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
-    new_carry, ys = _scan_batch(static_c, carry, xs, key)
+    new_carry, ys = _scan_batch_packed(
+        static_c,
+        carry,
+        {g: jnp.asarray(a) for g, a in packed.items()},
+        jnp.asarray(np.asarray(free_slots[:b], np.int32)),
+        jnp.ones(b, bool),
+        key,
+        layout,
+    )
     return [int(v) for v in np.asarray(ys["best"])], new_carry

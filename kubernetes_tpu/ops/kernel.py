@@ -201,8 +201,10 @@ def _ipa_filter(c: Dict, p: Dict):
     at_pair = c["pair_of_key"][c["pnode"][c["at_src"]], c["at_key"]]  # [A]
     existing_cnt = _seg_sum(match_at.astype(_I64), at_pair, vnp)
     existing_cnt = existing_cnt.at[0].set(0)
-    # int64 dot_general is unsupported by the TPU x64 rewrite; use a masked any
-    fail_existing = jnp.any(c["npair"] & (existing_cnt > 0)[None, :], axis=1)
+    # gather per node LABEL (pair_of_key, ~K columns) instead of sweeping the
+    # whole [N, Vnp] pair matrix: nodes carry few labels, Vnp is huge
+    hit_per_key = (existing_cnt > 0)[c["pair_of_key"]] & c["nkey"]  # [N, K]
+    fail_existing = jnp.any(hit_per_key, axis=1)
 
     def term_matches(prefix):
         """Per-term match of every existing pod: selector + namespaces."""
@@ -475,8 +477,12 @@ def _score_ipa(c: Dict, p: Dict, feasible):
     present = present | (_seg_sum(match_st.astype(_I64), st_pair, vnp) > 0)
     present = present.at[0].set(False)
     score_vec = score_vec.at[0].set(0)
-    # Score(): sum score_vec over the node's label pairs (masked sum, no i64 dot)
-    raw = jnp.sum(jnp.where(c["npair"], score_vec[None, :], 0), axis=1)
+    # Score(): sum score_vec over the node's label pairs — gather per label
+    # via pair_of_key ([N, K], K ~ label-key vocab) instead of the dense
+    # [N, Vnp] sweep; pair id 0 (no label) contributes score_vec[0] == 0
+    raw = jnp.sum(
+        jnp.where(c["nkey"], score_vec[c["pair_of_key"]], 0), axis=1
+    )
     any_present = jnp.any(present)
     big = jnp.iinfo(jnp.int64).max
     min_s = jnp.min(jnp.where(feasible, raw, big))

@@ -1,0 +1,241 @@
+"""Workload runner: declarative node/pod ops → throughput + latency stats.
+
+Reference: test/integration/scheduler_perf/scheduler_perf_test.go —
+workloads are op sequences (createNodes, createPods with optional
+podTemplate features, barrier); measured pods get timing; collectors
+sample SchedulingThroughput at 1s (util.go:220-284) and latency
+percentiles come from per-pod scheduling timestamps.
+
+The cluster is the real in-proc slice: APIServer + informers + the real
+Scheduler loop (oracle or TPU backend) — the same shape as the reference's
+mustSetupScheduler (util.go:61) with a real apiserver+etcd and no kubelet.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api import types as v1
+from ..apiserver import APIServer
+from ..client import Clientset, SharedInformerFactory
+from ..scheduler.framework.runtime import Framework
+from ..scheduler.plugins.registry import (
+    default_plugins_without,
+    new_in_tree_registry,
+)
+from ..scheduler.scheduler import Scheduler
+from ..testing.synth import make_node, make_pod
+
+DENSITY_FAIL_THRESHOLD = 30.0  # scheduler_test.go:41 threshold3K
+DENSITY_WARN_THRESHOLD = 100.0  # scheduler_test.go:40 warning3K
+
+
+@dataclass
+class PodTemplate:
+    """Pod features, mirroring performance-config.yaml templates."""
+
+    cpu: str = "100m"
+    memory: str = "128Mi"
+    labels: Dict[str, str] = field(default_factory=lambda: {"app": "perf"})
+    spread_zone: bool = False  # PodTopologySpread on zone, ScheduleAnyway
+    spread_hostname_hard: bool = False  # maxSkew=1 DoNotSchedule on hostname
+    anti_affinity_zone: bool = False  # required anti-affinity on zone
+
+    def build(self, name: str, namespace: str = "default") -> v1.Pod:
+        constraints = []
+        if self.spread_zone:
+            constraints.append(
+                v1.TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=v1.LABEL_ZONE,
+                    when_unsatisfiable="ScheduleAnyway",
+                    label_selector=v1.LabelSelector(match_labels=dict(self.labels)),
+                )
+            )
+        if self.spread_hostname_hard:
+            constraints.append(
+                v1.TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=v1.LABEL_HOSTNAME,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=v1.LabelSelector(match_labels=dict(self.labels)),
+                )
+            )
+        affinity = None
+        if self.anti_affinity_zone:
+            affinity = v1.Affinity(
+                pod_anti_affinity=v1.PodAntiAffinity(
+                    required_during_scheduling_ignored_during_execution=[
+                        v1.PodAffinityTerm(
+                            label_selector=v1.LabelSelector(
+                                match_labels=dict(self.labels)
+                            ),
+                            topology_key=v1.LABEL_ZONE,
+                        )
+                    ]
+                )
+            )
+        return make_pod(
+            name,
+            namespace=namespace,
+            cpu=self.cpu,
+            memory=self.memory,
+            labels=dict(self.labels),
+            constraints=constraints or None,
+            affinity=affinity,
+        )
+
+
+@dataclass
+class Workload:
+    """One benchmark case (a performance-config.yaml entry)."""
+
+    name: str
+    num_nodes: int
+    num_init_pods: int = 0
+    num_pods: int = 0  # measured
+    init_template: PodTemplate = field(default_factory=PodTemplate)
+    template: PodTemplate = field(default_factory=PodTemplate)
+    backend: str = "tpu"
+    n_zones: int = 3
+    max_batch: int = 128
+    timeout: float = 600.0
+
+
+@dataclass
+class Result:
+    name: str
+    backend: str
+    num_nodes: int
+    num_pods: int
+    duration_s: float
+    throughput_avg: float  # pods/s over the measured phase
+    throughput_p50: float  # of 1s samples
+    throughput_p90: float
+    throughput_p99: float
+    attempts: int = 0
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+def _percentile(samples: List[float], p: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, int(round(p / 100.0 * len(s) + 0.5)) - 1))
+    return s[idx]
+
+
+def run_workload(w: Workload, quiet: bool = True) -> Result:
+    api = APIServer()
+    cs = Clientset(api)
+    for i in range(w.num_nodes):
+        cs.nodes.create(
+            make_node(
+                f"node-{i}",
+                labels={
+                    v1.LABEL_HOSTNAME: f"node-{i}",
+                    v1.LABEL_ZONE: f"zone-{i % w.n_zones}",
+                    v1.LABEL_REGION: f"region-{i % w.n_zones % 2}",
+                },
+            )
+        )
+    factory = SharedInformerFactory(cs)
+    sched = Scheduler(cs, factory, backend=w.backend, max_batch=w.max_batch)
+    if w.backend == "oracle":
+        sched.framework = Framework(
+            new_in_tree_registry(),
+            plugins=default_plugins_without("DefaultPreemption"),
+            snapshot_fn=lambda: sched.snapshot,
+        )
+    factory.start()
+    if not factory.wait_for_cache_sync():
+        raise RuntimeError("informer sync failed")
+    try:
+        # init pods (scheduled but not measured — warms caches + compile)
+        if w.num_init_pods:
+            for i in range(w.num_init_pods):
+                cs.pods.create(w.init_template.build(f"init-{i}"))
+            sched.start()
+            if not _wait_all_bound(cs, w.num_init_pods, w.timeout):
+                raise RuntimeError("init pods did not all bind")
+        else:
+            sched.start()
+
+        # measured pods
+        for i in range(w.num_pods):
+            cs.pods.create(w.template.build(f"measure-{i}"))
+        t0 = time.perf_counter()
+        samples: List[float] = []
+        last_bound, last_t = 0, t0
+        total = w.num_init_pods + w.num_pods
+        deadline = t0 + w.timeout
+        while time.perf_counter() < deadline:
+            time.sleep(1.0)
+            pods, _ = cs.pods.list(namespace="default")
+            bound = sum(1 for p in pods if p.spec.node_name)
+            now = time.perf_counter()
+            samples.append((bound - (last_bound or w.num_init_pods)) / (now - last_t))
+            last_bound, last_t = bound, now
+            if bound >= total:
+                break
+        dt = time.perf_counter() - t0
+        pods, _ = cs.pods.list(namespace="default")
+        bound_measured = sum(1 for p in pods if p.spec.node_name) - w.num_init_pods
+        return Result(
+            name=w.name,
+            backend=w.backend,
+            num_nodes=w.num_nodes,
+            num_pods=w.num_pods,
+            duration_s=round(dt, 2),
+            throughput_avg=round(bound_measured / dt, 2) if dt else 0.0,
+            throughput_p50=round(_percentile(samples, 50), 2),
+            throughput_p90=round(_percentile(samples, 90), 2),
+            throughput_p99=round(_percentile(samples, 99), 2),
+        )
+    finally:
+        sched.stop()
+        factory.stop()
+
+
+def _wait_all_bound(cs: Clientset, n: int, timeout: float) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        pods, _ = cs.pods.list(namespace="default")
+        if sum(1 for p in pods if p.spec.node_name) >= n:
+            return True
+        time.sleep(0.2)
+    return False
+
+
+# the reference's benchmark suite shapes (performance-config.yaml)
+STANDARD_WORKLOADS = {
+    "SchedulingBasic": Workload(
+        "SchedulingBasic", num_nodes=500, num_init_pods=1000, num_pods=1000
+    ),
+    "Density3K": Workload("Density3K", num_nodes=100, num_pods=3000),
+    "SchedulingPodTopologySpread": Workload(
+        "SchedulingPodTopologySpread",
+        num_nodes=500,
+        num_init_pods=1000,
+        num_pods=1000,
+        template=PodTemplate(spread_zone=True),
+    ),
+    "SchedulingPodAntiAffinity": Workload(
+        "SchedulingPodAntiAffinity",
+        num_nodes=500,
+        num_init_pods=100,
+        num_pods=400,
+        template=PodTemplate(anti_affinity_zone=False),
+    ),
+    "Scheduling5000Nodes": Workload(
+        "Scheduling5000Nodes",
+        num_nodes=5000,
+        num_init_pods=1000,
+        num_pods=1000,
+        template=PodTemplate(spread_zone=True),
+    ),
+}

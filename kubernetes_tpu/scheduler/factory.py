@@ -1,0 +1,99 @@
+"""Scheduler assembly from KubeSchedulerConfiguration.
+
+Reference: pkg/scheduler/factory.go:90 create — config profiles →
+framework instances, extender configs → HTTPExtenders, queue/backoff
+knobs → PriorityQueue; cmd/kube-scheduler/app/server.go:299 Setup.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..client.clientset import Clientset
+from ..client.informer import SharedInformerFactory
+from .apis.config import (
+    ConfigError,
+    KubeSchedulerConfiguration,
+    default_configuration,
+    merged_plugins_for_profile,
+    validate_configuration,
+)
+from .extender import HTTPExtender
+from .framework.runtime import Framework
+from .plugins.registry import new_in_tree_registry
+from .scheduler import Scheduler
+from .tpu_backend import TPUBackend
+
+# score plugin name -> kernel weight key (ops/kernel.py DEFAULT_WEIGHTS)
+_KERNEL_WEIGHT_KEYS = {
+    "NodeResourcesBalancedAllocation": "balanced",
+    "ImageLocality": "image",
+    "InterPodAffinity": "ipa",
+    "NodeResourcesLeastAllocated": "least",
+    "NodeAffinity": "node_affinity",
+    "NodePreferAvoidPods": "prefer_avoid",
+    "PodTopologySpread": "pts",
+    "TaintToleration": "taint",
+}
+
+
+def create_scheduler(
+    clientset: Clientset,
+    informer_factory: SharedInformerFactory,
+    cfg: Optional[KubeSchedulerConfiguration] = None,
+    profile_name: Optional[str] = None,
+    registry=None,
+) -> Scheduler:
+    cfg = cfg or default_configuration()
+    validate_configuration(cfg)
+    if profile_name is None:
+        profile = cfg.profiles[0]
+    else:
+        by_name = {p.scheduler_name: p for p in cfg.profiles}
+        if profile_name not in by_name:
+            raise ConfigError(f"no profile named {profile_name!r}")
+        profile = by_name[profile_name]
+    merged = merged_plugins_for_profile(profile)
+
+    tpu_backend = None
+    if profile.backend == "tpu":
+        if cfg.extenders:
+            raise ConfigError(
+                "extenders require the oracle backend (profile backend: oracle)"
+            )
+        weights = {k: 0 for k in _KERNEL_WEIGHT_KEYS.values()}
+        for name, weight in merged.get("score", []):
+            key = _KERNEL_WEIGHT_KEYS.get(name)
+            if key is None:
+                raise ConfigError(
+                    f"score plugin {name!r} has no TPU kernel equivalent; "
+                    f"use backend: oracle for this profile"
+                )
+            weights[key] = weight
+        tpu_backend = TPUBackend(weights=weights)
+
+    sched = Scheduler(
+        clientset,
+        informer_factory,
+        backend=profile.backend,
+        tpu_backend=tpu_backend,
+        percentage_of_nodes_to_score=cfg.percentage_of_nodes_to_score,
+        max_batch=cfg.max_batch,
+        pod_initial_backoff=cfg.pod_initial_backoff_seconds,
+        pod_max_backoff=cfg.pod_max_backoff_seconds,
+        extenders=[HTTPExtender(e) for e in cfg.extenders],
+        parallelism=cfg.parallelism,
+    )
+    framework = Framework(
+        registry or new_in_tree_registry(),
+        profile_name=profile.scheduler_name,
+        plugins=merged,
+        plugin_config=profile.plugin_config,
+        snapshot_fn=lambda: sched.snapshot,
+        parallelism=cfg.parallelism,
+    )
+    framework.nominator = sched.nominator
+    framework.pdb_lister = sched._list_pdbs
+    sched.framework = framework
+    sched.profile_name = profile.scheduler_name
+    return sched

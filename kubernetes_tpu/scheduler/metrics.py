@@ -1,0 +1,81 @@
+"""Scheduler metric set (reference: pkg/scheduler/metrics/metrics.go:45-163).
+
+Same metric names as the reference so dashboards/harnesses carry over:
+schedule_attempts_total{result,profile}, e2e/algorithm duration histograms,
+framework_extension_point_duration_seconds, pending_pods{queue},
+scheduler_cache_size, preemption_victims/attempts.
+"""
+
+from __future__ import annotations
+
+from ..utils.metrics import Counter, Gauge, Histogram, legacy_registry
+
+SCHEDULED = "scheduled"
+UNSCHEDULABLE = "unschedulable"
+ERROR = "error"
+
+schedule_attempts = legacy_registry.register(
+    Counter(
+        "scheduler_schedule_attempts_total",
+        "Number of attempts to schedule pods, by result.",
+        ("result", "profile"),
+    )
+)
+e2e_scheduling_duration = legacy_registry.register(
+    Histogram(
+        "scheduler_e2e_scheduling_duration_seconds",
+        "E2e scheduling latency (scheduling algorithm + binding).",
+        ("result", "profile"),
+    )
+)
+scheduling_algorithm_duration = legacy_registry.register(
+    Histogram(
+        "scheduler_scheduling_algorithm_duration_seconds",
+        "Scheduling algorithm latency.",
+        (),
+    )
+)
+framework_extension_point_duration = legacy_registry.register(
+    Histogram(
+        "scheduler_framework_extension_point_duration_seconds",
+        "Latency per scheduling framework extension point.",
+        ("extension_point", "status", "profile"),
+    )
+)
+pending_pods = legacy_registry.register(
+    Gauge(
+        "scheduler_pending_pods",
+        "Pending pods by queue: active, backoff, unschedulable.",
+        ("queue",),
+    )
+)
+cache_size = legacy_registry.register(
+    Gauge(
+        "scheduler_scheduler_cache_size",
+        "Scheduler cache contents by type.",
+        ("type",),
+    )
+)
+preemption_attempts = legacy_registry.register(
+    Counter(
+        "scheduler_preemption_attempts_total",
+        "Total preemption attempts in the cluster.",
+        (),
+    )
+)
+preemption_victims = legacy_registry.register(
+    Histogram(
+        "scheduler_preemption_victims",
+        "Number of selected preemption victims.",
+        (),
+        buckets=(1, 2, 4, 8, 16, 32, 64),
+    )
+)
+batch_size = legacy_registry.register(
+    Histogram(
+        "scheduler_tpu_batch_size",
+        "Pods per fused TPU scheduling dispatch (TPU-build metric).",
+        (),
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+    )
+)

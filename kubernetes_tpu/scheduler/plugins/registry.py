@@ -74,7 +74,9 @@ def new_in_tree_registry() -> Registry:
     r.register("PodTopologySpread", lambda a, h: podtopologyspread.PodTopologySpread(a, h))
     r.register("InterPodAffinity", lambda a, h: interpodaffinity.InterPodAffinity(a, h))
     r.register("DefaultBinder", lambda a, h: nodebasic.DefaultBinder(a, h))
-    r.register("DefaultPreemption", lambda a, h: _UnschedulablePostFilter(a, h))
+    from .defaultpreemption import DefaultPreemption
+
+    r.register("DefaultPreemption", lambda a, h: DefaultPreemption(a, h))
     # placeholders (volume subsystem pending)
     for name in (
         "VolumeBinding",

@@ -22,17 +22,24 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
+import time as _time
+
 from ..api import types as v1
 from ..apiserver.server import APIError
 from ..client.clientset import Clientset
+from ..client.events import EventRecorder
 from ..client.informer import EventHandler, SharedInformerFactory, meta_namespace_key
 from ..utils import serde
+from . import metrics
 from .core import GenericScheduler, ScheduleResult
 from .framework.interface import CycleState, FitError
 from .framework.runtime import Framework
 from .framework.snapshot import Snapshot
 from .internal.cache import SchedulerCache
+from .internal.nominator import PodNominator
 from .internal.queue import PriorityQueue
+from .plugins.defaultpreemption import get_lower_priority_nominated_pods
+from .plugins.registry import default_plugins, new_in_tree_registry
 from .tpu_backend import TPUBackend
 
 
@@ -47,16 +54,34 @@ class Scheduler:
         percentage_of_nodes_to_score: int = 100,
         max_batch: int = 128,
         rng: Optional[random.Random] = None,
+        pod_initial_backoff: float = 1.0,
+        pod_max_backoff: float = 10.0,
+        extenders: Optional[List] = None,
+        parallelism: int = 16,
     ):
         self.client = clientset
         self.informers = informer_factory
         self.cache = SchedulerCache()
-        self.queue = PriorityQueue()
+        self.queue = PriorityQueue(
+            pod_initial_backoff=pod_initial_backoff,
+            pod_max_backoff=pod_max_backoff,
+        )
+        self.extenders = extenders or []
+        self.parallelism = parallelism
         self.backend = backend
-        self.framework = framework
         self.max_batch = max_batch
         self.rng = rng or random.Random()
         self.snapshot = Snapshot()
+        self.nominator = PodNominator()
+        # a Framework exists in BOTH modes: TPU mode uses it for the long
+        # tail (preemption dry-runs, extenders) — SURVEY.md §7 stage 4
+        self.framework = framework or Framework(
+            new_in_tree_registry(),
+            plugins=default_plugins(),
+            snapshot_fn=lambda: self.snapshot,
+        )
+        self.framework.nominator = self.nominator
+        self.framework.pdb_lister = self._list_pdbs
         if backend == "tpu":
             self.tpu = tpu_backend or TPUBackend(rng=self.rng)
             self.cache.add_listener(self.tpu)
@@ -64,6 +89,7 @@ class Scheduler:
             self.tpu = None
             self.algorithm = GenericScheduler(
                 percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+                extenders=self.extenders,
                 rng=self.rng,
             )
         self._stop = threading.Event()
@@ -71,6 +97,10 @@ class Scheduler:
         self._binders = ThreadPoolExecutor(max_workers=8, thread_name_prefix="binder")
         self._inflight = 0  # scheduling batches + binds not yet finished
         self._inflight_lock = threading.Lock()
+        self.profile_name = (
+            self.framework.profile_name if self.framework else "default-scheduler"
+        )
+        self.recorder = EventRecorder(clientset, self.profile_name)
         self._add_event_handlers()
 
     # -- event wiring (eventhandlers.go:364) -------------------------------
@@ -85,7 +115,10 @@ class Scheduler:
         def on_pod_add(pod: v1.Pod) -> None:
             if assigned(pod):
                 self.cache.add_pod(pod)  # may confirm an assumed pod
+                self.nominator.delete_nominated_pod_if_exists(pod)
             elif self._schedulable(pod):
+                if pod.status.nominated_node_name:
+                    self.nominator.add_nominated_pod(pod)
                 self.queue.add(pod)
 
         def on_pod_update(old: v1.Pod, new: v1.Pod) -> None:
@@ -94,7 +127,9 @@ class Scheduler:
                     self.cache.update_pod(old, new)
                 else:
                     self.cache.add_pod(new)
+                self.nominator.delete_nominated_pod_if_exists(new)
             elif self._schedulable(new):
+                self.nominator.update_nominated_pod(old, new)
                 self.queue.update(old, new)
 
         def on_pod_delete(pod: v1.Pod) -> None:
@@ -102,6 +137,7 @@ class Scheduler:
                 self.cache.remove_pod(pod)
                 self.queue.move_all_to_active_or_backoff_queue("AssignedPodDelete")
             else:
+                self.nominator.delete_nominated_pod_if_exists(pod)
                 self.queue.delete(pod)
 
         pods.add_event_handler(
@@ -166,6 +202,8 @@ class Scheduler:
             return False
         with self._inflight_lock:
             self._inflight += 1
+        t0 = _time.perf_counter()
+        n_scheduled = 1
         try:
             if self.backend == "tpu":
                 infos = [info]
@@ -174,10 +212,15 @@ class Scheduler:
                     if nxt is None:
                         break
                     infos.append(nxt)
+                n_scheduled = len(infos)
+                metrics.batch_size.observe(n_scheduled)
                 self._schedule_batch_tpu(infos)
             else:
                 self._schedule_one_oracle(info)
         finally:
+            dt = _time.perf_counter() - t0
+            for _ in range(n_scheduled):
+                metrics.scheduling_algorithm_duration.observe(dt / n_scheduled)
             with self._inflight_lock:
                 self._inflight -= 1
         return True
@@ -197,7 +240,13 @@ class Scheduler:
         for info in todo:
             node = by_key.get(v1.pod_key(info.pod))
             if node is None:
-                self._record_failure(info, cycle)
+                # re-dispatch singly to recover per-node failure statuses
+                # for the preemption dry-run (FitError carries them)
+                try:
+                    r = self.tpu.schedule(info.pod)
+                    self._assume_and_bind(info.pod, r.suggested_host)
+                except FitError as fe:
+                    self._record_failure(info, cycle, fe.filtered_nodes_statuses)
             else:
                 self._assume_and_bind(info.pod, node)
 
@@ -210,15 +259,85 @@ class Scheduler:
         state = CycleState()
         try:
             result = self.algorithm.schedule(
-                state, self.framework, pod, self.snapshot
+                state, self.framework, pod, self.snapshot, nominator=self.nominator
             )
-        except FitError:
-            self._record_failure(info, cycle)
+        except FitError as fe:
+            self._record_failure(info, cycle, fe.filtered_nodes_statuses, state)
             return
         self._assume_and_bind(pod, result.suggested_host)
 
-    def _record_failure(self, info, cycle: int) -> None:
+    # -- failure path: preemption then unschedulable queue -----------------
+
+    def _list_pdbs(self) -> List[v1.PodDisruptionBudget]:
+        try:
+            items, _ = self.client.resource("poddisruptionbudgets").list()
+            return items
+        except Exception:
+            return []
+
+    def _record_failure(
+        self,
+        info,
+        cycle: int,
+        statuses: Optional[Dict[str, object]] = None,
+        state: Optional[CycleState] = None,
+    ) -> None:
+        """scheduler.go:427 failure branch: RunPostFilterPlugins (preemption)
+        then park in the unschedulable queue with nominatedNodeName set so
+        the next attempt lands on the freed node."""
+        pod = info.pod
+        metrics.schedule_attempts.inc(
+            result=metrics.UNSCHEDULABLE, profile=self.profile_name
+        )
+        self.recorder.event(
+            pod, "Warning", "FailedScheduling",
+            f"0/{self.cache.node_count()} nodes are available",
+        )
+        if statuses:
+            try:
+                self._try_preempt(pod, statuses, state)
+            except Exception:
+                traceback.print_exc()
         self.queue.add_unschedulable_if_not_present(info, cycle)
+
+    def _try_preempt(self, pod: v1.Pod, statuses, state: Optional[CycleState]) -> None:
+        self.snapshot = self.cache.update_snapshot(self.snapshot)
+        if state is None:
+            # TPU path: the kernel bypassed the oracle PreFilter, but the
+            # preemption dry-run's AddPod/RemovePod extensions read its
+            # CycleState — run it here (framework.go:426)
+            state = CycleState()
+            st = self.framework.run_pre_filter_plugins(state, pod)
+            if st is not None and not st.is_success():
+                return
+        metrics.preemption_attempts.inc()
+        result, status = self.framework.run_post_filter_plugins(state, pod, statuses)
+        if result is None or status is None or not status.is_success():
+            return
+        node_name = result.nominated_node_name
+        metrics.preemption_victims.observe(len(result.victims))
+        self.recorder.event(
+            pod, "Normal", "Preempted",
+            f"preempted {len(result.victims)} pod(s) on node {node_name}",
+        )
+        # PrepareCandidate (default_preemption.go:690): patch nomination,
+        # evict victims, clear lower-priority nominations on that node
+        self.nominator.add_nominated_pod(pod, node_name)
+        try:
+            fresh = self.client.pods.get(pod.metadata.name, pod.metadata.namespace)
+            fresh.status.nominated_node_name = node_name
+            self.client.pods.update_status(fresh)
+        except APIError:
+            pass
+        for victim in result.victims:
+            try:
+                self.client.pods.delete(
+                    victim.metadata.name, victim.metadata.namespace
+                )
+            except APIError:
+                pass
+        for lower in get_lower_priority_nominated_pods(self.nominator, pod, node_name):
+            self.nominator.delete_nominated_pod_if_exists(lower)
 
     # -- assume + binding cycle (scheduler.go:359,:540) --------------------
 
@@ -241,6 +360,14 @@ class Scheduler:
                 assumed.metadata.namespace, assumed.metadata.name, node_name
             )
             self.cache.finish_binding(assumed)
+            metrics.schedule_attempts.inc(
+                result=metrics.SCHEDULED, profile=self.profile_name
+            )
+            self.recorder.event(
+                assumed, "Normal", "Scheduled",
+                f"Successfully assigned {assumed.metadata.namespace}/"
+                f"{assumed.metadata.name} to {node_name}",
+            )
         except APIError:
             self.cache.forget_pod(assumed)
             # retry with the UNASSIGNED pod: keeping the failed nodeName

@@ -1,0 +1,42 @@
+"""Time individual kernel sections at scale (dev tool)."""
+import os, sys, time
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops import kernel as K
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+N = int(os.environ.get("BENCH_NODES", "5000"))
+nodes, init_pods = synth_cluster(N, pods_per_node=2)
+enc = ClusterEncoding(); enc.set_cluster(nodes, init_pods)
+pe = PodEncoder(enc)
+pending = synth_pending_pods(1, spread=True)[0]
+pe.encode(pending)
+c = enc.device_state()
+p = {k: v for k, v in pe.encode(pending).items() if not k.startswith("_")}
+
+import jax.numpy as jnp
+sections = {
+    "filter_basics": lambda c, p: K._filter_basics(c, p),
+    "node_match": lambda c, p: K._node_match(c, p),
+    "pts_filter": lambda c, p: K._pts_filter(c, p, K._node_match(c, p)),
+    "ipa_filter": lambda c, p: K._ipa_filter(c, p),
+    "score_balanced+least+image": lambda c, p: (K._score_balanced(c, p), K._score_least(c, p), K._score_image(c, p)),
+    "score_taint+nodeaff": lambda c, p: (K._score_taint(c, p, c["valid"]), K._score_node_affinity(c, p, c["valid"])),
+    "score_pts": lambda c, p: K._score_pts(c, p, K._node_match(c, p), c["valid"]),
+    "score_ipa": lambda c, p: K._score_ipa(c, p, c["valid"]),
+    "FULL": lambda c, p: K.schedule_pod(c, p),
+}
+for name, fn in sections.items():
+    jf = jax.jit(fn)
+    out = jf(c, p); jax.block_until_ready(out)  # compile
+    t0 = time.perf_counter()
+    for _ in range(10):
+        out = jf(c, p)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / 10
+    print(f"{name}: {dt*1000:.2f}ms", flush=True)

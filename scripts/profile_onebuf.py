@@ -1,0 +1,56 @@
+import os, sys, time, functools
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax.numpy as jnp
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops.batch import CARRY_KEYS, _step
+from kubernetes_tpu.ops.kernel import DEFAULT_WEIGHTS
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+N, B = 5000, 100
+nodes, init_pods = synth_cluster(N, pods_per_node=2)
+enc = ClusterEncoding(); enc.set_cluster(nodes, init_pods)
+pe = PodEncoder(enc)
+pods = synth_pending_pods(3*B, spread=True)
+for q in pods: pe.encode(q)
+c = enc.device_state()
+key = tuple(sorted(DEFAULT_WEIGHTS.items()))
+static_c = {k: v for k, v in c.items() if k not in CARRY_KEYS}
+carry0 = {k: c[k] for k in CARRY_KEYS}
+
+def pack_one(arrays):
+    layout = []
+    chunks = []
+    off = 0
+    for k in sorted(arrays[0]):
+        arr = np.stack([np.asarray(a[k]) for a in arrays])
+        flat = arr.reshape(B, -1).astype(np.int64)
+        layout.append((k, off, flat.shape[1], arr.shape[1:], arr.dtype.str))
+        off += flat.shape[1]
+        chunks.append(flat)
+    return np.concatenate(chunks, axis=1), tuple(layout)
+
+@functools.partial(jax.jit, static_argnames=("weights_key", "layout"))
+def scan_onebuf(static_c, carry, buf, weights_key, layout):
+    pod = {}
+    for k, off, w, shape, dt in layout:
+        pod[k] = jax.lax.slice_in_dim(buf, off, off+w, axis=1).reshape((B,)+tuple(shape)).astype(jnp.dtype(dt))
+    xs = {"pod": pod, "pidx": jnp.arange(B, dtype=jnp.int32), "valid": jnp.ones(B, bool)}
+    step = functools.partial(_step, static_c, dict(weights_key))
+    return jax.lax.scan(step, carry, xs)
+
+for r in range(3):
+    t0 = time.perf_counter()
+    buf, layout = pack_one([{k: v for k, v in pe.encode(q).items() if not k.startswith("_")} for q in pods[r*B:(r+1)*B]])
+    t1 = time.perf_counter()
+    dbuf = jnp.asarray(buf); jax.block_until_ready(dbuf)
+    t2 = time.perf_counter()
+    nc, ys = scan_onebuf(static_c, carry0, dbuf, key, layout)
+    jax.block_until_ready(ys["best"])
+    t3 = time.perf_counter()
+    best = np.asarray(ys["best"])
+    t4 = time.perf_counter()
+    print(f"r{r}: pack={t1-t0:.3f} upload={t2-t1:.3f} exec={t3-t2:.3f} readback={t4-t3:.3f} buf={buf.nbytes//1024}KB", flush=True)

@@ -1,0 +1,43 @@
+import os, sys, time
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax.numpy as jnp
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops.batch import CARRY_KEYS, _pack_stacked, _scan_batch_packed
+from kubernetes_tpu.ops.kernel import DEFAULT_WEIGHTS
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+N, B = 5000, 100
+nodes, init_pods = synth_cluster(N, pods_per_node=2)
+enc = ClusterEncoding(); enc.set_cluster(nodes, init_pods)
+pe = PodEncoder(enc)
+pods = synth_pending_pods(3*B, spread=True)
+for q in pods: pe.encode(q)
+c = enc.device_state()
+key = tuple(sorted(DEFAULT_WEIGHTS.items()))
+static_c = {k: v for k, v in c.items() if k not in CARRY_KEYS}
+carry0 = {k: c[k] for k in CARRY_KEYS}
+
+for r in range(3):
+    arrays = [{k: v for k, v in pe.encode(q).items() if not k.startswith("_")} for q in pods[r*B:(r+1)*B]]
+    t0 = time.perf_counter()
+    stacked = {k: np.stack([np.asarray(a[k]) for a in arrays]) for k in arrays[0]}
+    packed, layout = _pack_stacked(stacked)
+    t1 = time.perf_counter()
+    dev = {g: jnp.asarray(a) for g, a in packed.items()}
+    pidx = jnp.asarray(np.arange(B, dtype=np.int32))
+    valid = jnp.ones(B, bool)
+    jax.block_until_ready(dev)
+    t2 = time.perf_counter()
+    new_carry, ys = _scan_batch_packed(static_c, carry0, dev, pidx, valid, key, layout)
+    jax.block_until_ready(ys["best"])
+    t3 = time.perf_counter()
+    best = np.asarray(ys["best"])
+    t4 = time.perf_counter()
+    jax.block_until_ready(new_carry)
+    t5 = time.perf_counter()
+    print(f"r{r}: pack={t1-t0:.3f} upload={t2-t1:.3f} exec(block ys)={t3-t2:.3f} "
+          f"readback={t4-t3:.3f} block_carry={t5-t4:.3f} total={t5-t0:.3f}", flush=True)

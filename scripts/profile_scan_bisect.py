@@ -1,0 +1,46 @@
+import os, sys, time, functools
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax.numpy as jnp
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops.batch import CARRY_KEYS, _step
+from kubernetes_tpu.ops.kernel import DEFAULT_WEIGHTS
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+N, B = 5000, 50
+nodes, init_pods = synth_cluster(N, pods_per_node=2)
+enc = ClusterEncoding(); enc.set_cluster(nodes, init_pods)
+pe = PodEncoder(enc)
+pods = synth_pending_pods(B, spread=True)
+for q in pods: pe.encode(q)
+c = enc.device_state()
+arrays = [{k: v for k, v in pe.encode(q).items() if not k.startswith("_")} for q in pods]
+stacked = {k: jnp.asarray(np.stack([np.asarray(a[k]) for a in arrays])) for k in arrays[0]}
+slots = np.asarray([enc._pod_free[-1 - i] for i in range(B)], np.int32)
+xs = {"pod": stacked, "pidx": jnp.asarray(slots), "valid": jnp.ones(B, bool)}
+static_c = {k: v for k, v in c.items() if k not in CARRY_KEYS}
+carry = {k: c[k] for k in CARRY_KEYS}
+key = tuple(sorted(DEFAULT_WEIGHTS.items()))
+
+def bench(name, jf, *args):
+    out = jf(*args); jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = jf(*args); jax.block_until_ready(out)
+    print(f"{name}: {(time.perf_counter()-t0)*1000/B:.3f}ms/pod", flush=True)
+
+# A: args-passed static_c (exactly _scan_batch)
+@functools.partial(jax.jit, static_argnames=("weights_key",))
+def variant_args(static_c, carry, xs, weights_key):
+    step = functools.partial(_step, static_c, dict(weights_key))
+    return jax.lax.scan(step, carry, xs)
+bench("A_args_static_c", variant_args, static_c, carry, xs, key)
+
+# B: closure static_c, same _step
+@jax.jit
+def variant_closure(carry, xs):
+    step = functools.partial(_step, static_c, DEFAULT_WEIGHTS)
+    return jax.lax.scan(step, carry, xs)
+bench("B_closure_static_c", variant_closure, carry, xs)

@@ -1,0 +1,89 @@
+"""Isolate lax.scan overhead vs carry-update overhead (dev tool)."""
+import os, sys, time
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax.numpy as jnp
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops import kernel as K
+from kubernetes_tpu.ops.batch import CARRY_KEYS
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+N = int(os.environ.get("BENCH_NODES", "5000"))
+B = 50
+nodes, init_pods = synth_cluster(N, pods_per_node=2)
+enc = ClusterEncoding(); enc.set_cluster(nodes, init_pods)
+pe = PodEncoder(enc)
+pods = synth_pending_pods(B, spread=True)
+for q in pods: pe.encode(q)
+c = enc.device_state()
+arrays = [{k: v for k, v in pe.encode(q).items() if not k.startswith("_")} for q in pods]
+stacked = {k: jnp.asarray(np.stack([np.asarray(a[k]) for a in arrays])) for k in arrays[0]}
+
+def bench(name, jf, *args):
+    out = jf(*args); jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = jf(*args); jax.block_until_ready(out)
+    print(f"{name}: {(time.perf_counter()-t0)*1000/B:.2f}ms/pod", flush=True)
+
+# 1: scan, no carry mutation (pure map over pods)
+@jax.jit
+def scan_nocarry(c, xs):
+    def step(carry, p):
+        out = K.schedule_pod(c, p)
+        return carry, jnp.argmax(out["total"])
+    return jax.lax.scan(step, 0, xs)
+bench("scan_nocarry", scan_nocarry, c, stacked)
+
+# 2: vmap over pods (no sequencing)
+@jax.jit
+def vmapped(c, xs):
+    return jax.vmap(lambda p: jnp.argmax(K.schedule_pod(c, p)["total"]))(xs)
+bench("vmap", vmapped, c, stacked)
+
+# 3: scan with ONLY the small-resource carry (no pod-row carry)
+@jax.jit
+def scan_rescarry(c, xs):
+    carry0 = {k: c[k] for k in ("requested", "nz_requested", "pod_count")}
+    def step(carry, p):
+        c2 = dict(c); c2.update(carry)
+        out = K.schedule_pod(c2, p)
+        best = jnp.argmax(out["total"])
+        add = (out["total"][best] >= 0).astype(jnp.int64)
+        carry = {
+            "requested": carry["requested"].at[best].add(p["req"] * add),
+            "nz_requested": carry["nz_requested"].at[best].add(p["nz_req"] * add),
+            "pod_count": carry["pod_count"].at[best].add(add.astype(jnp.int32)),
+        }
+        return carry, best
+    return jax.lax.scan(step, carry0, xs)
+bench("scan_rescarry", scan_rescarry, c, stacked)
+
+# 4: full carry (current schedule_batch shape)
+@jax.jit
+def scan_full(c, xs):
+    carry0 = {k: c[k] for k in CARRY_KEYS}
+    static_c = {k: v for k, v in c.items() if k not in CARRY_KEYS}
+    def step(carry, x):
+        c2 = dict(static_c); c2.update(carry)
+        out = K.schedule_pod(c2, x)
+        best = jnp.argmax(out["total"]).astype(jnp.int32)
+        feasible = out["total"][best] >= 0
+        add = feasible.astype(jnp.int64)
+        carry = dict(carry)
+        carry["requested"] = carry["requested"].at[best].add(x["req"] * add)
+        carry["nz_requested"] = carry["nz_requested"].at[best].add(x["nz_req"] * add)
+        carry["pod_count"] = carry["pod_count"].at[best].add(add.astype(jnp.int32))
+        pidx = jnp.int32(0)
+        carry["pvalid"] = carry["pvalid"].at[pidx].set(feasible)
+        carry["ppair"] = carry["ppair"].at[pidx].set(x["self_ppair"])
+        carry["pkey"] = carry["pkey"].at[pidx].set(x["self_pkey"])
+        carry["pnode"] = carry["pnode"].at[pidx].set(jnp.where(feasible, best, 0))
+        carry["pns"] = carry["pns"].at[pidx].set(x["self_ns"])
+        carry["pterm"] = carry["pterm"].at[pidx].set(False)
+        return carry, best
+    return jax.lax.scan(step, carry0, xs)
+bench("scan_fullcarry", scan_full, c, stacked)

@@ -1,0 +1,26 @@
+import os, sys, time
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax.numpy as jnp
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops.batch import schedule_batch
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+N = int(os.environ.get("BENCH_NODES", "5000"))
+B = int(os.environ.get("BENCH_BATCH", "100"))
+nodes, init_pods = synth_cluster(N, pods_per_node=2)
+enc = ClusterEncoding(); enc.set_cluster(nodes, init_pods)
+pe = PodEncoder(enc)
+pods = synth_pending_pods(3 * B, spread=True)
+for q in pods: pe.encode(q)
+c = enc.device_state()
+arrays = [{k: v for k, v in pe.encode(q).items() if not k.startswith("_")} for q in pods]
+slots = [enc._pod_free[-1 - i] for i in range(B)]
+for r in range(3):
+    t0 = time.perf_counter()
+    decisions, carry = schedule_batch(c, arrays[r*B:(r+1)*B], slots)
+    jax.block_until_ready(carry)
+    print(f"round{r}: {(time.perf_counter()-t0)*1000/B:.2f}ms/pod", flush=True)

@@ -1,0 +1,233 @@
+"""Config API, extender protocol, and factory assembly tests.
+
+Mirrors pkg/scheduler/apis/config/validation tests and the extender
+integration tier (test/integration/scheduler/extender_test.go — a live
+HTTP extender filtering/prioritizing real scheduling cycles)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Clientset, SharedInformerFactory
+from kubernetes_tpu.scheduler.apis.config import (
+    ConfigError,
+    Extender,
+    KubeSchedulerConfiguration,
+    KubeSchedulerProfile,
+    Plugin,
+    PluginSet,
+    Plugins,
+    default_configuration,
+    load_configuration,
+    merged_plugins_for_profile,
+    validate_configuration,
+)
+from kubernetes_tpu.scheduler.extender import HTTPExtender
+from kubernetes_tpu.scheduler.factory import create_scheduler
+from kubernetes_tpu.testing.synth import make_node, make_pod
+
+# ---------------------------------------------------------------------------
+# config
+
+
+def test_default_config_valid():
+    cfg = default_configuration()
+    validate_configuration(cfg)
+    merged = merged_plugins_for_profile(cfg.profiles[0])
+    assert ("NodeResourcesFit", 1) in merged["filter"]
+    assert ("PodTopologySpread", 2) in merged["score"]
+
+
+def test_merge_disable_star_and_enable():
+    profile = KubeSchedulerProfile(
+        plugins=Plugins(
+            score=PluginSet(
+                enabled=[Plugin("NodeResourcesLeastAllocated", 5)],
+                disabled=[Plugin("*")],
+            )
+        )
+    )
+    merged = merged_plugins_for_profile(profile)
+    assert merged["score"] == [("NodeResourcesLeastAllocated", 5)]
+    # other points untouched
+    assert any(n == "NodeResourcesFit" for n, _ in merged["filter"])
+
+
+def test_validation_rejects_bad_configs():
+    cfg = default_configuration()
+    cfg.percentage_of_nodes_to_score = 150
+    with pytest.raises(ConfigError):
+        validate_configuration(cfg)
+    cfg = default_configuration()
+    cfg.profiles.append(KubeSchedulerProfile())  # duplicate name
+    with pytest.raises(ConfigError):
+        validate_configuration(cfg)
+    cfg = default_configuration()
+    cfg.profiles[0].backend = "gpu"
+    with pytest.raises(ConfigError):
+        validate_configuration(cfg)
+    cfg = default_configuration()
+    cfg.profiles[0].plugins = Plugins(queue_sort=PluginSet(disabled=[Plugin("*")]))
+    with pytest.raises(ConfigError):
+        validate_configuration(cfg)
+
+
+def test_load_configuration_yaml():
+    text = """
+apiVersion: kubescheduler.config.k8s.io/v1beta1
+kind: KubeSchedulerConfiguration
+percentageOfNodesToScore: 50
+podInitialBackoffSeconds: 2
+profiles:
+  - schedulerName: tpu-scheduler
+    backend: tpu
+    plugins:
+      score:
+        disabled:
+          - name: ImageLocality
+    pluginConfig:
+      - name: NodeResourcesFit
+        args:
+          ignoredResources: ["example.com/foo"]
+extenders: []
+"""
+    cfg = load_configuration(text)
+    assert cfg.percentage_of_nodes_to_score == 50
+    assert cfg.profiles[0].scheduler_name == "tpu-scheduler"
+    merged = merged_plugins_for_profile(cfg.profiles[0])
+    assert not any(n == "ImageLocality" for n, _ in merged["score"])
+    assert cfg.profiles[0].plugin_config["NodeResourcesFit"]["ignoredResources"] == [
+        "example.com/foo"
+    ]
+
+
+def test_factory_tpu_weights_follow_profile():
+    api = APIServer()
+    cs = Clientset(api)
+    factory = SharedInformerFactory(cs)
+    cfg = default_configuration()
+    cfg.profiles[0].plugins = Plugins(
+        score=PluginSet(enabled=[Plugin("PodTopologySpread", 7)],
+                        disabled=[Plugin("ImageLocality")])
+    )
+    sched = create_scheduler(cs, factory, cfg)
+    assert sched.tpu.weights["pts"] == 7
+    assert sched.tpu.weights["image"] == 0
+    cfg2 = default_configuration()
+    cfg2.extenders = [Extender(url_prefix="http://localhost:9", filter_verb="filter")]
+    with pytest.raises(ConfigError):
+        create_scheduler(cs, factory, cfg2)
+    cfg2.profiles[0].backend = "oracle"
+    sched2 = create_scheduler(cs, factory, cfg2)
+    assert len(sched2.algorithm.extenders) == 1
+
+
+# ---------------------------------------------------------------------------
+# extender protocol against a live HTTP server
+
+
+class _ExtenderHandler(BaseHTTPRequestHandler):
+    calls = []
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        self.calls.append((self.path, body))
+        if self.path.endswith("/filter"):
+            names = [
+                n["metadata"]["name"] for n in body["nodes"]["items"]
+            ]
+            kept = [n for n in body["nodes"]["items"] if n["metadata"]["name"] != "node-0"]
+            resp = {
+                "nodes": {"items": kept},
+                "failedNodes": {"node-0": "extender says no"} if "node-0" in names else {},
+            }
+        elif self.path.endswith("/prioritize"):
+            resp = [
+                {"host": n["metadata"]["name"],
+                 "score": 10 if n["metadata"]["name"] == "node-2" else 0}
+                for n in body["nodes"]["items"]
+            ]
+        else:
+            resp = {"error": f"unknown verb {self.path}"}
+        data = json.dumps(resp).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def extender_server():
+    _ExtenderHandler.calls = []
+    server = HTTPServer(("127.0.0.1", 0), _ExtenderHandler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def test_http_extender_roundtrip(extender_server):
+    ext = HTTPExtender(
+        Extender(
+            url_prefix=extender_server,
+            filter_verb="filter",
+            prioritize_verb="prioritize",
+            weight=3,
+        )
+    )
+    nodes = [make_node(f"node-{i}") for i in range(3)]
+    pod = make_pod("p", cpu="100m")
+    kept, failed = ext.filter(pod, nodes)
+    assert [n.metadata.name for n in kept] == ["node-1", "node-2"]
+    assert failed == {"node-0": "extender says no"}
+    scores, weight = ext.prioritize(pod, nodes)
+    assert weight == 3
+    assert {s["host"]: s["score"] for s in scores}["node-2"] == 10
+
+
+def test_extender_in_live_scheduling(extender_server):
+    """Oracle loop + extender: node-0 excluded by Filter, node-2 boosted by
+    Prioritize (extender_test.go pattern)."""
+    api = APIServer()
+    cs = Clientset(api)
+    for i in range(3):
+        cs.nodes.create(make_node(f"node-{i}", labels={v1.LABEL_HOSTNAME: f"node-{i}"}))
+    factory = SharedInformerFactory(cs)
+    cfg = default_configuration()
+    cfg.profiles[0].backend = "oracle"
+    cfg.extenders = [
+        Extender(
+            url_prefix=extender_server,
+            filter_verb="filter",
+            prioritize_verb="prioritize",
+            weight=100,  # dominate in-tree scores
+        )
+    ]
+    sched = create_scheduler(cs, factory, cfg)
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    try:
+        sched.start()
+        cs.pods.create(make_pod("p", namespace="default", cpu="100m"))
+        deadline = time.monotonic() + 20
+        pod = None
+        while time.monotonic() < deadline:
+            pod = cs.pods.get("p", "default")
+            if pod.spec.node_name:
+                break
+            time.sleep(0.1)
+        assert pod.spec.node_name == "node-2"
+    finally:
+        sched.stop()
+        factory.stop()

@@ -1,0 +1,93 @@
+"""Metrics registry, events, leader election, trace tests.
+
+Reference models: component-base/metrics tests, client-go record/
+leaderelection tests (leaderelection_test.go — acquire, renew, lose on
+expiry, second elector takes over)."""
+
+from __future__ import annotations
+
+import time
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Clientset
+from kubernetes_tpu.client.events import EventRecorder
+from kubernetes_tpu.client.leaderelection import LeaderElectionConfig, LeaderElector
+from kubernetes_tpu.utils.metrics import Counter, Gauge, Histogram, Registry
+from kubernetes_tpu.utils.trace import Trace
+
+
+def test_metrics_collect_and_expose():
+    reg = Registry()
+    c = reg.register(Counter("requests_total", "Total requests.", ("code",)))
+    c.inc(code="200")
+    c.inc(code="200")
+    c.inc(code="500")
+    g = reg.register(Gauge("pending", "Pending items.", ("queue",)))
+    g.set(7, queue="active")
+    h = reg.register(Histogram("latency_seconds", "Latency.", ()))
+    for val in (0.004, 0.02, 0.02, 3.0):
+        h.observe(val)
+    text = reg.expose()
+    assert 'requests_total{code="200"} 2.0' in text
+    assert 'pending{queue="active"} 7' in text
+    assert "latency_seconds_count 4" in text
+    assert h.percentile(50) <= 0.05
+    assert h.percentile(99) >= 2.5
+
+
+def test_event_recorder_aggregates():
+    api = APIServer()
+    cs = Clientset(api)
+    rec = EventRecorder(cs, "test-component")
+    pod = v1.Pod(metadata=v1.ObjectMeta(name="p", namespace="default"))
+    rec.event(pod, "Normal", "Scheduled", "assigned default/p to n1")
+    rec.event(pod, "Normal", "Scheduled", "assigned default/p to n1")
+    events, _ = cs.resource("events").list()
+    assert len(events) == 1
+    assert events[0].count == 2
+    rec.event(pod, "Warning", "FailedScheduling", "0/3 nodes")
+    events, _ = cs.resource("events").list()
+    assert len(events) == 2
+
+
+def test_leader_election_failover():
+    api = APIServer()
+    cs = Clientset(api)
+    log = []
+    fast = LeaderElectionConfig(
+        identity="a", lease_duration=1.0, renew_deadline=0.6, retry_period=0.2
+    )
+    ea = LeaderElector(
+        cs, fast, lambda: log.append("a-start"), lambda: log.append("a-stop")
+    )
+    ea.start()
+    assert ea.is_leader.wait(5)
+    assert ea.leader_identity == "a"
+    cfg_b = LeaderElectionConfig(
+        identity="b", lease_duration=1.0, renew_deadline=0.6, retry_period=0.2
+    )
+    eb = LeaderElector(
+        cs, cfg_b, lambda: log.append("b-start"), lambda: log.append("b-stop")
+    )
+    eb.start()
+    time.sleep(1.0)
+    assert not eb.is_leader.is_set(), "b must not steal a live lease"
+    ea.stop()  # a stops renewing; lease expires; b adopts
+    assert eb.is_leader.wait(10), "b must take over after expiry"
+    assert eb.leader_identity == "b"
+    eb.stop()
+    assert "a-start" in log and "b-start" in log
+
+
+def test_trace_threshold():
+    tr = Trace("cycle", pod="default/p")
+    tr.step("filter")
+    assert not tr.log_if_long(10.0)
+    import io
+
+    buf = io.StringIO()
+    time.sleep(0.02)
+    tr.step("score")
+    assert tr.log_if_long(0.01, out=buf)
+    assert "cycle" in buf.getvalue() and "score" in buf.getvalue()

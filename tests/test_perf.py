@@ -1,0 +1,30 @@
+"""scheduler_perf harness sanity (the reference's perf tier shrunk to CI
+size: test/integration/scheduler_perf/scheduler_test.go density test)."""
+
+from __future__ import annotations
+
+import pytest
+
+from kubernetes_tpu.perf import Workload, run_workload
+from kubernetes_tpu.perf.harness import PodTemplate
+
+
+@pytest.mark.parametrize("backend", ["tpu"])
+def test_density_small(backend):
+    w = Workload(
+        "density-ci", num_nodes=20, num_pods=60, backend=backend, timeout=120
+    )
+    r = run_workload(w)
+    assert r.throughput_avg > 0
+    assert r.num_pods == 60
+    d = r.to_dict()
+    assert {"name", "backend", "throughput_avg", "throughput_p50"} <= set(d)
+
+
+def test_spread_template_shapes():
+    t = PodTemplate(spread_zone=True, spread_hostname_hard=True)
+    pod = t.build("x")
+    assert len(pod.spec.topology_spread_constraints) == 2
+    t2 = PodTemplate(anti_affinity_zone=True)
+    pod2 = t2.build("y")
+    assert pod2.spec.affinity.pod_anti_affinity is not None

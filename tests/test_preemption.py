@@ -1,0 +1,168 @@
+"""DefaultPreemption: unit cases on the dry-run algorithm + the live loop.
+
+Mirrors the reference's table-driven plugin tests
+(pkg/scheduler/framework/plugins/defaultpreemption/default_preemption_test.go)
+and the preemption integration tier (test/integration/scheduler/
+preemption_test.go): high-priority pods evict the cheapest adequate set of
+lower-priority victims, PDB-protected victims are avoided when possible,
+and Never-policy pods never preempt.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Clientset, SharedInformerFactory
+from kubernetes_tpu.scheduler.framework.interface import CycleState
+from kubernetes_tpu.scheduler.framework.runtime import Framework
+from kubernetes_tpu.scheduler.framework.snapshot import Snapshot
+from kubernetes_tpu.scheduler.internal.nominator import PodNominator
+from kubernetes_tpu.scheduler.plugins.defaultpreemption import DefaultPreemption
+from kubernetes_tpu.scheduler.plugins.registry import (
+    default_plugins,
+    new_in_tree_registry,
+)
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.testing.synth import make_node, make_pod
+
+
+def _framework(snapshot, pdbs=None):
+    f = Framework(
+        new_in_tree_registry(),
+        plugins=default_plugins(),
+        snapshot_fn=lambda: snapshot,
+    )
+    f.nominator = PodNominator()
+    f.pdb_lister = lambda: list(pdbs or [])
+    return f
+
+
+def _post_filter(snapshot, pod, pdbs=None):
+    f = _framework(snapshot, pdbs)
+    state = CycleState()
+    st = f.run_pre_filter_plugins(state, pod)
+    assert st is None
+    statuses = {}
+    for ni in snapshot.list():
+        s = f.run_filter_plugins(state, pod, ni)
+        if s:
+            statuses[ni.node.metadata.name] = next(iter(s.values()))
+    plugin = f.plugins["DefaultPreemption"]
+    return plugin.post_filter(state, pod, statuses)
+
+
+def test_preempts_lowest_priority_victim():
+    nodes = [make_node("n0", cpu="4"), make_node("n1", cpu="4")]
+    low0 = make_pod("low0", cpu="3500m", node_name="n0", priority=1)
+    low1 = make_pod("low1", cpu="3500m", node_name="n1", priority=5)
+    snapshot = Snapshot.from_objects([low0, low1], nodes)
+    pending = make_pod("high", cpu="3", priority=100)
+    result, status = _post_filter(snapshot, pending)
+    assert status is not None and status.is_success()
+    # n0's victim has lower priority -> preferred (pickOneNode criterion 2)
+    assert result.nominated_node_name == "n0"
+    assert [p.metadata.name for p in result.victims] == ["low0"]
+
+
+def test_never_policy_does_not_preempt():
+    nodes = [make_node("n0", cpu="4")]
+    low = make_pod("low", cpu="3500m", node_name="n0", priority=1)
+    snapshot = Snapshot.from_objects([low], nodes)
+    pending = make_pod("high", cpu="3", priority=100)
+    pending.spec.preemption_policy = "Never"
+    result, status = _post_filter(snapshot, pending)
+    assert result is None
+    assert not status.is_success()
+
+
+def test_no_preemption_of_equal_or_higher_priority():
+    nodes = [make_node("n0", cpu="4")]
+    peer = make_pod("peer", cpu="3500m", node_name="n0", priority=100)
+    snapshot = Snapshot.from_objects([peer], nodes)
+    pending = make_pod("high", cpu="3", priority=100)
+    result, status = _post_filter(snapshot, pending)
+    assert result is None
+
+
+def test_minimal_victim_set_reprieve():
+    """Reprieve keeps victims whose removal isn't needed
+    (selectVictimsOnNode:633): 3 low pods of 1 cpu each; pending needs 2 —
+    only two 1-cpu victims die, the highest-priority one survives."""
+    nodes = [make_node("n0", cpu="4", pods=10)]
+    lows = [
+        make_pod(f"low{i}", cpu="1", node_name="n0", priority=i) for i in range(3)
+    ]
+    # node: 3 cpu used, 1 free; pending wants 2.9 -> needs 2 evictions
+    snapshot = Snapshot.from_objects(lows, nodes)
+    pending = make_pod("high", cpu="2900m", priority=50)
+    result, status = _post_filter(snapshot, pending)
+    assert status.is_success()
+    names = sorted(p.metadata.name for p in result.victims)
+    assert names == ["low0", "low1"], names  # low2 (highest) reprieved
+
+
+def test_pdb_protected_avoided():
+    """Two equivalent nodes; one victim is PDB-protected with 0 allowed
+    disruptions -> pick the other node (pickOneNode criterion 1)."""
+    nodes = [make_node("n0", cpu="4"), make_node("n1", cpu="4")]
+    a = make_pod("a", cpu="3500m", node_name="n0", priority=1,
+                 labels={"app": "guarded"})
+    b = make_pod("b", cpu="3500m", node_name="n1", priority=1)
+    snapshot = Snapshot.from_objects([a, b], nodes)
+    pdb = v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="pdb", namespace="default"),
+        spec=v1.PodDisruptionBudgetSpec(
+            selector=v1.LabelSelector(match_labels={"app": "guarded"})
+        ),
+        status=v1.PodDisruptionBudgetStatus(disruptions_allowed=0),
+    )
+    pending = make_pod("high", cpu="3", priority=100)
+    result, status = _post_filter(snapshot, pending, pdbs=[pdb])
+    assert status.is_success()
+    assert result.nominated_node_name == "n1"
+
+
+@pytest.mark.parametrize("backend", ["oracle", "tpu"])
+def test_preemption_end_to_end(backend):
+    """Live loop: cluster full of low-priority pods; a critical pod arrives,
+    victims get deleted, the pod binds (integration preemption_test.go)."""
+    api = APIServer()
+    cs = Clientset(api)
+    for i in range(2):
+        cs.nodes.create(make_node(f"node-{i}", cpu="4",
+                                  labels={v1.LABEL_HOSTNAME: f"node-{i}"}))
+    factory = SharedInformerFactory(cs)
+    sched = Scheduler(cs, factory, backend=backend)
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    try:
+        sched.start()
+        for i in range(2):
+            cs.pods.create(make_pod(f"low-{i}", namespace="default",
+                                    cpu="3500m", priority=1))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            pods, _ = cs.pods.list(namespace="default")
+            if all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.1)
+        cs.pods.create(make_pod("critical", namespace="default",
+                                cpu="3", priority=1000))
+        deadline = time.monotonic() + 30
+        critical = None
+        while time.monotonic() < deadline:
+            critical = cs.pods.get("critical", "default")
+            if critical.spec.node_name:
+                break
+            time.sleep(0.1)
+        assert critical.spec.node_name, "critical pod must preempt and bind"
+        pods, _ = cs.pods.list(namespace="default")
+        low_remaining = [p for p in pods if p.metadata.name.startswith("low")]
+        assert len(low_remaining) == 1, "exactly one victim evicted"
+    finally:
+        sched.stop()
+        factory.stop()
