@@ -1,0 +1,52 @@
+"""storage.k8s.io/v1 + scheduling.k8s.io/v1 types.
+
+Reference: staging/src/k8s.io/api/storage/v1/types.go (StorageClass,
+CSINode) and scheduling/v1/types.go (PriorityClass).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .types import ObjectMeta
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    volume_binding_mode: str = "Immediate"  # Immediate | WaitForFirstConsumer
+    kind: str = "StorageClass"
+    api_version: str = "storage.k8s.io/v1"
+
+
+@dataclass
+class CSINodeDriver:
+    name: str = ""
+    node_id: str = ""
+    count: Optional[int] = None  # allocatable volume count
+
+
+@dataclass
+class CSINodeSpec:
+    drivers: Optional[List[CSINodeDriver]] = None
+
+
+@dataclass
+class CSINode:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CSINodeSpec = field(default_factory=CSINodeSpec)
+    kind: str = "CSINode"
+    api_version: str = "storage.k8s.io/v1"
+
+
+@dataclass
+class PriorityClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    description: str = ""
+    preemption_policy: Optional[str] = None
+    kind: str = "PriorityClass"
+    api_version: str = "scheduling.k8s.io/v1"

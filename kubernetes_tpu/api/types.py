@@ -42,6 +42,7 @@ class ObjectMeta:
     labels: Optional[Dict[str, str]] = None
     annotations: Optional[Dict[str, str]] = None
     owner_references: Optional[List[OwnerReference]] = None
+    finalizers: Optional[List[str]] = None
 
 
 @dataclass
@@ -267,11 +268,24 @@ class PodCondition:
 
 
 @dataclass
+class ContainerStatus:
+    name: str = ""
+    ready: bool = False
+    restart_count: int = 0
+    image: str = ""
+    state: str = ""  # waiting | running | terminated
+    exit_code: Optional[int] = None
+
+
+@dataclass
 class PodStatus:
     phase: str = ""  # Pending | Running | Succeeded | Failed | Unknown
     conditions: Optional[List[PodCondition]] = None
     nominated_node_name: str = ""
     start_time: Optional[float] = None
+    pod_ip: str = ""
+    host_ip: str = ""
+    container_statuses: Optional[List[ContainerStatus]] = None
 
 
 @dataclass
@@ -333,6 +347,178 @@ class PodDisruptionBudget:
     status: PodDisruptionBudgetStatus = field(default_factory=PodDisruptionBudgetStatus)
     kind: str = "PodDisruptionBudget"
     api_version: str = "policy/v1beta1"
+
+
+# ---------------------------------------------------------------------------
+# Pod templates (workload controllers stamp pods from these;
+# reference: staging/src/k8s.io/api/core/v1/types.go PodTemplateSpec)
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+# ---------------------------------------------------------------------------
+# Service / Endpoints (reference: core/v1 Service, Endpoints)
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    protocol: str = "TCP"
+    port: int = 0
+    target_port: int = 0
+    node_port: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    selector: Optional[Dict[str, str]] = None
+    ports: Optional[List[ServicePort]] = None
+    cluster_ip: str = ""
+    type: str = "ClusterIP"  # ClusterIP | NodePort | LoadBalancer | ExternalName
+    session_affinity: str = ""
+    external_name: str = ""
+
+
+@dataclass
+class ServiceStatus:
+    load_balancer_ingress: Optional[List[str]] = None
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+    status: ServiceStatus = field(default_factory=ServiceStatus)
+    kind: str = "Service"
+    api_version: str = "v1"
+
+
+@dataclass
+class EndpointAddress:
+    ip: str = ""
+    node_name: str = ""
+    target_ref_name: str = ""  # pod name (flattened ObjectReference)
+    target_ref_namespace: str = ""
+
+
+@dataclass
+class EndpointPort:
+    name: str = ""
+    port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class EndpointSubset:
+    addresses: Optional[List[EndpointAddress]] = None
+    not_ready_addresses: Optional[List[EndpointAddress]] = None
+    ports: Optional[List[EndpointPort]] = None
+
+
+@dataclass
+class Endpoints:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subsets: Optional[List[EndpointSubset]] = None
+    kind: str = "Endpoints"
+    api_version: str = "v1"
+
+
+# ---------------------------------------------------------------------------
+# Namespace (reference: core/v1 Namespace; finalizer-driven deletion)
+
+
+@dataclass
+class NamespaceSpec:
+    finalizers: Optional[List[str]] = None
+
+
+@dataclass
+class NamespaceStatus:
+    phase: str = ""  # Active | Terminating
+
+
+@dataclass
+class Namespace:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NamespaceSpec = field(default_factory=NamespaceSpec)
+    status: NamespaceStatus = field(default_factory=NamespaceStatus)
+    kind: str = "Namespace"
+    api_version: str = "v1"
+
+
+# ---------------------------------------------------------------------------
+# ConfigMap (reference: core/v1 ConfigMap)
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Optional[Dict[str, str]] = None
+    kind: str = "ConfigMap"
+    api_version: str = "v1"
+
+
+# ---------------------------------------------------------------------------
+# Persistent volumes (subset VolumeBinding needs; reference: core/v1
+# PersistentVolume/PersistentVolumeClaim + volume node affinity)
+
+
+@dataclass
+class VolumeNodeAffinity:
+    required: Optional[NodeSelector] = None
+
+
+@dataclass
+class PersistentVolumeSpec:
+    capacity: Optional[Dict[str, str]] = None
+    access_modes: Optional[List[str]] = None
+    storage_class_name: str = ""
+    claim_ref_namespace: str = ""  # flattened ObjectReference to bound claim
+    claim_ref_name: str = ""
+    node_affinity: Optional[VolumeNodeAffinity] = None
+    persistent_volume_reclaim_policy: str = ""
+
+
+@dataclass
+class PersistentVolumeStatus:
+    phase: str = ""  # Pending | Available | Bound | Released | Failed
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeSpec = field(default_factory=PersistentVolumeSpec)
+    status: PersistentVolumeStatus = field(default_factory=PersistentVolumeStatus)
+    kind: str = "PersistentVolume"
+    api_version: str = "v1"
+
+
+@dataclass
+class PersistentVolumeClaimSpec:
+    access_modes: Optional[List[str]] = None
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    storage_class_name: Optional[str] = None
+    volume_name: str = ""
+
+
+@dataclass
+class PersistentVolumeClaimStatus:
+    phase: str = ""  # Pending | Bound | Lost
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeClaimSpec = field(default_factory=PersistentVolumeClaimSpec)
+    status: PersistentVolumeClaimStatus = field(
+        default_factory=PersistentVolumeClaimStatus
+    )
+    kind: str = "PersistentVolumeClaim"
+    api_version: str = "v1"
 
 
 LABEL_HOSTNAME = "kubernetes.io/hostname"

@@ -63,6 +63,7 @@ class ResourceInfo:
 
 
 def _default_resources() -> Tuple["ResourceInfo", ...]:
+    from ..api import apps, batch, storage
     from ..client.events import Event
 
     return (
@@ -71,6 +72,21 @@ def _default_resources() -> Tuple["ResourceInfo", ...]:
         ResourceInfo("poddisruptionbudgets", v1.PodDisruptionBudget, True),
         ResourceInfo("events", Event, True),
         ResourceInfo("leases", v1.Lease, True),
+        ResourceInfo("services", v1.Service, True),
+        ResourceInfo("endpoints", v1.Endpoints, True),
+        ResourceInfo("namespaces", v1.Namespace, False),
+        ResourceInfo("configmaps", v1.ConfigMap, True),
+        ResourceInfo("persistentvolumes", v1.PersistentVolume, False),
+        ResourceInfo("persistentvolumeclaims", v1.PersistentVolumeClaim, True),
+        ResourceInfo("replicasets", apps.ReplicaSet, True),
+        ResourceInfo("deployments", apps.Deployment, True),
+        ResourceInfo("daemonsets", apps.DaemonSet, True),
+        ResourceInfo("statefulsets", apps.StatefulSet, True),
+        ResourceInfo("jobs", batch.Job, True),
+        ResourceInfo("cronjobs", batch.CronJob, True),
+        ResourceInfo("storageclasses", storage.StorageClass, False),
+        ResourceInfo("csinodes", storage.CSINode, False),
+        ResourceInfo("priorityclasses", storage.PriorityClass, False),
     )
 
 
@@ -156,6 +172,11 @@ class APIServer:
             admit(resource, "CREATE", obj)
         meta.uid = meta.uid or str(uuid.uuid4())
         meta.creation_timestamp = meta.creation_timestamp or time.time()
+        if resource == "namespaces" and "kubernetes" not in (meta.finalizers or []):
+            # stamped server-side at create (pkg/registry/core/namespace/
+            # strategy.go PrepareForCreate) so a delete racing the namespace
+            # controller can never skip the content drain
+            meta.finalizers = (meta.finalizers or []) + ["kubernetes"]
         key = self._key(info, meta.namespace, meta.name)
         body = serde.to_dict(obj)
         try:
@@ -195,11 +216,69 @@ class APIServer:
         return self._stamp(info, body, rev)
 
     def delete(self, resource: str, name: str, namespace: str = "") -> None:
+        """Delete, honoring finalizers: an object with a non-empty
+        metadata.finalizers list is soft-deleted (deletionTimestamp stamped,
+        object kept) until the last finalizer is removed by its controller —
+        the reference's graceful-deletion/finalization flow
+        (apiserver/pkg/registry/generic/registry/store.go Delete →
+        deletionTimestamp + finalizer wait)."""
         info = self._info(resource)
+        key = self._key(info, namespace, name)
         try:
-            self.store.delete(self._key(info, namespace, name))
+            kvv = self.store.get(key)
         except kv.KeyNotFound as e:
             raise NotFound(str(e))
+        body = kvv.value
+        if body.get("metadata", {}).get("finalizers"):
+
+            def apply(b):
+                nb = dict(b)
+                meta = dict(nb.get("metadata", {}))
+                meta.setdefault("deletionTimestamp", time.time())
+                nb["metadata"] = meta
+                return nb
+
+            try:
+                self.store.guaranteed_update(key, apply)
+            except kv.KeyNotFound as e:
+                raise NotFound(str(e))
+            return
+        try:
+            self.store.delete(key)
+        except kv.KeyNotFound as e:
+            raise NotFound(str(e))
+
+    def remove_finalizer(self, resource: str, name: str, namespace: str, finalizer: str) -> None:
+        """Drop one finalizer; if the object is soft-deleted and none remain,
+        complete the deletion (the finalization endpoint's behavior)."""
+        info = self._info(resource)
+        key = self._key(info, namespace, name)
+        done = {}
+
+        def apply(body):
+            nb = dict(body)
+            meta = dict(nb.get("metadata", {}))
+            fins = [f for f in meta.get("finalizers", []) if f != finalizer]
+            if fins:
+                meta["finalizers"] = fins
+            else:
+                meta.pop("finalizers", None)
+            nb["metadata"] = meta
+            done["delete"] = not fins and meta.get("deletionTimestamp") is not None
+            return nb
+
+        try:
+            self.store.guaranteed_update(key, apply)
+            if done.get("delete"):
+                self.store.delete(key)
+        except kv.KeyNotFound:
+            pass
+
+    def resources(self) -> Tuple[ResourceInfo, ...]:
+        """Registered resource infos (discovery — the namespace controller
+        and GC enumerate these the way the reference uses the discovery
+        client + metadata informers)."""
+        return tuple(self._resources.values())
 
     def list(
         self,

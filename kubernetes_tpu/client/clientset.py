@@ -55,6 +55,21 @@ class Clientset:
         self.api = api
         self.pods = _PodClient(api, "pods")
         self.nodes = _ResourceClient(api, "nodes")
+        self.services = _ResourceClient(api, "services")
+        self.endpoints = _ResourceClient(api, "endpoints")
+        self.namespaces = _ResourceClient(api, "namespaces")
+        self.configmaps = _ResourceClient(api, "configmaps")
+        self.persistentvolumes = _ResourceClient(api, "persistentvolumes")
+        self.persistentvolumeclaims = _ResourceClient(api, "persistentvolumeclaims")
+        self.replicasets = _ResourceClient(api, "replicasets")
+        self.deployments = _ResourceClient(api, "deployments")
+        self.daemonsets = _ResourceClient(api, "daemonsets")
+        self.statefulsets = _ResourceClient(api, "statefulsets")
+        self.jobs = _ResourceClient(api, "jobs")
+        self.cronjobs = _ResourceClient(api, "cronjobs")
+        self.storageclasses = _ResourceClient(api, "storageclasses")
+        self.csinodes = _ResourceClient(api, "csinodes")
+        self.priorityclasses = _ResourceClient(api, "priorityclasses")
 
     def resource(self, name: str) -> _ResourceClient:
         return _ResourceClient(self.api, name)

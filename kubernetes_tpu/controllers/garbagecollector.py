@@ -1,0 +1,114 @@
+"""Garbage collector: ownerReference-based cascading deletion.
+
+Reference: pkg/controller/garbagecollector/garbagecollector.go — the GC
+builds a dependency graph from every resource's ownerReferences
+(graph_builder.go) and deletes dependents whose owners are gone
+(attemptToDeleteItem, :501: an object is garbage when all its owner
+references point to non-existent objects).
+
+The reference also handles foreground deletion via the
+`foregroundDeletion` finalizer; here deletion is background-only (owner
+deleted → dependents collected on the next scan), which is the default
+propagation policy.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..apiserver.server import APIError, APIServer, NotFound
+from .base import Controller
+
+KIND_TO_RESOURCE = {
+    "Pod": "pods",
+    "Node": "nodes",
+    "ReplicaSet": "replicasets",
+    "Deployment": "deployments",
+    "DaemonSet": "daemonsets",
+    "StatefulSet": "statefulsets",
+    "Job": "jobs",
+    "CronJob": "cronjobs",
+    "Service": "services",
+    "Endpoints": "endpoints",
+    "ConfigMap": "configmaps",
+    "PersistentVolumeClaim": "persistentvolumeclaims",
+}
+
+
+class GarbageCollector(Controller):
+    name = "garbagecollector"
+
+    def __init__(self, clientset, scan_interval: float = 0.2):
+        super().__init__(workers=1)
+        self.client = clientset
+        self.api: APIServer = clientset.api
+        self._interval = scan_interval
+        self._scan_thread: Optional[threading.Thread] = None
+        self._stop_scan = threading.Event()
+
+    def run(self) -> None:
+        super().run()
+        self._scan_thread = threading.Thread(target=self._scan_loop, daemon=True)
+        self._scan_thread.start()
+
+    def stop(self) -> None:
+        self._stop_scan.set()
+        super().stop()
+        if self._scan_thread is not None:
+            self._scan_thread.join(timeout=5)
+
+    def _scan_loop(self) -> None:
+        while not self._stop_scan.wait(self._interval):
+            try:
+                self.collect_once()
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
+
+    def _owner_exists(
+        self, namespace: str, ref, cache: Dict[Tuple[str, str, str], Optional[str]]
+    ) -> bool:
+        resource = KIND_TO_RESOURCE.get(ref.kind)
+        if resource is None:
+            return True  # unknown kinds are never collected (virtual nodes)
+        ck = (resource, namespace, ref.name)
+        if ck not in cache:
+            try:
+                obj = self.api.get(resource, ref.name, namespace)
+                cache[ck] = obj.metadata.uid
+            except APIError:
+                try:  # cluster-scoped owner fallback
+                    obj = self.api.get(resource, ref.name, "")
+                    cache[ck] = obj.metadata.uid
+                except APIError:
+                    cache[ck] = None
+        uid = cache[ck]
+        return uid is not None and (not ref.uid or uid == ref.uid)
+
+    def collect_once(self) -> int:
+        """One full-graph scan; returns number of objects deleted."""
+        deleted = 0
+        cache: Dict[Tuple[str, str, str], Optional[str]] = {}
+        for info in self.api.resources():
+            items, _ = self.api.list(info.name)
+            for obj in items:
+                refs = obj.metadata.owner_references or []
+                if not refs:
+                    continue
+                if any(
+                    self._owner_exists(obj.metadata.namespace, r, cache) for r in refs
+                ):
+                    continue
+                try:
+                    self.api.delete(
+                        info.name, obj.metadata.name, obj.metadata.namespace
+                    )
+                    deleted += 1
+                except NotFound:
+                    pass
+        return deleted
+
+    def sync(self, key: str) -> None:
+        self.collect_once()

@@ -1,0 +1,94 @@
+"""Controller manager: the kube-controller-manager equivalent.
+
+Reference: cmd/kube-controller-manager/app/controllermanager.go —
+NewControllerInitializers (:387) maps names to start funcs; Run (:174)
+leader-elects, builds the shared informer factory, starts every enabled
+loop. Here the initializers build from one clientset + informer factory
+and run as daemon threads.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..client.informer import SharedInformerFactory
+from ..client.leaderelection import LeaderElectionConfig, LeaderElector
+from .daemonset import DaemonSetController
+from .deployment import DeploymentController
+from .endpoints import EndpointsController
+from .garbagecollector import GarbageCollector
+from .job import JobController
+from .namespace import NamespaceController
+from .nodelifecycle import NodeLifecycleController
+from .replicaset import ReplicaSetController
+from .statefulset import StatefulSetController
+
+
+def new_controller_initializers() -> Dict[str, Callable]:
+    """controllermanager.go:387 NewControllerInitializers equivalent."""
+    return {
+        "replicaset": lambda cs, inf, opts: ReplicaSetController(cs, inf),
+        "deployment": lambda cs, inf, opts: DeploymentController(cs, inf),
+        "daemonset": lambda cs, inf, opts: DaemonSetController(cs, inf),
+        "statefulset": lambda cs, inf, opts: StatefulSetController(cs, inf),
+        "job": lambda cs, inf, opts: JobController(cs, inf),
+        "endpoint": lambda cs, inf, opts: EndpointsController(cs, inf),
+        "namespace": lambda cs, inf, opts: NamespaceController(cs, inf),
+        "garbagecollector": lambda cs, inf, opts: GarbageCollector(cs),
+        "nodelifecycle": lambda cs, inf, opts: NodeLifecycleController(
+            cs,
+            inf,
+            node_monitor_period=opts.get("node_monitor_period", 5.0),
+            node_monitor_grace_period=opts.get("node_monitor_grace_period", 40.0),
+        ),
+    }
+
+
+class ControllerManager:
+    def __init__(
+        self,
+        clientset,
+        controllers: Optional[List[str]] = None,
+        leader_elect: bool = False,
+        identity: str = "kcm",
+        **opts,
+    ):
+        self.client = clientset
+        self.informers = SharedInformerFactory(clientset)
+        self._opts = opts
+        inits = new_controller_initializers()
+        names = controllers if controllers is not None else list(inits)
+        self.controllers = {
+            name: inits[name](clientset, self.informers, opts) for name in names
+        }
+        self._elector: Optional[LeaderElector] = None
+        if leader_elect:
+            self._elector = LeaderElector(
+                clientset,
+                LeaderElectionConfig(
+                    lock_name="kube-controller-manager",
+                    lock_namespace="kube-system",
+                    identity=identity,
+                ),
+                on_started_leading=self._start_all,
+                on_stopped_leading=self.stop,
+            )
+
+    def run(self, wait_sync: float = 10.0) -> None:
+        self.informers.start()
+        self.informers.wait_for_cache_sync(wait_sync)
+        if self._elector is not None:
+            self._elector.start()
+        else:
+            self._start_all()
+
+    def _start_all(self) -> None:
+        for ctrl in self.controllers.values():
+            ctrl.run()
+
+    def stop(self) -> None:
+        for ctrl in self.controllers.values():
+            ctrl.stop()
+        self.informers.stop()
+        if self._elector is not None:
+            self._elector.stop()

@@ -43,15 +43,33 @@ def _unwrap_optional(tp: Any) -> Any:
     return tp
 
 
+_HINTS_CACHE: Dict[type, Dict[str, Any]] = {}
+
+
+def _resolved_hints(cls: type) -> Dict[str, Any]:
+    hints = _HINTS_CACHE.get(cls)
+    if hints is None:
+        hints = typing.get_type_hints(cls)
+        _HINTS_CACHE[cls] = hints
+    return hints
+
+
 def to_dict(obj: Any) -> Any:
     """Serialize a dataclass (or container of them) to JSON-compatible dicts."""
     if obj is None:
         return None
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        hints = _resolved_hints(type(obj))
         out: Dict[str, Any] = {}
         for f in dataclasses.fields(obj):
             v = getattr(obj, f.name)
-            if v is None or v == "" or v == 0 or v is False or v == [] or v == {}:
+            if v is None:
+                continue
+            # Optional fields mirror Go pointers: a present zero value (e.g.
+            # *int32 replicas = 0) is serialized, only nil is omitted.
+            if not _is_optional(hints.get(f.name, f.type)) and (
+                v == "" or v == 0 or v is False or v == [] or v == {}
+            ):
                 continue
             out[_json_key(f)] = to_dict(v)
         return out
