@@ -17,8 +17,16 @@ class StorageClass:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     provisioner: str = ""
     volume_binding_mode: str = "Immediate"  # Immediate | WaitForFirstConsumer
+    reclaim_policy: str = "Delete"  # Delete | Retain
+    # [{"matchLabelExpressions": [{"key": ..., "values": [...]}]}] — the
+    # TopologySelectorTerm shape provisioners honour (storage/v1 types.go
+    # AllowedTopologies).
+    allowed_topologies: Optional[List[dict]] = None
     kind: str = "StorageClass"
     api_version: str = "storage.k8s.io/v1"
+
+# Provisioner value that means "static provisioning only" (storage/v1).
+PROVISIONER_NO_PROVISIONER = "kubernetes.io/no-provisioner"
 
 
 @dataclass

@@ -224,29 +224,34 @@ class APIServer:
         deletionTimestamp + finalizer wait)."""
         info = self._info(resource)
         key = self._key(info, namespace, name)
-        try:
-            kvv = self.store.get(key)
-        except kv.KeyNotFound as e:
-            raise NotFound(str(e))
-        body = kvv.value
-        if body.get("metadata", {}).get("finalizers"):
-
-            def apply(b):
-                nb = dict(b)
-                meta = dict(nb.get("metadata", {}))
-                meta.setdefault("deletionTimestamp", time.time())
-                nb["metadata"] = meta
-                return nb
-
+        # The finalizer check and the write are guarded by the same
+        # mod_revision so a concurrent add/remove of the last finalizer
+        # can't strand a soft-deleted object or bypass finalization
+        # (store.go Delete's conditional txn).
+        for _ in range(16):
             try:
-                self.store.guaranteed_update(key, apply)
+                kvv = self.store.get(key)
             except kv.KeyNotFound as e:
                 raise NotFound(str(e))
-            return
-        try:
-            self.store.delete(key)
-        except kv.KeyNotFound as e:
-            raise NotFound(str(e))
+            body = kvv.value
+            try:
+                if body.get("metadata", {}).get("finalizers"):
+                    if body.get("metadata", {}).get("deletionTimestamp") is not None:
+                        return  # already soft-deleted; rewriting would just
+                        # bump the revision and storm the watchers
+                    nb = dict(body)
+                    meta = dict(nb.get("metadata", {}))
+                    meta["deletionTimestamp"] = time.time()
+                    nb["metadata"] = meta
+                    self.store.update(key, nb, expected_mod_revision=kvv.mod_revision)
+                else:
+                    self.store.delete(key, expected_mod_revision=kvv.mod_revision)
+                return
+            except kv.Conflict:
+                continue
+            except kv.KeyNotFound as e:
+                raise NotFound(str(e))
+        raise Conflict(f"{key}: too many conflicts in delete")
 
     def remove_finalizer(self, resource: str, name: str, namespace: str, finalizer: str) -> None:
         """Drop one finalizer; if the object is soft-deleted and none remain,
@@ -268,9 +273,19 @@ class APIServer:
             return nb
 
         try:
-            self.store.guaranteed_update(key, apply)
-            if done.get("delete"):
-                self.store.delete(key)
+            rev = self.store.guaranteed_update(key, apply)
+            # guarded completion: if another writer (e.g. adding a new
+            # finalizer) raced in after the removal, re-check before deleting
+            while done.get("delete"):
+                try:
+                    self.store.delete(key, expected_mod_revision=rev)
+                    break
+                except kv.Conflict:
+                    kvv = self.store.get(key)
+                    meta = kvv.value.get("metadata", {})
+                    if meta.get("finalizers") or meta.get("deletionTimestamp") is None:
+                        break  # no longer eligible for hard delete
+                    rev = kvv.mod_revision
         except kv.KeyNotFound:
             pass
 

@@ -40,6 +40,13 @@ class ControllerExpectations:
         with self._lock:
             self._exp[key] = (0, n, time.time())
 
+    def set_expectations(self, key: str, creates: int, deletes: int) -> None:
+        """controller_utils.go SetExpectations — one record for a sync that
+        issues both creates and deletes (setting them separately would
+        overwrite the first count)."""
+        with self._lock:
+            self._exp[key] = (creates, deletes, time.time())
+
     def creation_observed(self, key: str) -> None:
         self._bump(key, -1, 0)
 

@@ -169,15 +169,15 @@ class DaemonSetController(Controller):
                     deletes.extend(
                         sorted(pods, key=lambda p: p.metadata.creation_timestamp or 0)[1:]
                     )
+            if creates or deletes:
+                self.expectations.set_expectations(key, len(creates), len(deletes))
             if creates:
-                self.expectations.expect_creations(key, len(creates))
                 for node_name in creates:
                     try:
                         self.client.pods.create(self._new_pod(ds, node_name))
                     except Exception:  # noqa: BLE001
                         self.expectations.creation_observed(key)
             if deletes:
-                self.expectations.expect_deletions(key, len(deletes))
                 for pod in deletes:
                     try:
                         self.client.pods.delete(

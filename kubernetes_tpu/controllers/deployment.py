@@ -185,7 +185,12 @@ class DeploymentController(Controller):
         want = d.spec.replicas if d.spec.replicas is not None else 1
         surge, unavail = max_surge_unavailable(d, want)
         new_want = new_rs.spec.replicas or 0
-        # reconcileNewReplicaSet: grow new RS up to want, bounded so that the
+        # reconcileNewReplicaSet: a fully rolled-out Deployment whose
+        # .spec.replicas shrank scales the new RS straight down
+        if new_want > want:
+            self._scale_rs(new_rs, want)
+            return
+        # grow new RS up to want, bounded so that the
         # total pod count never exceeds want + maxSurge
         total = sum(rs.spec.replicas or 0 for rs in old_rses) + new_want
         if new_want < want:

@@ -20,6 +20,7 @@ from .garbagecollector import GarbageCollector
 from .job import JobController
 from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
+from .persistentvolume import PersistentVolumeController
 from .replicaset import ReplicaSetController
 from .statefulset import StatefulSetController
 
@@ -35,6 +36,9 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "endpoint": lambda cs, inf, opts: EndpointsController(cs, inf),
         "namespace": lambda cs, inf, opts: NamespaceController(cs, inf),
         "garbagecollector": lambda cs, inf, opts: GarbageCollector(cs),
+        "persistentvolume-binder": lambda cs, inf, opts: PersistentVolumeController(
+            cs, inf
+        ),
         "nodelifecycle": lambda cs, inf, opts: NodeLifecycleController(
             cs,
             inf,

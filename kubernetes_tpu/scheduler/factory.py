@@ -23,6 +23,7 @@ from .framework.runtime import Framework
 from .plugins.registry import new_in_tree_registry
 from .scheduler import Scheduler
 from .tpu_backend import TPUBackend
+from ..volume.binder import SchedulerVolumeBinder
 
 # score plugin name -> kernel weight key (ops/kernel.py DEFAULT_WEIGHTS)
 _KERNEL_WEIGHT_KEYS = {
@@ -84,6 +85,18 @@ def create_scheduler(
         extenders=[HTTPExtender(e) for e in cfg.extenders],
         parallelism=cfg.parallelism,
     )
+    # Volume subsystem wiring: informer-cache listers + API client for the
+    # binder (volume_binding.go New → SchedulerVolumeBinder).
+    pvc_inf = informer_factory.informer_for("persistentvolumeclaims")
+    pv_inf = informer_factory.informer_for("persistentvolumes")
+    sc_inf = informer_factory.informer_for("storageclasses")
+    csi_inf = informer_factory.informer_for("csinodes")
+    volume_binder = SchedulerVolumeBinder(
+        list_pvcs=pvc_inf.list,
+        list_pvs=pv_inf.list,
+        list_storage_classes=sc_inf.list,
+        client=clientset,
+    )
     framework = Framework(
         registry or new_in_tree_registry(),
         profile_name=profile.scheduler_name,
@@ -91,6 +104,12 @@ def create_scheduler(
         plugin_config=profile.plugin_config,
         snapshot_fn=lambda: sched.snapshot,
         parallelism=cfg.parallelism,
+        handle_extras={
+            "volume_binder": volume_binder,
+            "volume_listers": (pvc_inf.list, pv_inf.list),
+            "csi_node_lister": csi_inf.list,
+            "client": clientset,
+        },
     )
     framework.nominator = sched.nominator
     framework.pdb_lister = sched._list_pdbs

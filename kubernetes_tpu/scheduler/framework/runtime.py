@@ -16,12 +16,65 @@ RunScorePlugins x nodes entirely.
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ...api.types import Node, Pod, pod_key
 from . import interface as fwk
 from .interface import Code, CycleState, NodeScore, Status
 from .types import NodeInfo, PodInfo
+
+
+class WaitingPod:
+    """A pod parked at Permit (runtime/waiting_pods_map.go waitingPod):
+    each WAIT-returning plugin must Allow it, any may Reject; the binding
+    goroutine blocks in Framework.wait_on_permit until resolution or the
+    max plugin timeout."""
+
+    def __init__(self, pod: Pod, plugin_timeouts: Dict[str, float]):
+        self.pod = pod
+        self._pending = set(plugin_timeouts)
+        self._cv = threading.Condition()
+        self._resolved = False
+        self._status: Optional[Status] = None
+        self._deadline = time.monotonic() + max(plugin_timeouts.values())
+
+    def pending_plugins(self) -> List[str]:
+        with self._cv:
+            return sorted(self._pending)
+
+    def allow(self, plugin_name: str) -> None:
+        with self._cv:
+            self._pending.discard(plugin_name)
+            if not self._pending and not self._resolved:
+                self._resolved = True
+                self._status = None  # success
+            self._cv.notify_all()
+
+    def reject(self, plugin_name: str, msg: str) -> None:
+        with self._cv:
+            if not self._resolved:
+                self._resolved = True
+                self._status = Status.unschedulable(
+                    f"pod {self.pod.metadata.name!r} rejected while waiting at "
+                    f"Permit: {msg}"
+                )
+                self._status.failed_plugin = plugin_name
+            self._cv.notify_all()
+
+    def wait(self) -> Optional[Status]:
+        with self._cv:
+            while not self._resolved:
+                remaining = self._deadline - time.monotonic()
+                if remaining <= 0:
+                    self._resolved = True
+                    self._status = Status.unschedulable(
+                        f"pod {self.pod.metadata.name!r} timed out waiting at Permit"
+                    )
+                    break
+                self._cv.wait(timeout=min(remaining, 0.5))
+            return self._status
 
 PluginFactory = Callable[[Optional[dict], "Framework"], fwk.Plugin]
 
@@ -57,12 +110,24 @@ class Framework:
         plugin_config: Optional[Dict[str, dict]] = None,
         snapshot_fn: Optional[Callable[[], object]] = None,
         parallelism: int = 16,
+        handle_extras: Optional[Dict[str, object]] = None,
     ):
         self.profile_name = profile_name
         self.parallelism = parallelism
         self._snapshot_fn = snapshot_fn
         self._plugins_cfg = plugins or {}
         plugin_config = plugin_config or {}
+        # Handle surface consumed by plugins at construction time
+        # (interface.go:515 Handle: listers, clientset, volume binder).
+        self.volume_binder = None
+        self.volume_listers = None
+        self.csi_node_lister = None
+        self.client = None
+        for key, value in (handle_extras or {}).items():
+            setattr(self, key, value)
+        # Permit waiting-pods map (runtime/waiting_pods_map.go)
+        self._waiting_pods: Dict[str, WaitingPod] = {}
+        self._waiting_lock = threading.Lock()
 
         # Instantiate each referenced plugin exactly once (framework.go:276).
         needed: List[str] = []
@@ -267,19 +332,60 @@ class Framework:
         for pl in reversed(self.reserve_plugins):
             pl.unreserve(state, pod, node_name)
 
+    # Longest a Permit plugin may park a pod (framework.go maxTimeout 15min).
+    MAX_PERMIT_TIMEOUT = 15 * 60.0
+
     def run_permit_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Optional[Status]:
+        """framework.go:962 RunPermitPlugins: WAIT-returning plugins park the
+        pod in the waiting-pods map; the binding cycle then blocks in
+        wait_on_permit (framework.go:1015)."""
+        plugin_timeouts: Dict[str, float] = {}
         for pl in self.permit_plugins:
-            status, _timeout = pl.permit(state, pod, node_name)
+            status, timeout = pl.permit(state, pod, node_name)
             if not fwk.is_success(status):
+                if status.code == Code.WAIT:
+                    plugin_timeouts[pl.name] = min(
+                        timeout or self.MAX_PERMIT_TIMEOUT, self.MAX_PERMIT_TIMEOUT
+                    )
+                    continue
                 if status.is_unschedulable():
                     status.failed_plugin = pl.name
                     return status
-                if status.code == Code.WAIT:
-                    # Simplified WaitOnPermit: waiting handled by caller.
-                    status.failed_plugin = pl.name
-                    return status
                 return Status(Code.ERROR, [f"running Permit plugin {pl.name!r}: {status.message()}"])
+        if plugin_timeouts:
+            wp = WaitingPod(pod, plugin_timeouts)
+            with self._waiting_lock:
+                self._waiting_pods[pod_key(pod)] = wp
+            return Status(Code.WAIT)
         return None
+
+    def wait_on_permit(self, pod: Pod) -> Optional[Status]:
+        """framework.go:1015 WaitOnPermit: block the binding goroutine until
+        every waiting Permit plugin allows (or one rejects / times out)."""
+        with self._waiting_lock:
+            wp = self._waiting_pods.get(pod_key(pod))
+        if wp is None:
+            return None
+        try:
+            return wp.wait()
+        finally:
+            with self._waiting_lock:
+                self._waiting_pods.pop(pod_key(pod), None)
+
+    def get_waiting_pod(self, key: str) -> Optional[WaitingPod]:
+        with self._waiting_lock:
+            return self._waiting_pods.get(key)
+
+    def iterate_waiting_pods(self) -> List[WaitingPod]:
+        with self._waiting_lock:
+            return list(self._waiting_pods.values())
+
+    def reject_waiting_pod(self, key: str, plugin_name: str, msg: str) -> bool:
+        wp = self.get_waiting_pod(key)
+        if wp is None:
+            return False
+        wp.reject(plugin_name, msg)
+        return True
 
     def run_pre_bind_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Optional[Status]:
         for pl in self.pre_bind_plugins:

@@ -4,56 +4,12 @@ Reference: pkg/scheduler/framework/plugins/registry.go NewInTreeRegistry and
 pkg/scheduler/algorithmprovider/registry.go:71 getDefaultConfig (plugin sets
 and score weights of the default profile).
 
-Volume plugins (VolumeBinding/Restrictions/Zone/Limits) are registered as
-permissive placeholders until the volume subsystem lands; they occupy the
-same extension points so profiles stay shape-compatible.
 """
 
 from __future__ import annotations
 
-from typing import Optional
-
-from ..framework import interface as fwk
 from ..framework.runtime import Registry
 from . import interpodaffinity, nodebasic, noderesources, podtopologyspread
-
-
-class _NoopFilter(fwk.PreFilterPlugin, fwk.FilterPlugin, fwk.ReservePlugin, fwk.PreBindPlugin):
-    """Placeholder for not-yet-implemented plugins; passes at every point."""
-
-    def __init__(self, args=None, handle=None):
-        pass
-
-    def pre_filter(self, state, pod):
-        return None
-
-    def filter(self, state, pod, node_info):
-        return None
-
-    def reserve(self, state, pod, node_name):
-        return None
-
-    def pre_bind(self, state, pod, node_name):
-        return None
-
-
-def _noop(name: str):
-    cls = type(name, (_NoopFilter,), {"name": name})
-    return lambda args, handle: cls(args, handle)
-
-
-class _UnschedulablePostFilter(fwk.PostFilterPlugin):
-    """Stand-in until defaultpreemption lands (task: preemption)."""
-
-    name = "DefaultPreemption"
-
-    def __init__(self, args=None, handle=None):
-        pass
-
-    def post_filter(self, state, pod, filtered_node_status_map):
-        from ..framework.interface import Status
-
-        return None, Status.unschedulable("preemption not available")
 
 
 def new_in_tree_registry() -> Registry:
@@ -77,17 +33,22 @@ def new_in_tree_registry() -> Registry:
     from .defaultpreemption import DefaultPreemption
 
     r.register("DefaultPreemption", lambda a, h: DefaultPreemption(a, h))
-    # placeholders (volume subsystem pending)
-    for name in (
-        "VolumeBinding",
-        "VolumeRestrictions",
-        "VolumeZone",
-        "NodeVolumeLimits",
-        "EBSLimits",
-        "GCEPDLimits",
-        "AzureDiskLimits",
+    from .volumebinding import VolumeBinding
+    from .volumes import NodeVolumeLimits, VolumeRestrictions, VolumeZone
+
+    r.register("VolumeBinding", lambda a, h: VolumeBinding(a, h))
+    r.register("VolumeRestrictions", lambda a, h: VolumeRestrictions(a, h))
+    r.register("VolumeZone", lambda a, h: VolumeZone(a, h))
+    r.register("NodeVolumeLimits", lambda a, h: NodeVolumeLimits(a, h))
+    # In-tree per-cloud limit plugins share the CSI-translated counting
+    # path (nodevolumelimits/non_csi.go), each scoped to its own driver.
+    for name, driver in (
+        ("EBSLimits", "ebs.csi.aws.com"),
+        ("GCEPDLimits", "pd.csi.storage.gke.io"),
+        ("AzureDiskLimits", "disk.csi.azure.com"),
     ):
-        r.register(name, _noop(name))
+        cls = type(name, (NodeVolumeLimits,), {"name": name, "only_driver": driver})
+        r.register(name, (lambda c: lambda a, h: c(a, h))(cls))
     return r
 
 
@@ -102,6 +63,14 @@ def default_plugins() -> dict:
             ("PodTopologySpread", 1),
             ("InterPodAffinity", 1),
             ("VolumeBinding", 1),
+            # TPU-build deviation: these precompute their per-pod state in
+            # PreFilter so Filter is per-node work only (the reference
+            # recomputes inside Filter, csi.go/volume_zone.go)
+            ("VolumeZone", 1),
+            ("NodeVolumeLimits", 1),
+            ("EBSLimits", 1),
+            ("GCEPDLimits", 1),
+            ("AzureDiskLimits", 1),
         ],
         "filter": [
             ("NodeUnschedulable", 1),

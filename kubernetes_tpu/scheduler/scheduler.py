@@ -32,7 +32,7 @@ from ..client.informer import EventHandler, SharedInformerFactory, meta_namespac
 from ..utils import serde
 from . import metrics
 from .core import GenericScheduler, ScheduleResult
-from .framework.interface import CycleState, FitError
+from .framework.interface import Code, CycleState, FitError
 from .framework.runtime import Framework
 from .framework.snapshot import Snapshot
 from .internal.cache import SchedulerCache
@@ -82,16 +82,18 @@ class Scheduler:
         )
         self.framework.nominator = self.nominator
         self.framework.pdb_lister = self._list_pdbs
+        # The oracle algorithm exists in BOTH modes: TPU mode routes pods
+        # whose constraints the kernel can't express (PVC volumes) to it
+        self.algorithm = GenericScheduler(
+            percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+            extenders=self.extenders,
+            rng=self.rng,
+        )
         if backend == "tpu":
             self.tpu = tpu_backend or TPUBackend(rng=self.rng)
             self.cache.add_listener(self.tpu)
         else:
             self.tpu = None
-            self.algorithm = GenericScheduler(
-                percentage_of_nodes_to_score=percentage_of_nodes_to_score,
-                extenders=self.extenders,
-                rng=self.rng,
-            )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._binders = ThreadPoolExecutor(max_workers=8, thread_name_prefix="binder")
@@ -232,9 +234,25 @@ class Scheduler:
             return True
         return self.cache.is_assumed_pod(pod)
 
+    @staticmethod
+    def _needs_oracle(pod: v1.Pod) -> bool:
+        """Pods whose constraints live outside the TPU kernel (PVC volumes:
+        VolumeBinding/Zone/Restrictions are host-side plugins) take the
+        oracle path; the kernel would silently ignore those constraints."""
+        return any(
+            (vol.source or {}).get("persistentVolumeClaim")
+            for vol in pod.spec.volumes or []
+        )
+
     def _schedule_batch_tpu(self, infos: List) -> None:
         cycle = self.queue.scheduling_cycle
         todo = [i for i in infos if not self._skip(i.pod)]
+        if self.framework is not None:
+            oracle_infos = [i for i in todo if self._needs_oracle(i.pod)]
+            if oracle_infos:
+                todo = [i for i in todo if not self._needs_oracle(i.pod)]
+                for info in oracle_infos:
+                    self._schedule_one_oracle(info)
         results = self.tpu.schedule_many([i.pod for i in todo])
         by_key = {v1.pod_key(p): node for p, node in results}
         for info in todo:
@@ -264,7 +282,7 @@ class Scheduler:
         except FitError as fe:
             self._record_failure(info, cycle, fe.filtered_nodes_statuses, state)
             return
-        self._assume_and_bind(pod, result.suggested_host)
+        self._assume_and_bind(pod, result.suggested_host, state)
 
     # -- failure path: preemption then unschedulable queue -----------------
 
@@ -341,7 +359,9 @@ class Scheduler:
 
     # -- assume + binding cycle (scheduler.go:359,:540) --------------------
 
-    def _assume_and_bind(self, pod: v1.Pod, node_name: str) -> None:
+    def _assume_and_bind(
+        self, pod: v1.Pod, node_name: str, state: Optional[CycleState] = None
+    ) -> None:
         # deep copy (scheduler.go:445 pod.DeepCopy before assume): the queue
         # and informer cache must not see the assumed nodeName
         assumed = serde.from_dict(v1.Pod, serde.to_dict(pod))
@@ -350,12 +370,67 @@ class Scheduler:
             self.cache.assume_pod(assumed)
         except ValueError:
             return  # already in cache (informer raced us)
+        state = state if state is not None else CycleState()
+        fwk = self.framework
+        if fwk is not None:
+            # RunReservePluginsReserve (scheduler.go:508)
+            st = fwk.run_reserve_plugins_reserve(state, assumed, node_name)
+            if st is not None and not st.is_success():
+                fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
+                self._abort_binding(assumed, f"Reserve: {st.message()}")
+                return
+            # RunPermitPlugins (scheduler.go:520); WAIT parks the pod and the
+            # binding goroutine blocks in wait_on_permit
+            st = fwk.run_permit_plugins(state, assumed, node_name)
+            if st is not None and not st.is_success() and st.code != Code.WAIT:
+                fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
+                self._abort_binding(assumed, f"Permit: {st.message()}")
+                return
+            if st is not None and st.code == Code.WAIT:
+                # WAIT-parked pods must NOT occupy the bounded binder pool:
+                # a gang larger than the pool would deadlock (every worker
+                # blocked in wait_on_permit, the unblocking pod queued
+                # behind them). The reference runs one goroutine per binding
+                # cycle (scheduler.go:540); give waiting pods their own
+                # thread to match.
+                with self._inflight_lock:
+                    self._inflight += 1
+                threading.Thread(
+                    target=self._bind,
+                    args=(assumed, node_name, state),
+                    name=f"binder-wait-{assumed.metadata.name}",
+                    daemon=True,
+                ).start()
+                return
         with self._inflight_lock:
             self._inflight += 1
-        self._binders.submit(self._bind, assumed, node_name)
+        self._binders.submit(self._bind, assumed, node_name, state)
 
-    def _bind(self, assumed: v1.Pod, node_name: str) -> None:
+    def _abort_binding(self, assumed: v1.Pod, reason: str) -> None:
+        """Reserve/Permit/PreBind failure: forget the assumed pod and retry
+        it unassigned (scheduler.go:516 failure branches)."""
+        self.cache.forget_pod(assumed)
+        self.recorder.event(assumed, "Warning", "FailedScheduling", reason)
+        retry = serde.from_dict(v1.Pod, serde.to_dict(assumed))
+        retry.spec.node_name = ""
+        self.queue.add(retry)
+
+    def _bind(self, assumed: v1.Pod, node_name: str, state: CycleState) -> None:
         try:
+            fwk = self.framework
+            if fwk is not None:
+                # WaitOnPermit (framework.go:1015) then PreBind (volume
+                # binding API writes happen here, scheduler.go:540)
+                st = fwk.wait_on_permit(assumed)
+                if st is not None and not st.is_success():
+                    fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
+                    self._abort_binding(assumed, f"Permit: {st.message()}")
+                    return
+                st = fwk.run_pre_bind_plugins(state, assumed, node_name)
+                if st is not None and not st.is_success():
+                    fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
+                    self._abort_binding(assumed, f"PreBind: {st.message()}")
+                    return
             self.client.pods.bind(
                 assumed.metadata.namespace, assumed.metadata.name, node_name
             )
@@ -368,6 +443,8 @@ class Scheduler:
                 f"Successfully assigned {assumed.metadata.namespace}/"
                 f"{assumed.metadata.name} to {node_name}",
             )
+            if self.framework is not None:
+                self.framework.run_post_bind_plugins(state, assumed, node_name)
         except APIError:
             self.cache.forget_pod(assumed)
             # retry with the UNASSIGNED pod: keeping the failed nodeName

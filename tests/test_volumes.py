@@ -1,0 +1,461 @@
+"""Volume subsystem tests: binder matching, VolumeBinding plugin semantics,
+VolumeRestrictions/VolumeZone/NodeVolumeLimits filters, PV controller.
+
+Reference semantics: pkg/controller/volume/scheduling/scheduler_binder.go,
+pkg/scheduler/framework/plugins/volumebinding/volume_binding.go,
+volumerestrictions/volume_restrictions.go, volumezone/volume_zone.go,
+nodevolumelimits/csi.go, pkg/controller/volume/persistentvolume.
+"""
+
+import time
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.api.storage import CSINode, CSINodeDriver, CSINodeSpec, StorageClass
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers.persistentvolume import PersistentVolumeController
+from kubernetes_tpu.scheduler.framework.interface import Code, CycleState
+from kubernetes_tpu.scheduler.framework.types import NodeInfo
+from kubernetes_tpu.scheduler.plugins.volumebinding import (
+    ERR_REASON_UNBOUND_IMMEDIATE,
+    VolumeBinding,
+)
+from kubernetes_tpu.scheduler.plugins.volumes import (
+    NodeVolumeLimits,
+    VolumeRestrictions,
+    VolumeZone,
+)
+from kubernetes_tpu.volume.binder import (
+    SchedulerVolumeBinder,
+    find_matching_volume,
+    pv_matches_claim,
+)
+
+from .util import make_node, make_pod
+
+
+def mk_pv(name, capacity="10Gi", cls="", node=None, access=("ReadWriteOnce",),
+          phase="Available", labels=None):
+    affinity = None
+    if node:
+        affinity = v1.VolumeNodeAffinity(
+            required=v1.NodeSelector(
+                node_selector_terms=[
+                    v1.NodeSelectorTerm(
+                        match_expressions=[
+                            v1.NodeSelectorRequirement(
+                                key=v1.LABEL_HOSTNAME, operator="In", values=[node]
+                            )
+                        ]
+                    )
+                ]
+            )
+        )
+    return v1.PersistentVolume(
+        metadata=v1.ObjectMeta(name=name, labels=dict(labels or {})),
+        spec=v1.PersistentVolumeSpec(
+            capacity={"storage": capacity},
+            access_modes=list(access),
+            storage_class_name=cls,
+            node_affinity=affinity,
+        ),
+        status=v1.PersistentVolumeStatus(phase=phase),
+    )
+
+
+def mk_pvc(name, request="5Gi", cls="", volume_name="", namespace="default",
+           access=("ReadWriteOnce",)):
+    return v1.PersistentVolumeClaim(
+        metadata=v1.ObjectMeta(name=name, namespace=namespace),
+        spec=v1.PersistentVolumeClaimSpec(
+            access_modes=list(access),
+            resources=v1.ResourceRequirements(requests={"storage": request}),
+            storage_class_name=cls,
+            volume_name=volume_name,
+        ),
+    )
+
+
+def pod_with_pvc(name, *claims, namespace="default"):
+    pod = make_pod(name, namespace=namespace, cpu="100m")
+    pod.spec.volumes = [
+        v1.Volume(name=f"v{i}", source={"persistentVolumeClaim": {"claimName": c}})
+        for i, c in enumerate(claims)
+    ]
+    return pod
+
+
+def mk_binder(pvcs=(), pvs=(), classes=(), client=None):
+    return SchedulerVolumeBinder(
+        list_pvcs=lambda: list(pvcs),
+        list_pvs=lambda: list(pvs),
+        list_storage_classes=lambda: list(classes),
+        client=client,
+    )
+
+
+WFFC = StorageClass(
+    metadata=v1.ObjectMeta(name="wffc"),
+    provisioner="kubernetes.io/no-provisioner",
+    volume_binding_mode="WaitForFirstConsumer",
+)
+WFFC_PROV = StorageClass(
+    metadata=v1.ObjectMeta(name="wffc-prov"),
+    provisioner="tpu.example/provisioner",
+    volume_binding_mode="WaitForFirstConsumer",
+)
+IMMEDIATE = StorageClass(
+    metadata=v1.ObjectMeta(name="fast"),
+    provisioner="kubernetes.io/no-provisioner",
+)
+
+
+class TestPVMatching:
+    def test_smallest_fitting_pv_wins(self):
+        pvs = [mk_pv("big", "100Gi"), mk_pv("small", "5Gi"), mk_pv("tiny", "1Gi")]
+        got = find_matching_volume(mk_pvc("c", request="5Gi"), pvs)
+        assert got.metadata.name == "small"
+
+    def test_class_and_access_and_phase_gates(self):
+        claim = mk_pvc("c", cls="fast", access=("ReadWriteMany",))
+        assert not pv_matches_claim(mk_pv("p1", cls=""), claim)
+        assert not pv_matches_claim(mk_pv("p2", cls="fast"), claim)  # access modes
+        bound = mk_pv("p3", cls="fast", access=("ReadWriteMany",), phase="Bound")
+        assert not pv_matches_claim(bound, claim)
+        ok = mk_pv("p4", cls="fast", access=("ReadWriteMany", "ReadWriteOnce"))
+        assert pv_matches_claim(ok, claim)
+
+    def test_node_affinity_gate(self):
+        node_a = make_node("a", labels={v1.LABEL_HOSTNAME: "a"})
+        node_b = make_node("b", labels={v1.LABEL_HOSTNAME: "b"})
+        pv = mk_pv("p", node="a")
+        claim = mk_pvc("c")
+        assert pv_matches_claim(pv, claim, node_a)
+        assert not pv_matches_claim(pv, claim, node_b)
+
+
+class TestVolumeBindingPlugin:
+    def test_no_pvcs_skips(self):
+        pl = VolumeBinding(binder=mk_binder())
+        state = CycleState()
+        assert pl.pre_filter(state, make_pod("p")) is None
+        ni = NodeInfo()
+        ni.set_node(make_node("n"))
+        assert pl.filter(state, make_pod("p"), ni) is None
+
+    def test_missing_claim_unresolvable(self):
+        pl = VolumeBinding(binder=mk_binder())
+        status = pl.pre_filter(CycleState(), pod_with_pvc("p", "nope"))
+        assert status.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_unbound_immediate_claim_unresolvable(self):
+        claim = mk_pvc("c", cls="fast")
+        pl = VolumeBinding(binder=mk_binder(pvcs=[claim], classes=[IMMEDIATE]))
+        status = pl.pre_filter(CycleState(), pod_with_pvc("p", "c"))
+        assert status.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+        assert ERR_REASON_UNBOUND_IMMEDIATE in status.message()
+
+    def test_bound_claim_node_affinity(self):
+        pv = mk_pv("pv-a", node="a", phase="Bound")
+        claim = mk_pvc("c", volume_name="pv-a")
+        pl = VolumeBinding(binder=mk_binder(pvcs=[claim], pvs=[pv]))
+        state = CycleState()
+        pod = pod_with_pvc("p", "c")
+        assert pl.pre_filter(state, pod) is None
+        ni_a, ni_b = NodeInfo(), NodeInfo()
+        ni_a.set_node(make_node("a", labels={v1.LABEL_HOSTNAME: "a"}))
+        ni_b.set_node(make_node("b", labels={v1.LABEL_HOSTNAME: "b"}))
+        assert pl.filter(state, pod, ni_a) is None
+        status = pl.filter(state, pod, ni_b)
+        assert status.code == Code.UNSCHEDULABLE
+
+    def test_wffc_static_binding_and_assume_excludes_pv(self):
+        pv = mk_pv("pv-a", node="a", cls="wffc")
+        claim1 = mk_pvc("c1", cls="wffc")
+        claim2 = mk_pvc("c2", cls="wffc")
+        binder = mk_binder(pvcs=[claim1, claim2], pvs=[pv], classes=[WFFC])
+        pl = VolumeBinding(binder=binder)
+        node_a = make_node("a", labels={v1.LABEL_HOSTNAME: "a"})
+        ni = NodeInfo()
+        ni.set_node(node_a)
+
+        state1 = CycleState()
+        pod1 = pod_with_pvc("p1", "c1")
+        assert pl.pre_filter(state1, pod1) is None
+        assert pl.filter(state1, pod1, ni) is None
+        assert pl.reserve(state1, pod1, "a") is None
+
+        # second pod can't get the same PV and has no provisioner
+        state2 = CycleState()
+        pod2 = pod_with_pvc("p2", "c2")
+        assert pl.pre_filter(state2, pod2) is None
+        status = pl.filter(state2, pod2, ni)
+        assert status is not None and status.code == Code.UNSCHEDULABLE
+
+        # unreserve releases it
+        pl.unreserve(state1, pod1, "a")
+        state3 = CycleState()
+        assert pl.pre_filter(state3, pod2) is None
+        assert pl.filter(state3, pod2, ni) is None
+
+    def test_provisionable_class_passes_filter(self):
+        claim = mk_pvc("c", cls="wffc-prov")
+        pl = VolumeBinding(binder=mk_binder(pvcs=[claim], classes=[WFFC_PROV]))
+        state = CycleState()
+        pod = pod_with_pvc("p", "c")
+        ni = NodeInfo()
+        ni.set_node(make_node("a", labels={v1.LABEL_HOSTNAME: "a"}))
+        assert pl.pre_filter(state, pod) is None
+        assert pl.filter(state, pod, ni) is None
+
+    def test_prebind_binds_via_api(self):
+        api = APIServer()
+        cs = Clientset(api)
+        cs.storageclasses.create(WFFC)
+        cs.persistentvolumes.create(mk_pv("pv-a", node="a", cls="wffc"))
+        cs.persistentvolumeclaims.create(mk_pvc("c1", cls="wffc"))
+
+        def list_pvcs():
+            return cs.persistentvolumeclaims.list()[0]
+
+        def list_pvs():
+            return cs.persistentvolumes.list()[0]
+
+        binder = SchedulerVolumeBinder(
+            list_pvcs, list_pvs, lambda: cs.storageclasses.list()[0], client=cs
+        )
+        pl = VolumeBinding(binder=binder)
+        state = CycleState()
+        pod = pod_with_pvc("p1", "c1")
+        ni = NodeInfo()
+        ni.set_node(make_node("a", labels={v1.LABEL_HOSTNAME: "a"}))
+        assert pl.pre_filter(state, pod) is None
+        assert pl.filter(state, pod, ni) is None
+        assert pl.reserve(state, pod, "a") is None
+        assert pl.pre_bind(state, pod, "a") is None
+
+        claim = cs.persistentvolumeclaims.get("c1", "default")
+        pv = cs.persistentvolumes.get("pv-a")
+        assert claim.spec.volume_name == "pv-a"
+        assert claim.status.phase == "Bound"
+        assert pv.spec.claim_ref_name == "c1"
+        assert pv.status.phase == "Bound"
+
+    def test_prebind_provisions_dynamically(self):
+        api = APIServer()
+        cs = Clientset(api)
+        cs.storageclasses.create(WFFC_PROV)
+        cs.persistentvolumeclaims.create(mk_pvc("c1", cls="wffc-prov"))
+        binder = SchedulerVolumeBinder(
+            lambda: cs.persistentvolumeclaims.list()[0],
+            lambda: cs.persistentvolumes.list()[0],
+            lambda: cs.storageclasses.list()[0],
+            client=cs,
+        )
+        pl = VolumeBinding(binder=binder)
+        state = CycleState()
+        pod = pod_with_pvc("p1", "c1")
+        ni = NodeInfo()
+        ni.set_node(make_node("a", labels={v1.LABEL_HOSTNAME: "a"}))
+        assert pl.pre_filter(state, pod) is None
+        assert pl.filter(state, pod, ni) is None
+        assert pl.reserve(state, pod, "a") is None
+        assert pl.pre_bind(state, pod, "a") is None
+
+        claim = cs.persistentvolumeclaims.get("c1", "default")
+        assert claim.status.phase == "Bound"
+        pv = cs.persistentvolumes.get(claim.spec.volume_name)
+        assert pv.spec.claim_ref_name == "c1"
+        # provisioned PV is node-affine to the selected node
+        assert pv.spec.node_affinity.required.node_selector_terms[0].match_expressions[0].values == ["a"]
+
+
+class TestVolumeRestrictions:
+    def _ni_with(self, source):
+        ni = NodeInfo()
+        ni.set_node(make_node("n"))
+        existing = make_pod("existing", node_name="n")
+        existing.spec.volumes = [v1.Volume(name="v", source=source)]
+        ni.add_pod(existing)
+        return ni
+
+    def test_gce_pd_rw_conflict(self):
+        pl = VolumeRestrictions()
+        ni = self._ni_with({"gcePersistentDisk": {"pdName": "d1"}})
+        pod = make_pod("p")
+        pod.spec.volumes = [v1.Volume(name="v", source={"gcePersistentDisk": {"pdName": "d1"}})]
+        status = pl.filter(CycleState(), pod, ni)
+        assert status is not None and status.code == Code.UNSCHEDULABLE
+
+    def test_gce_pd_both_readonly_ok(self):
+        pl = VolumeRestrictions()
+        ni = self._ni_with({"gcePersistentDisk": {"pdName": "d1", "readOnly": True}})
+        pod = make_pod("p")
+        pod.spec.volumes = [
+            v1.Volume(name="v", source={"gcePersistentDisk": {"pdName": "d1", "readOnly": True}})
+        ]
+        assert pl.filter(CycleState(), pod, ni) is None
+
+    def test_aws_ebs_conflicts_even_readonly(self):
+        pl = VolumeRestrictions()
+        ni = self._ni_with({"awsElasticBlockStore": {"volumeID": "vol-1", "readOnly": True}})
+        pod = make_pod("p")
+        pod.spec.volumes = [
+            v1.Volume(name="v", source={"awsElasticBlockStore": {"volumeID": "vol-1", "readOnly": True}})
+        ]
+        status = pl.filter(CycleState(), pod, ni)
+        assert status is not None and status.code == Code.UNSCHEDULABLE
+
+    def test_different_disks_ok(self):
+        pl = VolumeRestrictions()
+        ni = self._ni_with({"gcePersistentDisk": {"pdName": "d1"}})
+        pod = make_pod("p")
+        pod.spec.volumes = [v1.Volume(name="v", source={"gcePersistentDisk": {"pdName": "d2"}})]
+        assert pl.filter(CycleState(), pod, ni) is None
+
+
+class _Handle:
+    def __init__(self, pvcs=(), pvs=(), csinodes=()):
+        self.volume_listers = (lambda: list(pvcs), lambda: list(pvs))
+        self.csi_node_lister = lambda: list(csinodes)
+
+
+class TestVolumeZone:
+    def test_zone_conflict(self):
+        pv = mk_pv("pv-z", labels={v1.LABEL_ZONE: "z1"})
+        claim = mk_pvc("c", volume_name="pv-z")
+        pl = VolumeZone(handle=_Handle(pvcs=[claim], pvs=[pv]))
+        pod = pod_with_pvc("p", "c")
+        ni_match, ni_other = NodeInfo(), NodeInfo()
+        ni_match.set_node(make_node("a", labels={v1.LABEL_ZONE: "z1"}))
+        ni_other.set_node(make_node("b", labels={v1.LABEL_ZONE: "z2"}))
+        assert pl.filter(CycleState(), pod, ni_match) is None
+        status = pl.filter(CycleState(), pod, ni_other)
+        assert status is not None and status.code == Code.UNSCHEDULABLE
+
+    def test_node_without_zone_labels_passes(self):
+        pv = mk_pv("pv-z", labels={v1.LABEL_ZONE: "z1"})
+        claim = mk_pvc("c", volume_name="pv-z")
+        pl = VolumeZone(handle=_Handle(pvcs=[claim], pvs=[pv]))
+        ni = NodeInfo()
+        ni.set_node(make_node("a"))
+        assert pl.filter(CycleState(), pod_with_pvc("p", "c"), ni) is None
+
+
+class TestNodeVolumeLimits:
+    def _csi_pod(self, name, *handles):
+        pod = make_pod(name)
+        pod.spec.volumes = [
+            v1.Volume(name=f"v{i}", source={"csi": {"driver": "d1", "volumeHandle": h}})
+            for i, h in enumerate(handles)
+        ]
+        return pod
+
+    def test_limit_enforced(self):
+        csinode = CSINode(
+            metadata=v1.ObjectMeta(name="n"),
+            spec=CSINodeSpec(drivers=[CSINodeDriver(name="d1", count=2)]),
+        )
+        pl = NodeVolumeLimits(handle=_Handle(csinodes=[csinode]))
+        ni = NodeInfo()
+        ni.set_node(make_node("n"))
+        ni.add_pod(self._csi_pod("existing", "h1", "h2"))
+        status = pl.filter(CycleState(), self._csi_pod("new", "h3"), ni)
+        assert status is not None and status.code == Code.UNSCHEDULABLE
+
+    def test_shared_volume_not_double_counted(self):
+        csinode = CSINode(
+            metadata=v1.ObjectMeta(name="n"),
+            spec=CSINodeSpec(drivers=[CSINodeDriver(name="d1", count=2)]),
+        )
+        pl = NodeVolumeLimits(handle=_Handle(csinodes=[csinode]))
+        ni = NodeInfo()
+        ni.set_node(make_node("n"))
+        ni.add_pod(self._csi_pod("existing", "h1", "h2"))
+        assert pl.filter(CycleState(), self._csi_pod("new", "h2"), ni) is None
+
+    def test_no_limit_driver_passes(self):
+        pl = NodeVolumeLimits(handle=_Handle())
+        ni = NodeInfo()
+        ni.set_node(make_node("n"))
+        assert pl.filter(CycleState(), self._csi_pod("new", "h1"), ni) is None
+
+
+class TestPVController:
+    def _run(self, cs):
+        factory = SharedInformerFactory(cs)
+        ctrl = PersistentVolumeController(cs, factory)
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        ctrl.run()
+        return factory, ctrl
+
+    def _wait(self, fn, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if fn():
+                return True
+            time.sleep(0.05)
+        return False
+
+    def test_immediate_claim_binds_to_matching_pv(self):
+        api = APIServer()
+        cs = Clientset(api)
+        cs.storageclasses.create(IMMEDIATE)
+        cs.persistentvolumes.create(mk_pv("pv-1", cls="fast"))
+        factory, ctrl = self._run(cs)
+        try:
+            cs.persistentvolumeclaims.create(mk_pvc("c1", cls="fast"))
+            assert self._wait(
+                lambda: cs.persistentvolumeclaims.get("c1", "default").status.phase == "Bound"
+            )
+            pv = cs.persistentvolumes.get("pv-1")
+            assert pv.spec.claim_ref_name == "c1"
+        finally:
+            ctrl.stop()
+            factory.stop()
+
+    def test_immediate_provisioning(self):
+        api = APIServer()
+        cs = Clientset(api)
+        cs.storageclasses.create(
+            StorageClass(
+                metadata=v1.ObjectMeta(name="fast-prov"),
+                provisioner="tpu.example/provisioner",
+            )
+        )
+        factory, ctrl = self._run(cs)
+        try:
+            cs.persistentvolumeclaims.create(mk_pvc("c1", cls="fast-prov"))
+            assert self._wait(
+                lambda: cs.persistentvolumeclaims.get("c1", "default").status.phase == "Bound"
+            )
+        finally:
+            ctrl.stop()
+            factory.stop()
+
+    def test_reclaim_delete_on_claim_removal(self):
+        api = APIServer()
+        cs = Clientset(api)
+        cs.storageclasses.create(IMMEDIATE)
+        pv = mk_pv("pv-1", cls="fast")
+        pv.spec.persistent_volume_reclaim_policy = "Delete"
+        cs.persistentvolumes.create(pv)
+        factory, ctrl = self._run(cs)
+        try:
+            cs.persistentvolumeclaims.create(mk_pvc("c1", cls="fast"))
+            assert self._wait(
+                lambda: cs.persistentvolumeclaims.get("c1", "default").status.phase == "Bound"
+            )
+            cs.persistentvolumeclaims.delete("c1", "default")
+            def gone():
+                try:
+                    cs.persistentvolumes.get("pv-1")
+                    return False
+                except Exception:
+                    return True
+            assert self._wait(gone)
+        finally:
+            ctrl.stop()
+            factory.stop()
