@@ -42,6 +42,7 @@ class PodTemplate:
     spread_zone: bool = False  # PodTopologySpread on zone, ScheduleAnyway
     spread_hostname_hard: bool = False  # maxSkew=1 DoNotSchedule on hostname
     anti_affinity_zone: bool = False  # required anti-affinity on zone
+    extended: Optional[Dict[str, str]] = None  # e.g. {"example.com/gpu": "1"}
 
     def build(self, name: str, namespace: str = "default") -> v1.Pod:
         constraints = []
@@ -85,6 +86,7 @@ class PodTemplate:
             labels=dict(self.labels),
             constraints=constraints or None,
             affinity=affinity,
+            extended=self.extended,
         )
 
 
@@ -102,6 +104,12 @@ class Workload:
     n_zones: int = 3
     max_batch: int = 128
     timeout: float = 600.0
+    # gang scheduling (north-star stress: 8-pod groups over GPU nodes):
+    # measured pods are grouped into gangs of this size via the
+    # Coscheduling Permit plugin; 0 disables
+    gang_size: int = 0
+    gang_permit_timeout: float = 60.0
+    node_extended: Optional[Dict[str, str]] = None  # extra node capacity
 
 
 @dataclass
@@ -116,6 +124,7 @@ class Result:
     throughput_p90: float
     throughput_p99: float
     attempts: int = 0
+    num_bound: int = 0  # measured pods actually bound (== num_pods on success)
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -141,16 +150,30 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
                     v1.LABEL_ZONE: f"zone-{i % w.n_zones}",
                     v1.LABEL_REGION: f"region-{i % w.n_zones % 2}",
                 },
+                extended=w.node_extended,
             )
         )
     factory = SharedInformerFactory(cs)
     sched = Scheduler(cs, factory, backend=w.backend, max_batch=w.max_batch)
-    if w.backend == "oracle":
+    if w.backend == "oracle" or w.gang_size > 1:
+        plugins = default_plugins_without("DefaultPreemption")
+        plugin_config = {}
+        if w.gang_size > 1:
+            # Coscheduling needs BOTH points: permit gates, reserve indexes
+            plugins["permit"] = [("Coscheduling", 1)]
+            plugins["reserve"] = plugins.get("reserve", []) + [("Coscheduling", 1)]
+            plugin_config["Coscheduling"] = {
+                "permit_timeout_seconds": w.gang_permit_timeout
+            }
         sched.framework = Framework(
             new_in_tree_registry(),
-            plugins=default_plugins_without("DefaultPreemption"),
+            plugins=plugins,
+            plugin_config=plugin_config,
             snapshot_fn=lambda: sched.snapshot,
+            handle_extras={"cache": sched.cache},
         )
+        sched.framework.nominator = sched.nominator
+        sched.framework.pdb_lister = sched._list_pdbs
     factory.start()
     if not factory.wait_for_cache_sync():
         raise RuntimeError("informer sync failed")
@@ -166,8 +189,17 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             sched.start()
 
         # measured pods
+        from ..scheduler.plugins.coscheduling import (
+            GROUP_LABEL,
+            MIN_AVAILABLE_LABEL,
+        )
+
         for i in range(w.num_pods):
-            cs.pods.create(w.template.build(f"measure-{i}"))
+            pod = w.template.build(f"measure-{i}")
+            if w.gang_size > 1:
+                pod.metadata.labels[GROUP_LABEL] = f"gang-{i // w.gang_size}"
+                pod.metadata.labels[MIN_AVAILABLE_LABEL] = str(w.gang_size)
+            cs.pods.create(pod)
         t0 = time.perf_counter()
         samples: List[float] = []
         last_bound, last_t = 0, t0
@@ -195,6 +227,7 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             throughput_p50=round(_percentile(samples, 50), 2),
             throughput_p90=round(_percentile(samples, 90), 2),
             throughput_p99=round(_percentile(samples, 99), 2),
+            num_bound=bound_measured,
         )
     finally:
         sched.stop()
@@ -237,5 +270,15 @@ STANDARD_WORKLOADS = {
         num_init_pods=1000,
         num_pods=1000,
         template=PodTemplate(spread_zone=True),
+    ),
+    # north-star gang-scheduling stress (BASELINE.md): 1000 groups x 8 pods,
+    # 4000 GPU nodes, Coscheduling Permit gate
+    "GangScheduling": Workload(
+        "GangScheduling",
+        num_nodes=4000,
+        num_pods=8000,
+        gang_size=8,
+        template=PodTemplate(extended={"example.com/gpu": "1"}),
+        node_extended={"example.com/gpu": "8"},
     ),
 }

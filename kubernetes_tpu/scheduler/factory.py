@@ -113,6 +113,7 @@ def create_scheduler(
     )
     framework.nominator = sched.nominator
     framework.pdb_lister = sched._list_pdbs
+    framework.cache = sched.cache
     sched.framework = framework
     sched.profile_name = profile.scheduler_name
     return sched

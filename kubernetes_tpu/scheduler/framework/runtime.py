@@ -123,6 +123,7 @@ class Framework:
         self.volume_listers = None
         self.csi_node_lister = None
         self.client = None
+        self.cache = None  # SchedulerCache (Coscheduling reservation counts)
         for key, value in (handle_extras or {}).items():
             setattr(self, key, value)
         # Permit waiting-pods map (runtime/waiting_pods_map.go)

@@ -217,6 +217,12 @@ class SchedulerCache:
         with self._lock:
             return len(self._pod_states)
 
+    def list_pods(self) -> List[v1.Pod]:
+        """All known pods, assumed included (cache.go ListPods). Used by the
+        Coscheduling Permit plugin to count reserved gang members."""
+        with self._lock:
+            return [s.pod for s in self._pod_states.values()]
+
     # -- snapshot (cache.go:203 UpdateSnapshot) ----------------------------
 
     def update_snapshot(self, snapshot: Snapshot) -> Snapshot:

@@ -1,0 +1,151 @@
+"""Coscheduling (gang scheduling) Permit plugin.
+
+The reference has no in-tree gang plugin — Permit + PodNominator were
+designed to host exactly this as an out-of-tree plugin (reference:
+pkg/scheduler/framework/interface.go:384 PermitPlugin; the
+sig-scheduling coscheduling plugin is the canonical consumer). Semantics
+implemented here:
+
+  * pods opt in with labels `scheduling.k8s.io/group-name` and
+    `scheduling.k8s.io/min-available`;
+  * Permit counts the gang's members that are already reserved (assumed
+    or bound in the scheduler cache) plus those parked at Permit; while
+    the count is below min-available the pod WAITs (holding its
+    reservation) up to the configured timeout;
+  * the member that completes the gang allows every waiting member;
+  * when a member is rejected or unreserved, the whole gang is rejected
+    so partial gangs don't hold capacity (coscheduling's PostFilter/
+    Unreserve behavior).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+from ...api import types as v1
+from ..framework import interface as fwk
+from ..framework.interface import CycleState, Status
+
+GROUP_LABEL = "scheduling.k8s.io/group-name"
+MIN_AVAILABLE_LABEL = "scheduling.k8s.io/min-available"
+
+DEFAULT_PERMIT_TIMEOUT = 60.0
+
+
+def pod_group(pod: v1.Pod) -> Tuple[str, int]:
+    """(group name, min available) — ("", 0) for non-gang pods."""
+    labels = pod.metadata.labels or {}
+    group = labels.get(GROUP_LABEL, "")
+    if not group:
+        return "", 0
+    try:
+        min_available = int(labels.get(MIN_AVAILABLE_LABEL, "0"))
+    except ValueError:
+        min_available = 0
+    return group, min_available
+
+
+class Coscheduling(fwk.PermitPlugin, fwk.ReservePlugin):
+    """Must be enabled at BOTH the permit and reserve extension points:
+    reserve maintains the per-group membership index and unreserve performs
+    the gang-wide rejection.
+
+    Known (tiny, self-healing) race: a member whose Permit wait just timed
+    out stays counted as reserved for the microseconds between its timeout
+    and its unreserve on the same binding thread; a gang completed inside
+    that window binds without the dead member, which then retries, sees the
+    bound members, and re-joins immediately."""
+
+    name = "Coscheduling"
+
+    def __init__(self, args=None, handle=None):
+        self._handle = handle
+        args = args or {}
+        self._timeout = float(args.get("permit_timeout_seconds", DEFAULT_PERMIT_TIMEOUT))
+        self._lock = threading.Lock()
+        # (namespace, group) -> set of pod keys that passed Reserve and were
+        # not unreserved — O(group) permit counting instead of scanning the
+        # whole scheduler cache per permit
+        self._groups: dict = {}
+
+    # -- counting ----------------------------------------------------------
+
+    def _reserved_members(self, group: str, namespace: str, prune: bool = False) -> int:
+        """Gang members holding a reservation (passed Reserve, not
+        unreserved): assumed or bound pods. With prune=True, members the
+        scheduler cache no longer knows (bound then deleted, forgotten) are
+        dropped first — done only when a count is about to complete a gang,
+        so the O(cache) scan is once per gang completion, not per permit."""
+        cache = getattr(self._handle, "cache", None)
+        with self._lock:
+            members = set(self._groups.get((namespace, group), ()))
+        if prune and cache is not None and members:
+            known = {v1.pod_key(p) for p in cache.list_pods()}
+            stale = members - known
+            if stale:
+                with self._lock:
+                    live = self._groups.get((namespace, group))
+                    if live is not None:
+                        live -= stale
+                members -= stale
+        return len(members)
+
+    def _waiting_members(self, group: str, namespace: str):
+        handle = self._handle
+        if handle is None or not hasattr(handle, "iterate_waiting_pods"):
+            return []
+        out = []
+        for wp in handle.iterate_waiting_pods():
+            if wp.pod.metadata.namespace != namespace:
+                continue
+            g, _ = pod_group(wp.pod)
+            if g == group:
+                out.append(wp)
+        return out
+
+    # -- Permit ------------------------------------------------------------
+
+    def permit(self, state: CycleState, pod: v1.Pod, node_name: str) -> Tuple[Optional[Status], float]:
+        group, min_available = pod_group(pod)
+        if not group or min_available <= 1:
+            return None, 0
+        namespace = pod.metadata.namespace
+        # the reserved index includes this pod (Reserve ran) and the waiting
+        # pods (they reserved too): total == index size
+        total = self._reserved_members(group, namespace)
+        if total >= min_available:
+            # about to complete: re-count with pruning so stale members
+            # (deleted after binding) can't fake a full gang
+            total = self._reserved_members(group, namespace, prune=True)
+        if total >= min_available:
+            for wp in self._waiting_members(group, namespace):
+                wp.allow(self.name)
+            return None, 0
+        return Status.wait(f"gang {group!r}: {total}/{min_available} members"), self._timeout
+
+    # -- Reserve/Unreserve -------------------------------------------------
+
+    def reserve(self, state: CycleState, pod: v1.Pod, node_name: str) -> Optional[Status]:
+        group, min_available = pod_group(pod)
+        if not group or min_available <= 1:
+            return None
+        with self._lock:
+            self._groups.setdefault(
+                (pod.metadata.namespace, group), set()
+            ).add(v1.pod_key(pod))
+        return None
+
+    def unreserve(self, state: CycleState, pod: v1.Pod, node_name: str) -> None:
+        """A member failed after Reserve: drop it from the index and reject
+        the whole waiting gang so a partial gang doesn't camp on capacity
+        until every timeout fires."""
+        group, min_available = pod_group(pod)
+        if not group or min_available <= 1:
+            return
+        with self._lock:
+            members = self._groups.get((pod.metadata.namespace, group))
+            if members is not None:
+                members.discard(v1.pod_key(pod))
+        for wp in self._waiting_members(group, pod.metadata.namespace):
+            wp.reject(self.name, f"gang member {pod.metadata.name!r} was unreserved")

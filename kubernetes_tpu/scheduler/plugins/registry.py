@@ -30,9 +30,11 @@ def new_in_tree_registry() -> Registry:
     r.register("PodTopologySpread", lambda a, h: podtopologyspread.PodTopologySpread(a, h))
     r.register("InterPodAffinity", lambda a, h: interpodaffinity.InterPodAffinity(a, h))
     r.register("DefaultBinder", lambda a, h: nodebasic.DefaultBinder(a, h))
+    from .coscheduling import Coscheduling
     from .defaultpreemption import DefaultPreemption
 
     r.register("DefaultPreemption", lambda a, h: DefaultPreemption(a, h))
+    r.register("Coscheduling", lambda a, h: Coscheduling(a, h))
     from .volumebinding import VolumeBinding
     from .volumes import NodeVolumeLimits, VolumeRestrictions, VolumeZone
 

@@ -82,6 +82,7 @@ class Scheduler:
         )
         self.framework.nominator = self.nominator
         self.framework.pdb_lister = self._list_pdbs
+        self.framework.cache = self.cache  # Coscheduling counts reservations
         # The oracle algorithm exists in BOTH modes: TPU mode routes pods
         # whose constraints the kernel can't express (PVC volumes) to it
         self.algorithm = GenericScheduler(

@@ -48,12 +48,15 @@ def make_pod(
     affinity: Optional[v1.Affinity] = None,
     constraints: Optional[List[v1.TopologySpreadConstraint]] = None,
     image: str = "registry.example/app:v1",
+    extended: Optional[Dict[str, str]] = None,
 ) -> v1.Pod:
     requests: Dict[str, str] = {}
     if cpu is not None:
         requests["cpu"] = cpu
     if memory is not None:
         requests["memory"] = memory
+    if extended:
+        requests.update(extended)
     return v1.Pod(
         metadata=v1.ObjectMeta(name=name, namespace=namespace, labels=dict(labels or {})),
         spec=v1.PodSpec(

@@ -1,0 +1,204 @@
+"""Gang scheduling (Coscheduling Permit plugin) tests.
+
+Reference: Permit extension point (pkg/scheduler/framework/interface.go:384)
++ waiting-pods map (framework/runtime/waiting_pods_map.go); gang semantics
+per the sig-scheduling coscheduling plugin the Permit API was built for.
+"""
+
+import time
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.scheduler.framework.runtime import Framework, WaitingPod
+from kubernetes_tpu.scheduler.framework.interface import CycleState
+from kubernetes_tpu.scheduler.plugins.coscheduling import (
+    GROUP_LABEL,
+    MIN_AVAILABLE_LABEL,
+    Coscheduling,
+)
+from kubernetes_tpu.scheduler.plugins.registry import (
+    default_plugins_without,
+    new_in_tree_registry,
+)
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+from .util import make_node, make_pod
+
+
+def gang_pod(name, group, min_avail, namespace="default", cpu="100m"):
+    return make_pod(
+        name,
+        namespace=namespace,
+        cpu=cpu,
+        labels={GROUP_LABEL: group, MIN_AVAILABLE_LABEL: str(min_avail)},
+    )
+
+
+class _FakeCache:
+    def __init__(self, pods=()):
+        self.pods = list(pods)
+
+    def list_pods(self):
+        return list(self.pods)
+
+
+class _FakeHandle:
+    def __init__(self, cache=None, waiting=()):
+        self.cache = cache or _FakeCache()
+        self._waiting = list(waiting)
+
+    def iterate_waiting_pods(self):
+        return list(self._waiting)
+
+
+class TestPermitUnit:
+    def test_non_gang_pod_passes(self):
+        pl = Coscheduling(handle=_FakeHandle())
+        status, timeout = pl.permit(CycleState(), make_pod("p"), "n")
+        assert status is None and timeout == 0
+
+    def test_incomplete_gang_waits(self):
+        p1 = gang_pod("g-0", "job-a", 3)
+        pl = Coscheduling(
+            args={"permit_timeout_seconds": 5},
+            handle=_FakeHandle(cache=_FakeCache([p1])),
+        )
+        pl.reserve(CycleState(), p1, "n")
+        status, timeout = pl.permit(CycleState(), p1, "n")
+        assert status is not None and status.code.name == "WAIT"
+        assert timeout == 5
+
+    def test_completing_member_allows_waiting(self):
+        p1, p2, p3 = (gang_pod(f"g-{i}", "job-a", 3) for i in range(3))
+        w1 = WaitingPod(p1, {"Coscheduling": 10})
+        w2 = WaitingPod(p2, {"Coscheduling": 10})
+        # cache sees all three assumed; two are parked at Permit
+        handle = _FakeHandle(cache=_FakeCache([p1, p2, p3]), waiting=[w1, w2])
+        pl = Coscheduling(handle=handle)
+        for p in (p1, p2, p3):
+            pl.reserve(CycleState(), p, "n")
+        status, _ = pl.permit(CycleState(), p3, "n")
+        assert status is None
+        assert w1.wait() is None  # allowed
+        assert w2.wait() is None
+
+    def test_unreserve_rejects_gang(self):
+        p1, p2 = (gang_pod(f"g-{i}", "job-a", 3) for i in range(2))
+        w1 = WaitingPod(p1, {"Coscheduling": 10})
+        handle = _FakeHandle(cache=_FakeCache([p1, p2]), waiting=[w1])
+        pl = Coscheduling(handle=handle)
+        for p in (p1, p2):
+            pl.reserve(CycleState(), p, "n")
+        pl.unreserve(CycleState(), p2, "n")
+        st = w1.wait()
+        assert st is not None and st.is_unschedulable()
+
+    def test_other_namespace_not_counted(self):
+        p1 = gang_pod("g-0", "job-a", 2)
+        other = gang_pod("g-x", "job-a", 2, namespace="other")
+        pl = Coscheduling(handle=_FakeHandle(cache=_FakeCache([p1, other])))
+        pl.reserve(CycleState(), p1, "n")
+        pl.reserve(CycleState(), other, "n")
+        status, _ = pl.permit(CycleState(), p1, "n")
+        assert status is not None  # only 1 member in this namespace
+
+    def test_stale_members_pruned_before_completion(self):
+        # two members reserved then deleted from the cache must not fake a
+        # full gang for a late third member
+        p1, p2, p3 = (gang_pod(f"g-{i}", "job-a", 3) for i in range(3))
+        handle = _FakeHandle(cache=_FakeCache([p3]))  # only p3 still known
+        pl = Coscheduling(handle=handle)
+        for p in (p1, p2, p3):
+            pl.reserve(CycleState(), p, "n")
+        status, _ = pl.permit(CycleState(), p3, "n")
+        assert status is not None and status.code.name == "WAIT"
+
+
+def _gang_scheduler(cs, permit_timeout=5.0):
+    factory = SharedInformerFactory(cs)
+    plugins = default_plugins_without("DefaultPreemption")
+    plugins["permit"] = [("Coscheduling", 1)]
+    plugins["reserve"] = plugins.get("reserve", []) + [("Coscheduling", 1)]
+    sched = Scheduler(cs, factory, backend="oracle")
+    sched.framework = Framework(
+        new_in_tree_registry(),
+        plugins=plugins,
+        plugin_config={
+            "Coscheduling": {"permit_timeout_seconds": permit_timeout}
+        },
+        snapshot_fn=lambda: sched.snapshot,
+        handle_extras={"cache": sched.cache},
+    )
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    return factory, sched
+
+
+def _bound_count(cs):
+    pods, _ = cs.pods.list(namespace="default")
+    return sum(1 for p in pods if p.spec.node_name)
+
+
+class TestGangEndToEnd:
+    def test_gang_binds_only_when_complete(self):
+        api = APIServer()
+        cs = Clientset(api)
+        for i in range(4):
+            cs.nodes.create(make_node(f"node-{i}", labels={v1.LABEL_HOSTNAME: f"node-{i}"}))
+        factory, sched = _gang_scheduler(cs)
+        try:
+            sched.start()
+            cs.pods.create(gang_pod("g-0", "job-a", 3))
+            cs.pods.create(gang_pod("g-1", "job-a", 3))
+            time.sleep(1.5)
+            assert _bound_count(cs) == 0  # parked at Permit, not bound
+            cs.pods.create(gang_pod("g-2", "job-a", 3))
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and _bound_count(cs) < 3:
+                time.sleep(0.1)
+            assert _bound_count(cs) == 3
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_gang_timeout_then_completion(self):
+        api = APIServer()
+        cs = Clientset(api)
+        for i in range(4):
+            cs.nodes.create(make_node(f"node-{i}", labels={v1.LABEL_HOSTNAME: f"node-{i}"}))
+        factory, sched = _gang_scheduler(cs, permit_timeout=0.4)
+        try:
+            sched.start()
+            cs.pods.create(gang_pod("g-0", "job-b", 3))
+            cs.pods.create(gang_pod("g-1", "job-b", 3))
+            time.sleep(1.5)  # several timeout+retry rounds
+            assert _bound_count(cs) == 0
+            cs.pods.create(gang_pod("g-2", "job-b", 3))
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and _bound_count(cs) < 3:
+                time.sleep(0.1)
+            assert _bound_count(cs) == 3
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_two_gangs_interleaved(self):
+        api = APIServer()
+        cs = Clientset(api)
+        for i in range(8):
+            cs.nodes.create(make_node(f"node-{i}", labels={v1.LABEL_HOSTNAME: f"node-{i}"}))
+        factory, sched = _gang_scheduler(cs)
+        try:
+            sched.start()
+            for i in range(2):
+                cs.pods.create(gang_pod(f"a-{i}", "job-a", 2))
+                cs.pods.create(gang_pod(f"b-{i}", "job-b", 2))
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and _bound_count(cs) < 4:
+                time.sleep(0.1)
+            assert _bound_count(cs) == 4
+        finally:
+            sched.stop()
+            factory.stop()

@@ -28,3 +28,23 @@ def test_spread_template_shapes():
     t2 = PodTemplate(anti_affinity_zone=True)
     pod2 = t2.build("y")
     assert pod2.spec.affinity.pod_anti_affinity is not None
+
+
+@pytest.mark.parametrize("backend", ["tpu", "oracle"])
+def test_gang_workload_small(backend):
+    """North-star gang stress shrunk to CI size: 4-pod gangs over GPU nodes;
+    every gang must bind atomically via the Coscheduling Permit gate."""
+    w = Workload(
+        "gang-ci",
+        num_nodes=8,
+        num_pods=16,
+        gang_size=4,
+        backend=backend,
+        timeout=120,
+        gang_permit_timeout=30,
+        template=PodTemplate(extended={"example.com/gpu": "1"}),
+        node_extended={"example.com/gpu": "4"},
+    )
+    r = run_workload(w)
+    assert r.throughput_avg > 0
+    assert r.num_bound == 16  # every gang bound, none parked at Permit
