@@ -389,6 +389,32 @@ class ServiceStatus:
 
 
 @dataclass
+class ReplicationControllerSpec:
+    replicas: Optional[int] = None
+    selector: Optional[Dict[str, str]] = None  # map selector (core/v1)
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class ReplicationControllerStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+
+
+@dataclass
+class ReplicationController:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ReplicationControllerSpec = field(
+        default_factory=ReplicationControllerSpec
+    )
+    status: ReplicationControllerStatus = field(
+        default_factory=ReplicationControllerStatus
+    )
+    kind: str = "ReplicationController"
+    api_version: str = "v1"
+
+
+@dataclass
 class Service:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ServiceSpec = field(default_factory=ServiceSpec)

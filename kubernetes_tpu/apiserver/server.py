@@ -78,6 +78,7 @@ def _default_resources() -> Tuple["ResourceInfo", ...]:
         ResourceInfo("configmaps", v1.ConfigMap, True),
         ResourceInfo("persistentvolumes", v1.PersistentVolume, False),
         ResourceInfo("persistentvolumeclaims", v1.PersistentVolumeClaim, True),
+        ResourceInfo("replicationcontrollers", v1.ReplicationController, True),
         ResourceInfo("replicasets", apps.ReplicaSet, True),
         ResourceInfo("deployments", apps.Deployment, True),
         ResourceInfo("daemonsets", apps.DaemonSet, True),

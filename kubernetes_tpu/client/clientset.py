@@ -61,6 +61,7 @@ class Clientset:
         self.configmaps = _ResourceClient(api, "configmaps")
         self.persistentvolumes = _ResourceClient(api, "persistentvolumes")
         self.persistentvolumeclaims = _ResourceClient(api, "persistentvolumeclaims")
+        self.replicationcontrollers = _ResourceClient(api, "replicationcontrollers")
         self.replicasets = _ResourceClient(api, "replicasets")
         self.deployments = _ResourceClient(api, "deployments")
         self.daemonsets = _ResourceClient(api, "daemonsets")

@@ -91,6 +91,22 @@ def create_scheduler(
     pv_inf = informer_factory.informer_for("persistentvolumes")
     sc_inf = informer_factory.informer_for("storageclasses")
     csi_inf = informer_factory.informer_for("csinodes")
+    # Spread/service-affinity informers only when a profile plugin consumes
+    # them (the default profile doesn't); created eagerly — BEFORE
+    # informer_factory.start() — because a lazily-created informer would
+    # never be started.
+    enabled_names = {n for entries in merged.values() for n, _ in entries}
+    spread_listers = None
+    service_lister = None
+    if enabled_names & {"SelectorSpread", "ServiceAffinity"}:
+        svc_inf = informer_factory.informer_for("services")
+        rc_inf = informer_factory.informer_for("replicationcontrollers")
+        rs_inf = informer_factory.informer_for("replicasets")
+        ss_inf = informer_factory.informer_for("statefulsets")
+        service_lister = svc_inf.list
+        spread_listers = (
+            lambda: (svc_inf.list(), rc_inf.list(), rs_inf.list(), ss_inf.list())
+        )
     volume_binder = SchedulerVolumeBinder(
         list_pvcs=pvc_inf.list,
         list_pvs=pv_inf.list,
@@ -109,6 +125,8 @@ def create_scheduler(
             "volume_listers": (pvc_inf.list, pv_inf.list),
             "csi_node_lister": csi_inf.list,
             "client": clientset,
+            "service_lister": service_lister,
+            "spread_listers": spread_listers,
         },
     )
     framework.nominator = sched.nominator

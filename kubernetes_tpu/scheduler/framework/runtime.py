@@ -124,6 +124,8 @@ class Framework:
         self.csi_node_lister = None
         self.client = None
         self.cache = None  # SchedulerCache (Coscheduling reservation counts)
+        self.service_lister = None  # ServiceAffinity
+        self.spread_listers = None  # SelectorSpread: () -> (svcs, rcs, rss, sss)
         for key, value in (handle_extras or {}).items():
             setattr(self, key, value)
         # Permit waiting-pods map (runtime/waiting_pods_map.go)

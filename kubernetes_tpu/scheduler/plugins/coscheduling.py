@@ -68,6 +68,7 @@ class Coscheduling(fwk.PermitPlugin, fwk.ReservePlugin):
         # not unreserved — O(group) permit counting instead of scanning the
         # whole scheduler cache per permit
         self._groups: dict = {}
+        self._reserve_count = 0
 
     # -- counting ----------------------------------------------------------
 
@@ -108,7 +109,20 @@ class Coscheduling(fwk.PermitPlugin, fwk.ReservePlugin):
 
     def permit(self, state: CycleState, pod: v1.Pod, node_name: str) -> Tuple[Optional[Status], float]:
         group, min_available = pod_group(pod)
-        if not group or min_available <= 1:
+        if not group:
+            return None, 0
+        if min_available < 1:
+            # a grouped pod with a missing/garbled min-available label must
+            # not silently bind solo while its siblings wait on it forever —
+            # surface the misconfiguration
+            return (
+                Status.unschedulable_and_unresolvable(
+                    f"gang {group!r}: invalid or missing "
+                    f"{MIN_AVAILABLE_LABEL} label"
+                ),
+                0,
+            )
+        if min_available == 1:
             return None, 0
         namespace = pod.metadata.namespace
         # the reserved index includes this pod (Reserve ran) and the waiting
@@ -126,6 +140,10 @@ class Coscheduling(fwk.PermitPlugin, fwk.ReservePlugin):
 
     # -- Reserve/Unreserve -------------------------------------------------
 
+    # sweep the whole index every N reserves so groups whose pods are long
+    # gone (bound then deleted) don't accumulate forever
+    _SWEEP_EVERY = 256
+
     def reserve(self, state: CycleState, pod: v1.Pod, node_name: str) -> Optional[Status]:
         group, min_available = pod_group(pod)
         if not group or min_available <= 1:
@@ -134,7 +152,22 @@ class Coscheduling(fwk.PermitPlugin, fwk.ReservePlugin):
             self._groups.setdefault(
                 (pod.metadata.namespace, group), set()
             ).add(v1.pod_key(pod))
+            self._reserve_count += 1
+            sweep = self._reserve_count % self._SWEEP_EVERY == 0
+        if sweep:
+            self._sweep()
         return None
+
+    def _sweep(self) -> None:
+        cache = getattr(self._handle, "cache", None)
+        if cache is None:
+            return
+        known = {v1.pod_key(p) for p in cache.list_pods()}
+        with self._lock:
+            for key in list(self._groups):
+                self._groups[key] &= known
+                if not self._groups[key]:
+                    del self._groups[key]
 
     def unreserve(self, state: CycleState, pod: v1.Pod, node_name: str) -> None:
         """A member failed after Reserve: drop it from the index and reject

@@ -35,6 +35,13 @@ def new_in_tree_registry() -> Registry:
 
     r.register("DefaultPreemption", lambda a, h: DefaultPreemption(a, h))
     r.register("Coscheduling", lambda a, h: Coscheduling(a, h))
+    from .nodelabel import NodeLabel
+    from .selectorspread import SelectorSpread
+    from .serviceaffinity import ServiceAffinity
+
+    r.register("SelectorSpread", lambda a, h: SelectorSpread(a, h))
+    r.register("NodeLabel", lambda a, h: NodeLabel(a, h))
+    r.register("ServiceAffinity", lambda a, h: ServiceAffinity(a, h))
     from .volumebinding import VolumeBinding
     from .volumes import NodeVolumeLimits, VolumeRestrictions, VolumeZone
 

@@ -202,3 +202,12 @@ class TestGangEndToEnd:
         finally:
             sched.stop()
             factory.stop()
+
+
+def test_invalid_min_available_rejected():
+    from kubernetes_tpu.scheduler.framework.interface import Code
+
+    pod = make_pod("g-0", labels={GROUP_LABEL: "job-a"})  # no min-available
+    pl = Coscheduling(handle=_FakeHandle())
+    status, _ = pl.permit(CycleState(), pod, "n")
+    assert status is not None and status.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE

@@ -1,0 +1,229 @@
+"""SelectorSpread / NodeLabel / ServiceAffinity plugins + legacy Policy API.
+
+Reference: selectorspread/selector_spread.go, nodelabel/node_label.go,
+serviceaffinity/service_affinity.go, apis/config/legacy_types.go +
+framework/plugins/legacy_registry.go.
+"""
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.scheduler.apis.config import merged_plugins_for_profile
+from kubernetes_tpu.scheduler.apis.legacy import policy_to_profile
+from kubernetes_tpu.scheduler.framework.interface import Code, CycleState, NodeScore
+from kubernetes_tpu.scheduler.framework.snapshot import Snapshot
+from kubernetes_tpu.scheduler.framework.types import NodeInfo
+from kubernetes_tpu.scheduler.plugins.nodelabel import NodeLabel
+from kubernetes_tpu.scheduler.plugins.selectorspread import (
+    SelectorSpread,
+    default_selector,
+)
+from kubernetes_tpu.scheduler.plugins.serviceaffinity import ServiceAffinity
+
+from .util import make_node, make_pod
+
+
+def svc(name, selector, namespace="default"):
+    return v1.Service(
+        metadata=v1.ObjectMeta(name=name, namespace=namespace),
+        spec=v1.ServiceSpec(selector=dict(selector)),
+    )
+
+
+class _Handle:
+    def __init__(self, snapshot, services=(), rcs=(), rss=(), sss=()):
+        self._snapshot = snapshot
+        self.service_lister = lambda: list(services)
+        self.spread_listers = lambda: (list(services), list(rcs), list(rss), list(sss))
+
+    def snapshot_shared_lister(self):
+        return self._snapshot
+
+
+def _snapshot(pods, nodes):
+    return Snapshot.from_objects(pods, nodes)
+
+
+class TestDefaultSelector:
+    def test_conjunction_of_matching_services(self):
+        pod = make_pod("p", labels={"app": "web", "tier": "fe"})
+        services = [svc("s1", {"app": "web"}), svc("s2", {"app": "other"})]
+        sel = default_selector(pod, services, [], [], [])
+        assert sel.matches({"app": "web"})
+        assert not sel.matches({"app": "other"})
+
+    def test_no_owner_matches_nothing(self):
+        pod = make_pod("p", labels={"app": "web"})
+        sel = default_selector(pod, [], [], [], [])
+        assert not sel.matches({"app": "web"})
+
+
+class TestSelectorSpread:
+    def _cluster(self):
+        nodes = [
+            make_node("n0", labels={v1.LABEL_HOSTNAME: "n0", v1.LABEL_ZONE: "z0"}),
+            make_node("n1", labels={v1.LABEL_HOSTNAME: "n1", v1.LABEL_ZONE: "z1"}),
+        ]
+        pods = [
+            make_pod("e0", node_name="n0", labels={"app": "web"}),
+            make_pod("e1", node_name="n0", labels={"app": "web"}),
+            make_pod("e2", node_name="n1", labels={"app": "web"}),
+        ]
+        return pods, nodes
+
+    def test_less_loaded_node_scores_higher(self):
+        pods, nodes = self._cluster()
+        snapshot = _snapshot(pods, nodes)
+        handle = _Handle(snapshot, services=[svc("web", {"app": "web"})])
+        pl = SelectorSpread(handle=handle)
+        pod = make_pod("new", labels={"app": "web"})
+        state = CycleState()
+        assert pl.pre_score(state, pod, nodes) is None
+        s0, _ = pl.score(state, pod, "n0")
+        s1, _ = pl.score(state, pod, "n1")
+        assert (s0, s1) == (2, 1)
+        scores = [NodeScore("n0", s0), NodeScore("n1", s1)]
+        assert pl.normalize_score(state, pod, scores) is None
+        # n1 (fewer service pods in node AND zone) must outrank n0
+        assert scores[1].score > scores[0].score
+
+    def test_pod_without_owners_scores_zero(self):
+        pods, nodes = self._cluster()
+        snapshot = _snapshot(pods, nodes)
+        handle = _Handle(snapshot)  # no services
+        pl = SelectorSpread(handle=handle)
+        pod = make_pod("new", labels={"app": "web"})
+        state = CycleState()
+        pl.pre_score(state, pod, nodes)
+        s0, _ = pl.score(state, pod, "n0")
+        assert s0 == 0
+
+
+class TestNodeLabel:
+    def test_filter_presence(self):
+        pl = NodeLabel(args={"presentLabels": ["zone"], "absentLabels": ["bad"]})
+        ni_ok, ni_missing, ni_bad = NodeInfo(), NodeInfo(), NodeInfo()
+        ni_ok.set_node(make_node("a", labels={"zone": "z1"}))
+        ni_missing.set_node(make_node("b"))
+        ni_bad.set_node(make_node("c", labels={"zone": "z1", "bad": "1"}))
+        assert pl.filter(CycleState(), make_pod("p"), ni_ok) is None
+        assert pl.filter(CycleState(), make_pod("p"), ni_missing).code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+        assert pl.filter(CycleState(), make_pod("p"), ni_bad).code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_score_fraction_of_preferences(self):
+        nodes = [make_node("a", labels={"ssd": "true"})]
+        handle = _Handle(_snapshot([], nodes))
+        pl = NodeLabel(
+            args={
+                "presentLabelsPreference": ["ssd"],
+                "absentLabelsPreference": ["spinning"],
+            },
+            handle=handle,
+        )
+        score, st = pl.score(CycleState(), make_pod("p"), "a")
+        assert st is None and score == 100
+
+
+class TestServiceAffinity:
+    def test_filter_pins_label_values(self):
+        nodes = [
+            make_node("a", labels={"rack": "r1"}),
+            make_node("b", labels={"rack": "r2"}),
+            make_node("c"),
+        ]
+        existing = make_pod("e0", node_name="a", labels={"app": "db"})
+        snapshot = _snapshot([existing], nodes)
+        handle = _Handle(snapshot, services=[svc("db", {"app": "db"})])
+        pl = ServiceAffinity(args={"affinityLabels": ["rack"]}, handle=handle)
+        pod = make_pod("new", labels={"app": "db"})
+        state = CycleState()
+        assert pl.pre_filter(state, pod) is None
+        ni = {n.metadata.name: NodeInfo() for n in nodes}
+        for n in nodes:
+            ni[n.metadata.name].set_node(n)
+        assert pl.filter(state, pod, ni["a"]) is None  # same rack
+        assert pl.filter(state, pod, ni["b"]).code == Code.UNSCHEDULABLE
+        assert pl.filter(state, pod, ni["c"]).code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_score_spreads_across_label_values(self):
+        nodes = [
+            make_node("a", labels={"rack": "r1"}),
+            make_node("b", labels={"rack": "r2"}),
+        ]
+        existing = [
+            make_pod("e0", node_name="a", labels={"app": "db"}),
+            make_pod("e1", node_name="a", labels={"app": "db"}),
+        ]
+        snapshot = _snapshot(existing, nodes)
+        handle = _Handle(snapshot, services=[svc("db", {"app": "db"})])
+        pl = ServiceAffinity(
+            args={"antiAffinityLabelsPreference": ["rack"]}, handle=handle
+        )
+        pod = make_pod("new", labels={"app": "db"})
+        state = CycleState()
+        sa, _ = pl.score(state, pod, "a")
+        sb, _ = pl.score(state, pod, "b")
+        assert sa == 2 and sb == 0
+        scores = [NodeScore("a", sa), NodeScore("b", sb)]
+        pl.normalize_score(state, pod, scores)
+        assert scores[1].score > scores[0].score
+
+
+class TestLegacyPolicy:
+    def test_policy_maps_to_plugins(self):
+        policy = {
+            "kind": "Policy",
+            "predicates": [
+                {"name": "PodFitsResources"},
+                {"name": "PodToleratesNodeTaints"},
+                {
+                    "name": "CheckNodeLabelPresence",
+                    "argument": {
+                        "labelsPresence": {"labels": ["zone"], "presence": True}
+                    },
+                },
+            ],
+            "priorities": [
+                {"name": "LeastRequestedPriority", "weight": 2},
+                {
+                    "name": "ServiceAntiAffinityPriority",
+                    "weight": 3,
+                    "argument": {"serviceAntiAffinity": {"label": "rack"}},
+                },
+            ],
+        }
+        profile = policy_to_profile(policy)
+        merged = merged_plugins_for_profile(profile)
+        assert ("NodeResourcesFit", 1) in merged["filter"]
+        assert ("TaintToleration", 1) in merged["filter"]
+        assert ("NodeLabel", 1) in merged["filter"]
+        assert ("NodeResourcesLeastAllocated", 2) in merged["score"]
+        assert ("ServiceAffinity", 3) in merged["score"]
+        # defaults NOT selected by the policy are gone ('*' disable)
+        assert all(n != "InterPodAffinity" for n, _ in merged["filter"])
+        assert all(n != "PodTopologySpread" for n, _ in merged["score"])
+        # mandatory wiring intact
+        assert merged["queueSort"] == [("PrioritySort", 1)]
+        assert merged["bind"] == [("DefaultBinder", 1)]
+        assert profile.plugin_config["NodeLabel"]["presentLabels"] == ["zone"]
+        assert profile.plugin_config["ServiceAffinity"][
+            "antiAffinityLabelsPreference"
+        ] == ["rack"]
+
+    def test_unknown_predicate_rejected(self):
+        import pytest
+
+        from kubernetes_tpu.scheduler.apis.config import ConfigError
+
+        with pytest.raises(ConfigError):
+            policy_to_profile({"predicates": [{"name": "NoSuchPredicate"}]})
+
+
+def test_duplicate_priorities_sum_weights():
+    policy = {
+        "priorities": [
+            {"name": "SelectorSpreadPriority", "weight": 1},
+            {"name": "ServiceSpreadingPriority", "weight": 5},
+        ]
+    }
+    profile = policy_to_profile(policy)
+    merged = merged_plugins_for_profile(profile)
+    assert ("SelectorSpread", 6) in merged["score"]
