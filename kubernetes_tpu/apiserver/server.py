@@ -106,15 +106,24 @@ class TypedWatch:
     def stop(self) -> None:
         self._raw.stop()
 
+    def _hydrate(self, ev: kv.Event) -> WatchEvent:
+        # stamp the event revision as resourceVersion (etcd3: the event's
+        # object carries mod_revision == event revision), matching _stamp
+        # on get/list — informer caches must hold current RVs or every
+        # optimistic update they feed conflicts
+        obj = serde.from_dict(self._typ, ev.value)
+        obj.metadata.resource_version = str(ev.revision)
+        return WatchEvent(ev.type, obj, ev.revision)
+
     def __iter__(self) -> Iterator[WatchEvent]:
         for ev in self._raw:
-            yield WatchEvent(ev.type, serde.from_dict(self._typ, ev.value), ev.revision)
+            yield self._hydrate(ev)
 
     def poll(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         ev = self._raw.poll(timeout)
         if ev is None:
             return None
-        return WatchEvent(ev.type, serde.from_dict(self._typ, ev.value), ev.revision)
+        return self._hydrate(ev)
 
 
 # admission plugin signature: (resource, operation, obj) -> None | raises

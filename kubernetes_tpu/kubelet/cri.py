@@ -1,0 +1,193 @@
+"""Container Runtime Interface: the kubelet↔runtime contract + fake impl.
+
+Reference: staging/src/k8s.io/cri-api/pkg/apis/runtime/v1alpha2/api.proto
+(RunPodSandbox / CreateContainer / StartContainer / StopContainer /
+RemoveContainer / ListPodSandbox / ListContainers) and the fake runtime
+kubemark's hollow kubelet wires (pkg/kubelet/cri/remote/fake). The fake
+holds sandbox/container state in memory with optional per-op latency so
+hollow nodes exercise the full kubelet state machine without a container
+runtime.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+SANDBOX_READY = "SANDBOX_READY"
+SANDBOX_NOTREADY = "SANDBOX_NOTREADY"
+
+CONTAINER_CREATED = "CONTAINER_CREATED"
+CONTAINER_RUNNING = "CONTAINER_RUNNING"
+CONTAINER_EXITED = "CONTAINER_EXITED"
+
+
+@dataclass
+class PodSandbox:
+    id: str = ""
+    pod_name: str = ""
+    pod_namespace: str = ""
+    pod_uid: str = ""
+    state: str = SANDBOX_READY
+    created_at: float = 0.0
+    ip: str = ""
+
+
+@dataclass
+class RuntimeContainer:
+    id: str = ""
+    sandbox_id: str = ""
+    name: str = ""
+    image: str = ""
+    state: str = CONTAINER_CREATED
+    created_at: float = 0.0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    exit_code: int = 0
+    restart_count: int = 0
+
+
+class CRIError(Exception):
+    pass
+
+
+class FakeRuntimeService:
+    """In-memory CRI runtime (fake CRI + fake image service)."""
+
+    def __init__(self, op_latency: float = 0.0, ip_prefix: str = "10.0"):
+        self._lock = threading.Lock()
+        self._sandboxes: Dict[str, PodSandbox] = {}
+        self._containers: Dict[str, RuntimeContainer] = {}
+        self._op_latency = op_latency
+        self._ip_prefix = ip_prefix
+        self._ip_counter = 0
+        # test hooks: container name -> exit code to fail with on start
+        self.fail_starts: Dict[str, int] = {}
+
+    def _latency(self) -> None:
+        if self._op_latency > 0:
+            time.sleep(self._op_latency)
+
+    # -- sandboxes ---------------------------------------------------------
+
+    def run_pod_sandbox(self, pod_name: str, pod_namespace: str, pod_uid: str) -> str:
+        self._latency()
+        with self._lock:
+            sid = f"sb-{uuid.uuid4().hex[:12]}"
+            self._ip_counter += 1
+            self._sandboxes[sid] = PodSandbox(
+                id=sid,
+                pod_name=pod_name,
+                pod_namespace=pod_namespace,
+                pod_uid=pod_uid,
+                state=SANDBOX_READY,
+                created_at=time.time(),
+                ip=f"{self._ip_prefix}.{self._ip_counter // 256}.{self._ip_counter % 256}",
+            )
+            return sid
+
+    def stop_pod_sandbox(self, sandbox_id: str) -> None:
+        self._latency()
+        with self._lock:
+            sb = self._sandboxes.get(sandbox_id)
+            if sb is None:
+                raise CRIError(f"sandbox {sandbox_id} not found")
+            sb.state = SANDBOX_NOTREADY
+            for c in self._containers.values():
+                if c.sandbox_id == sandbox_id and c.state == CONTAINER_RUNNING:
+                    c.state = CONTAINER_EXITED
+                    c.exit_code = 137
+                    c.finished_at = time.time()
+
+    def remove_pod_sandbox(self, sandbox_id: str) -> None:
+        self._latency()
+        with self._lock:
+            self._sandboxes.pop(sandbox_id, None)
+            self._containers = {
+                cid: c
+                for cid, c in self._containers.items()
+                if c.sandbox_id != sandbox_id
+            }
+
+    def list_pod_sandboxes(self) -> List[PodSandbox]:
+        with self._lock:
+            return [PodSandbox(**vars(s)) for s in self._sandboxes.values()]
+
+    # -- containers --------------------------------------------------------
+
+    def create_container(
+        self, sandbox_id: str, name: str, image: str, restart_count: int = 0
+    ) -> str:
+        self._latency()
+        with self._lock:
+            if sandbox_id not in self._sandboxes:
+                raise CRIError(f"sandbox {sandbox_id} not found")
+            cid = f"c-{uuid.uuid4().hex[:12]}"
+            self._containers[cid] = RuntimeContainer(
+                id=cid,
+                sandbox_id=sandbox_id,
+                name=name,
+                image=image,
+                state=CONTAINER_CREATED,
+                created_at=time.time(),
+                restart_count=restart_count,
+            )
+            return cid
+
+    def start_container(self, container_id: str) -> None:
+        self._latency()
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c is None:
+                raise CRIError(f"container {container_id} not found")
+            fail = self.fail_starts.get(c.name)
+            if fail is not None:
+                c.state = CONTAINER_EXITED
+                c.exit_code = fail
+                c.finished_at = time.time()
+                return
+            c.state = CONTAINER_RUNNING
+            c.started_at = time.time()
+
+    def stop_container(self, container_id: str, exit_code: int = 0) -> None:
+        self._latency()
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c is None:
+                return
+            if c.state == CONTAINER_RUNNING:
+                c.state = CONTAINER_EXITED
+                c.exit_code = exit_code
+                c.finished_at = time.time()
+
+    def remove_container(self, container_id: str) -> None:
+        self._latency()
+        with self._lock:
+            self._containers.pop(container_id, None)
+
+    def list_containers(self) -> List[RuntimeContainer]:
+        with self._lock:
+            return [RuntimeContainer(**vars(c)) for c in self._containers.values()]
+
+    # -- test helpers ------------------------------------------------------
+
+    def kill_container(self, pod_uid: str, name: str, exit_code: int = 1) -> bool:
+        """Simulate a container crash (drives PLEG + restart policy)."""
+        with self._lock:
+            sandbox_ids = {
+                s.id for s in self._sandboxes.values() if s.pod_uid == pod_uid
+            }
+            for c in self._containers.values():
+                if (
+                    c.sandbox_id in sandbox_ids
+                    and c.name == name
+                    and c.state == CONTAINER_RUNNING
+                ):
+                    c.state = CONTAINER_EXITED
+                    c.exit_code = exit_code
+                    c.finished_at = time.time()
+                    return True
+        return False

@@ -1,0 +1,166 @@
+"""Full-cluster end-to-end: apiserver + controller manager + scheduler +
+hollow kubelets, including node-failure detection and elastic recovery.
+
+Reference shape: test/e2e (real cluster suites) + kubemark scale runs +
+nodelifecycle failure handling (node_lifecycle_controller.go:756
+monitorNodeHealth, taint manager NoExecute eviction).
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import apps
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers.manager import ControllerManager
+from kubernetes_tpu.kubemark import HollowCluster
+from kubernetes_tpu.scheduler.apis.config import default_configuration
+from kubernetes_tpu.scheduler.factory import create_scheduler
+
+from .util import FAST_KUBELET, wait_until
+
+
+
+@pytest.fixture()
+def full_cluster():
+    api = APIServer()
+    cs = Clientset(api)
+    hollow = HollowCluster(cs, n_nodes=5, config_overrides=FAST_KUBELET)
+    hollow.start()
+
+    kcm = ControllerManager(
+        cs,
+        controllers=["replicaset", "deployment", "nodelifecycle"],
+        node_monitor_period=0.3,
+        node_monitor_grace_period=2.0,
+    )
+    kcm.run()
+
+    sched_factory = SharedInformerFactory(cs)
+    cfg = default_configuration()
+    cfg.profiles[0].backend = "oracle"
+    sched = create_scheduler(cs, sched_factory, cfg)
+    sched_factory.start()
+    assert sched_factory.wait_for_cache_sync()
+    sched.start()
+
+    yield api, cs, hollow
+
+    sched.stop()
+    sched_factory.stop()
+    kcm.stop()
+    hollow.stop()
+
+
+def test_deployment_runs_on_hollow_nodes(full_cluster):
+    api, cs, hollow = full_cluster
+    cs.deployments.create(
+        apps.Deployment(
+            metadata=v1.ObjectMeta(name="web", namespace="default"),
+            spec=apps.DeploymentSpec(
+                replicas=10,
+                selector=v1.LabelSelector(match_labels={"app": "web"}),
+                template=v1.PodTemplateSpec(
+                    metadata=v1.ObjectMeta(labels={"app": "web"}),
+                    spec=v1.PodSpec(
+                        containers=[
+                            v1.Container(
+                                name="c",
+                                image="img:1",
+                                resources=v1.ResourceRequirements(
+                                    requests={"cpu": "100m"}
+                                ),
+                            )
+                        ]
+                    ),
+                ),
+            ),
+        )
+    )
+
+    def all_running():
+        pods, _ = cs.pods.list(namespace="default")
+        return len(pods) == 10 and all(
+            p.spec.node_name and p.status.phase == "Running" for p in pods
+        )
+
+    assert wait_until(all_running, timeout=60), [
+        (p.metadata.name, p.spec.node_name, p.status.phase)
+        for p in cs.pods.list(namespace="default")[0]
+    ]
+    assert wait_until(
+        lambda: cs.deployments.get("web", "default").status.available_replicas == 10
+    )
+
+
+def test_node_failure_detection_and_recovery(full_cluster):
+    """Kill a kubelet; the nodelifecycle controller must detect the stale
+    heartbeat, taint the node NoExecute, evict its pods, and the
+    replicaset + scheduler must re-run them elsewhere."""
+    api, cs, hollow = full_cluster
+    cs.deployments.create(
+        apps.Deployment(
+            metadata=v1.ObjectMeta(name="ha", namespace="default"),
+            spec=apps.DeploymentSpec(
+                replicas=5,
+                selector=v1.LabelSelector(match_labels={"app": "ha"}),
+                template=v1.PodTemplateSpec(
+                    metadata=v1.ObjectMeta(labels={"app": "ha"}),
+                    spec=v1.PodSpec(
+                        containers=[
+                            v1.Container(
+                                name="c",
+                                image="img:1",
+                                resources=v1.ResourceRequirements(
+                                    requests={"cpu": "100m"}
+                                ),
+                            )
+                        ]
+                    ),
+                ),
+            ),
+        )
+    )
+
+    def n_running():
+        pods, _ = cs.pods.list(namespace="default")
+        return sum(
+            1
+            for p in pods
+            if p.spec.node_name and p.status.phase == "Running"
+        )
+
+    assert wait_until(lambda: n_running() == 5, timeout=60)
+
+    # pick a node that actually runs a pod and kill its kubelet
+    pods, _ = cs.pods.list(namespace="default")
+    victim_node = next(p.spec.node_name for p in pods if p.spec.node_name)
+    victim = next(
+        kl for kl in hollow.kubelets if kl.config.node_name == victim_node
+    )
+    victim.stop()
+
+    def node_unreachable():
+        node = cs.nodes.get(victim_node)
+        return any(
+            t.key == v1.TAINT_NODE_UNREACHABLE for t in node.spec.taints or []
+        )
+
+    assert wait_until(node_unreachable, timeout=30)
+
+    def recovered():
+        pods, _ = cs.pods.list(namespace="default")
+        running = [
+            p
+            for p in pods
+            if p.status.phase == "Running" and p.spec.node_name != victim_node
+        ]
+        return len(running) == 5
+
+    assert wait_until(recovered, timeout=60), [
+        (p.metadata.name, p.spec.node_name, p.status.phase)
+        for p in cs.pods.list(namespace="default")[0]
+    ]

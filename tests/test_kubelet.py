@@ -1,0 +1,271 @@
+"""Kubelet node agent tests: PLEG, syncPod state machine, restart policy,
+status/heartbeat managers, eviction, hollow-cluster scale.
+
+Reference: pkg/kubelet (kubelet.go syncLoop, pleg/generic.go,
+kuberuntime_manager.go SyncPod, kubelet_node_status.go, eviction/) and
+pkg/kubemark.
+"""
+
+import time
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.kubelet.cri import CONTAINER_RUNNING, FakeRuntimeService
+from kubernetes_tpu.kubelet.kubelet import LEASE_NAMESPACE, Kubelet, KubeletConfig
+from kubernetes_tpu.kubelet.pleg import (
+    CONTAINER_DIED,
+    CONTAINER_REMOVED,
+    CONTAINER_STARTED,
+    PLEG,
+)
+from kubernetes_tpu.kubemark import HollowCluster
+
+from .util import FAST_KUBELET as FAST, make_pod, wait_until as _wait
+
+
+
+class TestPLEG:
+    def test_start_die_remove_events(self):
+        rt = FakeRuntimeService()
+        pleg = PLEG(rt)
+        assert pleg.relist() == []
+        sid = rt.run_pod_sandbox("p", "default", "uid-1")
+        cid = rt.create_container(sid, "c0", "img")
+        rt.start_container(cid)
+        events = pleg.relist()
+        assert [e.type for e in events] == [CONTAINER_STARTED]
+        assert events[0].pod_uid == "uid-1"
+        rt.stop_container(cid, exit_code=1)
+        assert [e.type for e in pleg.relist()] == [CONTAINER_DIED]
+        rt.remove_container(cid)
+        assert [e.type for e in pleg.relist()] == [CONTAINER_REMOVED]
+        assert pleg.relist() == []
+
+
+def _cluster_with_kubelet(node_name="node-0", runtime=None, stats=None):
+    api = APIServer()
+    cs = Clientset(api)
+    factory = SharedInformerFactory(cs)
+    kl = Kubelet(
+        cs,
+        factory,
+        config=KubeletConfig(node_name=node_name, **FAST),
+        runtime=runtime or FakeRuntimeService(),
+        stats_provider=stats,
+    )
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    kl.run()
+    return api, cs, factory, kl
+
+
+class TestKubeletSyncPod:
+    def test_pod_runs_to_running(self):
+        api, cs, factory, kl = _cluster_with_kubelet()
+        try:
+            cs.pods.create(make_pod("web-0", node_name="node-0", cpu="100m"))
+
+            def running():
+                p = cs.pods.get("web-0", "default")
+                return p.status.phase == "Running"
+
+            assert _wait(running)
+            p = cs.pods.get("web-0", "default")
+            assert p.status.pod_ip
+            assert p.status.host_ip == "node-0"
+            assert p.status.container_statuses[0].state == "running"
+            assert any(
+                c.type == "Ready" and c.status == "True"
+                for c in p.status.conditions
+            )
+        finally:
+            kl.stop()
+            factory.stop()
+
+    def test_crashed_container_restarts(self):
+        rt = FakeRuntimeService()
+        api, cs, factory, kl = _cluster_with_kubelet(runtime=rt)
+        try:
+            cs.pods.create(make_pod("crashy", node_name="node-0"))
+            assert _wait(
+                lambda: cs.pods.get("crashy", "default").status.phase == "Running"
+            )
+            uid = cs.pods.get("crashy", "default").metadata.uid
+            assert rt.kill_container(uid, "c0", exit_code=1)
+            # restartPolicy Always: kubelet restarts with restart_count+1
+            assert _wait(
+                lambda: any(
+                    (s.restart_count or 0) >= 1 and s.state == "running"
+                    for s in (
+                        cs.pods.get("crashy", "default").status.container_statuses
+                        or []
+                    )
+                )
+            )
+        finally:
+            kl.stop()
+            factory.stop()
+
+    def test_restart_policy_never_failed(self):
+        rt = FakeRuntimeService()
+        rt.fail_starts["c0"] = 2  # container exits immediately with code 2
+        api, cs, factory, kl = _cluster_with_kubelet(runtime=rt)
+        try:
+            pod = make_pod("oneshot", node_name="node-0")
+            pod.spec.restart_policy = "Never"
+            cs.pods.create(pod)
+            assert _wait(
+                lambda: cs.pods.get("oneshot", "default").status.phase == "Failed"
+            )
+            st = cs.pods.get("oneshot", "default").status.container_statuses[0]
+            assert st.state == "terminated" and st.exit_code == 2
+        finally:
+            kl.stop()
+            factory.stop()
+
+    def test_deleted_pod_cleans_runtime(self):
+        rt = FakeRuntimeService()
+        api, cs, factory, kl = _cluster_with_kubelet(runtime=rt)
+        try:
+            cs.pods.create(make_pod("gone", node_name="node-0"))
+            assert _wait(lambda: len(rt.list_containers()) == 1)
+            cs.pods.delete("gone", "default")
+            assert _wait(lambda: not rt.list_pod_sandboxes())
+            assert not rt.list_containers()
+        finally:
+            kl.stop()
+            factory.stop()
+
+
+class TestHeartbeats:
+    def test_node_registered_with_lease_and_ready(self):
+        api, cs, factory, kl = _cluster_with_kubelet()
+        try:
+            node = cs.nodes.get("node-0")
+            assert node.status.capacity["pods"] == "110"
+            ready = [c for c in node.status.conditions if c.type == "Ready"]
+            assert ready and ready[0].status == "True"
+
+            def lease_fresh():
+                try:
+                    lease = cs.resource("leases").get("node-0", LEASE_NAMESPACE)
+                except Exception:
+                    return False
+                return (
+                    lease.spec.renew_time is not None
+                    and time.time() - lease.spec.renew_time < 5
+                )
+
+            assert _wait(lease_fresh)
+            # renewal advances
+            t1 = cs.resource("leases").get("node-0", LEASE_NAMESPACE).spec.renew_time
+            assert _wait(
+                lambda: cs.resource("leases")
+                .get("node-0", LEASE_NAMESPACE)
+                .spec.renew_time
+                > t1
+            )
+        finally:
+            kl.stop()
+            factory.stop()
+
+
+class TestEviction:
+    def test_memory_pressure_evicts_lowest_priority(self):
+        # Deterministic pressure: report pressure exactly while the intended
+        # victim still exists server-side. The pressured status tick reads
+        # stats first, then evicts the lowest-priority pod ("low"); the next
+        # tick sees "low" gone and reports no pressure — so exactly one pod
+        # is ever evicted regardless of scheduling delays (under sustained
+        # pressure the eviction manager takes one victim per interval, which
+        # would race the survival assertion below).
+        armed = [False]
+        holder = {}
+
+        def stats():
+            if not armed[0]:
+                return 0.0
+            try:
+                holder["cs"].pods.get("low", "default")
+                return 0.99
+            except Exception:
+                return 0.0
+
+        api, cs, factory, kl = _cluster_with_kubelet(stats=stats)
+        holder["cs"] = cs
+        try:
+            low = make_pod("low", node_name="node-0", priority=1)
+            high = make_pod("high", node_name="node-0", priority=100)
+            cs.pods.create(low)
+            cs.pods.create(high)
+            assert _wait(
+                lambda: all(
+                    cs.pods.get(n, "default").status.phase == "Running"
+                    for n in ("low", "high")
+                )
+            )
+            # watch node updates from here: the MemoryPressure=True condition
+            # is only reported during the pressured tick, so assert it from
+            # the event stream rather than racing the subsequent clear
+            _, rev = cs.nodes.list()
+            watch = cs.nodes.watch(since_revision=rev)
+            armed[0] = True
+
+            def evicted():
+                try:
+                    cs.pods.get("low", "default")
+                    return False
+                except Exception:
+                    return True
+
+            assert _wait(evicted)
+            # the high-priority pod survives (no further pressured ticks)
+            _wait(lambda: False, timeout=0.8)  # one full status period
+            assert cs.pods.get("high", "default").status.phase == "Running"
+            # the node reported MemoryPressure during the pressured tick
+            saw_pressure = False
+            while True:
+                ev = watch.poll(timeout=1.0)
+                if ev is None:
+                    break
+                for c in ev.object.status.conditions or []:
+                    if c.type == "MemoryPressure" and c.status == "True":
+                        saw_pressure = True
+                if saw_pressure:
+                    break
+            watch.stop()
+            assert saw_pressure
+        finally:
+            kl.stop()
+            factory.stop()
+
+
+class TestHollowCluster:
+    def test_scale_pods_run_everywhere(self):
+        api = APIServer()
+        cs = Clientset(api)
+        hollow = HollowCluster(cs, n_nodes=10, config_overrides=FAST)
+        hollow.start()
+        try:
+            assert _wait(lambda: len(cs.nodes.list()[0]) == 10)
+            # bind 3 pods per node directly (scheduler integration is
+            # covered end-to-end in test_cluster_e2e)
+            for i in range(30):
+                cs.pods.create(make_pod(f"w-{i}", node_name=f"hollow-{i % 10}"))
+
+            def all_running():
+                pods, _ = cs.pods.list(namespace="default")
+                return len(pods) == 30 and all(
+                    p.status.phase == "Running" for p in pods
+                )
+
+            assert _wait(all_running, timeout=30)
+            # every runtime actually holds its pods' containers
+            total = sum(
+                len(rt.list_containers()) for rt in hollow.runtimes.values()
+            )
+            assert total == 30
+        finally:
+            hollow.stop()

@@ -2,9 +2,29 @@
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 from kubernetes_tpu.api import types as v1
+
+# fast kubelet timing for hollow-cluster tests (seconds)
+FAST_KUBELET = dict(
+    sync_period=0.5,
+    pleg_period=0.1,
+    housekeeping_period=0.3,
+    lease_renew_period=0.3,
+    node_status_period=0.3,
+)
+
+
+def wait_until(fn, timeout: float = 30.0, interval: float = 0.05) -> bool:
+    """Poll fn until truthy or timeout (level-triggered test waits)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
 
 
 def make_node(
