@@ -39,8 +39,10 @@ def log(msg: str) -> None:
 
 def main() -> None:
     n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
-    n_meas = int(os.environ.get("BENCH_PODS", "1000"))
-    batch = int(os.environ.get("BENCH_BATCH", "100"))
+    # keep pods a multiple of batch: a ragged final batch changes the scan
+    # shape and pays a fresh ~35s XLA compile inside the measured window
+    n_meas = int(os.environ.get("BENCH_PODS", "1024"))
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
     n_warm = batch
 
     from kubernetes_tpu.models.encoding import ClusterEncoding

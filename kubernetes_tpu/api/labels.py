@@ -79,6 +79,23 @@ def _parse_int64(s: str) -> Optional[int]:
     return v
 
 
+def _split_requirements(s: str):
+    """Split on commas not inside `in (...)` value parentheses."""
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(0, depth - 1)
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return out
+
+
 class Selector:
     """Compiled label selector: a conjunction of requirements.
 
@@ -116,6 +133,43 @@ class Selector:
     def from_match_labels(cls, match_labels: Optional[Dict[str, str]]) -> "Selector":
         """labels.SelectorFromSet — nil/empty set matches everything."""
         reqs = [(k, IN, [v]) for k, v in sorted((match_labels or {}).items())]
+        return cls(reqs)
+
+    @classmethod
+    def parse(cls, selector: str) -> "Selector":
+        """labels.Parse (selector.go:852): the string grammar used by
+        `kubectl -l` / list options — comma-joined requirements of the
+        forms `k=v`, `k==v`, `k!=v`, `k in (a,b)`, `k notin (a,b)`, `k`
+        (exists), `!k` (does not exist), `k>n`, `k<n`."""
+        import re
+
+        set_req = re.compile(
+            r"^(?P<key>\S+)\s+(?P<op>in|notin)\s*\((?P<vals>[^)]*)\)$", re.IGNORECASE
+        )
+        reqs = []
+        for part in _split_requirements(selector):
+            part = part.strip()
+            if not part:
+                continue
+            m = set_req.match(part)
+            if m:
+                # the real lexer tokenizes on '(' so `k in(a,b)` is valid
+                op = IN if m.group("op").lower() == "in" else NOT_IN
+                values = [v.strip() for v in m.group("vals").split(",") if v.strip()]
+                reqs.append((m.group("key"), op, values))
+                continue
+            for token, op in (("!=", NOT_IN), ("==", IN), ("=", IN), (">", GT), ("<", LT)):
+                idx = part.find(token)
+                if idx > 0:
+                    reqs.append(
+                        (part[:idx].strip(), op, [part[idx + len(token):].strip()])
+                    )
+                    break
+            else:
+                if part.startswith("!"):
+                    reqs.append((part[1:].strip(), DOES_NOT_EXIST, []))
+                else:
+                    reqs.append((part, EXISTS, []))
         return cls(reqs)
 
     def matches(self, labels: Optional[Dict[str, str]]) -> bool:

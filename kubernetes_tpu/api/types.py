@@ -73,8 +73,8 @@ class Taint:
 class NodeSpec:
     unschedulable: bool = False
     taints: Optional[List[Taint]] = None
-    pod_cidr: str = ""
-    provider_id: str = ""
+    pod_cidr: str = field(default="", metadata={"json": "podCIDR"})
+    provider_id: str = field(default="", metadata={"json": "providerID"})
 
 
 @dataclass
@@ -214,7 +214,7 @@ class ContainerPort:
     host_port: int = 0
     container_port: int = 0
     protocol: str = "TCP"
-    host_ip: str = ""
+    host_ip: str = field(default="", metadata={"json": "hostIP"})
 
 
 @dataclass
@@ -283,8 +283,8 @@ class PodStatus:
     conditions: Optional[List[PodCondition]] = None
     nominated_node_name: str = ""
     start_time: Optional[float] = None
-    pod_ip: str = ""
-    host_ip: str = ""
+    pod_ip: str = field(default="", metadata={"json": "podIP"})
+    host_ip: str = field(default="", metadata={"json": "hostIP"})
     container_statuses: Optional[List[ContainerStatus]] = None
 
 
@@ -377,7 +377,7 @@ class ServicePort:
 class ServiceSpec:
     selector: Optional[Dict[str, str]] = None
     ports: Optional[List[ServicePort]] = None
-    cluster_ip: str = ""
+    cluster_ip: str = field(default="", metadata={"json": "clusterIP"})
     type: str = "ClusterIP"  # ClusterIP | NodePort | LoadBalancer | ExternalName
     session_affinity: str = ""
     external_name: str = ""
@@ -544,6 +544,54 @@ class PersistentVolumeClaim:
         default_factory=PersistentVolumeClaimStatus
     )
     kind: str = "PersistentVolumeClaim"
+    api_version: str = "v1"
+
+
+# ---------------------------------------------------------------------------
+# ResourceQuota / LimitRange (reference: core/v1 ResourceQuota :5512,
+# LimitRange :5415 in staging/src/k8s.io/api/core/v1/types.go)
+
+
+@dataclass
+class ResourceQuotaSpec:
+    hard: Optional[Dict[str, str]] = None  # resource name -> quantity
+    scopes: Optional[List[str]] = None
+
+
+@dataclass
+class ResourceQuotaStatus:
+    hard: Optional[Dict[str, str]] = None
+    used: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class ResourceQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceQuotaSpec = field(default_factory=ResourceQuotaSpec)
+    status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+    kind: str = "ResourceQuota"
+    api_version: str = "v1"
+
+
+@dataclass
+class LimitRangeItem:
+    type: str = "Container"  # Container | Pod
+    max: Optional[Dict[str, str]] = None
+    min: Optional[Dict[str, str]] = None
+    default: Optional[Dict[str, str]] = None  # default limits
+    default_request: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class LimitRangeSpec:
+    limits: Optional[List[LimitRangeItem]] = None
+
+
+@dataclass
+class LimitRange:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LimitRangeSpec = field(default_factory=LimitRangeSpec)
+    kind: str = "LimitRange"
     api_version: str = "v1"
 
 

@@ -1,0 +1,250 @@
+"""In-tree admission plugins.
+
+Reference: plugin/pkg/admission/* wired through the apiserver's
+mutate-then-validate chain (staging/src/k8s.io/apiserver/pkg/admission).
+Implemented set (the ones the control plane's own behavior depends on):
+
+  * NamespaceLifecycle  — reject creates in missing/terminating namespaces
+    (namespace/lifecycle/admission.go)
+  * LimitRanger         — apply container default requests/limits, enforce
+    min/max (limitranger/admission.go)
+  * Priority            — resolve priorityClassName -> spec.priority
+    (priority/admission.go)
+  * DefaultTolerationSeconds — add 300s not-ready/unreachable NoExecute
+    tolerations (defaulttolerationseconds/admission.go)
+  * ResourceQuota       — enforce namespace quotas on pod creation
+    (resourcequota/admission.go; usage recalculated by the quota
+    controller, controllers/resourcequota.py)
+
+Each plugin is a callable (resource, operation, obj) -> None that mutates
+in place (mutating chain) or raises Invalid (validating chain).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..api import types as v1
+from ..api.quantity import Quantity, parse_quantity
+from .server import APIServer, Invalid, NotFound
+
+DEFAULT_TOLERATION_SECONDS = 300  # defaulttolerationseconds/admission.go:38
+
+
+def namespace_lifecycle(api: APIServer):
+    """Reject writes into nonexistent or terminating namespaces."""
+
+    exempt = {"default", "kube-system", "kube-public", "kube-node-lease"}
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource == "namespaces" or op != "CREATE":
+            return
+        info = api._info(resource)
+        if not info.namespaced:
+            return
+        ns = obj.metadata.namespace
+        if not ns:
+            return
+        try:
+            namespace = api.get("namespaces", ns)
+        except NotFound:
+            if ns in exempt:
+                return  # system namespaces exist implicitly here
+            raise Invalid(f"namespace {ns!r} not found")
+        if namespace.metadata.deletion_timestamp is not None:
+            raise Invalid(f"namespace {ns!r} is terminating")
+
+    return admit
+
+
+def limit_ranger(api: APIServer):
+    """Defaults + min/max enforcement from LimitRange objects."""
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "pods" or op != "CREATE":
+            return
+        try:
+            limits, _ = api.list("limitranges", obj.metadata.namespace)
+        except NotFound:
+            return
+        items = [it for lr in limits for it in (lr.spec.limits or [])]
+        if not items:
+            return
+        for container in obj.spec.containers or []:
+            res = container.resources or v1.ResourceRequirements()
+            requests = dict(res.requests or {})
+            clims = dict(res.limits or {})
+            for item in items:
+                if item.type != "Container":
+                    continue
+                for k, qty in (item.default_request or {}).items():
+                    requests.setdefault(k, qty)
+                for k, qty in (item.default or {}).items():
+                    clims.setdefault(k, qty)
+                for k, qty in (item.min or {}).items():
+                    if k in requests and parse_quantity(requests[k]) < parse_quantity(qty):
+                        raise Invalid(
+                            f"minimum {k} usage per Container is {qty}"
+                        )
+                for k, qty in (item.max or {}).items():
+                    if k in requests and parse_quantity(requests[k]) > parse_quantity(qty):
+                        raise Invalid(
+                            f"maximum {k} usage per Container is {qty}"
+                        )
+            container.resources = v1.ResourceRequirements(
+                requests=requests or None, limits=clims or None
+            )
+
+    return admit
+
+
+def priority_admission(api: APIServer):
+    """Resolve spec.priorityClassName to spec.priority
+    (plugin/pkg/admission/priority/admission.go:131)."""
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "pods" or op != "CREATE":
+            return
+        name = obj.spec.priority_class_name
+        if not name:
+            return
+        try:
+            pc = api.get("priorityclasses", name)
+        except NotFound:
+            raise Invalid(f"no PriorityClass with name {name!r} was found")
+        obj.spec.priority = pc.value
+
+    return admit
+
+
+def default_toleration_seconds(api: APIServer):
+    """Append 300s NoExecute tolerations for not-ready/unreachable unless
+    the pod already tolerates them."""
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "pods" or op != "CREATE":
+            return
+        tolerations = list(obj.spec.tolerations or [])
+        for key in (v1.TAINT_NODE_NOT_READY, v1.TAINT_NODE_UNREACHABLE):
+            if any(
+                t.key in (key, None, "") and t.effect in ("NoExecute", "", None)
+                for t in tolerations
+            ):
+                continue
+            tolerations.append(
+                v1.Toleration(
+                    key=key,
+                    operator="Exists",
+                    effect="NoExecute",
+                    toleration_seconds=DEFAULT_TOLERATION_SECONDS,
+                )
+            )
+        obj.spec.tolerations = tolerations
+
+    return admit
+
+
+def pod_compute_usage(pod: v1.Pod) -> Dict[str, int]:
+    """Pod's chargeable quota usage: requests.cpu (milli), requests.memory
+    (bytes), pods (count). Terminal pods don't count
+    (resourcequota/evaluator/core/pods.go)."""
+    if pod.status.phase in ("Succeeded", "Failed"):
+        return {}
+    cpu = 0
+    mem = 0
+    for c in pod.spec.containers or []:
+        req = (c.resources.requests or {}) if c.resources else {}
+        cpu += Quantity(req.get("cpu", 0)).milli_value()
+        mem += Quantity(req.get("memory", 0)).value()
+    return {"requests.cpu": cpu, "requests.memory": mem, "pods": 1}
+
+
+_QUOTA_COUNTED = {
+    "services": "services",
+    "configmaps": "configmaps",
+    "persistentvolumeclaims": "persistentvolumeclaims",
+    "replicationcontrollers": "replicationcontrollers",
+}
+
+
+def _hard_to_units(hard: Dict[str, str]) -> Dict[str, int]:
+    out = {}
+    for k, qty in (hard or {}).items():
+        key = {"cpu": "requests.cpu", "memory": "requests.memory"}.get(k, k)
+        if key == "requests.cpu":
+            out[key] = Quantity(qty).milli_value()
+        elif key == "requests.memory":
+            out[key] = Quantity(qty).value()
+        else:
+            out[key] = Quantity(qty).value()
+    return out
+
+
+def resource_quota(api: APIServer):
+    """Enforce hard limits at pod/object creation against current usage.
+
+    The reference admission checks the evaluator's usage against
+    status.hard with a live recompute on conflict; here usage comes from
+    the same store the controller recalculates into status.used."""
+
+    def current_usage(namespace: str) -> Dict[str, int]:
+        used: Dict[str, int] = {}
+        pods, _ = api.list("pods", namespace)
+        for pod in pods:
+            for k, amt in pod_compute_usage(pod).items():
+                used[k] = used.get(k, 0) + amt
+        for resource, key in _QUOTA_COUNTED.items():
+            items, _ = api.list(resource, namespace)
+            used[key] = len(items)
+        return used
+
+    def admit(resource: str, op: str, obj) -> None:
+        if op != "CREATE":
+            return
+        chargeable = resource == "pods" or resource in _QUOTA_COUNTED
+        if not chargeable:
+            return
+        ns = obj.metadata.namespace
+        if not ns:
+            return
+        quotas, _ = api.list("resourcequotas", ns)
+        if not quotas:
+            return
+        used = current_usage(ns)
+        if resource == "pods":
+            delta = pod_compute_usage(obj)
+        else:
+            delta = {_QUOTA_COUNTED[resource]: 1}
+        for quota in quotas:
+            hard = _hard_to_units(quota.spec.hard or {})
+            for key, limit in hard.items():
+                want = used.get(key, 0) + delta.get(key, 0)
+                if want > limit:
+                    raise Invalid(
+                        f"exceeded quota: {quota.metadata.name}, "
+                        f"requested: {key}={delta.get(key, 0)}, "
+                        f"used: {key}={used.get(key, 0)}, "
+                        f"limited: {key}={limit}"
+                    )
+
+    return admit
+
+
+def default_admission_chain(api: APIServer) -> Tuple[List, List]:
+    """(mutating, validating) — reference default-enabled order
+    (kubeapiserver/options/plugins.go)."""
+    mutating = [
+        namespace_lifecycle(api),
+        priority_admission(api),
+        default_toleration_seconds(api),
+        limit_ranger(api),
+    ]
+    validating = [resource_quota(api)]
+    return mutating, validating
+
+
+def install_default_admission(api: APIServer) -> APIServer:
+    mutating, validating = default_admission_chain(api)
+    api._mutating.extend(mutating)
+    api._validating.extend(validating)
+    return api

@@ -63,12 +63,18 @@ class ResourceInfo:
 
 
 def _default_resources() -> Tuple["ResourceInfo", ...]:
-    from ..api import apps, batch, storage
+    from ..api import apps, autoscaling, batch, discovery, storage
     from ..client.events import Event
 
     return (
         ResourceInfo("pods", v1.Pod, True),
         ResourceInfo("nodes", v1.Node, False),
+        ResourceInfo("endpointslices", discovery.EndpointSlice, True),
+        ResourceInfo(
+            "horizontalpodautoscalers", autoscaling.HorizontalPodAutoscaler, True
+        ),
+        ResourceInfo("resourcequotas", v1.ResourceQuota, True),
+        ResourceInfo("limitranges", v1.LimitRange, True),
         ResourceInfo("poddisruptionbudgets", v1.PodDisruptionBudget, True),
         ResourceInfo("events", Event, True),
         ResourceInfo("leases", v1.Lease, True),
@@ -176,23 +182,27 @@ class APIServer:
         meta = obj.metadata
         if not meta.name:
             raise Invalid("metadata.name is required")
-        for admit in self._mutating:
-            admit(resource, "CREATE", obj)
-        for admit in self._validating:
-            admit(resource, "CREATE", obj)
-        meta.uid = meta.uid or str(uuid.uuid4())
-        meta.creation_timestamp = meta.creation_timestamp or time.time()
-        if resource == "namespaces" and "kubernetes" not in (meta.finalizers or []):
-            # stamped server-side at create (pkg/registry/core/namespace/
-            # strategy.go PrepareForCreate) so a delete racing the namespace
-            # controller can never skip the content drain
-            meta.finalizers = (meta.finalizers or []) + ["kubernetes"]
-        key = self._key(info, meta.namespace, meta.name)
-        body = serde.to_dict(obj)
-        try:
-            rev = self.store.create(key, body)
-        except kv.KeyExists:
-            raise AlreadyExists(key)
+        # admission + store write under one lock: quota admission reads
+        # current usage and must not race another create past the hard
+        # limit (the reference CASes quota status.used instead)
+        with self._lock:
+            for admit in self._mutating:
+                admit(resource, "CREATE", obj)
+            for admit in self._validating:
+                admit(resource, "CREATE", obj)
+            meta.uid = meta.uid or str(uuid.uuid4())
+            meta.creation_timestamp = meta.creation_timestamp or time.time()
+            if resource == "namespaces" and "kubernetes" not in (meta.finalizers or []):
+                # stamped server-side at create (pkg/registry/core/namespace/
+                # strategy.go PrepareForCreate) so a delete racing the
+                # namespace controller can never skip the content drain
+                meta.finalizers = (meta.finalizers or []) + ["kubernetes"]
+            key = self._key(info, meta.namespace, meta.name)
+            body = serde.to_dict(obj)
+            try:
+                rev = self.store.create(key, body)
+            except kv.KeyExists:
+                raise AlreadyExists(key)
         return self._stamp(info, body, rev)
 
     def get(self, resource: str, name: str, namespace: str = "") -> Any:

@@ -170,6 +170,7 @@ class SharedInformerFactory:
         self._clientset = clientset
         self._informers: Dict[str, Informer] = {}
         self._lock = threading.Lock()
+        self._started = False
 
     def informer_for(self, resource: str) -> Informer:
         with self._lock:
@@ -180,6 +181,12 @@ class SharedInformerFactory:
                     client = self._clientset.resource(resource)
                 inf = Informer(client)
                 self._informers[resource] = inf
+                if self._started:
+                    # factory already running: late informers start now
+                    # (client-go requires a second Start() call; implicit
+                    # here so consumers created after Run aren't silently
+                    # cache-dead)
+                    inf.start()
             return inf
 
     def pods(self) -> Informer:
@@ -190,11 +197,13 @@ class SharedInformerFactory:
 
     def start(self) -> None:
         with self._lock:
+            self._started = True
             for inf in self._informers.values():
                 inf.start()
 
     def stop(self) -> None:
         with self._lock:
+            self._started = False
             for inf in self._informers.values():
                 inf.stop()
 

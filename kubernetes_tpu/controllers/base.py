@@ -119,13 +119,18 @@ class Controller:
                 continue
             try:
                 self.sync(key)
-            except Exception:  # noqa: BLE001 — requeue with backoff, like
+            except Exception as e:  # noqa: BLE001 — requeue with backoff, like
                 # processNextWorkItem's utilruntime.HandleError + AddRateLimited
                 if not self._stopped.is_set():
                     self.queue.add_rate_limited(key)
-                    import traceback
+                    from ..apiserver.server import AlreadyExists, Conflict
 
-                    traceback.print_exc()
+                    if not isinstance(e, (AlreadyExists, Conflict)):
+                        # conflicts / create races are the normal
+                        # informer-lag retry path; don't spam the log
+                        import traceback
+
+                        traceback.print_exc()
             else:
                 self.queue.forget(key)
             finally:

@@ -13,16 +13,22 @@ from typing import Callable, Dict, List, Optional
 
 from ..client.informer import SharedInformerFactory
 from ..client.leaderelection import LeaderElectionConfig, LeaderElector
+from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .deployment import DeploymentController
+from .disruption import DisruptionController
 from .endpoints import EndpointsController
+from .endpointslice import EndpointSliceController
 from .garbagecollector import GarbageCollector
 from .job import JobController
 from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
 from .persistentvolume import PersistentVolumeController
+from .podautoscaler import HorizontalController
 from .replicaset import ReplicaSetController
+from .resourcequota import ResourceQuotaController
 from .statefulset import StatefulSetController
+from .ttlafterfinished import TTLAfterFinishedController
 
 
 def new_controller_initializers() -> Dict[str, Callable]:
@@ -34,6 +40,7 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "statefulset": lambda cs, inf, opts: StatefulSetController(cs, inf),
         "job": lambda cs, inf, opts: JobController(cs, inf),
         "endpoint": lambda cs, inf, opts: EndpointsController(cs, inf),
+        "endpointslice": lambda cs, inf, opts: EndpointSliceController(cs, inf),
         "namespace": lambda cs, inf, opts: NamespaceController(cs, inf),
         "garbagecollector": lambda cs, inf, opts: GarbageCollector(cs),
         "persistentvolume-binder": lambda cs, inf, opts: PersistentVolumeController(
@@ -44,6 +51,22 @@ def new_controller_initializers() -> Dict[str, Callable]:
             inf,
             node_monitor_period=opts.get("node_monitor_period", 5.0),
             node_monitor_grace_period=opts.get("node_monitor_grace_period", 40.0),
+        ),
+        "cronjob": lambda cs, inf, opts: CronJobController(
+            cs, inf, sync_period=opts.get("cronjob_sync_period", 10.0)
+        ),
+        "ttl-after-finished": lambda cs, inf, opts: TTLAfterFinishedController(
+            cs, inf, sync_period=opts.get("ttl_sync_period", 5.0)
+        ),
+        "disruption": lambda cs, inf, opts: DisruptionController(cs, inf),
+        "horizontalpodautoscaling": lambda cs, inf, opts: HorizontalController(
+            cs,
+            inf,
+            metrics=opts.get("hpa_metrics"),
+            sync_period=opts.get("hpa_sync_period", 15.0),
+        ),
+        "resourcequota": lambda cs, inf, opts: ResourceQuotaController(
+            cs, inf, sync_period=opts.get("quota_sync_period", 5.0)
         ),
     }
 

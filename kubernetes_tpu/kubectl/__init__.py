@@ -1,0 +1,3 @@
+from .cli import Kubectl, main
+
+__all__ = ["Kubectl", "main"]

@@ -1,0 +1,511 @@
+"""kubectl: the CLI over the clientset.
+
+Reference: staging/src/k8s.io/kubectl (cobra commands over client-go /
+cli-runtime builders). The verb set here covers the daily-driver surface:
+get / describe / create -f / apply -f (3-way merge via the
+last-applied-configuration annotation, pkg/cmd/apply) / delete / scale /
+label / annotate / taint / cordon / uncordon / drain (pkg/drain) /
+rollout status|restart. Manifests are YAML or JSON in the wire shape
+(camelCase, utils/serde).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from ..api import types as v1
+from ..api.labels import Selector
+from ..apiserver.server import APIError, NotFound
+from ..utils import serde
+
+LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
+
+ALIASES = {
+    "po": "pods", "pod": "pods",
+    "no": "nodes", "node": "nodes",
+    "svc": "services", "service": "services",
+    "ep": "endpoints",
+    "ns": "namespaces", "namespace": "namespaces",
+    "cm": "configmaps", "configmap": "configmaps",
+    "pv": "persistentvolumes", "persistentvolume": "persistentvolumes",
+    "pvc": "persistentvolumeclaims", "persistentvolumeclaim": "persistentvolumeclaims",
+    "rc": "replicationcontrollers",
+    "rs": "replicasets", "replicaset": "replicasets",
+    "deploy": "deployments", "deployment": "deployments",
+    "ds": "daemonsets", "daemonset": "daemonsets",
+    "sts": "statefulsets", "statefulset": "statefulsets",
+    "job": "jobs",
+    "cj": "cronjobs", "cronjob": "cronjobs",
+    "sc": "storageclasses", "storageclass": "storageclasses",
+    "pc": "priorityclasses", "priorityclass": "priorityclasses",
+    "pdb": "poddisruptionbudgets", "poddisruptionbudget": "poddisruptionbudgets",
+    "lease": "leases",
+    "eps": "endpointslices", "endpointslice": "endpointslices",
+    "crd": "customresourcedefinitions",
+    "hpa": "horizontalpodautoscalers",
+    "horizontalpodautoscaler": "horizontalpodautoscalers",
+    "quota": "resourcequotas", "resourcequota": "resourcequotas",
+    "limits": "limitranges", "limitrange": "limitranges",
+}
+
+
+def _age(ts: Optional[float]) -> str:
+    if not ts:
+        return "<unknown>"
+    s = max(0, int(time.time() - ts))
+    if s < 120:
+        return f"{s}s"
+    if s < 7200:
+        return f"{s // 60}m"
+    if s < 172800:
+        return f"{s // 3600}h"
+    return f"{s // 86400}d"
+
+
+class Kubectl:
+    def __init__(self, clientset, out=None, default_namespace: str = "default"):
+        self.cs = clientset
+        self.out = out if out is not None else sys.stdout
+        self.default_ns = default_namespace
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _print(self, *parts: str) -> None:
+        print(*parts, file=self.out)
+
+    def _resource(self, name: str) -> str:
+        name = name.lower()
+        return ALIASES.get(name, name)
+
+    def _kind_to_resource(self, kind: str) -> str:
+        for info in self.cs.api.resources():
+            try:
+                if info.type().kind == kind:
+                    return info.name
+            except Exception:  # noqa: BLE001 — types without default kind
+                continue
+        raise APIError(f"no resource registered for kind {kind!r}")
+
+    def _client(self, resource: str):
+        return self.cs.resource(self._resource(resource))
+
+    def _namespaced(self, resource: str) -> bool:
+        info = self.cs.api._info(self._resource(resource))
+        return info.namespaced
+
+    def _load_manifests(self, path: str) -> List[Dict]:
+        if path == "-":
+            text = sys.stdin.read()
+        else:
+            with open(path) as f:
+                text = f.read()
+        docs = [d for d in yaml.safe_load_all(text) if d]
+        return docs
+
+    def _obj_from_dict(self, doc: Dict):
+        kind = doc.get("kind")
+        if not kind:
+            raise APIError("manifest missing kind")
+        resource = self._kind_to_resource(kind)
+        info = self.cs.api._info(resource)
+        return resource, serde.from_dict(info.type, doc)
+
+    # -- entry --------------------------------------------------------------
+
+    def run(self, argv: List[str]) -> int:
+        parser = argparse.ArgumentParser(prog="kubectl", add_help=True)
+        parser.add_argument("-n", "--namespace", default=self.default_ns)
+        sub = parser.add_subparsers(dest="verb", required=True)
+
+        p = sub.add_parser("get")
+        p.add_argument("resource")
+        p.add_argument("name", nargs="?")
+        p.add_argument("-o", "--output", default="")
+        p.add_argument("-l", "--selector", default="")
+        p.add_argument("-A", "--all-namespaces", action="store_true")
+
+        p = sub.add_parser("describe")
+        p.add_argument("resource")
+        p.add_argument("name")
+
+        for verb in ("create", "apply"):
+            p = sub.add_parser(verb)
+            p.add_argument("-f", "--filename", required=True)
+
+        p = sub.add_parser("delete")
+        p.add_argument("resource", nargs="?")
+        p.add_argument("name", nargs="?")
+        p.add_argument("-f", "--filename")
+
+        p = sub.add_parser("scale")
+        p.add_argument("target")  # resource/name
+        p.add_argument("--replicas", type=int, required=True)
+
+        p = sub.add_parser("label")
+        p.add_argument("resource")
+        p.add_argument("name")
+        p.add_argument("pairs", nargs="+")
+        p.add_argument("--overwrite", action="store_true")
+
+        p = sub.add_parser("annotate")
+        p.add_argument("resource")
+        p.add_argument("name")
+        p.add_argument("pairs", nargs="+")
+        p.add_argument("--overwrite", action="store_true")
+
+        p = sub.add_parser("taint")
+        p.add_argument("resource")  # must be nodes
+        p.add_argument("name")
+        p.add_argument("taints", nargs="+")
+
+        for verb in ("cordon", "uncordon"):
+            p = sub.add_parser(verb)
+            p.add_argument("name")
+
+        p = sub.add_parser("drain")
+        p.add_argument("name")
+        p.add_argument("--ignore-daemonsets", action="store_true")
+        p.add_argument("--force", action="store_true")
+        p.add_argument("--grace-period", type=int, default=-1)
+
+        p = sub.add_parser("rollout")
+        p.add_argument("action", choices=["status", "restart"])
+        p.add_argument("target")  # deployment/name
+
+        args = parser.parse_args(argv)
+        try:
+            getattr(self, f"cmd_{args.verb}")(args)
+            return 0
+        except APIError as e:
+            self._print(f"Error: {e}")
+            return 1
+
+    # -- verbs --------------------------------------------------------------
+
+    def cmd_get(self, args) -> None:
+        resource = self._resource(args.resource)
+        client = self._client(resource)
+        sel = Selector.parse(args.selector) if args.selector else None
+        if args.name:
+            ns = args.namespace if self._namespaced(resource) else ""
+            items = [client.get(args.name, ns)]
+        else:
+            ns = None
+            if self._namespaced(resource) and not args.all_namespaces:
+                ns = args.namespace
+            items, _ = client.list(namespace=ns, label_selector=sel)
+        if args.output in ("yaml", "json"):
+            docs = [serde.to_dict(o) for o in items]
+            payload = docs[0] if args.name else {"kind": "List", "items": docs}
+            if args.output == "yaml":
+                self._print(yaml.safe_dump(payload, sort_keys=False).rstrip())
+            else:
+                self._print(json.dumps(payload, indent=2))
+            return
+        if args.output == "name":
+            for o in items:
+                self._print(f"{resource}/{o.metadata.name}")
+            return
+        self._table(resource, items, wide=args.output == "wide")
+
+    def _table(self, resource: str, items: List[Any], wide: bool) -> None:
+        rows: List[List[str]] = []
+        if resource == "pods":
+            hdr = ["NAME", "READY", "STATUS", "RESTARTS", "AGE"] + (
+                ["NODE"] if wide else []
+            )
+            for o in items:
+                total = len(o.spec.containers or [])
+                ready = sum(1 for c in o.status.container_statuses or [] if c.ready)
+                restarts = sum(
+                    c.restart_count for c in o.status.container_statuses or []
+                )
+                row = [
+                    o.metadata.name,
+                    f"{ready}/{total}",
+                    o.status.phase or "Pending",
+                    str(restarts),
+                    _age(o.metadata.creation_timestamp),
+                ]
+                if wide:
+                    row.append(o.spec.node_name or "<none>")
+                rows.append(row)
+        elif resource == "nodes":
+            hdr = ["NAME", "STATUS", "AGE"]
+            for o in items:
+                ready = next(
+                    (c.status for c in o.status.conditions or [] if c.type == "Ready"),
+                    "Unknown",
+                )
+                status = {"True": "Ready", "False": "NotReady"}.get(ready, "NotReady")
+                if o.spec.unschedulable:
+                    status += ",SchedulingDisabled"
+                rows.append([o.metadata.name, status, _age(o.metadata.creation_timestamp)])
+        elif resource == "deployments":
+            hdr = ["NAME", "READY", "UP-TO-DATE", "AVAILABLE", "AGE"]
+            for o in items:
+                want = o.spec.replicas if o.spec.replicas is not None else 1
+                rows.append([
+                    o.metadata.name,
+                    f"{o.status.ready_replicas or 0}/{want}",
+                    str(o.status.updated_replicas or 0),
+                    str(o.status.available_replicas or 0),
+                    _age(o.metadata.creation_timestamp),
+                ])
+        elif resource == "services":
+            hdr = ["NAME", "TYPE", "CLUSTER-IP", "PORT(S)", "AGE"]
+            for o in items:
+                ports = ",".join(
+                    f"{p.port}/{p.protocol}" + (f":{p.node_port}" if p.node_port else "")
+                    for p in o.spec.ports or []
+                )
+                rows.append([
+                    o.metadata.name,
+                    o.spec.type or "ClusterIP",
+                    o.spec.cluster_ip or "None",
+                    ports or "<none>",
+                    _age(o.metadata.creation_timestamp),
+                ])
+        else:
+            hdr = ["NAME", "AGE"]
+            for o in items:
+                rows.append([o.metadata.name, _age(o.metadata.creation_timestamp)])
+        widths = [
+            max(len(hdr[i]), *(len(r[i]) for r in rows)) if rows else len(hdr[i])
+            for i in range(len(hdr))
+        ]
+        self._print("   ".join(h.ljust(w) for h, w in zip(hdr, widths)).rstrip())
+        for r in rows:
+            self._print("   ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+
+    def cmd_describe(self, args) -> None:
+        resource = self._resource(args.resource)
+        ns = args.namespace if self._namespaced(resource) else ""
+        obj = self._client(resource).get(args.name, ns)
+        doc = serde.to_dict(obj)
+        self._print(f"Name:         {obj.metadata.name}")
+        if self._namespaced(resource):
+            self._print(f"Namespace:    {obj.metadata.namespace}")
+        self._print(f"Labels:       {obj.metadata.labels or '<none>'}")
+        self._print(f"Annotations:  {obj.metadata.annotations or '<none>'}")
+        for section in ("spec", "status"):
+            if section in doc:
+                self._print(f"{section.title()}:")
+                body = yaml.safe_dump(doc[section], sort_keys=False).rstrip()
+                for line in body.splitlines():
+                    self._print(f"  {line}")
+
+    def cmd_create(self, args) -> None:
+        for doc in self._load_manifests(args.filename):
+            resource, obj = self._obj_from_dict(doc)
+            if self._namespaced(resource) and not obj.metadata.namespace:
+                obj.metadata.namespace = args.namespace
+            created = self.cs.resource(resource).create(obj)
+            self._print(f"{resource}/{created.metadata.name} created")
+
+    def cmd_apply(self, args) -> None:
+        """3-way merge apply (reference: kubectl apply,
+        staging/src/k8s.io/kubectl/pkg/cmd/apply — last-applied annotation
+        + patch computed from (last-applied, live, new); untyped JSON merge
+        semantics: lists replace wholesale)."""
+        for doc in self._load_manifests(args.filename):
+            resource, obj = self._obj_from_dict(doc)
+            if self._namespaced(resource) and not obj.metadata.namespace:
+                obj.metadata.namespace = args.namespace
+            client = self.cs.resource(resource)
+            ns = obj.metadata.namespace if self._namespaced(resource) else ""
+            new_doc = serde.to_dict(obj)
+            try:
+                live = client.get(obj.metadata.name, ns)
+            except NotFound:
+                obj.metadata.annotations = dict(obj.metadata.annotations or {})
+                obj.metadata.annotations[LAST_APPLIED] = json.dumps(new_doc)
+                client.create(obj)
+                self._print(f"{resource}/{obj.metadata.name} created")
+                continue
+            live_doc = serde.to_dict(live)
+            prev = json.loads(
+                (live.metadata.annotations or {}).get(LAST_APPLIED, "{}")
+            )
+            merged = _three_way_merge(prev, live_doc, new_doc)
+            merged.setdefault("metadata", {}).setdefault("annotations", {})[
+                LAST_APPLIED
+            ] = json.dumps(new_doc)
+            # preserve server-populated identity/concurrency fields
+            merged["metadata"]["resourceVersion"] = live_doc["metadata"].get(
+                "resourceVersion"
+            )
+            merged["metadata"]["uid"] = live_doc["metadata"].get("uid")
+            info = self.cs.api._info(resource)
+            client.update(serde.from_dict(info.type, merged))
+            self._print(f"{resource}/{obj.metadata.name} configured")
+
+    def cmd_delete(self, args) -> None:
+        if args.filename:
+            for doc in self._load_manifests(args.filename):
+                resource, obj = self._obj_from_dict(doc)
+                ns = (
+                    obj.metadata.namespace or args.namespace
+                    if self._namespaced(resource)
+                    else ""
+                )
+                self.cs.resource(resource).delete(obj.metadata.name, ns)
+                self._print(f"{resource}/{obj.metadata.name} deleted")
+            return
+        if not args.resource or not args.name:
+            raise APIError("delete requires RESOURCE NAME or -f FILE")
+        resource = self._resource(args.resource)
+        ns = args.namespace if self._namespaced(resource) else ""
+        self._client(resource).delete(args.name, ns)
+        self._print(f"{resource}/{args.name} deleted")
+
+    def cmd_scale(self, args) -> None:
+        resource, name = args.target.split("/", 1)
+        resource = self._resource(resource)
+        client = self._client(resource)
+        ns = args.namespace if self._namespaced(resource) else ""
+        obj = client.get(name, ns)
+        obj.spec.replicas = args.replicas
+        client.update(obj)
+        self._print(f"{resource}/{name} scaled")
+
+    def _patch_map(self, args, field: str) -> None:
+        resource = self._resource(args.resource)
+        client = self._client(resource)
+        ns = args.namespace if self._namespaced(resource) else ""
+        obj = client.get(args.name, ns)
+        current = dict(getattr(obj.metadata, field) or {})
+        for pair in args.pairs:
+            if pair.endswith("-"):
+                current.pop(pair[:-1], None)
+                continue
+            key, _, value = pair.partition("=")
+            if key in current and not args.overwrite and current[key] != value:
+                raise APIError(
+                    f"'{key}' already has a value; use --overwrite"
+                )
+            current[key] = value
+        setattr(obj.metadata, field, current or None)
+        client.update(obj)
+        self._print(f"{resource}/{args.name} {field.rstrip('s')}ed")
+
+    def cmd_label(self, args) -> None:
+        self._patch_map(args, "labels")
+
+    def cmd_annotate(self, args) -> None:
+        self._patch_map(args, "annotations")
+
+    def cmd_taint(self, args) -> None:
+        if self._resource(args.resource) != "nodes":
+            raise APIError("taint only applies to nodes")
+        node = self.cs.nodes.get(args.name)
+        taints = list(node.spec.taints or [])
+        for spec in args.taints:
+            if spec.endswith("-"):
+                key = spec[:-1].split("=")[0].split(":")[0]
+                taints = [t for t in taints if t.key != key]
+                continue
+            kv, _, effect = spec.rpartition(":")
+            if not effect:
+                raise APIError(f"invalid taint spec {spec!r}")
+            key, _, value = kv.partition("=")
+            taints = [t for t in taints if not (t.key == key and t.effect == effect)]
+            taints.append(v1.Taint(key=key, value=value, effect=effect))
+        node.spec.taints = taints or None
+        self.cs.nodes.update(node)
+        self._print(f"node/{args.name} tainted")
+
+    def _set_unschedulable(self, name: str, value: bool) -> None:
+        node = self.cs.nodes.get(name)
+        node.spec.unschedulable = value
+        self.cs.nodes.update(node)
+
+    def cmd_cordon(self, args) -> None:
+        self._set_unschedulable(args.name, True)
+        self._print(f"node/{args.name} cordoned")
+
+    def cmd_uncordon(self, args) -> None:
+        self._set_unschedulable(args.name, False)
+        self._print(f"node/{args.name} uncordoned")
+
+    def cmd_drain(self, args) -> None:
+        """Cordon + evict every pod (reference: kubectl drain,
+        staging/src/k8s.io/kubectl/pkg/drain/drain.go filters: DaemonSet
+        pods need --ignore-daemonsets, unmanaged pods need --force)."""
+        self._set_unschedulable(args.name, True)
+        self._print(f"node/{args.name} cordoned")
+        pods, _ = self.cs.pods.list()
+        for pod in pods:
+            if pod.spec.node_name != args.name:
+                continue
+            owner = (pod.metadata.owner_references or [None])[0]
+            if owner is not None and owner.kind == "DaemonSet":
+                if not args.ignore_daemonsets:
+                    raise APIError(
+                        f"cannot delete DaemonSet-managed pod {pod.metadata.name} "
+                        "(use --ignore-daemonsets)"
+                    )
+                continue  # ignored, left running
+            if owner is None and not args.force:
+                raise APIError(
+                    f"cannot delete unmanaged pod {pod.metadata.name} (use --force)"
+                )
+            self.cs.pods.delete(pod.metadata.name, pod.metadata.namespace)
+            self._print(f"pod/{pod.metadata.name} evicted")
+        self._print(f"node/{args.name} drained")
+
+    def cmd_rollout(self, args) -> None:
+        resource, name = args.target.split("/", 1)
+        resource = self._resource(resource)
+        if resource != "deployments":
+            raise APIError("rollout supports deployments")
+        dep = self.cs.deployments.get(name, args.namespace)
+        if args.action == "status":
+            want = dep.spec.replicas if dep.spec.replicas is not None else 1
+            have = dep.status.available_replicas or 0
+            if have >= want:
+                self._print(f'deployment "{name}" successfully rolled out')
+            else:
+                self._print(
+                    f"Waiting for deployment \"{name}\" rollout to finish: "
+                    f"{have} of {want} updated replicas are available..."
+                )
+            return
+        # restart: stamp the pod template (kubectl rollout restart's
+        # restartedAt annotation) to trigger a new rollout
+        tmpl_meta = dep.spec.template.metadata
+        tmpl_meta.annotations = dict(tmpl_meta.annotations or {})
+        tmpl_meta.annotations["kubectl.kubernetes.io/restartedAt"] = str(time.time())
+        self.cs.deployments.update(dep)
+        self._print(f"deployment.apps/{name} restarted")
+
+
+def _three_way_merge(prev: Any, live: Any, new: Any) -> Any:
+    """Untyped 3-way JSON merge: fields in new win; fields present in prev
+    but gone from new are deleted from live; everything else keeps the live
+    value. Lists replace wholesale (JSON-merge-patch semantics; the
+    reference additionally does strategic list merges for typed fields)."""
+    if not (isinstance(live, dict) and isinstance(new, dict)):
+        return new
+    prev = prev if isinstance(prev, dict) else {}
+    out = dict(live)
+    for key in set(prev) - set(new):
+        out.pop(key, None)
+    for key, val in new.items():
+        out[key] = _three_way_merge(prev.get(key), live.get(key), val)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Standalone entry: drives a fresh in-proc cluster (demo use)."""
+    from ..apiserver.server import APIServer
+    from ..client.clientset import Clientset
+
+    return Kubectl(Clientset(APIServer())).run(argv or sys.argv[1:])

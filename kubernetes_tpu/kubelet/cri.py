@@ -58,6 +58,9 @@ class FakeRuntimeService:
     """In-memory CRI runtime (fake CRI + fake image service)."""
 
     def __init__(self, op_latency: float = 0.0, ip_prefix: str = "10.0"):
+        """ip_prefix: 2 octets -> pods use prefix.x.y (a /16 podCIDR);
+        3 octets -> pods use prefix.y (a /24 podCIDR, kubemark's per-node
+        range)."""
         self._lock = threading.Lock()
         self._sandboxes: Dict[str, PodSandbox] = {}
         self._containers: Dict[str, RuntimeContainer] = {}
@@ -73,11 +76,30 @@ class FakeRuntimeService:
 
     # -- sandboxes ---------------------------------------------------------
 
+    def _alloc_ip(self) -> str:
+        """Lowest free address in the range (real CNI IPAM reuses released
+        IPs; a monotonic counter would wrap and hand a live pod's IP to a
+        new sandbox under churn). Suffix 0 is skipped (network address)."""
+        slash24 = self._ip_prefix.count(".") == 2
+        in_use = {sb.ip for sb in self._sandboxes.values()}
+        limit = 256 if slash24 else 65536
+        start = self._ip_counter + 1  # first-fit from last allocation
+        for off in range(limit - 1):
+            n = (start + off - 1) % (limit - 1) + 1  # cycle [1, limit-1]
+            ip = (
+                f"{self._ip_prefix}.{n}"
+                if slash24
+                else f"{self._ip_prefix}.{n // 256}.{n % 256}"
+            )
+            if ip not in in_use:
+                self._ip_counter = n
+                return ip
+        raise RuntimeError(f"pod IP range {self._ip_prefix} exhausted")
+
     def run_pod_sandbox(self, pod_name: str, pod_namespace: str, pod_uid: str) -> str:
         self._latency()
         with self._lock:
             sid = f"sb-{uuid.uuid4().hex[:12]}"
-            self._ip_counter += 1
             self._sandboxes[sid] = PodSandbox(
                 id=sid,
                 pod_name=pod_name,
@@ -85,7 +107,7 @@ class FakeRuntimeService:
                 pod_uid=pod_uid,
                 state=SANDBOX_READY,
                 created_at=time.time(),
-                ip=f"{self._ip_prefix}.{self._ip_counter // 256}.{self._ip_counter % 256}",
+                ip=self._alloc_ip(),
             )
             return sid
 

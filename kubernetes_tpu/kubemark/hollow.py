@@ -30,7 +30,10 @@ class HollowCluster:
         overrides = config_overrides or {}
         for i in range(n_nodes):
             name = f"{node_name_prefix}-{i}"
-            runtime = FakeRuntimeService()
+            # per-node pod-IP range (the real CNI hands each node a podCIDR;
+            # one shared prefix would collide pod IPs across nodes and break
+            # endpoint/proxy state keyed by IP)
+            runtime = FakeRuntimeService(ip_prefix=f"10.{64 + i // 256}.{i % 256}")
             cfg = KubeletConfig(
                 node_name=name,
                 labels=(labels_for(i) if labels_for else {}),

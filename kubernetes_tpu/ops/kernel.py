@@ -59,6 +59,14 @@ DEFAULT_WEIGHTS = {
 
 _I64 = jnp.int64
 _F64 = jnp.float64
+# Counting dtype for the pod-table sweeps (PTS/IPA pair counts, match
+# sums). int64 is EMULATED on the TPU vector unit — the four
+# affinity/topology sections dominated the fused step at ~12.6ms of
+# 14.1ms per pod before this. Counts are bounded by the pod-table size
+# and weighted sums by 100*weight*terms, so int32 holds them exactly and
+# score parity with the int64 oracle is preserved; section outputs are
+# cast back to int64 at the [N]-sized boundary.
+_CNT = jnp.int32
 
 
 def _seg_sum(data, segment_ids, num_segments):
@@ -148,7 +156,7 @@ def _pts_filter(c: Dict, p: Dict, node_match):
         & (c["pns"] == p["self_ns"])[:, None]
     )  # [P, C]
     node_counts = jax.vmap(
-        lambda m: _seg_sum(m.astype(_I64), c["pnode"], n), in_axes=1
+        lambda m: _seg_sum(m.astype(_CNT), c["pnode"], n), in_axes=1
     )(match_pc)  # [C, N]
     count_pair = jax.vmap(
         lambda cnts, pids: _seg_sum(cnts, pids, vnp), in_axes=(0, 1)
@@ -163,12 +171,12 @@ def _pts_filter(c: Dict, p: Dict, node_match):
     )  # [C, Vnp]
     col = jnp.arange(vnp)[None, :]
     reg_real = reg & (col > 0)
-    big = jnp.iinfo(jnp.int64).max
+    big = jnp.iinfo(_CNT).max
     min_c = jnp.min(jnp.where(reg_real, shared_cnt, big), axis=1)
     min_c = jnp.where(min_c == big, 0, min_c)  # no registered pairs -> 0
     self_match = eval_reqs_single(
         p["ptsf_op"], p["ptsf_rkey"], p["ptsf_pairs"], p["self_ppair"], p["self_pkey"]
-    ).astype(_I64)  # [C]
+    ).astype(_CNT)  # [C]
     cnt_n = jnp.take_along_axis(shared_cnt.T, pair_cn, axis=0)  # [N, C] counts at node pair
     reg_n = jnp.take_along_axis(reg_real.T, pair_cn, axis=0)
     cnt_n = jnp.where(reg_n, cnt_n, 0)
@@ -176,7 +184,7 @@ def _pts_filter(c: Dict, p: Dict, node_match):
     fail_missing = jnp.any(valid_c[None, :] & ~key_on_node, axis=1)
     skew = cnt_n + self_match[None, :] - min_c[None, :]
     fail_skew = jnp.any(
-        valid_c[None, :] & key_on_node & (skew > p["ptsf_skew"][None, :].astype(_I64)),
+        valid_c[None, :] & key_on_node & (skew > p["ptsf_skew"][None, :].astype(_CNT)),
         axis=1,
     )
     mask = ~(any_c & (fail_missing | fail_skew))
@@ -199,7 +207,7 @@ def _ipa_filter(c: Dict, p: Dict):
         & c["pvalid"][c["at_src"]]
     )  # [A]
     at_pair = c["pair_of_key"][c["pnode"][c["at_src"]], c["at_key"]]  # [A]
-    existing_cnt = _seg_sum(match_at.astype(_I64), at_pair, vnp)
+    existing_cnt = _seg_sum(match_at.astype(_CNT), at_pair, vnp)
     existing_cnt = existing_cnt.at[0].set(0)
     # gather per node LABEL (pair_of_key, ~K columns) instead of sweeping the
     # whole [N, Vnp] pair matrix: nodes carry few labels, Vnp is huge
@@ -223,7 +231,7 @@ def _ipa_filter(c: Dict, p: Dict):
         pair_pt = c["pair_of_key"][c["pnode"][:, None], keys[None, :]]  # [P, T]
         m = match_pt & c["pvalid"][:, None] & valid[None, :]
         cnt = jax.vmap(
-            lambda mm, pids: _seg_sum(mm.astype(_I64), pids, vnp), in_axes=(1, 1)
+            lambda mm, pids: _seg_sum(mm.astype(_CNT), pids, vnp), in_axes=(1, 1)
         )(m, pair_pt)  # [T, Vnp]
         return jnp.sum(cnt, axis=0).at[0].set(0)  # [Vnp]
 
@@ -398,11 +406,11 @@ def _score_pts(c: Dict, p: Dict, node_match, feasible):
         & (c["pns"] == p["self_ns"])[:, None]
     )  # [P, C]
     node_counts = jax.vmap(
-        lambda m: _seg_sum(m.astype(_I64), c["pnode"], n), in_axes=1
+        lambda m: _seg_sum(m.astype(_CNT), c["pnode"], n), in_axes=1
     )(match_pc)  # [C, N]
     src = node_match & has_all & c["valid"]  # scoring.go:252 count eligibility
     count_pair = jax.vmap(
-        lambda cnts, pids: _seg_sum(cnts * src.astype(_I64), pids, vnp),
+        lambda cnts, pids: _seg_sum(cnts * src.astype(_CNT), pids, vnp),
         in_axes=(0, 1),
     )(node_counts, pair_cn)  # [C, Vnp]
     # one shared (key,value)-keyed map across same-key constraints
@@ -439,7 +447,7 @@ def _score_ipa(c: Dict, p: Dict, feasible):
     interpodaffinity/scoring.go:88 processExistingPod, :225 Score, :247
     NormalizeScore)."""
     vnp = c["npair"].shape[1]
-    hard_w = c["hard_pod_affinity_weight"].astype(_I64)
+    hard_w = c["hard_pod_affinity_weight"].astype(_CNT)
     # (a) incoming preferred terms vs existing pods
     match_pt = eval_reqs(p["ipap_op"], p["ipap_rkey"], p["ipap_pairs"], c["ppair"], c["pkey"])
     match_pt = (
@@ -450,10 +458,10 @@ def _score_ipa(c: Dict, p: Dict, feasible):
     )  # [P, T]
     pair_pt = c["pair_of_key"][c["pnode"][:, None], p["ipap_key"][None, :]]
     cnt_t = jax.vmap(
-        lambda m, pids: _seg_sum(m.astype(_I64), pids, vnp), in_axes=(1, 1)
+        lambda m, pids: _seg_sum(m.astype(_CNT), pids, vnp), in_axes=(1, 1)
     )(match_pt, pair_pt)  # [T, Vnp]
     cnt_t = cnt_t.at[:, 0].set(0)
-    score_vec = jnp.sum(cnt_t * p["ipap_weight"][:, None], axis=0)  # [Vnp]
+    score_vec = jnp.sum(cnt_t * p["ipap_weight"].astype(_CNT)[:, None], axis=0)  # [Vnp]
     present = jnp.any(cnt_t > 0, axis=0)
     # (b) existing pods' terms vs the incoming pod
     w_st = jnp.where(
@@ -461,8 +469,8 @@ def _score_ipa(c: Dict, p: Dict, feasible):
         hard_w,
         jnp.where(
             c["st_kind"] == ST_PREFERRED_AFFINITY,
-            c["st_weight"].astype(_I64),
-            -c["st_weight"].astype(_I64),
+            c["st_weight"].astype(_CNT),
+            -c["st_weight"].astype(_CNT),
         ),
     )
     match_st = (
@@ -474,7 +482,7 @@ def _score_ipa(c: Dict, p: Dict, feasible):
     )  # [S]
     st_pair = c["pair_of_key"][c["pnode"][c["st_src"]], c["st_key"]]
     score_vec = score_vec + _seg_sum(jnp.where(match_st, w_st, 0), st_pair, vnp)
-    present = present | (_seg_sum(match_st.astype(_I64), st_pair, vnp) > 0)
+    present = present | (_seg_sum(match_st.astype(_CNT), st_pair, vnp) > 0)
     present = present.at[0].set(False)
     score_vec = score_vec.at[0].set(0)
     # Score(): sum score_vec over the node's label pairs — gather per label
@@ -484,7 +492,7 @@ def _score_ipa(c: Dict, p: Dict, feasible):
         jnp.where(c["nkey"], score_vec[c["pair_of_key"]], 0), axis=1
     )
     any_present = jnp.any(present)
-    big = jnp.iinfo(jnp.int64).max
+    big = jnp.iinfo(_CNT).max
     min_s = jnp.min(jnp.where(feasible, raw, big))
     max_s = jnp.max(jnp.where(feasible, raw, -big))
     diff = (max_s - min_s).astype(_F64)

@@ -1,0 +1,4 @@
+from .proxier import Netfilter, Packet, Proxier
+from .endpointslicecache import EndpointSliceCache
+
+__all__ = ["Netfilter", "Packet", "Proxier", "EndpointSliceCache"]

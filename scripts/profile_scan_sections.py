@@ -1,0 +1,77 @@
+"""Which kernel section dominates the scan step? Stub sections one at a
+time (monkeypatch kernel module globals) and re-time the whole scan."""
+import os, sys, time, functools
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax.numpy as jnp
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops import kernel as K
+from kubernetes_tpu.ops.batch import CARRY_KEYS, _step
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+N, B = int(os.environ.get("BENCH_NODES", "5000")), 64
+nodes, init_pods = synth_cluster(N, pods_per_node=2)
+enc = ClusterEncoding(); enc.set_cluster(nodes, init_pods)
+pe = PodEncoder(enc)
+pods = synth_pending_pods(B, spread=True)
+for q in pods: pe.encode(q)
+c = enc.device_state()
+arrays = [{k: v for k, v in pe.encode(q).items() if not k.startswith("_")} for q in pods]
+stacked = {k: jnp.asarray(np.stack([np.asarray(a[k]) for a in arrays])) for k in arrays[0]}
+slots = np.asarray([enc._pod_free[-1 - i] for i in range(B)], np.int32)
+xs = {"pod": stacked, "pidx": jnp.asarray(slots), "valid": jnp.ones(B, bool)}
+static_c = {k: v for k, v in c.items() if k not in CARRY_KEYS}
+carry = {k: c[k] for k in CARRY_KEYS}
+
+n = int(np.asarray(c["valid"]).shape[0])
+ones_n = jnp.ones(n, bool)
+zeros_n = jnp.zeros(n, jnp.int64)
+
+STUBS = {
+    "pts_filter": ("_pts_filter", lambda c, p, nm: (ones_n, jnp.zeros(n, bool))),
+    "ipa_filter": ("_ipa_filter", lambda c, p: (ones_n, jnp.zeros(n, bool))),
+    "score_pts": ("_score_pts", lambda c, p, nm, f: zeros_n),
+    "score_ipa": ("_score_ipa", lambda c, p, f: zeros_n),
+    "node_match": ("_node_match", lambda c, p: ones_n),
+    "filter_basics": ("_filter_basics", lambda c, p: (ones_n,) * 5),
+    "scores_basic": ("_score_balanced", lambda c, p: zeros_n),
+    "score_taint": ("_score_taint", lambda c, p, f: zeros_n),
+    "score_nodeaff": ("_score_node_affinity", lambda c, p, f: zeros_n),
+    "score_image": ("_score_image", lambda c, p: zeros_n),
+}
+
+def run(name):
+    @jax.jit
+    def jf(carry, xs):
+        step = functools.partial(_step, static_c, K.DEFAULT_WEIGHTS)
+        return jax.lax.scan(step, carry, xs)
+    out = jf(carry, xs); jax.block_until_ready(out)
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = jf(carry, xs); jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:24s} {best*1000:8.1f}ms  {best*1000/B:6.2f}ms/pod", flush=True)
+    return best
+
+print("device:", jax.devices()[0], " B =", B, " N =", n, " P =", np.asarray(c['pvalid']).shape)
+full = run("FULL")
+for label, (attr, stub) in STUBS.items():
+    orig = getattr(K, attr)
+    setattr(K, attr, stub)
+    try:
+        run(f"minus {label}")
+    finally:
+        setattr(K, attr, orig)
+# everything stubbed: pure scan+argmax+carry-update floor
+origs = {attr: getattr(K, attr) for attr, _ in STUBS.values()}
+for attr, stub in STUBS.values():
+    setattr(K, attr, stub)
+try:
+    run("minus ALL (floor)")
+finally:
+    for attr, fn in origs.items():
+        setattr(K, attr, fn)

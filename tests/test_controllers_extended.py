@@ -1,0 +1,441 @@
+"""CronJob, TTL-after-finished, Disruption, HPA, ResourceQuota controllers
+and in-tree admission plugins.
+
+Reference shape: pkg/controller/{cronjob,ttlafterfinished,disruption,
+podautoscaler,resourcequota} unit tests + plugin/pkg/admission tests.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import apps, batch
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.api.storage import PriorityClass
+from kubernetes_tpu.apiserver.admission import install_default_admission
+from kubernetes_tpu.apiserver.server import APIServer, Invalid
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers.cronjob import CronJobController, CronSchedule
+from kubernetes_tpu.controllers.disruption import DisruptionController
+from kubernetes_tpu.controllers.podautoscaler import HorizontalController
+from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
+from kubernetes_tpu.controllers.ttlafterfinished import TTLAfterFinishedController
+
+from .util import make_pod, wait_until
+
+
+@pytest.fixture()
+def cluster():
+    api = APIServer()
+    cs = Clientset(api)
+    factory = SharedInformerFactory(cs)
+    return api, cs, factory
+
+
+class TestCronSchedule:
+    def test_every_minute(self):
+        s = CronSchedule("* * * * *")
+        assert s.matches(time.mktime((2026, 7, 30, 10, 5, 0, 3, 0, 0)))
+
+    def test_fields(self):
+        s = CronSchedule("*/15 3 * * *")
+        # 03:00, 03:15, ... UTC
+        t = 3 * 3600 + 15 * 60  # 1970-01-01T03:15Z
+        assert s.matches(t)
+        assert not s.matches(t + 60)
+        assert not s.matches(t + 3600)
+
+    def test_unmet_times(self):
+        s = CronSchedule("* * * * *")
+        times = s.unmet_times(0, 600)
+        assert times == [float(60 * i) for i in range(1, 11)]
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            CronSchedule("* * *")
+        with pytest.raises(ValueError):
+            CronSchedule("99 * * * *")
+
+    def test_latest_unmet_huge_backlog_is_fast(self):
+        s = CronSchedule("*/5 * * * *")
+        year = 365 * 86400.0
+        t0 = time.perf_counter()
+        latest = s.latest_unmet(0.0, year + 123.0)
+        assert time.perf_counter() - t0 < 0.05  # backlog-size independent
+        assert latest == year  # most recent 5-minute mark, not minute 5
+        # unsatisfiable schedule (Feb 31): no match, still fast
+        dead = CronSchedule("0 0 31 2 *")
+        t0 = time.perf_counter()
+        assert dead.latest_unmet(0.0, year) is None
+        assert time.perf_counter() - t0 < 0.1
+        assert dead.next_after(0.0) is None
+
+
+def _cronjob(name="cj", schedule="* * * * *", **spec_kw):
+    return batch.CronJob(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        spec=batch.CronJobSpec(
+            schedule=schedule,
+            job_template_spec=batch.JobSpec(
+                template=v1.PodTemplateSpec(
+                    metadata=v1.ObjectMeta(labels={"cron": name}),
+                    spec=v1.PodSpec(
+                        containers=[v1.Container(name="c", image="i")],
+                        restart_policy="Never",
+                    ),
+                )
+            ),
+            **spec_kw,
+        ),
+    )
+
+
+class TestCronJobController:
+    def test_creates_job_at_schedule(self, cluster):
+        api, cs, factory = cluster
+        ctrl = CronJobController(cs, factory)
+        cj = _cronjob()
+        cj.metadata.creation_timestamp = 1.0
+        cs.cronjobs.create(cj)
+        ctrl.sync_all(now=61.0)
+        jobs, _ = cs.jobs.list()
+        assert len(jobs) == 1
+        assert jobs[0].metadata.name == "cj-1"
+        assert jobs[0].metadata.owner_references[0].kind == "CronJob"
+        got = cs.cronjobs.get("cj", "default")
+        assert got.status.last_schedule_time == 60.0
+        assert got.status.active == ["cj-1"]
+        # re-sync at the same time: no duplicate
+        ctrl.sync_all(now=61.0)
+        assert len(cs.jobs.list()[0]) == 1
+
+    def test_suspend(self, cluster):
+        api, cs, factory = cluster
+        ctrl = CronJobController(cs, factory)
+        cj = _cronjob(suspend=True)
+        cj.metadata.creation_timestamp = 1.0
+        cs.cronjobs.create(cj)
+        ctrl.sync_all(now=61.0)
+        assert cs.jobs.list()[0] == []
+
+    def test_forbid_concurrency(self, cluster):
+        api, cs, factory = cluster
+        ctrl = CronJobController(cs, factory)
+        cj = _cronjob(concurrency_policy="Forbid")
+        cj.metadata.creation_timestamp = 1.0
+        cs.cronjobs.create(cj)
+        ctrl.sync_all(now=61.0)
+        assert len(cs.jobs.list()[0]) == 1
+        # first job still active -> second tick must not create another
+        ctrl.sync_all(now=121.0)
+        assert len(cs.jobs.list()[0]) == 1
+
+    def test_replace_concurrency(self, cluster):
+        api, cs, factory = cluster
+        ctrl = CronJobController(cs, factory)
+        cj = _cronjob(concurrency_policy="Replace")
+        cj.metadata.creation_timestamp = 1.0
+        cs.cronjobs.create(cj)
+        ctrl.sync_all(now=61.0)
+        ctrl.sync_all(now=121.0)
+        jobs, _ = cs.jobs.list()
+        assert [j.metadata.name for j in jobs] == ["cj-2"]
+
+    def test_history_limits(self, cluster):
+        api, cs, factory = cluster
+        ctrl = CronJobController(cs, factory)
+        cj = _cronjob(successful_jobs_history_limit=1)
+        cj.metadata.creation_timestamp = 1.0
+        cs.cronjobs.create(cj)
+        for minute in (1, 2, 3):
+            ctrl.sync_all(now=60.0 * minute + 1)
+            jobs, _ = cs.jobs.list()
+            newest = max(jobs, key=lambda j: j.metadata.name)
+            newest.status.conditions = [
+                batch.JobCondition(type="Complete", status="True")
+            ]
+            newest.status.completion_time = 60.0 * minute + 30
+            cs.jobs.update_status(newest)
+        ctrl.sync_all(now=241.0)
+        names = {j.metadata.name for j in cs.jobs.list()[0]}
+        # only the newest finished job plus the one created at t=241
+        assert names == {"cj-3", "cj-4"}
+
+
+class TestTTLAfterFinished:
+    def test_deletes_after_ttl(self, cluster):
+        api, cs, factory = cluster
+        ctrl = TTLAfterFinishedController(cs, factory)
+        job = batch.Job(
+            metadata=v1.ObjectMeta(name="j", namespace="default"),
+            spec=batch.JobSpec(
+                ttl_seconds_after_finished=100,
+                template=v1.PodTemplateSpec(
+                    spec=v1.PodSpec(containers=[v1.Container(name="c", image="i")])
+                ),
+            ),
+        )
+        cs.jobs.create(job)
+        ctrl.sync_all(now=1000.0)  # not finished: kept
+        assert len(cs.jobs.list()[0]) == 1
+        live = cs.jobs.get("j", "default")
+        live.status.conditions = [batch.JobCondition(type="Complete", status="True")]
+        live.status.completion_time = 1000.0
+        cs.jobs.update_status(live)
+        ctrl.sync_all(now=1099.0)
+        assert len(cs.jobs.list()[0]) == 1  # TTL not yet expired
+        ctrl.sync_all(now=1101.0)
+        assert cs.jobs.list()[0] == []
+
+
+class TestDisruptionController:
+    def test_status_from_min_available(self, cluster):
+        api, cs, factory = cluster
+        ctrl = DisruptionController(cs, factory)
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        ctrl.run()
+        try:
+            cs.resource("poddisruptionbudgets").create(
+                v1.PodDisruptionBudget(
+                    metadata=v1.ObjectMeta(name="pdb", namespace="default"),
+                    spec=v1.PodDisruptionBudgetSpec(
+                        min_available="2",
+                        selector=v1.LabelSelector(match_labels={"app": "db"}),
+                    ),
+                )
+            )
+            for i in range(3):
+                pod = make_pod(f"db-{i}", labels={"app": "db"}, node_name="n1")
+                pod.status.phase = "Running"
+                pod.status.conditions = [v1.PodCondition(type="Ready", status="True")]
+                cs.pods.create(pod)
+
+            def ok():
+                pdb = cs.resource("poddisruptionbudgets").get("pdb", "default")
+                return (
+                    pdb.status.current_healthy == 3
+                    and pdb.status.desired_healthy == 2
+                    and pdb.status.disruptions_allowed == 1
+                    and pdb.status.expected_pods == 3
+                )
+
+            assert wait_until(ok)
+        finally:
+            ctrl.stop()
+            factory.stop()
+
+    def test_percentage_max_unavailable(self, cluster):
+        api, cs, factory = cluster
+        ctrl = DisruptionController(cs, factory)
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        ctrl.run()
+        try:
+            rs = apps.ReplicaSet(
+                metadata=v1.ObjectMeta(name="rs", namespace="default"),
+                spec=apps.ReplicaSetSpec(
+                    replicas=4,
+                    selector=v1.LabelSelector(match_labels={"app": "web"}),
+                ),
+            )
+            created_rs = cs.replicasets.create(rs)
+            cs.resource("poddisruptionbudgets").create(
+                v1.PodDisruptionBudget(
+                    metadata=v1.ObjectMeta(name="pdb", namespace="default"),
+                    spec=v1.PodDisruptionBudgetSpec(
+                        max_unavailable="50%",
+                        selector=v1.LabelSelector(match_labels={"app": "web"}),
+                    ),
+                )
+            )
+            for i in range(4):
+                pod = make_pod(f"web-{i}", labels={"app": "web"}, node_name="n1")
+                pod.metadata.owner_references = [
+                    v1.OwnerReference(
+                        kind="ReplicaSet",
+                        name="rs",
+                        uid=created_rs.metadata.uid,
+                        controller=True,
+                    )
+                ]
+                pod.status.phase = "Running"
+                pod.status.conditions = [v1.PodCondition(type="Ready", status="True")]
+                cs.pods.create(pod)
+
+            def ok():
+                pdb = cs.resource("poddisruptionbudgets").get("pdb", "default")
+                # expected 4, maxUnavailable 50% -> desired 2, allowed 2
+                return (
+                    pdb.status.expected_pods == 4
+                    and pdb.status.desired_healthy == 2
+                    and pdb.status.disruptions_allowed == 2
+                )
+
+            assert wait_until(ok)
+        finally:
+            ctrl.stop()
+            factory.stop()
+
+
+def _deployment(name="web", replicas=2):
+    return apps.Deployment(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        spec=apps.DeploymentSpec(
+            replicas=replicas,
+            selector=v1.LabelSelector(match_labels={"app": name}),
+            template=v1.PodTemplateSpec(
+                metadata=v1.ObjectMeta(labels={"app": name}),
+                spec=v1.PodSpec(containers=[v1.Container(name="c", image="i")]),
+            ),
+        ),
+    )
+
+
+class TestHorizontalController:
+    def _pods(self, cs, n, util):
+        for i in range(n):
+            pod = make_pod(f"web-{i}", labels={"app": "web"}, node_name="n1")
+            pod.status.phase = "Running"
+            cs.pods.create(pod)
+        return lambda pod: util
+
+    def test_scales_up_and_clamps(self, cluster):
+        api, cs, factory = cluster
+        cs.deployments.create(_deployment(replicas=2))
+        metrics = self._pods(cs, 2, 200)  # 200% of target 80 -> ratio 2.5
+        ctrl = HorizontalController(cs, factory, metrics=metrics)
+        from kubernetes_tpu.api.autoscaling import (
+            CrossVersionObjectReference,
+            HorizontalPodAutoscaler,
+            HorizontalPodAutoscalerSpec,
+        )
+
+        cs.resource("horizontalpodautoscalers").create(
+            HorizontalPodAutoscaler(
+                metadata=v1.ObjectMeta(name="hpa", namespace="default"),
+                spec=HorizontalPodAutoscalerSpec(
+                    scale_target_ref=CrossVersionObjectReference(
+                        kind="Deployment", name="web"
+                    ),
+                    min_replicas=1,
+                    max_replicas=4,
+                    target_cpu_utilization_percentage=80,
+                ),
+            )
+        )
+        ctrl.sync_all()
+        dep = cs.deployments.get("web", "default")
+        assert dep.spec.replicas == 4  # ceil(2*2.5)=5 clamped to max 4
+        hpa = cs.resource("horizontalpodautoscalers").get("hpa", "default")
+        assert hpa.status.desired_replicas == 4
+        assert hpa.status.current_cpu_utilization_percentage == 200
+
+    def test_tolerance_band_holds(self, cluster):
+        api, cs, factory = cluster
+        cs.deployments.create(_deployment(replicas=2))
+        metrics = self._pods(cs, 2, 85)  # ratio 1.0625 < 1.1 tolerance
+        ctrl = HorizontalController(cs, factory, metrics=metrics)
+        from kubernetes_tpu.api.autoscaling import (
+            CrossVersionObjectReference,
+            HorizontalPodAutoscaler,
+            HorizontalPodAutoscalerSpec,
+        )
+
+        cs.resource("horizontalpodautoscalers").create(
+            HorizontalPodAutoscaler(
+                metadata=v1.ObjectMeta(name="hpa", namespace="default"),
+                spec=HorizontalPodAutoscalerSpec(
+                    scale_target_ref=CrossVersionObjectReference(
+                        kind="Deployment", name="web"
+                    ),
+                    max_replicas=10,
+                    target_cpu_utilization_percentage=80,
+                ),
+            )
+        )
+        ctrl.sync_all()
+        assert cs.deployments.get("web", "default").spec.replicas == 2
+
+
+class TestAdmission:
+    def test_priority_resolution(self):
+        api = install_default_admission(APIServer())
+        cs = Clientset(api)
+        cs.resource("priorityclasses").create(
+            PriorityClass(
+                metadata=v1.ObjectMeta(name="high"), value=1000
+            )
+        )
+        pod = make_pod("p")
+        pod.spec.priority_class_name = "high"
+        created = cs.pods.create(pod)
+        assert created.spec.priority == 1000
+        bad = make_pod("q")
+        bad.spec.priority_class_name = "nope"
+        with pytest.raises(Invalid):
+            cs.pods.create(bad)
+
+    def test_default_toleration_seconds(self):
+        api = install_default_admission(APIServer())
+        cs = Clientset(api)
+        created = cs.pods.create(make_pod("p"))
+        tols = {
+            t.key: t.toleration_seconds for t in created.spec.tolerations or []
+        }
+        assert tols.get(v1.TAINT_NODE_NOT_READY) == 300
+        assert tols.get(v1.TAINT_NODE_UNREACHABLE) == 300
+
+    def test_limit_ranger_defaults_and_max(self):
+        api = install_default_admission(APIServer())
+        cs = Clientset(api)
+        cs.resource("limitranges").create(
+            v1.LimitRange(
+                metadata=v1.ObjectMeta(name="lr", namespace="default"),
+                spec=v1.LimitRangeSpec(
+                    limits=[
+                        v1.LimitRangeItem(
+                            type="Container",
+                            default_request={"cpu": "100m"},
+                            max={"cpu": "1"},
+                        )
+                    ]
+                ),
+            )
+        )
+        created = cs.pods.create(make_pod("p"))
+        assert created.spec.containers[0].resources.requests["cpu"] == "100m"
+        big = make_pod("q", cpu="2")
+        with pytest.raises(Invalid):
+            cs.pods.create(big)
+
+    def test_namespace_lifecycle(self):
+        api = install_default_admission(APIServer())
+        cs = Clientset(api)
+        with pytest.raises(Invalid):
+            cs.pods.create(make_pod("p", namespace="nope"))
+        cs.namespaces.create(v1.Namespace(metadata=v1.ObjectMeta(name="ok")))
+        cs.pods.create(make_pod("p", namespace="ok"))
+
+    def test_resource_quota_enforced_and_status(self):
+        api = install_default_admission(APIServer())
+        cs = Clientset(api)
+        factory = SharedInformerFactory(cs)
+        cs.resource("resourcequotas").create(
+            v1.ResourceQuota(
+                metadata=v1.ObjectMeta(name="rq", namespace="default"),
+                spec=v1.ResourceQuotaSpec(hard={"cpu": "1", "pods": "2"}),
+            )
+        )
+        cs.pods.create(make_pod("a", cpu="600m"))
+        with pytest.raises(Invalid):
+            cs.pods.create(make_pod("b", cpu="600m"))  # cpu would exceed 1
+        cs.pods.create(make_pod("c", cpu="100m"))
+        with pytest.raises(Invalid):
+            cs.pods.create(make_pod("d"))  # pod count would exceed 2
+        ctrl = ResourceQuotaController(cs, factory)
+        ctrl.sync_once()
+        rq = cs.resource("resourcequotas").get("rq", "default")
+        assert rq.status.used["cpu"] == "700m"
+        assert rq.status.used["pods"] == "2"

@@ -1,0 +1,233 @@
+"""kubectl CLI tests against an in-proc cluster.
+
+Reference shape: staging/src/k8s.io/kubectl command tests (cmd/*_test.go)
+— verbs over a fake cluster, asserting output and API effects.
+"""
+
+import io
+import json
+
+import pytest
+import yaml
+
+from kubernetes_tpu.api import apps
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.api.labels import Selector
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.kubectl import Kubectl
+
+from .util import make_node, make_pod
+
+
+@pytest.fixture()
+def kubectl():
+    api = APIServer()
+    cs = Clientset(api)
+    out = io.StringIO()
+    return Kubectl(cs, out=out), cs, out
+
+
+def _lines(out):
+    return out.getvalue().strip().splitlines()
+
+
+class TestSelectorParse:
+    def test_grammar(self):
+        sel = Selector.parse("a=1,b!=2,c in (x, y),d notin (z),e,!f,g>5")
+        assert sel.matches({"a": "1", "c": "x", "e": "", "g": "7"})
+        assert not sel.matches({"a": "1", "c": "x", "e": "", "g": "7", "f": ""})
+        assert not sel.matches({"a": "1", "c": "q", "e": "", "g": "7"})
+        assert not sel.matches({"a": "1", "c": "x", "e": "", "g": "7", "b": "2"})
+
+    def test_set_op_without_space_before_paren(self):
+        # real kubectl lexer splits on '(' — no space required
+        sel = Selector.parse("app in(web,api)")
+        assert sel.matches({"app": "web"})
+        assert not sel.matches({"app": "db"})
+        sel = Selector.parse("app notin(web)")
+        assert sel.matches({"app": "db"})
+        assert not sel.matches({"app": "web"})
+
+
+class TestGet:
+    def test_get_pods_table(self, kubectl):
+        k, cs, out = kubectl
+        cs.nodes.create(make_node("n1"))
+        p = make_pod("web-1", node_name="n1", labels={"app": "web"})
+        cs.pods.create(p)
+        assert k.run(["get", "pods"]) == 0
+        lines = _lines(out)
+        assert lines[0].split()[:3] == ["NAME", "READY", "STATUS"]
+        assert lines[1].startswith("web-1")
+
+    def test_get_with_selector_and_output(self, kubectl):
+        k, cs, out = kubectl
+        cs.pods.create(make_pod("a", labels={"app": "x"}))
+        cs.pods.create(make_pod("b", labels={"app": "y"}))
+        assert k.run(["get", "pods", "-l", "app=x", "-o", "name"]) == 0
+        assert _lines(out) == ["pods/a"]
+
+    def test_get_yaml_roundtrip(self, kubectl):
+        k, cs, out = kubectl
+        cs.pods.create(make_pod("a", labels={"app": "x"}))
+        assert k.run(["get", "pods", "a", "-o", "yaml"]) == 0
+        doc = yaml.safe_load(out.getvalue())
+        assert doc["metadata"]["name"] == "a"
+        assert doc["metadata"]["labels"] == {"app": "x"}
+
+    def test_get_nodes_status(self, kubectl):
+        k, cs, out = kubectl
+        cs.nodes.create(make_node("n1"))
+        assert k.run(["cordon", "n1"]) == 0
+        out.truncate(0), out.seek(0)
+        assert k.run(["get", "nodes"]) == 0
+        assert "SchedulingDisabled" in _lines(out)[1]
+
+
+class TestManifests:
+    DEPLOY = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "web"},
+        "spec": {
+            "replicas": 2,
+            "selector": {"matchLabels": {"app": "web"}},
+            "template": {
+                "metadata": {"labels": {"app": "web"}},
+                "spec": {"containers": [{"name": "c", "image": "img:1"}]},
+            },
+        },
+    }
+
+    def test_create_from_file(self, kubectl, tmp_path):
+        k, cs, out = kubectl
+        f = tmp_path / "d.yaml"
+        f.write_text(yaml.safe_dump(self.DEPLOY))
+        assert k.run(["create", "-f", str(f)]) == 0
+        dep = cs.deployments.get("web", "default")
+        assert dep.spec.replicas == 2
+
+    def test_apply_three_way(self, kubectl, tmp_path):
+        k, cs, out = kubectl
+        f = tmp_path / "d.yaml"
+        f.write_text(yaml.safe_dump(self.DEPLOY))
+        assert k.run(["apply", "-f", str(f)]) == 0
+        assert "created" in out.getvalue()
+        # server-side mutation not tracked by apply: status update
+        dep = cs.deployments.get("web", "default")
+        dep.status.replicas = 2
+        cs.deployments.update_status(dep)
+        # re-apply with replicas gone (field removal) and image changed
+        doc = json.loads(json.dumps(self.DEPLOY))
+        del doc["spec"]["replicas"]
+        doc["spec"]["template"]["spec"]["containers"][0]["image"] = "img:2"
+        f.write_text(yaml.safe_dump(doc))
+        assert k.run(["apply", "-f", str(f)]) == 0
+        dep = cs.deployments.get("web", "default")
+        assert dep.spec.replicas is None  # removed by 3-way merge
+        assert dep.spec.template.spec.containers[0].image == "img:2"
+        assert dep.status.replicas == 2  # live-only field preserved
+
+    def test_delete_from_file(self, kubectl, tmp_path):
+        k, cs, out = kubectl
+        f = tmp_path / "d.yaml"
+        f.write_text(yaml.safe_dump(self.DEPLOY))
+        assert k.run(["create", "-f", str(f)]) == 0
+        assert k.run(["delete", "-f", str(f)]) == 0
+        from kubernetes_tpu.apiserver.server import NotFound
+
+        with pytest.raises(NotFound):
+            cs.deployments.get("web", "default")
+
+
+class TestNodeOps:
+    def test_scale(self, kubectl):
+        k, cs, out = kubectl
+        cs.deployments.create(
+            apps.Deployment(
+                metadata=v1.ObjectMeta(name="web", namespace="default"),
+                spec=apps.DeploymentSpec(
+                    replicas=1,
+                    selector=v1.LabelSelector(match_labels={"a": "b"}),
+                    template=v1.PodTemplateSpec(
+                        metadata=v1.ObjectMeta(labels={"a": "b"}),
+                        spec=v1.PodSpec(containers=[v1.Container(name="c", image="i")]),
+                    ),
+                ),
+            )
+        )
+        assert k.run(["scale", "deploy/web", "--replicas", "5"]) == 0
+        assert cs.deployments.get("web", "default").spec.replicas == 5
+
+    def test_label_annotate(self, kubectl):
+        k, cs, out = kubectl
+        cs.pods.create(make_pod("p"))
+        assert k.run(["label", "pods", "p", "tier=db"]) == 0
+        assert cs.pods.get("p", "default").metadata.labels["tier"] == "db"
+        # no overwrite without flag
+        assert k.run(["label", "pods", "p", "tier=web"]) == 1
+        assert k.run(["label", "pods", "p", "tier=web", "--overwrite"]) == 0
+        assert cs.pods.get("p", "default").metadata.labels["tier"] == "web"
+        assert k.run(["label", "pods", "p", "tier-"]) == 0
+        assert "tier" not in (cs.pods.get("p", "default").metadata.labels or {})
+        assert k.run(["annotate", "pods", "p", "note=x"]) == 0
+        assert cs.pods.get("p", "default").metadata.annotations["note"] == "x"
+
+    def test_taint(self, kubectl):
+        k, cs, out = kubectl
+        cs.nodes.create(make_node("n1"))
+        assert k.run(["taint", "nodes", "n1", "gpu=true:NoSchedule"]) == 0
+        node = cs.nodes.get("n1")
+        assert node.spec.taints[0].key == "gpu"
+        assert node.spec.taints[0].effect == "NoSchedule"
+        assert k.run(["taint", "nodes", "n1", "gpu-"]) == 0
+        assert not cs.nodes.get("n1").spec.taints
+
+    def test_drain(self, kubectl):
+        k, cs, out = kubectl
+        cs.nodes.create(make_node("n1"))
+        managed = make_pod("m", node_name="n1")
+        managed.metadata.owner_references = [
+            v1.OwnerReference(kind="ReplicaSet", name="rs")
+        ]
+        ds_pod = make_pod("d", node_name="n1")
+        ds_pod.metadata.owner_references = [
+            v1.OwnerReference(kind="DaemonSet", name="ds")
+        ]
+        bare = make_pod("b", node_name="n1")
+        for p in (managed, ds_pod, bare):
+            cs.pods.create(p)
+        # refuses: daemonset pod present
+        assert k.run(["drain", "n1"]) == 1
+        assert (
+            k.run(["drain", "n1", "--ignore-daemonsets"]) == 1
+        )  # bare pod needs --force
+        assert k.run(["drain", "n1", "--ignore-daemonsets", "--force"]) == 0
+        remaining = {p.metadata.name for p in cs.pods.list()[0]}
+        assert remaining == {"d"}  # only the DaemonSet pod stays
+        assert cs.nodes.get("n1").spec.unschedulable
+
+    def test_rollout_status(self, kubectl):
+        k, cs, out = kubectl
+        cs.deployments.create(
+            apps.Deployment(
+                metadata=v1.ObjectMeta(name="web", namespace="default"),
+                spec=apps.DeploymentSpec(
+                    replicas=2,
+                    selector=v1.LabelSelector(match_labels={"a": "b"}),
+                    template=v1.PodTemplateSpec(
+                        metadata=v1.ObjectMeta(labels={"a": "b"}),
+                        spec=v1.PodSpec(containers=[v1.Container(name="c", image="i")]),
+                    ),
+                ),
+            )
+        )
+        assert k.run(["rollout", "status", "deploy/web"]) == 0
+        assert "Waiting" in out.getvalue()
+        dep = cs.deployments.get("web", "default")
+        dep.status.available_replicas = 2
+        cs.deployments.update_status(dep)
+        out.truncate(0), out.seek(0)
+        assert k.run(["rollout", "status", "deploy/web"]) == 0
+        assert "successfully rolled out" in out.getvalue()

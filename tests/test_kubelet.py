@@ -269,3 +269,20 @@ class TestHollowCluster:
             assert total == 30
         finally:
             hollow.stop()
+
+
+class TestFakeCRIIPAM:
+    def test_pod_ip_reuse_no_collision_under_churn(self):
+        # /24 mode: monotonic allocation would wrap at 256 and hand a live
+        # pod's IP to a new sandbox; first-fit reuse must not
+        rt = FakeRuntimeService(ip_prefix="10.64.0")
+        keeper = rt.run_pod_sandbox("keep", "default", "uid-keep")
+        keep_ip = next(s.ip for s in rt.list_pod_sandboxes() if s.id == keeper)
+        for i in range(300):  # churn well past the 256 range
+            sid = rt.run_pod_sandbox(f"p{i}", "default", f"uid-{i}")
+            rt.stop_pod_sandbox(sid)
+            rt.remove_pod_sandbox(sid)
+        fresh = rt.run_pod_sandbox("new", "default", "uid-new")
+        ips = [s.ip for s in rt.list_pod_sandboxes()]
+        assert len(ips) == len(set(ips)) == 2
+        assert keep_ip in ips

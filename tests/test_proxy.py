@@ -1,0 +1,226 @@
+"""kube-proxy equivalent: EndpointSlice controller + proxier chain model.
+
+Reference shape: pkg/proxy/iptables/proxier_test.go (syncProxyRules rule
+synthesis, session affinity, nodeports, no-endpoints REJECT) and
+pkg/controller/endpointslice tests.
+"""
+
+import random
+from collections import Counter
+
+import pytest
+
+from kubernetes_tpu.api import discovery
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers.endpointslice import EndpointSliceController
+from kubernetes_tpu.proxy import Packet, Proxier
+
+from .util import wait_until
+
+
+def _svc(name, cluster_ip, port=80, target_port=8080, selector=None, **kw):
+    return v1.Service(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        spec=v1.ServiceSpec(
+            selector=selector or {"app": name},
+            cluster_ip=cluster_ip,
+            ports=[v1.ServicePort(name="http", port=port, target_port=target_port)],
+            **kw,
+        ),
+    )
+
+
+def _running_pod(name, ip, labels):
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name, namespace="default", labels=labels),
+        spec=v1.PodSpec(node_name="n1", containers=[v1.Container(name="c", image="i")]),
+        status=v1.PodStatus(
+            phase="Running",
+            pod_ip=ip,
+            conditions=[v1.PodCondition(type="Ready", status="True")],
+        ),
+    )
+
+
+@pytest.fixture()
+def cluster():
+    api = APIServer()
+    cs = Clientset(api)
+    factory = SharedInformerFactory(cs)
+    ctrl = EndpointSliceController(cs, factory)
+    proxier = Proxier(factory, rng=random.Random(7))
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    ctrl.run()
+    yield cs, proxier
+    ctrl.stop()
+    factory.stop()
+
+
+def _slices_for(cs, name):
+    slices, _ = cs.resource("endpointslices").list(namespace="default")
+    return [
+        s
+        for s in slices
+        if (s.metadata.labels or {}).get(discovery.LABEL_SERVICE_NAME) == name
+    ]
+
+
+class TestEndpointSliceController:
+    def test_slices_mirror_pods(self, cluster):
+        cs, _ = cluster
+        cs.services.create(_svc("web", "10.0.0.1"))
+        for i in range(3):
+            cs.pods.create(_running_pod(f"web-{i}", f"10.1.0.{i}", {"app": "web"}))
+        assert wait_until(
+            lambda: sum(
+                len(s.endpoints or []) for s in _slices_for(cs, "web")
+            ) == 3
+        )
+        sl = _slices_for(cs, "web")[0]
+        assert sl.ports[0].port == 8080
+        assert all(ep.conditions.ready for ep in sl.endpoints)
+
+    def test_slice_chunking(self, cluster):
+        cs, _ = cluster
+        ctrl_max = discovery.MAX_ENDPOINTS_PER_SLICE
+        cs.services.create(_svc("big", "10.0.0.2"))
+        for i in range(ctrl_max + 5):
+            cs.pods.create(
+                _running_pod(f"big-{i}", f"10.2.{i // 250}.{i % 250}", {"app": "big"})
+            )
+        assert wait_until(
+            lambda: sorted(
+                len(s.endpoints or []) for s in _slices_for(cs, "big")
+            ) == [5, ctrl_max]
+        )
+
+    def test_service_delete_removes_slices(self, cluster):
+        cs, _ = cluster
+        cs.services.create(_svc("gone", "10.0.0.3"))
+        cs.pods.create(_running_pod("gone-0", "10.3.0.0", {"app": "gone"}))
+        assert wait_until(lambda: len(_slices_for(cs, "gone")) == 1)
+        cs.services.delete("gone", "default")
+        assert wait_until(lambda: len(_slices_for(cs, "gone")) == 0)
+
+
+class TestProxier:
+    def test_clusterip_dnat_balances(self, cluster):
+        cs, proxier = cluster
+        cs.services.create(_svc("web", "10.0.0.1"))
+        ips = {f"10.1.0.{i}" for i in range(3)}
+        for i in range(3):
+            cs.pods.create(_running_pod(f"web-{i}", f"10.1.0.{i}", {"app": "web"}))
+        assert wait_until(
+            lambda: sum(
+                1 for n in proxier.netfilter.chains if n.startswith("KUBE-SEP-")
+            ) == 3
+        )
+        hits = Counter()
+        for i in range(300):
+            ip, port = proxier.route(
+                Packet(dst_ip="10.0.0.1", dst_port=80, src_ip=f"10.9.0.{i}")
+            )
+            assert port == 8080
+            hits[ip] += 1
+        assert set(hits) == ips
+        # statistic-random cascade is roughly uniform
+        assert all(60 <= v <= 140 for v in hits.values()), hits
+
+    def test_no_endpoints_rejects(self, cluster):
+        cs, proxier = cluster
+        cs.services.create(_svc("empty", "10.0.0.9"))
+        assert wait_until(lambda: proxier.sync_count > 0)
+        wait_until(
+            lambda: any(
+                r.target == "REJECT" and r.dst_ip == "10.0.0.9"
+                for r in proxier.netfilter.chains["KUBE-SERVICES"].rules
+            )
+        )
+        with pytest.raises(ConnectionRefusedError):
+            proxier.route(Packet(dst_ip="10.0.0.9", dst_port=80, src_ip="10.9.9.9"))
+
+    def test_unknown_vip_passes_through(self, cluster):
+        _, proxier = cluster
+        proxier.sync_proxy_rules()
+        with pytest.raises(LookupError):
+            proxier.route(Packet(dst_ip="192.168.1.1", dst_port=443, src_ip="x"))
+
+    def test_session_affinity_client_ip(self, cluster):
+        cs, proxier = cluster
+        cs.services.create(
+            _svc("sticky", "10.0.0.4", session_affinity="ClientIP")
+        )
+        for i in range(4):
+            cs.pods.create(
+                _running_pod(f"sticky-{i}", f"10.4.0.{i}", {"app": "sticky"})
+            )
+        assert wait_until(
+            lambda: sum(len(s.endpoints or []) for s in _slices_for(cs, "sticky")) == 4
+            and proxier.sync_count > 0
+            and any(
+                r.dst_ip == "10.0.0.4" and r.target != "REJECT"
+                for r in proxier.netfilter.chains["KUBE-SERVICES"].rules
+            )
+        )
+        first = proxier.route(Packet(dst_ip="10.0.0.4", dst_port=80, src_ip="10.9.0.1"))
+        for _ in range(50):
+            again = proxier.route(
+                Packet(dst_ip="10.0.0.4", dst_port=80, src_ip="10.9.0.1")
+            )
+            assert again == first
+        # a different client may land elsewhere and then sticks too
+        other = proxier.route(Packet(dst_ip="10.0.0.4", dst_port=80, src_ip="10.9.0.2"))
+        for _ in range(20):
+            assert (
+                proxier.route(Packet(dst_ip="10.0.0.4", dst_port=80, src_ip="10.9.0.2"))
+                == other
+            )
+
+    def test_nodeport_routes(self, cluster):
+        cs, proxier = cluster
+        svc = _svc("np", "10.0.0.5", type="NodePort")
+        svc.spec.ports[0].node_port = 30080
+        cs.services.create(svc)
+        cs.pods.create(_running_pod("np-0", "10.5.0.0", {"app": "np"}))
+        assert wait_until(
+            lambda: sum(len(s.endpoints or []) for s in _slices_for(cs, "np")) == 1
+            and any(
+                r.dst_port == 30080
+                for r in proxier.netfilter.chains.get(
+                    "KUBE-NODEPORTS", type("C", (), {"rules": []})
+                ).rules
+            )
+        )
+        # node IP, nodePort -> falls through KUBE-SERVICES to KUBE-NODEPORTS
+        ip, port = proxier.route(
+            Packet(dst_ip="172.16.0.7", dst_port=30080, src_ip="z")
+        )
+        assert (ip, port) == ("10.5.0.0", 8080)
+
+    def test_endpoint_removal_resyncs(self, cluster):
+        cs, proxier = cluster
+        cs.services.create(_svc("shrink", "10.0.0.6"))
+        for i in range(2):
+            cs.pods.create(
+                _running_pod(f"shrink-{i}", f"10.6.0.{i}", {"app": "shrink"})
+            )
+        assert wait_until(
+            lambda: sum(len(s.endpoints or []) for s in _slices_for(cs, "shrink")) == 2
+        )
+        cs.pods.delete("shrink-0", "default")
+        def only_one_left():
+            try:
+                hits = {
+                    proxier.route(
+                        Packet(dst_ip="10.0.0.6", dst_port=80, src_ip=f"c{i}")
+                    )[0]
+                    for i in range(20)
+                }
+            except ConnectionRefusedError:
+                return False
+            return hits == {"10.6.0.1"}
+        assert wait_until(only_one_left)
