@@ -150,6 +150,10 @@ class APIServer:
         self._resources: Dict[str, ResourceInfo] = {r.name: r for r in resources}
         self._mutating = mutating_admission or []
         self._validating = validating_admission or []
+        # called AFTER a successful create/update/hard-delete with
+        # (resource, op, obj) — serving-state side effects (e.g. CRD
+        # registration) must not fire for writes the store rejects
+        self._post_write: List[AdmissionFunc] = []
         self._lock = threading.Lock()
 
     def register_resource(self, info: ResourceInfo) -> None:
@@ -203,7 +207,10 @@ class APIServer:
                 rev = self.store.create(key, body)
             except kv.KeyExists:
                 raise AlreadyExists(key)
-        return self._stamp(info, body, rev)
+        created = self._stamp(info, body, rev)
+        for hook in self._post_write:
+            hook(resource, "CREATE", created)
+        return created
 
     def get(self, resource: str, name: str, namespace: str = "") -> Any:
         info = self._info(resource)
@@ -233,7 +240,10 @@ class APIServer:
             raise NotFound(str(e))
         except kv.Conflict as e:
             raise Conflict(str(e))
-        return self._stamp(info, body, rev)
+        updated = self._stamp(info, body, rev)
+        for hook in self._post_write:
+            hook(resource, op, updated)
+        return updated
 
     def delete(self, resource: str, name: str, namespace: str = "") -> None:
         """Delete, honoring finalizers: an object with a non-empty
@@ -265,7 +275,12 @@ class APIServer:
                     nb["metadata"] = meta
                     self.store.update(key, nb, expected_mod_revision=kvv.mod_revision)
                 else:
-                    self.store.delete(key, expected_mod_revision=kvv.mod_revision)
+                    del_rev = self.store.delete(
+                        key, expected_mod_revision=kvv.mod_revision
+                    )
+                    deleted = self._stamp(info, body, del_rev)
+                    for hook in self._post_write:
+                        hook(resource, "DELETE", deleted)
                 return
             except kv.Conflict:
                 continue
@@ -290,6 +305,7 @@ class APIServer:
                 meta.pop("finalizers", None)
             nb["metadata"] = meta
             done["delete"] = not fins and meta.get("deletionTimestamp") is not None
+            done["body"] = nb
             return nb
 
         try:
@@ -298,7 +314,10 @@ class APIServer:
             # finalizer) raced in after the removal, re-check before deleting
             while done.get("delete"):
                 try:
-                    self.store.delete(key, expected_mod_revision=rev)
+                    del_rev = self.store.delete(key, expected_mod_revision=rev)
+                    deleted = self._stamp(info, done["body"], del_rev)
+                    for hook in self._post_write:
+                        hook(resource, "DELETE", deleted)
                     break
                 except kv.Conflict:
                     kvv = self.store.get(key)
@@ -334,7 +353,10 @@ class APIServer:
         return items, rev
 
     def watch(
-        self, resource: str, namespace: Optional[str] = None, since_revision: int = 0
+        self,
+        resource: str,
+        namespace: Optional[str] = None,
+        since_revision: Optional[int] = None,
     ) -> TypedWatch:
         info = self._info(resource)
         raw = self.store.watch(self._prefix(info, namespace), since_revision)

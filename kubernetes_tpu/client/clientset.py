@@ -41,7 +41,9 @@ class _ResourceClient:
     ) -> Tuple[List[Any], int]:
         return self._api.list(self._resource, namespace, label_selector)
 
-    def watch(self, namespace: Optional[str] = None, since_revision: int = 0) -> TypedWatch:
+    def watch(
+        self, namespace: Optional[str] = None, since_revision: Optional[int] = None
+    ) -> TypedWatch:
         return self._api.watch(self._resource, namespace, since_revision)
 
 

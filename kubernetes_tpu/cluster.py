@@ -1,0 +1,205 @@
+"""Cluster bootstrap: bring up a whole control plane in one call.
+
+Reference: cmd/kubeadm's init flow wires the control-plane components
+(etcd, apiserver, controller-manager, scheduler) and joins nodes; this is
+the in-process equivalent — one object that assembles the store (Python
+or native C++), apiserver (+ default admission chain + CRDs), controller
+manager, scheduler (oracle or TPU backend), per-node proxies, and hollow
+kubelets, with /configz entries installed for each component. Tests and
+demos use it as `with Cluster(n_nodes=4) as c: c.kubectl(...)`.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Dict, List, Optional
+
+from .apiserver.admission import install_default_admission
+from .apiserver.crd import CRDManager
+from .apiserver.server import APIServer
+from .client.clientset import Clientset
+from .client.informer import SharedInformerFactory
+from .controllers.manager import ControllerManager
+from .kubectl import Kubectl
+from .kubemark import HollowCluster
+from .proxy import Proxier
+from .scheduler.apis.config import default_configuration
+from .scheduler.factory import create_scheduler
+from .utils import configz
+from .utils.featuregate import default_feature_gate
+
+DEFAULT_CONTROLLERS = [
+    "replicaset",
+    "deployment",
+    "daemonset",
+    "statefulset",
+    "job",
+    "cronjob",
+    "ttl-after-finished",
+    "endpoint",
+    "endpointslice",
+    "namespace",
+    "garbagecollector",
+    "persistentvolume-binder",
+    "nodelifecycle",
+    "disruption",
+    "resourcequota",
+]
+
+FAST_NODE_CONFIG = dict(
+    sync_period=0.5,
+    pleg_period=0.1,
+    housekeeping_period=0.3,
+    lease_renew_period=0.3,
+    node_status_period=0.3,
+)
+
+
+class Cluster:
+    def __init__(
+        self,
+        n_nodes: int = 0,
+        controllers: Optional[List[str]] = None,
+        scheduler_backend: Optional[str] = None,
+        native_store: bool = False,
+        feature_gates: str = "",
+        admission: bool = True,
+        proxies: bool = False,
+        node_config: Optional[Dict] = None,
+        controller_opts: Optional[Dict] = None,
+    ):
+        # save the process-global gate overrides so stop() can restore them
+        # (gates must not leak across Cluster instances)
+        self._fg_saved = default_feature_gate.overrides()
+        try:
+            self._init(
+                n_nodes,
+                controllers,
+                scheduler_backend,
+                native_store,
+                feature_gates,
+                admission,
+                proxies,
+                node_config,
+                controller_opts,
+            )
+        except BaseException:
+            default_feature_gate.restore(self._fg_saved)
+            raise
+
+    def _init(
+        self,
+        n_nodes,
+        controllers,
+        scheduler_backend,
+        native_store,
+        feature_gates,
+        admission,
+        proxies,
+        node_config,
+        controller_opts,
+    ) -> None:
+        if feature_gates:
+            default_feature_gate.set_from_string(feature_gates)
+        store = None
+        if native_store:
+            from .store.native import NativeKVStore
+
+            store = NativeKVStore()
+        self.api = APIServer(store=store)
+        if admission:
+            install_default_admission(self.api)
+        self.crds = CRDManager(self.api).install()
+        self.client = Clientset(self.api)
+        self.hollow: Optional[HollowCluster] = None
+        if n_nodes:
+            self.hollow = HollowCluster(
+                self.client,
+                n_nodes=n_nodes,
+                config_overrides=node_config or FAST_NODE_CONFIG,
+            )
+        self.kcm = ControllerManager(
+            self.client,
+            controllers=controllers if controllers is not None else DEFAULT_CONTROLLERS,
+            **(controller_opts or {}),
+        )
+        self.proxiers: List[Proxier] = []
+        if proxies and self.hollow is not None:
+            for kl in self.hollow.kubelets:
+                self.proxiers.append(
+                    Proxier(self.kcm.informers, node_name=kl.config.node_name)
+                )
+        self._sched_factory = SharedInformerFactory(self.client)
+        self.scheduler_config = default_configuration()
+        if scheduler_backend:
+            for profile in self.scheduler_config.profiles:
+                profile.backend = scheduler_backend
+        self.scheduler = create_scheduler(
+            self.client, self._sched_factory, self.scheduler_config
+        )
+        self._started = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Cluster":
+        try:
+            if self.hollow is not None:
+                self.hollow.start()
+            self.kcm.run()
+            self._sched_factory.start()
+            if not self._sched_factory.wait_for_cache_sync():
+                raise RuntimeError("scheduler informers failed to sync")
+            self.scheduler.start()
+            self._fg_state = default_feature_gate.state()
+            configz.install("kubescheduler.config.k8s.io", self.scheduler_config)
+            configz.install("featuregates", self._fg_state)
+        except BaseException:
+            # partial start must not leak component threads or gate
+            # overrides (the context manager's __exit__ never runs when
+            # __enter__ raises)
+            self._teardown()
+            raise
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self._teardown()
+
+    def _teardown(self) -> None:
+        for closer in (
+            self.scheduler.stop,
+            self._sched_factory.stop,
+            self.kcm.stop,
+            self.hollow.stop if self.hollow is not None else None,
+        ):
+            if closer is None:
+                continue
+            try:
+                closer()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        # only remove OUR entries (another live cluster may have
+        # re-installed the canonical names) and restore gate overrides
+        configz.delete_if_is("kubescheduler.config.k8s.io", self.scheduler_config)
+        if getattr(self, "_fg_state", None) is not None:
+            configz.delete_if_is("featuregates", self._fg_state)
+        default_feature_gate.restore(self._fg_saved)
+        self._started = False
+
+    def __enter__(self) -> "Cluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- conveniences -------------------------------------------------------
+
+    def kubectl(self, *argv: str) -> str:
+        """Run a kubectl command; returns its output (raises on rc != 0)."""
+        out = io.StringIO()
+        rc = Kubectl(self.client, out=out).run(list(argv))
+        if rc != 0:
+            raise RuntimeError(f"kubectl {' '.join(argv)} failed:\n{out.getvalue()}")
+        return out.getvalue()

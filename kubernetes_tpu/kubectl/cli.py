@@ -91,6 +91,15 @@ class Kubectl:
                     return info.name
             except Exception:  # noqa: BLE001 — types without default kind
                 continue
+        # custom resources: resolve through the CRD names (discovery would
+        # serve these in the reference)
+        try:
+            crds, _ = self.cs.api.list("customresourcedefinitions")
+        except APIError:
+            crds = []
+        for crd in crds:
+            if crd.spec.names.kind == kind:
+                return crd.spec.names.plural
         raise APIError(f"no resource registered for kind {kind!r}")
 
     def _client(self, resource: str):

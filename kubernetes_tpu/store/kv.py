@@ -189,26 +189,20 @@ class KVStore:
             return self._rev
 
     def guaranteed_update(self, key: str, fn, max_retries: int = 16) -> int:
-        """Read-modify-write with conflict retry (etcd3 store.go:286
-        GuaranteedUpdate's optimistic loop). fn(value) -> new value."""
-        for _ in range(max_retries):
-            kv = self.get(key)
-            new_value = fn(kv.value)
-            try:
-                return self.update(key, new_value, expected_mod_revision=kv.mod_revision)
-            except Conflict:
-                continue
-        raise Conflict(f"{key}: too many conflicts in guaranteed_update")
+        return guaranteed_update(self, key, fn, max_retries)
 
     # -- watch -------------------------------------------------------------
 
-    def watch(self, prefix: str = "", since_revision: int = 0) -> Watch:
-        """Events with revision > since_revision under prefix. since=0 means
-        'from now'. Raises Compacted if the backlog was trimmed past the
-        requested revision."""
+    def watch(self, prefix: str = "", since_revision: Optional[int] = None) -> Watch:
+        """Events with revision > since_revision under prefix. since=None
+        means 'from now' (live-only); any int — INCLUDING 0, the revision
+        of an empty store — replays history after that revision, so a
+        lister that saw revision 0 has no list->watch event gap. Raises
+        Compacted if the backlog was trimmed past the requested
+        revision."""
         with self._lock:
             w = Watch(self, prefix)
-            if since_revision:
+            if since_revision is not None:
                 if since_revision < self._compacted_rev:
                     raise Compacted(
                         f"revision {since_revision} compacted (floor {self._compacted_rev})"
@@ -240,3 +234,17 @@ class KVStore:
             while self._history and self._history[0].revision <= revision:
                 dropped = self._history.popleft()
                 self._compacted_rev = dropped.revision
+
+
+def guaranteed_update(store, key: str, fn, max_retries: int = 16) -> int:
+    """Read-modify-write with conflict retry (etcd3 store.go:286
+    GuaranteedUpdate's optimistic loop). fn(value) -> new value. Shared by
+    every store backend so retry semantics can't diverge."""
+    for _ in range(max_retries):
+        kv = store.get(key)
+        new_value = fn(kv.value)
+        try:
+            return store.update(key, new_value, expected_mod_revision=kv.mod_revision)
+        except Conflict:
+            continue
+    raise Conflict(f"{key}: too many conflicts in guaranteed_update")

@@ -1,0 +1,48 @@
+"""/configz registry (component-base/configz equivalent).
+
+Reference: staging/src/k8s.io/component-base/configz/configz.go — each
+component installs its live ComponentConfig under a name; the /configz
+handler serializes the whole map so operators can inspect the running
+configuration.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict
+
+from . import serde
+
+_lock = threading.Lock()
+_registry: Dict[str, Any] = {}
+
+
+def install(name: str, config: Any) -> None:
+    """Register (or replace) a component's live config object."""
+    with _lock:
+        _registry[name] = config
+
+
+def delete(name: str) -> None:
+    with _lock:
+        _registry.pop(name, None)
+
+
+def delete_if_is(name: str, config: Any) -> None:
+    """Remove the entry only if it is still this exact object — two
+    components (test clusters) sharing a canonical name must not delete
+    each other's live entry."""
+    with _lock:
+        if _registry.get(name) is config:
+            _registry.pop(name, None)
+
+
+def snapshot() -> Dict[str, Any]:
+    """JSON-compatible view of every registered config (the /configz body)."""
+    with _lock:
+        return {name: serde.to_dict(cfg) for name, cfg in _registry.items()}
+
+
+def handler_body() -> str:
+    return json.dumps(snapshot(), indent=2, sort_keys=True)

@@ -58,6 +58,9 @@ def to_dict(obj: Any) -> Any:
     """Serialize a dataclass (or container of them) to JSON-compatible dicts."""
     if obj is None:
         return None
+    custom = getattr(obj, "__serde_to_dict__", None)
+    if custom is not None and not isinstance(obj, type):
+        return custom()
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         hints = _resolved_hints(type(obj))
         out: Dict[str, Any] = {}
@@ -97,6 +100,8 @@ def _from_value(tp: Any, data: Any) -> Any:
         args = get_args(tp)
         val_tp = args[1] if len(args) == 2 else Any
         return {k: _from_value(val_tp, v) for k, v in data.items()}
+    if isinstance(tp, type) and hasattr(tp, "__serde_from_dict__"):
+        return tp.__serde_from_dict__(data)
     if dataclasses.is_dataclass(tp):
         hints = typing.get_type_hints(tp)
         kwargs = {}

@@ -1,0 +1,288 @@
+// Revisioned, ordered, watchable KV store — native (C++) etcd-equivalent.
+//
+// Reference: the reference cluster keeps all state in etcd, a separate
+// native process reached over gRPC (staging/src/k8s.io/apiserver/pkg/
+// storage/etcd3/store.go:143 Create, :286 GuaranteedUpdate, :816 Watch;
+// SURVEY.md §2.4.2). This library reproduces the same transactional
+// semantics as kubernetes_tpu/store/kv.py behind a C ABI consumed via
+// ctypes (kubernetes_tpu/store/native.py):
+//
+//   * one monotonically-increasing int64 revision across all keys;
+//   * create-if-absent; update/delete guarded by expected mod revision;
+//   * prefix range reads returning (items, store revision);
+//   * watches replayed from any uncompacted revision, then live, with a
+//     bounded event log (compaction -> -2 "compacted", the 410 Gone
+//     analog).
+//
+// All blocking waits happen in native code (std::condition_variable), so
+// Python watch polls release the GIL — informer fan-out does not serialize
+// the interpreter the way the pure-Python store's queue.get does.
+//
+// Wire format (list/event buffers) is length-prefixed little-endian; the
+// Python side slices it with struct.unpack_from. Buffers are malloc'd here
+// and released with kv_buf_free.
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Value {
+  std::string data;
+  int64_t create_rev = 0;
+  int64_t mod_rev = 0;
+};
+
+struct EventRec {
+  uint8_t type;  // 0 ADDED, 1 MODIFIED, 2 DELETED
+  std::string key;
+  std::string value;  // current (ADDED/MODIFIED) or last (DELETED)
+  int64_t rev;
+};
+
+struct WatchState {
+  std::string prefix;
+  std::deque<EventRec> queue;
+  bool stopped = false;
+};
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;  // signaled on any new event
+  std::map<std::string, Value> data;  // ordered -> prefix scans
+  std::deque<EventRec> history;
+  size_t history_limit;
+  int64_t rev = 0;
+  int64_t compacted_rev = 0;
+  std::unordered_map<int64_t, std::shared_ptr<WatchState>> watches;
+  int64_t next_watch_id = 1;
+
+  explicit Store(size_t limit) : history_limit(limit) {}
+
+  void append_event(uint8_t type, const std::string& key,
+                    const std::string& value) {
+    EventRec ev{type, key, value, rev};
+    history.push_back(ev);
+    while (history.size() > history_limit) {
+      compacted_rev = history.front().rev;
+      history.pop_front();
+    }
+    for (auto& [id, w] : watches) {
+      if (!w->stopped && key.compare(0, w->prefix.size(), w->prefix) == 0) {
+        w->queue.push_back(ev);
+      }
+    }
+    cv.notify_all();
+  }
+};
+
+char* alloc_buf(size_t n) { return static_cast<char*>(malloc(n)); }
+
+void put_u32(std::string& out, uint32_t v) {
+  out.append(reinterpret_cast<const char*>(&v), 4);
+}
+void put_i64(std::string& out, int64_t v) {
+  out.append(reinterpret_cast<const char*>(&v), 8);
+}
+
+char* to_heap(const std::string& s, int64_t* out_len) {
+  char* buf = alloc_buf(s.size());
+  memcpy(buf, s.data(), s.size());
+  *out_len = static_cast<int64_t>(s.size());
+  return buf;
+}
+
+void encode_event(std::string& out, const EventRec& ev) {
+  out.push_back(static_cast<char>(ev.type));
+  put_u32(out, static_cast<uint32_t>(ev.key.size()));
+  out.append(ev.key);
+  put_u32(out, static_cast<uint32_t>(ev.value.size()));
+  out.append(ev.value);
+  put_i64(out, ev.rev);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kv_new(int64_t history_limit) {
+  return new Store(history_limit > 0 ? static_cast<size_t>(history_limit)
+                                     : 100000);
+}
+
+void kv_free(void* h) { delete static_cast<Store*>(h); }
+
+void kv_buf_free(char* p) { free(p); }
+
+// -> new revision, or -1 if the key exists
+int64_t kv_create(void* h, const char* key, const char* val, int64_t len) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  std::string k(key);
+  if (s->data.count(k)) return -1;
+  s->rev += 1;
+  Value v{std::string(val, static_cast<size_t>(len)), s->rev, s->rev};
+  s->data.emplace(k, v);
+  s->append_event(0, k, v.data);
+  return s->rev;
+}
+
+// expected_rev: -1 = unconditional. -> new revision, -1 not found,
+// -2 conflict
+int64_t kv_update(void* h, const char* key, const char* val, int64_t len,
+                  int64_t expected_rev) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  auto it = s->data.find(key);
+  if (it == s->data.end()) return -1;
+  if (expected_rev >= 0 && it->second.mod_rev != expected_rev) return -2;
+  s->rev += 1;
+  it->second.data.assign(val, static_cast<size_t>(len));
+  it->second.mod_rev = s->rev;
+  s->append_event(1, it->first, it->second.data);
+  return s->rev;
+}
+
+// -> revision of the delete, -1 not found, -2 conflict
+int64_t kv_delete(void* h, const char* key, int64_t expected_rev) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  auto it = s->data.find(key);
+  if (it == s->data.end()) return -1;
+  if (expected_rev >= 0 && it->second.mod_rev != expected_rev) return -2;
+  s->rev += 1;
+  std::string last = std::move(it->second.data);
+  std::string k = it->first;
+  s->data.erase(it);
+  s->append_event(2, k, last);
+  return s->rev;
+}
+
+// -> malloc'd value buffer (caller frees), or NULL if absent.
+char* kv_get(void* h, const char* key, int64_t* out_len,
+             int64_t* out_create_rev, int64_t* out_mod_rev) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  auto it = s->data.find(key);
+  if (it == s->data.end()) return nullptr;
+  *out_create_rev = it->second.create_rev;
+  *out_mod_rev = it->second.mod_rev;
+  return to_heap(it->second.data, out_len);
+}
+
+// Buffer: [u32 n] n*{u32 klen, key, u32 vlen, val, i64 create, i64 mod}
+// [i64 store_rev]; caller frees.
+char* kv_list(void* h, const char* prefix, int64_t* out_len) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  std::string p(prefix);
+  std::string out;
+  uint32_t n = 0;
+  std::string body;
+  for (auto it = s->data.lower_bound(p); it != s->data.end(); ++it) {
+    if (it->first.compare(0, p.size(), p) != 0) break;
+    put_u32(body, static_cast<uint32_t>(it->first.size()));
+    body.append(it->first);
+    put_u32(body, static_cast<uint32_t>(it->second.data.size()));
+    body.append(it->second.data);
+    put_i64(body, it->second.create_rev);
+    put_i64(body, it->second.mod_rev);
+    n += 1;
+  }
+  put_u32(out, n);
+  out.append(body);
+  put_i64(out, s->rev);
+  return to_heap(out, out_len);
+}
+
+int64_t kv_rev(void* h) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->rev;
+}
+
+int64_t kv_compacted_rev(void* h) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->compacted_rev;
+}
+
+// Drop history up to and including `revision` (etcd compaction).
+void kv_compact(void* h, int64_t revision) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  while (!s->history.empty() && s->history.front().rev <= revision) {
+    s->compacted_rev = s->history.front().rev;
+    s->history.pop_front();
+  }
+}
+
+// since_rev: -1 = live-only ("from now"); >= 0 replays history with
+// rev > since_rev. -> watch id, or -2 if since_rev predates the retained
+// log (compacted, the 410 Gone analog).
+int64_t kv_watch_new(void* h, const char* prefix, int64_t since_rev) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  auto w = std::make_shared<WatchState>();
+  w->prefix = prefix;
+  if (since_rev >= 0) {
+    if (since_rev < s->compacted_rev) return -2;
+    for (const auto& ev : s->history) {
+      if (ev.rev > since_rev &&
+          ev.key.compare(0, w->prefix.size(), w->prefix) == 0) {
+        w->queue.push_back(ev);
+      }
+    }
+  }
+  int64_t id = s->next_watch_id++;
+  s->watches.emplace(id, std::move(w));
+  return id;
+}
+
+void kv_watch_free(void* h, int64_t wid) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  auto it = s->watches.find(wid);
+  if (it != s->watches.end()) {
+    it->second->stopped = true;
+    s->watches.erase(it);
+  }
+  s->cv.notify_all();
+}
+
+// Poll one event. Returns malloc'd event buffer (see encode_event) or
+// NULL on timeout / unknown watch. Blocks in native code (GIL released
+// by ctypes).
+char* kv_watch_poll(void* h, int64_t wid, int64_t timeout_ms,
+                    int64_t* out_len) {
+  Store* s = static_cast<Store*>(h);
+  std::unique_lock<std::mutex> lk(s->mu);
+  auto it = s->watches.find(wid);
+  if (it == s->watches.end()) return nullptr;
+  std::shared_ptr<WatchState> w = it->second;
+  if (w->queue.empty() && timeout_ms > 0) {
+    s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+      return w->stopped || !w->queue.empty() ||
+             s->watches.find(wid) == s->watches.end();
+    });
+  }
+  if (s->watches.find(wid) == s->watches.end() || w->queue.empty()) {
+    return nullptr;
+  }
+  EventRec ev = std::move(w->queue.front());
+  w->queue.pop_front();
+  std::string out;
+  encode_event(out, ev);
+  return to_heap(out, out_len);
+}
+
+}  // extern "C"

@@ -1,0 +1,82 @@
+"""Cluster bootstrap (the kubeadm-init-equivalent wiring): full control
+plane in one object, over both store backends."""
+
+import pytest
+
+from kubernetes_tpu.cluster import Cluster
+
+from .util import wait_until
+
+
+@pytest.mark.parametrize("native_store", [False, True])
+def test_full_stack_bootstrap(native_store, tmp_path):
+    import yaml
+
+    manifest = tmp_path / "app.yaml"
+    manifest.write_text(
+        yaml.safe_dump_all([
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": "web"},
+                "spec": {
+                    "replicas": 4,
+                    "selector": {"matchLabels": {"app": "web"}},
+                    "template": {
+                        "metadata": {"labels": {"app": "web"}},
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "c",
+                                    "image": "img:1",
+                                    "resources": {"requests": {"cpu": "50m"}},
+                                }
+                            ]
+                        },
+                    },
+                },
+            },
+            {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": {"name": "web"},
+                "spec": {
+                    "selector": {"app": "web"},
+                    "clusterIP": "10.0.0.20",
+                    "ports": [{"name": "http", "port": 80, "targetPort": 8080}],
+                },
+            },
+        ])
+    )
+    with Cluster(
+        n_nodes=3, scheduler_backend="oracle", native_store=native_store
+    ) as c:
+        c.kubectl("apply", "-f", str(manifest))
+
+        def all_running():
+            pods, _ = c.client.pods.list(namespace="default")
+            return (
+                sum(1 for p in pods if p.status.phase == "Running") == 4
+            )
+
+        assert wait_until(all_running, timeout=60)
+        # endpoints + endpointslices materialized
+        assert wait_until(
+            lambda: sum(
+                len(s.endpoints or [])
+                for s in c.client.resource("endpointslices").list(
+                    namespace="default"
+                )[0]
+            )
+            == 4
+        )
+        out = c.kubectl("get", "deploy")
+        assert "4/4" in out
+        # default admission ran (tolerations stamped)
+        pod = c.client.pods.list(namespace="default")[0][0]
+        tol_keys = {t.key for t in pod.spec.tolerations or []}
+        assert "node.kubernetes.io/not-ready" in tol_keys
+        # configz live
+        from kubernetes_tpu.utils import configz
+
+        assert "kubescheduler.config.k8s.io" in configz.snapshot()

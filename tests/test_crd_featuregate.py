@@ -1,0 +1,262 @@
+"""CRDs (apiextensions equivalent), feature gates, configz.
+
+Reference shape: apiextensions-apiserver integration tests (CRD create ->
+CR serving -> schema validation), component-base featuregate/configz unit
+tests.
+"""
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.crd import (
+    CRDManager,
+    CustomResourceDefinition,
+    CustomResourceDefinitionNames,
+    CustomResourceDefinitionSpec,
+    CustomResourceDefinitionVersion,
+    CustomResourceValidation,
+    JSONSchemaProps,
+    Unstructured,
+)
+from kubernetes_tpu.apiserver.server import APIServer, Invalid, NotFound
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.utils import configz
+from kubernetes_tpu.utils.featuregate import (
+    ALPHA,
+    GA,
+    FeatureGate,
+    FeatureSpec,
+)
+
+from .util import wait_until
+
+
+def _crd(with_schema=False):
+    schema = None
+    if with_schema:
+        schema = CustomResourceValidation(
+            open_apiv3_schema=JSONSchemaProps(
+                type="object",
+                required=["spec"],
+                properties={
+                    "spec": JSONSchemaProps(
+                        type="object",
+                        required=["replicas"],
+                        properties={
+                            "replicas": JSONSchemaProps(type="integer"),
+                            "backends": JSONSchemaProps(
+                                type="array",
+                                items=JSONSchemaProps(type="string"),
+                            ),
+                        },
+                    )
+                },
+            )
+        )
+    return CustomResourceDefinition(
+        metadata=v1.ObjectMeta(name="widgets.example.com"),
+        spec=CustomResourceDefinitionSpec(
+            group="example.com",
+            names=CustomResourceDefinitionNames(
+                plural="widgets", singular="widget", kind="Widget"
+            ),
+            versions=[
+                CustomResourceDefinitionVersion(name="v1", schema=schema)
+            ],
+        ),
+    )
+
+
+@pytest.fixture()
+def cluster():
+    api = APIServer()
+    CRDManager(api).install()
+    return api, Clientset(api)
+
+
+class TestCRD:
+    def test_crd_serves_custom_resource(self, cluster):
+        api, cs = cluster
+        cs.resource("customresourcedefinitions").create(_crd())
+        created = cs.resource("widgets").create(
+            Unstructured({
+                "apiVersion": "example.com/v1",
+                "kind": "Widget",
+                "metadata": {"name": "w1", "namespace": "default"},
+                "spec": {"replicas": 3},
+            })
+        )
+        assert created.metadata.resource_version
+        got = cs.resource("widgets").get("w1", "default")
+        assert got["spec"] == {"replicas": 3}
+        assert got.kind == "Widget"
+        items, _ = cs.resource("widgets").list(namespace="default")
+        assert len(items) == 1
+        cs.resource("widgets").delete("w1", "default")
+        with pytest.raises(NotFound):
+            cs.resource("widgets").get("w1", "default")
+
+    def test_crd_watch_and_informer(self, cluster):
+        api, cs = cluster
+        cs.resource("customresourcedefinitions").create(_crd())
+        factory = SharedInformerFactory(cs)
+        inf = factory.informer_for("widgets")
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        try:
+            cs.resource("widgets").create(
+                Unstructured({
+                    "kind": "Widget",
+                    "metadata": {"name": "w1", "namespace": "default"},
+                })
+            )
+            assert wait_until(lambda: inf.get("default/w1") is not None)
+        finally:
+            factory.stop()
+
+    def test_schema_validation(self, cluster):
+        api, cs = cluster
+        cs.resource("customresourcedefinitions").create(_crd(with_schema=True))
+        with pytest.raises(Invalid):  # missing required spec
+            cs.resource("widgets").create(
+                Unstructured({"metadata": {"name": "bad", "namespace": "default"}})
+            )
+        with pytest.raises(Invalid):  # replicas wrong type
+            cs.resource("widgets").create(
+                Unstructured({
+                    "metadata": {"name": "bad", "namespace": "default"},
+                    "spec": {"replicas": "three"},
+                })
+            )
+        with pytest.raises(Invalid):  # array item wrong type
+            cs.resource("widgets").create(
+                Unstructured({
+                    "metadata": {"name": "bad", "namespace": "default"},
+                    "spec": {"replicas": 1, "backends": ["a", 2]},
+                })
+            )
+        cs.resource("widgets").create(
+            Unstructured({
+                "metadata": {"name": "ok", "namespace": "default"},
+                "spec": {"replicas": 1, "backends": ["a", "b"]},
+            })
+        )
+
+    def test_crd_name_validation(self, cluster):
+        api, cs = cluster
+        bad = _crd()
+        bad.metadata.name = "wrong"
+        with pytest.raises(Invalid):
+            cs.resource("customresourcedefinitions").create(bad)
+
+    def test_unknown_resource_without_crd(self, cluster):
+        api, cs = cluster
+        with pytest.raises(NotFound):
+            cs.resource("widgets").list()
+
+    def test_kubectl_resolves_custom_kind(self, cluster, tmp_path):
+        import io
+
+        import yaml
+
+        from kubernetes_tpu.kubectl import Kubectl
+
+        api, cs = cluster
+        cs.resource("customresourcedefinitions").create(_crd())
+        out = io.StringIO()
+        k = Kubectl(cs, out=out)
+        f = tmp_path / "w.yaml"
+        f.write_text(
+            yaml.safe_dump({
+                "apiVersion": "example.com/v1",
+                "kind": "Widget",
+                "metadata": {"name": "w1"},
+                "spec": {"replicas": 2},
+            })
+        )
+        assert k.run(["create", "-f", str(f)]) == 0
+        assert cs.resource("widgets").get("w1", "default")["spec"]["replicas"] == 2
+        out.truncate(0), out.seek(0)
+        assert k.run(["get", "widgets", "w1", "-o", "yaml"]) == 0
+        doc = yaml.safe_load(out.getvalue())
+        assert doc["spec"] == {"replicas": 2}
+
+
+class TestFeatureGate:
+    def test_stages_and_overrides(self):
+        fg = FeatureGate({
+            "A": FeatureSpec(default=False, pre_release=ALPHA),
+            "B": FeatureSpec(default=True),
+            "Locked": FeatureSpec(default=True, pre_release=GA, lock_to_default=True),
+        })
+        assert not fg.enabled("A")
+        assert fg.enabled("B")
+        fg.set_from_string("A=true, B=false")
+        assert fg.enabled("A") and not fg.enabled("B")
+        with pytest.raises(ValueError):
+            fg.set("Locked", False)
+        with pytest.raises(KeyError):
+            fg.enabled("Nope")
+        with pytest.raises(ValueError):
+            fg.set_from_string("A=maybe")
+        assert fg.state() == {"A": True, "B": False, "Locked": True}
+
+    def test_duplicate_registration(self):
+        fg = FeatureGate({"A": FeatureSpec(default=False)})
+        fg.add({"A": FeatureSpec(default=False)})  # identical: ok
+        with pytest.raises(ValueError):
+            fg.add({"A": FeatureSpec(default=True)})
+
+
+class TestConfigz:
+    def test_install_snapshot(self):
+        from kubernetes_tpu.scheduler.apis.config import default_configuration
+
+        configz.install("kubescheduler.config.k8s.io", default_configuration())
+        try:
+            snap = configz.snapshot()
+            assert "kubescheduler.config.k8s.io" in snap
+            assert isinstance(snap["kubescheduler.config.k8s.io"], dict)
+            body = configz.handler_body()
+            assert "kubescheduler" in body
+        finally:
+            configz.delete("kubescheduler.config.k8s.io")
+        assert "kubescheduler.config.k8s.io" not in configz.snapshot()
+
+
+class TestCRDLifecycle:
+    def test_crd_delete_unregisters(self, cluster):
+        api, cs = cluster
+        cs.resource("customresourcedefinitions").create(_crd())
+        cs.resource("widgets").create(
+            Unstructured({"metadata": {"name": "w1", "namespace": "default"}})
+        )
+        cs.resource("customresourcedefinitions").delete("widgets.example.com")
+        with pytest.raises(NotFound):
+            cs.resource("widgets").list()
+
+    def test_rejected_write_does_not_change_serving(self, cluster):
+        api, cs = cluster
+        cs.resource("customresourcedefinitions").create(_crd(with_schema=True))
+        # re-create same name WITHOUT schema: AlreadyExists — and the
+        # schema must still be enforced afterwards
+        from kubernetes_tpu.apiserver.server import AlreadyExists
+
+        with pytest.raises(AlreadyExists):
+            cs.resource("customresourcedefinitions").create(_crd())
+        with pytest.raises(Invalid):
+            cs.resource("widgets").create(
+                Unstructured({"metadata": {"name": "bad", "namespace": "default"}})
+            )
+
+
+class TestFeatureGateRestore:
+    def test_cluster_restores_gates(self):
+        from kubernetes_tpu.cluster import Cluster
+        from kubernetes_tpu.utils.featuregate import default_feature_gate
+
+        assert not default_feature_gate.enabled("CSIStorageCapacity")
+        with Cluster(n_nodes=0, controllers=[], feature_gates="CSIStorageCapacity=true"):
+            assert default_feature_gate.enabled("CSIStorageCapacity")
+        assert not default_feature_gate.enabled("CSIStorageCapacity")
