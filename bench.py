@@ -41,14 +41,17 @@ def main() -> None:
     n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
     # keep pods a multiple of batch: a ragged final batch changes the scan
     # shape and pays a fresh ~35s XLA compile inside the measured window
-    n_meas = int(os.environ.get("BENCH_PODS", "1024"))
-    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    n_meas = int(os.environ.get("BENCH_PODS", "2048"))
+    batch = int(os.environ.get("BENCH_BATCH", "1024"))
     n_warm = batch
 
     from kubernetes_tpu.models.encoding import ClusterEncoding
     from kubernetes_tpu.models.pod_encoder import PodEncoder
     from kubernetes_tpu.ops.batch import pod_batchable, schedule_batch
+    from kubernetes_tpu.ops.hoisted import schedule_batch_hoisted
     from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+    hoisted = os.environ.get("BENCH_HOISTED", "1") == "1"
 
     t0 = time.perf_counter()
     nodes, init_pods = synth_cluster(n_nodes, pods_per_node=2)
@@ -83,8 +86,11 @@ def main() -> None:
         ]
         assert all(pod_batchable(pa) for pa in arrays)
         c = enc.device_state()
-        slots = [enc._pod_free[-1 - i] for i in range(len(pods))]
-        decisions, _ = schedule_batch(c, arrays, slots)
+        if hoisted:
+            decisions, _ = schedule_batch_hoisted(c, arrays)
+        else:
+            slots = [enc._pod_free[-1 - i] for i in range(len(pods))]
+            decisions, _ = schedule_batch(c, arrays, slots)
         for pod, best in zip(pods, decisions):
             if best < 0:
                 continue
@@ -96,6 +102,7 @@ def main() -> None:
 
     t0 = time.perf_counter()
     run_batch(pending[:n_warm])
+    enc.device_state()  # warm the dirty-row scatter (compile) pre-measurement
     log(f"warmup+compile: {n_warm} pods in {time.perf_counter() - t0:.1f}s")
 
     t0 = time.perf_counter()

@@ -63,10 +63,12 @@ class ResourceInfo:
 
 
 def _default_resources() -> Tuple["ResourceInfo", ...]:
-    from ..api import apps, autoscaling, batch, discovery, storage
+    from ..api import apps, autoscaling, batch, discovery, metrics, storage
     from ..client.events import Event
 
     return (
+        ResourceInfo("nodemetrics", metrics.NodeMetrics, False),
+        ResourceInfo("podmetrics", metrics.PodMetrics, True),
         ResourceInfo("pods", v1.Pod, True),
         ResourceInfo("nodes", v1.Node, False),
         ResourceInfo("endpointslices", discovery.EndpointSlice, True),

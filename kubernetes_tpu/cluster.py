@@ -65,6 +65,7 @@ class Cluster:
         feature_gates: str = "",
         admission: bool = True,
         proxies: bool = False,
+        metrics_server: bool = False,
         node_config: Optional[Dict] = None,
         controller_opts: Optional[Dict] = None,
     ):
@@ -80,6 +81,7 @@ class Cluster:
                 feature_gates,
                 admission,
                 proxies,
+                metrics_server,
                 node_config,
                 controller_opts,
             )
@@ -96,6 +98,7 @@ class Cluster:
         feature_gates,
         admission,
         proxies,
+        metrics_server,
         node_config,
         controller_opts,
     ) -> None:
@@ -137,6 +140,11 @@ class Cluster:
         self.scheduler = create_scheduler(
             self.client, self._sched_factory, self.scheduler_config
         )
+        self.metrics_server = None
+        if metrics_server:
+            from .api.metrics import MetricsServer
+
+            self.metrics_server = MetricsServer(self.client, period=2.0)
         self._started = False
 
     # -- lifecycle ----------------------------------------------------------
@@ -150,6 +158,8 @@ class Cluster:
             if not self._sched_factory.wait_for_cache_sync():
                 raise RuntimeError("scheduler informers failed to sync")
             self.scheduler.start()
+            if self.metrics_server is not None:
+                self.metrics_server.run()
             self._fg_state = default_feature_gate.state()
             configz.install("kubescheduler.config.k8s.io", self.scheduler_config)
             configz.install("featuregates", self._fg_state)
@@ -169,6 +179,7 @@ class Cluster:
 
     def _teardown(self) -> None:
         for closer in (
+            self.metrics_server.stop if self.metrics_server is not None else None,
             self.scheduler.stop,
             self._sched_factory.stop,
             self.kcm.stop,

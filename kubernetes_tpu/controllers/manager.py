@@ -31,6 +31,12 @@ from .statefulset import StatefulSetController
 from .ttlafterfinished import TTLAfterFinishedController
 
 
+def _metrics_api_source(cs):
+    from ..api.metrics import pod_metrics_source
+
+    return pod_metrics_source(cs)
+
+
 def new_controller_initializers() -> Dict[str, Callable]:
     """controllermanager.go:387 NewControllerInitializers equivalent."""
     return {
@@ -62,7 +68,9 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "horizontalpodautoscaling": lambda cs, inf, opts: HorizontalController(
             cs,
             inf,
-            metrics=opts.get("hpa_metrics"),
+            # default source: the metrics API (metrics-server objects),
+            # exactly what the reference HPA consumes
+            metrics=opts.get("hpa_metrics") or _metrics_api_source(cs),
             sync_period=opts.get("hpa_sync_period", 15.0),
         ),
         "resourcequota": lambda cs, inf, opts: ResourceQuotaController(

@@ -188,6 +188,9 @@ class Kubectl:
         p.add_argument("action", choices=["status", "restart"])
         p.add_argument("target")  # deployment/name
 
+        p = sub.add_parser("top")
+        p.add_argument("resource", choices=["nodes", "node", "pods", "pod", "no", "po"])
+
         args = parser.parse_args(argv)
         try:
             getattr(self, f"cmd_{args.verb}")(args)
@@ -494,6 +497,56 @@ class Kubectl:
         tmpl_meta.annotations["kubectl.kubernetes.io/restartedAt"] = str(time.time())
         self.cs.deployments.update(dep)
         self._print(f"deployment.apps/{name} restarted")
+
+    def cmd_top(self, args) -> None:
+        """kubectl top nodes|pods from the metrics API (metrics.k8s.io;
+        staging/src/k8s.io/kubectl/pkg/cmd/top)."""
+        from ..api.quantity import Quantity
+
+        resource = self._resource(args.resource)
+        hdr = ["NAME", "CPU(cores)", "MEMORY(bytes)"]
+        if resource == "nodes":
+            metrics, _ = self.cs.resource("nodemetrics").list()
+            rows = [
+                [
+                    m.metadata.name,
+                    (m.usage or {}).get("cpu", "0m"),
+                    _fmt_mem((m.usage or {}).get("memory", "0")),
+                ]
+                for m in sorted(metrics, key=lambda m: m.metadata.name)
+            ]
+        else:
+            metrics, _ = self.cs.resource("podmetrics").list(
+                namespace=args.namespace
+            )
+            rows = []
+            for m in sorted(metrics, key=lambda m: m.metadata.name):
+                cpu = sum(
+                    Quantity((c.usage or {}).get("cpu", 0)).milli_value()
+                    for c in m.containers or []
+                )
+                mem = sum(
+                    Quantity((c.usage or {}).get("memory", 0)).value()
+                    for c in m.containers or []
+                )
+                rows.append([m.metadata.name, f"{cpu}m", _fmt_mem(str(mem))])
+        widths = [
+            max(len(hdr[i]), *(len(r[i]) for r in rows)) if rows else len(hdr[i])
+            for i in range(len(hdr))
+        ]
+        self._print("   ".join(h.ljust(w) for h, w in zip(hdr, widths)).rstrip())
+        for r in rows:
+            self._print("   ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+
+
+def _fmt_mem(qty: str) -> str:
+    try:
+        from ..api.quantity import Quantity
+
+        mib = Quantity(qty).value() // (1024 * 1024)
+        return f"{mib}Mi"
+    except Exception:  # noqa: BLE001
+        return qty
 
 
 def _three_way_merge(prev: Any, live: Any, new: Any) -> Any:

@@ -86,6 +86,17 @@ def _seg_max_bool(flags, segment_ids, num_segments):
 # Filters
 
 
+def fit_mask(requested, pod_count, alloc, allowed_pods, req, req_check, req_has_any):
+    """NodeResourcesFit (fit.go:230 fitsRequest): insufficient if
+    request > allocatable − requested per checked dim, or pod count full.
+    Shared by the generic kernel and the hoisted scan step."""
+    free = alloc - requested
+    over = (req[None, :] > free) & req_check[None, :]
+    fail_dims = req_has_any & jnp.any(over, axis=1)
+    fail_count = (pod_count.astype(_I64) + 1) > allowed_pods
+    return ~(fail_count | fail_dims)
+
+
 def _filter_basics(c: Dict, p: Dict):
     """NodeName, NodeUnschedulable, TaintToleration, NodePorts,
     NodeResourcesFit masks. References: nodename/node_name.go,
@@ -104,11 +115,10 @@ def _filter_basics(c: Dict, p: Dict):
     tr = c["ports_triple"][:, p["want_triple"]] > 0
     conflict = jnp.where(p["want_wild"][None, :], pa, pw | tr) & p["want_valid"][None, :]
     mask_ports = ~jnp.any(conflict, axis=1)
-    free = c["alloc"] - c["requested"]
-    over = (p["req"][None, :] > free) & p["req_check"][None, :]
-    fail_dims = p["req_has_any"] & jnp.any(over, axis=1)
-    fail_count = (c["pod_count"].astype(_I64) + 1) > c["allowed_pods"]
-    mask_fit = ~(fail_count | fail_dims)
+    mask_fit = fit_mask(
+        c["requested"], c["pod_count"], c["alloc"], c["allowed_pods"],
+        p["req"], p["req_check"], p["req_has_any"],
+    )
     return mask_name, mask_unsched, mask_taint, mask_ports, mask_fit
 
 
@@ -284,14 +294,14 @@ def _ipa_filter(c: Dict, p: Dict):
 # Scores (each returns raw-normalized int64 in [0,100] BEFORE weighting)
 
 
-def _score_balanced(c: Dict, p: Dict):
+def balanced_score(nz_requested, nz_req, alloc):
     """(1 - |cpuFraction - memFraction|) * 100, fractions over NonZero
     requested+pod (reference: noderesources/balanced_allocation.go:82,
-    resource_allocation.go:91)."""
-    cpu_req = (c["nz_requested"][:, 0] + p["nz_req"][0]).astype(_F64)
-    mem_req = (c["nz_requested"][:, 1] + p["nz_req"][1]).astype(_F64)
-    cpu_cap = c["alloc"][:, 0].astype(_F64)
-    mem_cap = c["alloc"][:, 1].astype(_F64)
+    resource_allocation.go:91). Shared by kernel + hoisted step."""
+    cpu_req = (nz_requested[:, 0] + nz_req[0]).astype(_F64)
+    mem_req = (nz_requested[:, 1] + nz_req[1]).astype(_F64)
+    cpu_cap = alloc[:, 0].astype(_F64)
+    mem_cap = alloc[:, 1].astype(_F64)
     cpu_frac = jnp.where(cpu_cap == 0, 1.0, cpu_req / cpu_cap)
     mem_frac = jnp.where(mem_cap == 0, 1.0, mem_req / mem_cap)
     diff = jnp.abs(cpu_frac - mem_frac)
@@ -299,16 +309,26 @@ def _score_balanced(c: Dict, p: Dict):
     return jnp.where((cpu_frac >= 1) | (mem_frac >= 1), 0, score)
 
 
-def _score_least(c: Dict, p: Dict):
+def least_allocated_score(nz_requested, nz_req, alloc):
     """leastResourceScorer with default cpu/mem weights 1/1 (reference:
-    noderesources/least_allocated.go:93,:108)."""
+    noderesources/least_allocated.go:93,:108). Shared by kernel +
+    hoisted step."""
+
     def one(dim):
-        cap = c["alloc"][:, dim]
-        req = c["nz_requested"][:, dim] + p["nz_req"][dim]
+        cap = alloc[:, dim]
+        req = nz_requested[:, dim] + nz_req[dim]
         s = (cap - req) * MAX_NODE_SCORE // jnp.where(cap == 0, 1, cap)
         return jnp.where((cap == 0) | (req > cap), 0, s)
 
     return (one(0) + one(1)) // 2
+
+
+def _score_balanced(c: Dict, p: Dict):
+    return balanced_score(c["nz_requested"], p["nz_req"], c["alloc"])
+
+
+def _score_least(c: Dict, p: Dict):
+    return least_allocated_score(c["nz_requested"], p["nz_req"], c["alloc"])
 
 
 def _score_image(c: Dict, p: Dict):
@@ -336,26 +356,34 @@ def _score_prefer_avoid(c: Dict, p: Dict):
     return jnp.where(avoided, 0, MAX_NODE_SCORE).astype(_I64)
 
 
+def _taint_count(c: Dict, p: Dict):
+    """Untolerated PreferNoSchedule taints per node (pre-normalization)."""
+    prefer = c["taint_effect"][None, :] == EFFECT_PREFER_NO_SCHEDULE
+    return jnp.sum(c["taints"] & prefer & ~p["tol_prefer"][None, :], axis=1).astype(_I64)
+
+
 def _score_taint(c: Dict, p: Dict, feasible):
     """TaintToleration: count untolerated PreferNoSchedule taints, then
     DefaultNormalizeScore reverse (reference:
     tainttoleration/taint_toleration.go:107, helper/normalize_score.go:26)."""
-    prefer = c["taint_effect"][None, :] == EFFECT_PREFER_NO_SCHEDULE
-    cnt = jnp.sum(c["taints"] & prefer & ~p["tol_prefer"][None, :], axis=1).astype(_I64)
-    return _normalize_default(cnt, feasible, reverse=True)
+    return _normalize_default(_taint_count(c, p), feasible, reverse=True)
+
+
+def _nodeaff_count(c: Dict, p: Dict):
+    """Matched preferred-term weight sum per node (pre-normalization)."""
+    match = eval_reqs(
+        p["npref_op"], p["npref_key"], p["npref_pairs"],
+        c["npair"], c["nkey"],
+        threshold=p["npref_thr"], num=c["nnum"], num_valid=c["nnum_valid"],
+    )  # [N, T]
+    return jnp.sum(match.astype(_I64) * p["npref_weight"][None, :], axis=1)
 
 
 def _score_node_affinity(c: Dict, p: Dict, feasible):
     """NodeAffinity Score: sum preferred-term weights whose preference
     matches, then DefaultNormalizeScore (reference:
     nodeaffinity/node_affinity.go:139)."""
-    match = eval_reqs(
-        p["npref_op"], p["npref_key"], p["npref_pairs"],
-        c["npair"], c["nkey"],
-        threshold=p["npref_thr"], num=c["nnum"], num_valid=c["nnum_valid"],
-    )  # [N, T]
-    cnt = jnp.sum(match.astype(_I64) * p["npref_weight"][None, :], axis=1)
-    return _normalize_default(cnt, feasible, reverse=False)
+    return _normalize_default(_nodeaff_count(c, p), feasible, reverse=False)
 
 
 def _normalize_default(scores, feasible, reverse: bool):
@@ -446,6 +474,13 @@ def _score_ipa(c: Dict, p: Dict, feasible):
     """InterPodAffinity PreScore+Score+NormalizeScore (reference:
     interpodaffinity/scoring.go:88 processExistingPod, :225 Score, :247
     NormalizeScore)."""
+    raw, any_present = _score_ipa_raw(c, p)
+    return _score_ipa_normalize(raw, any_present, feasible)
+
+
+def _score_ipa_raw(c: Dict, p: Dict):
+    """Per-node raw IPA score + whether any term matched (pre-normalize);
+    independent of the feasible set."""
     vnp = c["npair"].shape[1]
     hard_w = c["hard_pod_affinity_weight"].astype(_CNT)
     # (a) incoming preferred terms vs existing pods
@@ -491,7 +526,10 @@ def _score_ipa(c: Dict, p: Dict, feasible):
     raw = jnp.sum(
         jnp.where(c["nkey"], score_vec[c["pair_of_key"]], 0), axis=1
     )
-    any_present = jnp.any(present)
+    return raw, jnp.any(present)
+
+
+def _score_ipa_normalize(raw, any_present, feasible):
     big = jnp.iinfo(_CNT).max
     min_s = jnp.min(jnp.where(feasible, raw, big))
     max_s = jnp.max(jnp.where(feasible, raw, -big))

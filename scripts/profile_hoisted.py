@@ -1,0 +1,40 @@
+"""Hoisted-path cost split: fixed (prologue+dispatch) vs per-step."""
+import os, sys, time
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import copy
+import numpy as np
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops.hoisted import schedule_batch_hoisted
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+N = int(os.environ.get("BENCH_NODES", "5000"))
+nodes, init_pods = synth_cluster(N, pods_per_node=2)
+pending = synth_pending_pods(300, spread=True)
+phantoms = []
+for i, p in enumerate(pending):
+    q = copy.deepcopy(p); q.metadata.name = f"ph-{i}"
+    q.spec.node_name = nodes[i % len(nodes)].metadata.name
+    phantoms.append(q)
+enc = ClusterEncoding(); enc.set_cluster(nodes, init_pods + phantoms)
+pe = PodEncoder(enc)
+for p in pending: pe.encode(p)
+enc.device_state()
+for q in phantoms: enc.remove_pod(q)
+
+print("device:", jax.devices()[0])
+for bs in (8, 64, 256):
+    pods = pending[:bs]
+    arrays = [{k: v for k, v in pe.encode(p).items() if not k.startswith("_")} for p in pods]
+    c = enc.device_state()
+    schedule_batch_hoisted(c, arrays)  # compile
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        d, ys = schedule_batch_hoisted(c, arrays)
+        jax.block_until_ready(ys["best"])
+        times.append(time.perf_counter() - t0)
+    print(f"B={bs:4d}  best={min(times)*1e3:8.1f}ms  per-step={min(times)/bs*1e3:7.2f}ms")

@@ -1,0 +1,110 @@
+"""kube-aggregator equivalent: APIService routing to delegate servers."""
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.aggregator import (
+    AggregatedAPIServer,
+    APIService,
+    APIServiceSpec,
+)
+from kubernetes_tpu.apiserver.server import APIServer, NotFound, ResourceInfo
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+
+from .util import make_pod, wait_until
+
+
+def _delegate_with_widgets():
+    from dataclasses import dataclass, field
+
+    @dataclass
+    class Widget:
+        metadata: v1.ObjectMeta = field(default_factory=v1.ObjectMeta)
+        size: int = 0
+        kind: str = "Widget"
+        api_version: str = "ext.example.com/v1"
+
+    delegate = APIServer(resources=(ResourceInfo("widgets", Widget, True),))
+    return delegate, Widget
+
+
+class TestAggregator:
+    def test_routes_to_delegate_and_local(self):
+        agg = AggregatedAPIServer()
+        delegate, Widget = _delegate_with_widgets()
+        agg.register_api_service(
+            APIService(
+                metadata=v1.ObjectMeta(name="v1.ext.example.com"),
+                spec=APIServiceSpec(group="ext.example.com", version="v1"),
+            ),
+            delegate,
+        )
+        cs = Clientset(agg)
+        # local resources unaffected
+        cs.pods.create(make_pod("p"))
+        assert cs.pods.get("p", "default")
+        # extension resource served through the aggregator
+        cs.resource("widgets").create(
+            Widget(metadata=v1.ObjectMeta(name="w", namespace="default"), size=3)
+        )
+        assert cs.resource("widgets").get("w", "default").size == 3
+        # ...and lives in the DELEGATE's store, not the local one
+        assert delegate.get("widgets", "w", "default").size == 3
+        with pytest.raises(NotFound):
+            agg.local.get("widgets", "w", "default")
+        # APIService object is visible as a resource
+        svcs, _ = cs.resource("apiservices").list()
+        assert [s.metadata.name for s in svcs] == ["v1.ext.example.com"]
+        assert svcs[0].status.conditions[0].status == "True"
+
+    def test_name_validation(self):
+        agg = AggregatedAPIServer()
+        delegate, _ = _delegate_with_widgets()
+        with pytest.raises(ValueError):
+            agg.register_api_service(
+                APIService(
+                    metadata=v1.ObjectMeta(name="wrong"),
+                    spec=APIServiceSpec(group="ext.example.com", version="v1"),
+                ),
+                delegate,
+            )
+
+    def test_informer_watches_extension_resource(self):
+        agg = AggregatedAPIServer()
+        delegate, Widget = _delegate_with_widgets()
+        agg.register_api_service(
+            APIService(
+                metadata=v1.ObjectMeta(name="v1.ext.example.com"),
+                spec=APIServiceSpec(group="ext.example.com", version="v1"),
+            ),
+            delegate,
+        )
+        cs = Clientset(agg)
+        factory = SharedInformerFactory(cs)
+        inf = factory.informer_for("widgets")
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        try:
+            cs.resource("widgets").create(
+                Widget(metadata=v1.ObjectMeta(name="w", namespace="default"))
+            )
+            assert wait_until(lambda: inf.get("default/w") is not None)
+        finally:
+            factory.stop()
+
+    def test_local_wins_name_collisions(self):
+        agg = AggregatedAPIServer()
+        delegate = APIServer()  # serves "pods" too
+        agg.register_api_service(
+            APIService(
+                metadata=v1.ObjectMeta(name="v1.core.example.com"),
+                spec=APIServiceSpec(group="core.example.com", version="v1"),
+            ),
+            delegate,
+        )
+        cs = Clientset(agg)
+        cs.pods.create(make_pod("p"))
+        assert agg.local.get("pods", "p", "default")
+        with pytest.raises(NotFound):
+            delegate.get("pods", "p", "default")

@@ -1,0 +1,151 @@
+"""Template-hoisted batch scheduler: decision parity with the generic
+batched scan (which is itself pinned to the per-pod kernel and the Go
+oracle by tests/test_batch.py)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops.batch import pod_batchable, schedule_batch
+from kubernetes_tpu.ops.hoisted import schedule_batch_hoisted, template_fingerprint
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+from .util import make_pod
+
+
+def _encode_all(enc, pe, pods):
+    arrays = [
+        {k: v for k, v in pe.encode(p).items() if not k.startswith("_")}
+        for p in pods
+    ]
+    assert all(pod_batchable(pa) for pa in arrays)
+    return arrays
+
+
+def _run_both(nodes, init_pods, pending):
+    import copy
+
+    enc = ClusterEncoding()
+    # phantom-bind copies of the pending pods so the pod table is sized
+    # for the whole batch (bench.py's pre-sizing trick)
+    phantoms = []
+    for i, p in enumerate(pending):
+        q = copy.deepcopy(p)
+        q.metadata.name = f"phantom-{i}"
+        q.spec.node_name = nodes[i % len(nodes)].metadata.name
+        phantoms.append(q)
+    enc.set_cluster(nodes, init_pods + phantoms)
+    pe = PodEncoder(enc)
+    for p in pending:
+        pe.encode(p)
+    enc.device_state()
+    for q in phantoms:
+        enc.remove_pod(q)
+
+    arrays = _encode_all(enc, pe, pending)
+    c = enc.device_state()
+    slots = [enc._pod_free[-1 - i] for i in range(len(pending))]
+    generic, _ = schedule_batch(c, arrays, slots)
+    hoisted, ys = schedule_batch_hoisted(c, arrays)
+    return generic, hoisted, ys
+
+
+def _bind_pending(pods, nodes):
+    for i, p in enumerate(pods):
+        p.spec.node_name = nodes[i % len(nodes)].metadata.name
+    return pods
+
+
+class TestHoistedParity:
+    def test_spread_templates(self):
+        nodes, init_pods = synth_cluster(24, pods_per_node=2)
+        pending = synth_pending_pods(40, spread=True)
+        generic, hoisted, ys = _run_both(nodes, init_pods, pending)
+        assert hoisted == generic
+        assert all(d >= 0 for d in hoisted)
+
+    def test_no_constraints(self):
+        nodes, init_pods = synth_cluster(10, pods_per_node=1)
+        pending = synth_pending_pods(16, spread=False)
+        generic, hoisted, _ = _run_both(nodes, init_pods, pending)
+        assert hoisted == generic
+
+    def test_capacity_pressure_infeasible_tail(self):
+        # tiny nodes: later pods must become infeasible identically
+        nodes, init_pods = synth_cluster(3, pods_per_node=0)
+        for node in nodes:
+            node.status.allocatable["cpu"] = "250m"
+            node.status.capacity["cpu"] = "250m"
+        pending = synth_pending_pods(12, spread=True)  # 100m each
+        generic, hoisted, _ = _run_both(nodes, init_pods, pending)
+        assert hoisted == generic
+        assert -1 in hoisted  # capacity exhausted for the tail
+
+    def test_hostname_hard_spread(self):
+        nodes, init_pods = synth_cluster(6, pods_per_node=1)
+        pending = []
+        for i in range(10):
+            pending.append(
+                make_pod(
+                    f"hard-{i}",
+                    cpu="50m",
+                    labels={"app": "hard"},
+                    constraints=[
+                        v1.TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key=v1.LABEL_HOSTNAME,
+                            when_unsatisfiable="DoNotSchedule",
+                            label_selector=v1.LabelSelector(
+                                match_labels={"app": "hard"}
+                            ),
+                        )
+                    ],
+                )
+            )
+        generic, hoisted, _ = _run_both(nodes, init_pods, pending)
+        assert hoisted == generic
+        # maxSkew=1 over 6 nodes: first 6 land on distinct nodes
+        assert len({d for d in hoisted[:6]}) == 6
+
+    def test_mixed_templates_cross_counting(self):
+        # two templates whose selectors MATCH EACH OTHER's pods: assumed
+        # pods of template A must update template B's counts
+        nodes, init_pods = synth_cluster(8, pods_per_node=1)
+        pending = []
+        for i in range(12):
+            labels = {"tier": "web", "idx": f"t{i % 2}"}
+            pending.append(
+                make_pod(
+                    f"x-{i}",
+                    cpu="50m",
+                    labels=labels,
+                    constraints=[
+                        v1.TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key=v1.LABEL_ZONE,
+                            when_unsatisfiable="ScheduleAnyway",
+                            label_selector=v1.LabelSelector(
+                                match_labels={"tier": "web"}  # matches BOTH
+                            ),
+                        )
+                    ],
+                )
+            )
+        generic, hoisted, _ = _run_both(nodes, init_pods, pending)
+        assert hoisted == generic
+
+    def test_fingerprint_groups_identical_specs(self):
+        nodes, init_pods = synth_cluster(4, pods_per_node=0)
+        pending = synth_pending_pods(8, n_templates=2, spread=True)
+        enc = ClusterEncoding()
+        enc.set_cluster(nodes, init_pods + _bind_pending(pending, nodes))
+        pe = PodEncoder(enc)
+        for p in pending:
+            p.spec.node_name = ""
+            pe.encode(p)
+        enc.device_state()
+        arrays = _encode_all(enc, pe, pending)
+        fps = {template_fingerprint(a) for a in arrays}
+        assert len(fps) == 2

@@ -1,0 +1,112 @@
+"""metrics.k8s.io: MetricsServer scrape loop, kubectl top, HPA wired to
+the metrics API (the metrics-server + HPA + top integration).
+"""
+
+import io
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.api.metrics import MetricsServer, pod_metrics_source
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.kubectl import Kubectl
+
+from .util import make_node, make_pod
+
+
+def _running(pod):
+    pod.status.phase = "Running"
+    return pod
+
+
+class TestMetricsServer:
+    def test_scrape_and_top(self):
+        api = APIServer()
+        cs = Clientset(api)
+        cs.nodes.create(make_node("n1"))
+        cs.nodes.create(make_node("n2"))
+        cs.pods.create(_running(make_pod("a", cpu="200m", memory="64Mi", node_name="n1")))
+        cs.pods.create(_running(make_pod("b", cpu="300m", memory="128Mi", node_name="n1")))
+        cs.pods.create(_running(make_pod("c", cpu="100m", memory="32Mi", node_name="n2")))
+        ms = MetricsServer(cs)
+        ms.scrape_once()
+        nm = cs.resource("nodemetrics").get("n1")
+        assert nm.usage["cpu"] == "500m"
+        pm = cs.resource("podmetrics").get("a", "default")
+        assert pm.containers[0].usage["cpu"] == "200m"
+
+        out = io.StringIO()
+        k = Kubectl(cs, out=out)
+        assert k.run(["top", "nodes"]) == 0
+        lines = out.getvalue().strip().splitlines()
+        assert lines[0].split() == ["NAME", "CPU(cores)", "MEMORY(bytes)"]
+        assert "500m" in lines[1] and "192Mi" in lines[1]
+        out.truncate(0), out.seek(0)
+        assert k.run(["top", "pods"]) == 0
+        assert "300m" in out.getvalue()
+
+        # pod deleted -> its metrics are pruned on the next scrape
+        cs.pods.delete("a", "default")
+        ms.scrape_once()
+        import pytest
+
+        from kubernetes_tpu.apiserver.server import NotFound
+
+        with pytest.raises(NotFound):
+            cs.resource("podmetrics").get("a", "default")
+
+    def test_hpa_reads_metrics_api(self):
+        from kubernetes_tpu.api import apps
+        from kubernetes_tpu.controllers.podautoscaler import HorizontalController
+
+        api = APIServer()
+        cs = Clientset(api)
+        cs.deployments.create(
+            apps.Deployment(
+                metadata=v1.ObjectMeta(name="web", namespace="default"),
+                spec=apps.DeploymentSpec(
+                    replicas=2,
+                    selector=v1.LabelSelector(match_labels={"app": "web"}),
+                    template=v1.PodTemplateSpec(
+                        metadata=v1.ObjectMeta(labels={"app": "web"}),
+                        spec=v1.PodSpec(
+                            containers=[v1.Container(name="c", image="i")]
+                        ),
+                    ),
+                ),
+            )
+        )
+        for i in range(2):
+            cs.pods.create(
+                _running(
+                    make_pod(f"web-{i}", cpu="100m", labels={"app": "web"}, node_name="n1")
+                )
+            )
+        # usage = 2x requests -> utilization 200% of the 80% target
+        ms = MetricsServer(
+            cs, usage_fn=lambda pod: {"cpu": "200m", "memory": "0"}
+        )
+        ms.scrape_once()
+        from kubernetes_tpu.api.autoscaling import (
+            CrossVersionObjectReference,
+            HorizontalPodAutoscaler,
+            HorizontalPodAutoscalerSpec,
+        )
+
+        cs.resource("horizontalpodautoscalers").create(
+            HorizontalPodAutoscaler(
+                metadata=v1.ObjectMeta(name="hpa", namespace="default"),
+                spec=HorizontalPodAutoscalerSpec(
+                    scale_target_ref=CrossVersionObjectReference(
+                        kind="Deployment", name="web"
+                    ),
+                    max_replicas=8,
+                    target_cpu_utilization_percentage=80,
+                ),
+            )
+        )
+        factory = SharedInformerFactory(cs)
+        ctrl = HorizontalController(cs, factory, metrics=pod_metrics_source(cs))
+        ctrl.sync_all()
+        dep = cs.deployments.get("web", "default")
+        assert dep.spec.replicas == 5  # ceil(2 * 200/80)
