@@ -78,6 +78,15 @@ class Informer:
                 for obj in self._cache.values():
                     handler.on_add(obj)
 
+    def remove_event_handler(self, handler: EventHandler) -> None:
+        """Deregister (client-go 2.26+ RemoveEventHandler): stopped
+        consumers (e.g. a killed kubelet) must not stay fanned-out to."""
+        with self._lock:
+            try:
+                self._handlers.remove(handler)
+            except ValueError:
+                pass
+
     # -- run loop ----------------------------------------------------------
 
     def start(self) -> None:

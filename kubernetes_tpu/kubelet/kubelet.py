@@ -88,13 +88,12 @@ class Kubelet:
         self._events: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
-        self.pod_informer.add_event_handler(
-            EventHandler(
-                on_add=self._on_pod_change,
-                on_update=lambda old, new: self._on_pod_change(new),
-                on_delete=self._on_pod_delete,
-            )
+        self._handler = EventHandler(
+            on_add=self._on_pod_change,
+            on_update=lambda old, new: self._on_pod_change(new),
+            on_delete=self._on_pod_delete,
         )
+        self.pod_informer.add_event_handler(self._handler)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -114,6 +113,9 @@ class Kubelet:
 
     def stop(self) -> None:
         self._stop.set()
+        # deregister from the shared informer: a dead kubelet must not
+        # keep receiving (and queueing) pod events
+        self.pod_informer.remove_event_handler(self._handler)
         with self._workers_lock:
             workers = list(self._workers.values())
             self._workers.clear()

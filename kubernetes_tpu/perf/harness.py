@@ -42,6 +42,7 @@ class PodTemplate:
     spread_zone: bool = False  # PodTopologySpread on zone, ScheduleAnyway
     spread_hostname_hard: bool = False  # maxSkew=1 DoNotSchedule on hostname
     anti_affinity_zone: bool = False  # required anti-affinity on zone
+    anti_affinity_hostname: bool = False  # required anti-affinity per node
     extended: Optional[Dict[str, str]] = None  # e.g. {"example.com/gpu": "1"}
 
     def build(self, name: str, namespace: str = "default") -> v1.Pod:
@@ -65,7 +66,7 @@ class PodTemplate:
                 )
             )
         affinity = None
-        if self.anti_affinity_zone:
+        if self.anti_affinity_zone or self.anti_affinity_hostname:
             affinity = v1.Affinity(
                 pod_anti_affinity=v1.PodAntiAffinity(
                     required_during_scheduling_ignored_during_execution=[
@@ -73,7 +74,11 @@ class PodTemplate:
                             label_selector=v1.LabelSelector(
                                 match_labels=dict(self.labels)
                             ),
-                            topology_key=v1.LABEL_ZONE,
+                            topology_key=(
+                                v1.LABEL_ZONE
+                                if self.anti_affinity_zone
+                                else v1.LABEL_HOSTNAME
+                            ),
                         )
                     ]
                 )

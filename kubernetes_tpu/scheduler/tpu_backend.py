@@ -27,6 +27,7 @@ from ..api import types as v1
 from ..models.encoding import ClusterEncoding
 from ..models.pod_encoder import PodEncoder
 from ..ops.batch import pod_batchable, schedule_batch, shape_signature
+from ..ops.hoisted import schedule_batch_hoisted
 from ..ops.kernel import DEFAULT_WEIGHTS, schedule_pod_jit
 from .core import ScheduleResult
 from .framework.interface import FitError, Status
@@ -140,7 +141,18 @@ class TPUBackend(CacheListener):
                     arrays.append(q)
                     j += 1
                 c = self.enc.device_state()
-                if len(self.enc._pod_free) < len(group):
+
+                def _clean():
+                    return [
+                        {k: v for k, v in a.items() if not k.startswith("_")}
+                        for a in arrays
+                    ]
+
+                if all(not g.spec.node_name for g in group):
+                    # pending pods: the template-hoisted scan (no in-scan
+                    # pod-table writes, ~4x faster step) — the default path
+                    decisions, _ = schedule_batch_hoisted(c, _clean(), self.weights)
+                elif len(self.enc._pod_free) < len(group):
                     # pod table full: schedule singly (each add triggers
                     # its own rebuild/growth)
                     for g in group:
@@ -152,12 +164,9 @@ class TPUBackend(CacheListener):
                             results.append((g, None))
                     i = j
                     continue
-                slots = [self.enc._pod_free[-1 - k] for k in range(len(group))]
-                clean = [
-                    {k: v for k, v in a.items() if not k.startswith("_")}
-                    for a in arrays
-                ]
-                decisions, _ = schedule_batch(c, clean, slots, self.weights)
+                else:
+                    slots = [self.enc._pod_free[-1 - k] for k in range(len(group))]
+                    decisions, _ = schedule_batch(c, _clean(), slots, self.weights)
                 for g, best in zip(group, decisions):
                     if best < 0:
                         results.append((g, None))

@@ -1,0 +1,123 @@
+"""Chaosmonkey: periodic fault injection against a running cluster.
+
+Reference: test/e2e/chaosmonkey/chaosmonkey.go:48 — a chaosmonkey Do()s
+disruptions while registered tests run; the reboot/disruptive e2e suites
+use it to prove the control plane re-converges. Here the disruptions are
+the ones a hollow cluster can suffer: kubelet kill (node death), kubelet
+restart (recovery), and random pod deletion (workload churn). Each
+disruption is recorded so tests can assert recovery against the actual
+injection history.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+
+@dataclass
+class Disruption:
+    kind: str  # kill-kubelet | restart-kubelet | delete-pod
+    target: str
+    at: float = field(default_factory=time.time)
+
+
+class ChaosMonkey:
+    def __init__(
+        self,
+        cluster,  # kubernetes_tpu.cluster.Cluster (needs .hollow/.client)
+        period: float = 1.0,
+        rng: Optional[random.Random] = None,
+        disruptions: Optional[List[str]] = None,
+    ):
+        self.cluster = cluster
+        self.period = period
+        self.rng = rng or random.Random(0)
+        self.kinds = disruptions or ["kill-kubelet", "restart-kubelet", "delete-pod"]
+        self.history: List[Disruption] = []
+        self._dead: List = []  # kubelets killed and not yet restarted
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period):
+            try:
+                self.do_one()
+            except Exception:  # noqa: BLE001 — chaos must not crash the test
+                pass
+
+    # -- disruptions --------------------------------------------------------
+
+    def do_one(self) -> Optional[Disruption]:
+        kind = self.rng.choice(self.kinds)
+        fn = {
+            "kill-kubelet": self._kill_kubelet,
+            "restart-kubelet": self._restart_kubelet,
+            "delete-pod": self._delete_pod,
+        }[kind]
+        d = fn()
+        if d is not None:
+            self.history.append(d)
+        return d
+
+    def _kill_kubelet(self) -> Optional[Disruption]:
+        hollow = self.cluster.hollow
+        if hollow is None:
+            return None
+        alive = [kl for kl in hollow.kubelets if kl not in self._dead]
+        if len(alive) <= 1:
+            return None  # always leave one node standing
+        victim = self.rng.choice(alive)
+        victim.stop()
+        self._dead.append(victim)
+        return Disruption("kill-kubelet", victim.config.node_name)
+
+    def _restart_kubelet(self) -> Optional[Disruption]:
+        if not self._dead:
+            return None
+        kl = self._dead.pop(self.rng.randrange(len(self._dead)))
+        # a restarted kubelet is a FRESH process over the same node name
+        # and runtime (kubelet restart reconciles from CRI via PLEG)
+        from ..kubelet.kubelet import Kubelet
+
+        fresh = Kubelet(
+            self.cluster.hollow.client,
+            self.cluster.hollow.factory,
+            config=kl.config,
+            runtime=kl.runtime,
+        )
+        idx = self.cluster.hollow.kubelets.index(kl)
+        self.cluster.hollow.kubelets[idx] = fresh
+        fresh.run()
+        return Disruption("restart-kubelet", kl.config.node_name)
+
+    def _delete_pod(self) -> Optional[Disruption]:
+        pods, _ = self.cluster.client.pods.list(namespace="default")
+        candidates = [p for p in pods if p.metadata.deletion_timestamp is None]
+        if not candidates:
+            return None
+        victim = self.rng.choice(candidates)
+        self.cluster.client.pods.delete(
+            victim.metadata.name, victim.metadata.namespace
+        )
+        return Disruption(
+            "delete-pod", f"{victim.metadata.namespace}/{victim.metadata.name}"
+        )
+
+    # -- assertions ---------------------------------------------------------
+
+    def restart_all_dead(self) -> None:
+        while self._dead:
+            self._restart_kubelet()

@@ -1,0 +1,78 @@
+"""Chaosmonkey e2e: inject node deaths/restarts and pod deletions while a
+deployment runs; the control plane must re-converge to the desired state.
+
+Reference shape: test/e2e/chaosmonkey + the disruptive/reboot suites.
+"""
+
+import random
+import time
+
+from kubernetes_tpu.cluster import Cluster
+from kubernetes_tpu.testing.chaos import ChaosMonkey
+
+from .util import wait_until
+
+
+def test_cluster_survives_chaos(tmp_path):
+    import yaml
+
+    manifest = tmp_path / "app.yaml"
+    manifest.write_text(
+        yaml.safe_dump({
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "ha"},
+            "spec": {
+                "replicas": 6,
+                "selector": {"matchLabels": {"app": "ha"}},
+                "template": {
+                    "metadata": {"labels": {"app": "ha"}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "img:1",
+                                "resources": {"requests": {"cpu": "50m"}},
+                            }
+                        ]
+                    },
+                },
+            },
+        })
+    )
+    with Cluster(
+        n_nodes=5,
+        scheduler_backend="oracle",
+        controllers=["replicaset", "deployment", "nodelifecycle"],
+        controller_opts={
+            "node_monitor_period": 0.3,
+            "node_monitor_grace_period": 2.0,
+        },
+    ) as c:
+        c.kubectl("apply", "-f", str(manifest))
+
+        def n_running():
+            pods, _ = c.client.pods.list(namespace="default")
+            return sum(1 for p in pods if p.status.phase == "Running")
+
+        assert wait_until(lambda: n_running() == 6, timeout=60)
+
+        monkey = ChaosMonkey(c, period=0.5, rng=random.Random(42))
+        monkey.run()
+        time.sleep(6)  # ~12 disruptions
+        monkey.stop()
+        assert len(monkey.history) >= 4
+        kinds = {d.kind for d in monkey.history}
+        assert "delete-pod" in kinds or "kill-kubelet" in kinds
+        monkey.restart_all_dead()  # end the experiment with all nodes back
+
+        # convergence: all 6 replicas running on live nodes again
+        def converged():
+            pods, _ = c.client.pods.list(namespace="default")
+            running = [p for p in pods if p.status.phase == "Running"]
+            return len(running) == 6 and len(pods) == 6
+
+        assert wait_until(converged, timeout=90), [
+            (p.metadata.name, p.spec.node_name, p.status.phase)
+            for p in c.client.pods.list(namespace="default")[0]
+        ]
