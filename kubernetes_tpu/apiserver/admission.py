@@ -227,6 +227,7 @@ def resource_quota(api: APIServer):
                         f"limited: {key}={limit}"
                     )
 
+    admit.atomic = True  # runs under the server write lock (CAS analog)
     return admit
 
 

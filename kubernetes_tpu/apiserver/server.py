@@ -188,14 +188,19 @@ class APIServer:
         meta = obj.metadata
         if not meta.name:
             raise Invalid("metadata.name is required")
-        # admission + store write under one lock: quota admission reads
-        # current usage and must not race another create past the hard
-        # limit (the reference CASes quota status.used instead)
+        # non-atomic admission runs OUTSIDE the lock — webhook plugins do
+        # blocking HTTP here and may re-enter the server; only hooks
+        # flagged `atomic` (quota: usage check must not race the write
+        # past the hard limit) run under the lock with the store write
+        for admit in self._mutating:
+            admit(resource, "CREATE", obj)
+        for admit in self._validating:
+            if not getattr(admit, "atomic", False):
+                admit(resource, "CREATE", obj)
         with self._lock:
-            for admit in self._mutating:
-                admit(resource, "CREATE", obj)
             for admit in self._validating:
-                admit(resource, "CREATE", obj)
+                if getattr(admit, "atomic", False):
+                    admit(resource, "CREATE", obj)
             meta.uid = meta.uid or str(uuid.uuid4())
             meta.creation_timestamp = meta.creation_timestamp or time.time()
             if resource == "namespaces" and "kubernetes" not in (meta.finalizers or []):
@@ -256,6 +261,17 @@ class APIServer:
         deletionTimestamp + finalizer wait)."""
         info = self._info(resource)
         key = self._key(info, namespace, name)
+        # DELETE admission (validating webhooks guard deletions in the
+        # reference dispatcher); the current object is what hooks see
+        try:
+            current = self.get(resource, name, namespace)
+        except NotFound:
+            current = None
+        if current is not None:
+            for admit in self._mutating:
+                admit(resource, "DELETE", current)
+            for admit in self._validating:
+                admit(resource, "DELETE", current)
         # The finalizer check and the write are guarded by the same
         # mod_revision so a concurrent add/remove of the last finalizer
         # can't strand a soft-deleted object or bypass finalization

@@ -142,6 +142,52 @@ def _chain_hash(*parts: str) -> str:
     return hashlib.sha256("/".join(parts).encode()).hexdigest()[:16].upper()
 
 
+class BoundedFrequencyRunner:
+    """Serialize + rate-limit a sync function (the reference's
+    async.BoundedFrequencyRunner): immediate run when outside the
+    min interval, one deferred timer-run otherwise. Shared by the
+    iptables and ipvs proxier modes."""
+
+    def __init__(self, fn, min_interval: float = 0.0):
+        self._fn = fn
+        self._min = min_interval
+        self._lock = threading.Lock()
+        self._mutex = threading.Lock()  # serializes fn itself
+        self._last = 0.0
+        self._pending = False
+
+    def run(self) -> None:
+        with self._lock:
+            now = time.time()
+            if self._min and now - self._last < self._min:
+                if not self._pending:
+                    self._pending = True
+                    delay = max(0.0, self._min - (now - self._last))
+                    timer = threading.Timer(delay, self.flush)
+                    timer.daemon = True
+                    timer.start()
+                return
+            self._last = now
+        with self._mutex:
+            self._fn()
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._pending:
+                return
+            self._pending = False
+            self._last = time.time()
+        with self._mutex:
+            self._fn()
+
+    def run_now(self) -> None:
+        """Unconditional serialized run (tests / manual resync)."""
+        with self._lock:
+            self._last = time.time()
+        with self._mutex:
+            self._fn()
+
+
 class Proxier:
     """Per-node proxy: informers -> Netfilter rule graph.
 
@@ -160,14 +206,12 @@ class Proxier:
         self.node_name = node_name
         self.netfilter = Netfilter(rng=rng)
         self.slice_cache = EndpointSliceCache()
-        self._min_sync = min_sync_period
-        self._last_sync = 0.0
-        self._lock = threading.Lock()
-        # serialize rule synthesis: service and slice events arrive on
-        # different informer dispatch threads; without this, a sync that
-        # read an older snapshot can finish last and clobber newer rules
-        self._sync_mutex = threading.Lock()
-        self._pending = False
+        # serializes rule synthesis (service and slice events arrive on
+        # different informer dispatch threads; an older-snapshot sync must
+        # not finish last and clobber newer rules) and rate-limits it
+        self._runner = BoundedFrequencyRunner(
+            self._sync_proxy_rules_locked, min_sync_period
+        )
         self.sync_count = 0
         self.svc_informer = informer_factory.informer_for("services")
         self.slice_informer = informer_factory.informer_for("endpointslices")
@@ -195,35 +239,16 @@ class Proxier:
         self._schedule_sync()
 
     def _schedule_sync(self) -> None:
-        with self._lock:
-            now = time.time()
-            if self._min_sync and now - self._last_sync < self._min_sync:
-                # rate-limited: defer to a timer (BoundedFrequencyRunner's
-                # RetryAfter) so the deferred state can't go stale forever
-                if not self._pending:
-                    self._pending = True
-                    delay = max(0.0, self._min_sync - (now - self._last_sync))
-                    timer = threading.Timer(delay, self.flush_pending)
-                    timer.daemon = True
-                    timer.start()
-                return
-            self._last_sync = now
-        self.sync_proxy_rules()
+        self._runner.run()
 
     def flush_pending(self) -> None:
         """Run a sync if one was rate-limited (BoundedFrequencyRunner tick)."""
-        with self._lock:
-            if not self._pending:
-                return
-            self._pending = False
-            self._last_sync = time.time()
-        self.sync_proxy_rules()
+        self._runner.flush()
 
     # -- the resync ---------------------------------------------------------
 
     def sync_proxy_rules(self) -> None:
-        with self._sync_mutex:
-            self._sync_proxy_rules_locked()
+        self._runner.run_now()
 
     def _sync_proxy_rules_locked(self) -> None:
         chains: Dict[str, Chain] = {}

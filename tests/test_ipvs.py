@@ -1,0 +1,138 @@
+"""IPVS proxier mode: virtual-server table, schedulers, persistence.
+
+Reference shape: pkg/proxy/ipvs/proxier_test.go.
+"""
+
+from collections import Counter
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers.endpointslice import EndpointSliceController
+from kubernetes_tpu.proxy import IPVSProxier, Packet
+from kubernetes_tpu.proxy.ipvs import IPVSTable, RealServer, VirtualServer
+
+from .util import wait_until
+
+
+class TestIPVSTable:
+    def _vs(self, scheduler="rr", persistence=0.0, n=3):
+        return VirtualServer(
+            ip="10.0.0.1", port=80, scheduler=scheduler,
+            persistence_seconds=persistence,
+            reals=[RealServer(ip=f"10.1.0.{i}", port=8080) for i in range(n)],
+        )
+
+    def test_round_robin(self):
+        t = IPVSTable()
+        t.replace([self._vs()])
+        got = [t.route(Packet("10.0.0.1", 80, src_ip=f"c{i}"))[0] for i in range(6)]
+        assert got == ["10.1.0.0", "10.1.0.1", "10.1.0.2"] * 2
+
+    def test_least_connection(self):
+        t = IPVSTable()
+        t.replace([self._vs(scheduler="lc")])
+        first = t.route(Packet("10.0.0.1", 80, src_ip="a"))
+        second = t.route(Packet("10.0.0.1", 80, src_ip="b"))
+        third = t.route(Packet("10.0.0.1", 80, src_ip="c"))
+        assert {first[0], second[0], third[0]} == {
+            "10.1.0.0", "10.1.0.1", "10.1.0.2"
+        }
+        # close a connection: that real becomes least-loaded again
+        t.conn_close(("10.0.0.1", 80, "TCP"), (first[0], 8080))
+        assert t.route(Packet("10.0.0.1", 80, src_ip="d"))[0] == first[0]
+
+    def test_source_hash_stable(self):
+        t = IPVSTable()
+        t.replace([self._vs(scheduler="sh")])
+        picks = {t.route(Packet("10.0.0.1", 80, src_ip="client-1"))[0] for _ in range(10)}
+        assert len(picks) == 1
+
+    def test_persistence(self):
+        t = IPVSTable()
+        t.replace([self._vs(persistence=60.0)])
+        first = t.route(Packet("10.0.0.1", 80, src_ip="sticky"))
+        for _ in range(5):
+            assert t.route(Packet("10.0.0.1", 80, src_ip="sticky")) == first
+
+    def test_no_reals_refused_and_unknown_none(self):
+        t = IPVSTable()
+        t.replace([VirtualServer(ip="10.0.0.1", port=80)])
+        with pytest.raises(ConnectionRefusedError):
+            t.route(Packet("10.0.0.1", 80, src_ip="x"))
+        assert t.route(Packet("10.9.9.9", 80, src_ip="x")) is None
+
+    def test_replace_preserves_connections_and_rr_position(self):
+        t = IPVSTable()
+        t.replace([self._vs(scheduler="lc")])
+        t.route(Packet("10.0.0.1", 80, src_ip="a"))  # one conn on real 0
+        t.replace([self._vs(scheduler="lc")])
+        # real 0 still has the active connection after resync
+        vs = t.virtual_servers()[0]
+        assert sum(r.active_conn for r in vs.reals) == 1
+
+
+class TestIPVSProxier:
+    def test_end_to_end_sync_and_route(self):
+        api = APIServer()
+        cs = Clientset(api)
+        factory = SharedInformerFactory(cs)
+        ctrl = EndpointSliceController(cs, factory)
+        proxier = IPVSProxier(factory)
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        ctrl.run()
+        try:
+            cs.services.create(
+                v1.Service(
+                    metadata=v1.ObjectMeta(name="web", namespace="default"),
+                    spec=v1.ServiceSpec(
+                        selector={"app": "web"},
+                        cluster_ip="10.0.0.10",
+                        type="NodePort",
+                        ports=[
+                            v1.ServicePort(
+                                name="http", port=80, target_port=8080,
+                                node_port=30080,
+                            )
+                        ],
+                    ),
+                )
+            )
+            for i in range(3):
+                cs.pods.create(
+                    v1.Pod(
+                        metadata=v1.ObjectMeta(
+                            name=f"w{i}", namespace="default",
+                            labels={"app": "web"},
+                        ),
+                        spec=v1.PodSpec(
+                            node_name="n1",
+                            containers=[v1.Container(name="c", image="i")],
+                        ),
+                        status=v1.PodStatus(
+                            phase="Running", pod_ip=f"10.1.0.{i}",
+                            conditions=[v1.PodCondition(type="Ready", status="True")],
+                        ),
+                    )
+                )
+            assert wait_until(
+                lambda: any(
+                    len(vs.reals) == 3 for vs in proxier.table.virtual_servers()
+                )
+            )
+            hits = Counter(
+                proxier.route(Packet("10.0.0.10", 80, src_ip=f"c{i}"))[0]
+                for i in range(9)
+            )
+            assert set(hits) == {"10.1.0.0", "10.1.0.1", "10.1.0.2"}
+            assert all(v == 3 for v in hits.values())  # strict rr fairness
+            # nodePort on any node address
+            ip, port = proxier.route(Packet("172.16.0.9", 30080, src_ip="z"))
+            assert port == 8080 and ip.startswith("10.1.0.")
+        finally:
+            ctrl.stop()
+            factory.stop()
