@@ -371,16 +371,10 @@ def _run(c_all: Dict, tp: Dict, batch_self: Dict, xs: Dict, weights_key):
     return jax.lax.scan(step, carry, xs)
 
 
-def schedule_batch_hoisted(
-    cluster: Dict,
-    pod_arrays_list: List[Dict],
-    weights: Optional[Dict[str, int]] = None,
-) -> Tuple[List[int], Dict]:
-    """Schedule a batchable batch with template hoisting.
-
-    Requirements (assert; callers route through ops/batch.py otherwise):
-    every pod batchable (no affinity terms/ports) and unbound (no
-    spec.nodeName). Returns (decisions, ys)."""
+def prepare_batch(pod_arrays_list: List[Dict]) -> Tuple[Dict, Dict, Dict]:
+    """Group the batch by template and build the scan inputs:
+    (stacked templates, batch self-rows, xs). Asserts hoisting
+    preconditions (batchable + unbound)."""
     from .batch import pod_batchable
 
     b = len(pod_arrays_list)
@@ -415,6 +409,20 @@ def schedule_batch_hoisted(
         "j": jnp.arange(b, dtype=jnp.int32),
         "valid": jnp.ones(b, bool),
     }
+    return tp, batch_self, xs
+
+
+def schedule_batch_hoisted(
+    cluster: Dict,
+    pod_arrays_list: List[Dict],
+    weights: Optional[Dict[str, int]] = None,
+) -> Tuple[List[int], Dict]:
+    """Schedule a batchable batch with template hoisting.
+
+    Requirements (assert; callers route through ops/batch.py otherwise):
+    every pod batchable (no affinity terms/ports) and unbound (no
+    spec.nodeName). Returns (decisions, ys)."""
+    tp, batch_self, xs = prepare_batch(pod_arrays_list)
     key = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     _, ys = _run(cluster, tp, batch_self, xs, key)
     return [int(v) for v in np.asarray(ys["best"])], ys

@@ -141,3 +141,22 @@ class ShardedScheduler:
         c = shard_cluster(cluster, self.mesh)
         p = replicate_pod(pod_arrays, self.mesh)
         return _kernel_with_select(c, p, self.weights_key)
+
+    def schedule_batch_hoisted(self, cluster: Dict, pod_arrays_list):
+        """Template-hoisted batched scan over the mesh: node-axis arrays
+        sharded, templates/batch rows replicated. The prologue's pod-table
+        sweeps run replicated; per-node masks/scores and the in-scan
+        normalization max/min and count scatters become GSPMD collectives
+        over ICI. Decisions are bit-identical to the single-device scan
+        (tests/test_hoisted.py::TestShardedHoisted). Returns
+        (decisions, ys) — the same contract as
+        ops.hoisted.schedule_batch_hoisted, so callers are swappable."""
+        from ..ops import hoisted
+
+        tp, batch_self, xs = hoisted.prepare_batch(pod_arrays_list)
+        c = shard_cluster(cluster, self.mesh)
+        tp = replicate_pod(tp, self.mesh)
+        batch_self = replicate_pod(batch_self, self.mesh)
+        xs = replicate_pod(xs, self.mesh)
+        _, ys = hoisted._run(c, tp, batch_self, xs, self.weights_key)
+        return [int(v) for v in np.asarray(ys["best"])], ys

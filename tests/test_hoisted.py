@@ -24,12 +24,12 @@ def _encode_all(enc, pe, pods):
     return arrays
 
 
-def _run_both(nodes, init_pods, pending):
+def _presized_encoding(nodes, init_pods, pending):
+    """Encoding with the pod table pre-sized for the whole batch
+    (bench.py's phantom-bind trick)."""
     import copy
 
     enc = ClusterEncoding()
-    # phantom-bind copies of the pending pods so the pod table is sized
-    # for the whole batch (bench.py's pre-sizing trick)
     phantoms = []
     for i, p in enumerate(pending):
         q = copy.deepcopy(p)
@@ -43,7 +43,11 @@ def _run_both(nodes, init_pods, pending):
     enc.device_state()
     for q in phantoms:
         enc.remove_pod(q)
+    return enc, pe
 
+
+def _run_both(nodes, init_pods, pending):
+    enc, pe = _presized_encoding(nodes, init_pods, pending)
     arrays = _encode_all(enc, pe, pending)
     c = enc.device_state()
     slots = [enc._pod_free[-1 - i] for i in range(len(pending))]
@@ -149,3 +153,27 @@ class TestHoistedParity:
         arrays = _encode_all(enc, pe, pending)
         fps = {template_fingerprint(a) for a in arrays}
         assert len(fps) == 2
+
+
+class TestShardedHoisted:
+    def test_mesh_parity(self):
+        """The hoisted scan sharded over an 8-device mesh must make the
+        SAME decisions as the single-device scan (GSPMD collectives for
+        normalization + count scatters)."""
+        import jax
+
+        from kubernetes_tpu.parallel.sharded import ShardedScheduler, make_mesh
+
+        # 26 nodes on an 8-device mesh: NOT divisible, so pad_node_axis
+        # adds 6 all-zero rows — the parity assert also proves padded
+        # nodes are never chosen
+        nodes, init_pods = synth_cluster(26, pods_per_node=2)
+        pending = synth_pending_pods(24, spread=True)
+        enc, pe = _presized_encoding(nodes, init_pods, pending)
+        arrays = _encode_all(enc, pe, pending)
+        c = enc.device_state()
+        single, _ = schedule_batch_hoisted(c, arrays)
+        mesh = make_mesh(n_devices=min(8, len(jax.devices())))
+        sharded, _ = ShardedScheduler(mesh=mesh).schedule_batch_hoisted(c, arrays)
+        assert sharded == single
+        assert all(d < 26 for d in sharded)  # real node indices only
