@@ -140,7 +140,9 @@ RBAC_RESOURCES = (
 
 
 class _AuthorizedResourceClient:
-    """clientset-compatible per-resource facade enforcing RBAC per verb."""
+    """clientset-compatible per-resource facade: the secured chain in the
+    reference's handler order — authn happened at as_user; each verb then
+    runs APF (seat held for the call) and RBAC authorization."""
 
     def __init__(self, secure: "SecureAPIServer", user: UserInfo, resource: str):
         self._s = secure
@@ -157,31 +159,63 @@ class _AuthorizedResourceClient:
                 + (f' in namespace "{namespace}"' if namespace else "")
             )
 
+    def _gated(self, verb: str, namespace: str, name: str, fn):
+        fc = self._s.flow_controller
+        if fc is None:
+            self._check(verb, namespace, name)
+            return fn()
+        from .flowcontrol import RequestInfo
+
+        req = RequestInfo(
+            user=self._user.name,
+            groups=self._user.groups,
+            verb=verb,
+            resource=self._resource,
+        )
+        with fc.dispatch(req):
+            self._check(verb, namespace, name)
+            return fn()
+
     def create(self, obj):
-        self._check("create", obj.metadata.namespace)
-        return self._s.api.create(self._resource, obj)
+        return self._gated(
+            "create", obj.metadata.namespace, "",
+            lambda: self._s.api.create(self._resource, obj),
+        )
 
     def get(self, name: str, namespace: str = ""):
-        self._check("get", namespace, name)
-        return self._s.api.get(self._resource, name, namespace)
+        return self._gated(
+            "get", namespace, name,
+            lambda: self._s.api.get(self._resource, name, namespace),
+        )
 
     def update(self, obj):
-        self._check("update", obj.metadata.namespace, obj.metadata.name)
-        return self._s.api.update(self._resource, obj)
+        return self._gated(
+            "update", obj.metadata.namespace, obj.metadata.name,
+            lambda: self._s.api.update(self._resource, obj),
+        )
 
     def update_status(self, obj):
-        self._check("update", obj.metadata.namespace, obj.metadata.name)
-        return self._s.api.update_status(self._resource, obj)
+        return self._gated(
+            "update", obj.metadata.namespace, obj.metadata.name,
+            lambda: self._s.api.update_status(self._resource, obj),
+        )
 
     def delete(self, name: str, namespace: str = ""):
-        self._check("delete", namespace, name)
-        return self._s.api.delete(self._resource, name, namespace)
+        return self._gated(
+            "delete", namespace, name,
+            lambda: self._s.api.delete(self._resource, name, namespace),
+        )
 
     def list(self, namespace=None, label_selector=None):
-        self._check("list", namespace or "")
-        return self._s.api.list(self._resource, namespace, label_selector)
+        return self._gated(
+            "list", namespace or "", "",
+            lambda: self._s.api.list(self._resource, namespace, label_selector),
+        )
 
     def watch(self, namespace=None, since_revision=None):
+        # watches are long-lived: classify/authorize but do NOT hold a
+        # seat for the stream's lifetime (the reference accounts watch
+        # setup, not the stream)
         self._check("watch", namespace or "")
         return self._s.api.watch(self._resource, namespace, since_revision)
 
@@ -202,14 +236,17 @@ class _AuthorizedClientset:
 
 
 class SecureAPIServer:
-    """APIServer + authn + RBAC authz (the secured handler chain)."""
+    """APIServer + authn + APF + RBAC authz (the secured handler chain,
+    in the reference's order: WithAuthentication →
+    WithPriorityAndFairness → WithAuthorization)."""
 
-    def __init__(self, api: Optional[APIServer] = None):
+    def __init__(self, api: Optional[APIServer] = None, flow_controller=None):
         self.api = api or APIServer()
         for info in RBAC_RESOURCES:
             self.api.register_resource(info)
         self.authenticator = TokenAuthenticator()
         self.authorizer = RBACAuthorizer(self.api)
+        self.flow_controller = flow_controller
 
     def as_user(self, token: str) -> _AuthorizedClientset:
         """Authenticate a bearer token -> authorized clientset facade."""

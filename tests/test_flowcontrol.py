@@ -1,0 +1,274 @@
+"""API Priority & Fairness: classification, seat limits, queue overflow,
+exempt bypass.
+
+Reference shape: apiserver/pkg/util/flowcontrol tests.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.flowcontrol import (
+    FlowController,
+    FlowSchema,
+    FlowSchemaRule,
+    FlowSchemaSpec,
+    FlowSchemaSubject,
+    PriorityLevelConfiguration,
+    PriorityLevelConfigurationSpec,
+    PriorityLevelLimited,
+    RequestInfo,
+    TooManyRequests,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+
+
+@pytest.fixture()
+def fc():
+    return FlowController(APIServer(), default_timeout=0.5)
+
+
+class TestClassification:
+    def test_defaults_installed(self, fc):
+        plcs, _ = fc.api.list("prioritylevelconfigurations")
+        assert {p.metadata.name for p in plcs} == {"exempt", "global-default"}
+        schemas, _ = fc.api.list("flowschemas")
+        assert {s.metadata.name for s in schemas} == {"exempt", "catch-all"}
+
+    def test_masters_exempt_catchall_rest(self, fc):
+        admin = fc.classify(RequestInfo(user="root", groups=("system:masters",)))
+        assert admin.exempt
+        dev = fc.classify(RequestInfo(user="dev", verb="list", resource="pods"))
+        assert dev.name == "global-default"
+
+    def test_precedence_and_rules(self, fc):
+        fc.api.create("prioritylevelconfigurations", PriorityLevelConfiguration(
+            metadata=v1.ObjectMeta(name="workload-low"),
+            spec=PriorityLevelConfigurationSpec(
+                limited=PriorityLevelLimited(assured_concurrency_shares=2)
+            ),
+        ))
+        fc.api.create("flowschemas", FlowSchema(
+            metadata=v1.ObjectMeta(name="controllers"),
+            spec=FlowSchemaSpec(
+                priority_level_configuration="workload-low",
+                matching_precedence=100,
+                rules=[FlowSchemaRule(
+                    subjects=[FlowSchemaSubject(kind="Group", name="controllers")],
+                    resources=["pods"],
+                )],
+            ),
+        ))
+        req = RequestInfo(user="rs-controller", groups=("controllers",),
+                          verb="create", resource="pods")
+        assert fc.classify(req).name == "workload-low"
+        # non-matching resource falls through to catch-all
+        other = RequestInfo(user="rs-controller", groups=("controllers",),
+                            verb="create", resource="nodes")
+        assert fc.classify(other).name == "global-default"
+
+
+class TestSeats:
+    def _tight_level(self, fc, seats=1, queue=1):
+        fc.api.create("prioritylevelconfigurations", PriorityLevelConfiguration(
+            metadata=v1.ObjectMeta(name="tight"),
+            spec=PriorityLevelConfigurationSpec(
+                limited=PriorityLevelLimited(
+                    assured_concurrency_shares=seats, queue_length_limit=queue
+                )
+            ),
+        ))
+        fc.api.create("flowschemas", FlowSchema(
+            metadata=v1.ObjectMeta(name="tight"),
+            spec=FlowSchemaSpec(
+                priority_level_configuration="tight",
+                matching_precedence=10,
+                rules=[FlowSchemaRule(
+                    subjects=[FlowSchemaSubject(kind="User", name="busy")]
+                )],
+            ),
+        ))
+        return RequestInfo(user="busy", verb="create", resource="pods")
+
+    def test_seat_serialization(self, fc):
+        req = self._tight_level(fc, seats=1, queue=10)
+        running = []
+        peak = []
+
+        def work(i):
+            with fc.dispatch(req, timeout=5):
+                running.append(i)
+                peak.append(len(running))
+                time.sleep(0.05)
+                running.remove(i)
+
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert max(peak) == 1  # one seat -> fully serialized
+
+    def test_queue_overflow_rejects(self, fc):
+        req = self._tight_level(fc, seats=1, queue=1)
+        hold = threading.Event()
+        entered = threading.Event()
+
+        def holder():
+            with fc.dispatch(req, timeout=5):
+                entered.set()
+                hold.wait(2)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert entered.wait(2)
+        # one waiter fits the queue...
+        rejected = []
+
+        def waiter():
+            try:
+                with fc.dispatch(req, timeout=1.5):
+                    pass
+            except TooManyRequests:
+                rejected.append("waiter")
+
+        w = threading.Thread(target=waiter)
+        w.start()
+        time.sleep(0.1)
+        # ...the next overflows immediately
+        with pytest.raises(TooManyRequests, match="queue full"):
+            with fc.dispatch(req, timeout=1):
+                pass
+        hold.set()
+        t.join()
+        w.join()
+        assert not rejected  # the queued waiter got the seat after release
+
+    def test_exempt_never_blocks(self, fc):
+        req = self._tight_level(fc, seats=1, queue=1)
+        admin = RequestInfo(user="root", groups=("system:masters",))
+        with fc.dispatch(req, timeout=5):
+            for _ in range(5):  # exempt traffic unaffected by the full level
+                with fc.dispatch(admin):
+                    pass
+
+    def test_seat_timeout(self, fc):
+        req = self._tight_level(fc, seats=1, queue=5)
+        hold = threading.Event()
+        entered = threading.Event()
+
+        def holder():
+            with fc.dispatch(req, timeout=5):
+                entered.set()
+                hold.wait(3)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert entered.wait(2)
+        with pytest.raises(TooManyRequests, match="timed out"):
+            with fc.dispatch(req, timeout=0.2):
+                pass
+        hold.set()
+        t.join()
+
+
+class TestConfigRefreshStability:
+    def test_seats_survive_unrelated_store_writes(self, fc):
+        """Any store write bumps the revision; the level cache must NOT
+        rebuild (minting fresh semaphores while seats are held would
+        bypass the concurrency limit)."""
+        fc.api.create("prioritylevelconfigurations", PriorityLevelConfiguration(
+            metadata=v1.ObjectMeta(name="one-seat"),
+            spec=PriorityLevelConfigurationSpec(
+                limited=PriorityLevelLimited(
+                    assured_concurrency_shares=1, queue_length_limit=8
+                )
+            ),
+        ))
+        fc.api.create("flowschemas", FlowSchema(
+            metadata=v1.ObjectMeta(name="one-seat"),
+            spec=FlowSchemaSpec(
+                priority_level_configuration="one-seat",
+                matching_precedence=5,
+                rules=[FlowSchemaRule(
+                    subjects=[FlowSchemaSubject(kind="User", name="writer")]
+                )],
+            ),
+        ))
+        req = RequestInfo(user="writer", verb="create", resource="pods")
+        peak = []
+        active = []
+        lock = threading.Lock()
+
+        def work(i):
+            from .util import make_pod
+
+            from kubernetes_tpu.client.clientset import Clientset
+
+            cs = Clientset(fc.api)
+            with fc.dispatch(req, timeout=5):
+                with lock:
+                    active.append(i)
+                    peak.append(len(active))
+                cs.pods.create(make_pod(f"w-{i}"))  # store write mid-seat
+                time.sleep(0.02)
+                with lock:
+                    active.remove(i)
+
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert max(peak) == 1
+
+
+class TestSecuredChainIntegration:
+    def test_apf_wired_between_authn_and_authz(self):
+        """SecureAPIServer(flow_controller=...) gates every verb: a full
+        priority level 429s a user even when RBAC would allow the call."""
+        from kubernetes_tpu.apiserver.auth import SecureAPIServer
+
+        from .util import make_pod
+
+        api = APIServer()
+        fc = FlowController(api, default_timeout=0.2)
+        secure = SecureAPIServer(api, flow_controller=fc)
+        secure.authenticator.add_token("root", "root", ["system:masters"])
+        secure.authenticator.add_token("busy-t", "busy")
+        # grant 'busy' full pod access; then choke its priority level
+        from kubernetes_tpu.api import rbac
+
+        api.create("clusterroles", rbac.ClusterRole(
+            metadata=v1.ObjectMeta(name="pods-all"),
+            rules=[rbac.PolicyRule(verbs=["*"], resources=["pods"])]))
+        api.create("clusterrolebindings", rbac.ClusterRoleBinding(
+            metadata=v1.ObjectMeta(name="pods-all"),
+            subjects=[rbac.Subject(kind="User", name="busy")],
+            role_ref=rbac.RoleRef(kind="ClusterRole", name="pods-all")))
+        api.create("prioritylevelconfigurations", PriorityLevelConfiguration(
+            metadata=v1.ObjectMeta(name="choke"),
+            spec=PriorityLevelConfigurationSpec(
+                limited=PriorityLevelLimited(
+                    assured_concurrency_shares=1, queue_length_limit=0))))
+        api.create("flowschemas", FlowSchema(
+            metadata=v1.ObjectMeta(name="choke"),
+            spec=FlowSchemaSpec(priority_level_configuration="choke",
+                matching_precedence=5,
+                rules=[FlowSchemaRule(
+                    subjects=[FlowSchemaSubject(kind="User", name="busy")])])))
+        cs = secure.as_user("busy-t")
+        cs.pods.create(make_pod("ok"))  # one seat free -> succeeds
+        # hold the single seat; the next call must 429, not Forbidden
+        level = fc.classify(RequestInfo(user="busy", verb="get", resource="pods"))
+        level.acquire(timeout=1)
+        try:
+            with pytest.raises(TooManyRequests):
+                cs.pods.get("ok", "default")
+        finally:
+            level.release()
+        cs.pods.get("ok", "default")  # seat released -> flows again
+        # exempt masters unaffected throughout
+        secure.as_user("root").pods.list()
