@@ -286,6 +286,8 @@ class PodStatus:
     pod_ip: str = field(default="", metadata={"json": "podIP"})
     host_ip: str = field(default="", metadata={"json": "hostIP"})
     container_statuses: Optional[List[ContainerStatus]] = None
+    reason: str = ""  # e.g. UnexpectedAdmissionError, Evicted
+    message: str = ""
 
 
 @dataclass

@@ -28,17 +28,24 @@ GROUP_AUTHENTICATED = "system:authenticated"
 
 
 class Unauthorized(APIError):
-    """No/invalid credentials (401)."""
+    """No/invalid credentials."""
+
+    code = 401
 
 
 class Forbidden(APIError):
-    """Authenticated but not allowed (403)."""
+    """Authenticated but not allowed."""
+
+    code = 403
 
 
 @dataclass(frozen=True)
 class UserInfo:
     name: str
     groups: tuple = ()
+    # the real authenticated identity when this user is impersonated
+    # (WithImpersonation, apiserver/pkg/endpoints/filters/impersonation.go)
+    impersonated_by: str = ""
 
 
 class TokenAuthenticator:
@@ -139,10 +146,76 @@ RBAC_RESOURCES = (
 )
 
 
+def _with_audit(logger, user: UserInfo, verb: str, resource: str,
+                namespace: str, name: str, inner, body=None):
+    """WithAudit (config.go:737): RequestReceived before dispatch,
+    ResponseComplete with the real status code after — wrapping flow
+    control and authorization so 429s and 403s are in the trail."""
+    if logger is None:
+        return inner()
+    from . import audit as audit_pkg
+    from ..utils import serde
+
+    rule = logger.policy.level_for(user.name, verb, resource, namespace)
+    if not audit_pkg.record_levels(rule.level):
+        return inner()
+    audit_id = logger.new_audit_id()
+
+    def event(stage, code, response_object=None):
+        return audit_pkg.Event(
+            audit_id=audit_id,
+            stage=stage,
+            level=rule.level,
+            user=user.name,
+            groups=list(user.groups),
+            verb=verb,
+            resource=resource,
+            namespace=namespace,
+            name=name,
+            impersonated_by=user.impersonated_by,
+            response_code=code,
+            request_object=(
+                serde.to_dict(body)
+                if body is not None and audit_pkg.includes_request(rule.level)
+                else None
+            ),
+            response_object=response_object,
+        )
+
+    if audit_pkg.STAGE_REQUEST_RECEIVED not in rule.omit_stages:
+        logger.emit(event(audit_pkg.STAGE_REQUEST_RECEIVED, 0))
+    omit_complete = audit_pkg.STAGE_RESPONSE_COMPLETE in rule.omit_stages
+    try:
+        out = inner()
+    except APIError as e:
+        if not omit_complete:
+            logger.emit(
+                event(audit_pkg.STAGE_RESPONSE_COMPLETE, getattr(e, "code", 500))
+            )
+        raise
+    except BaseException:
+        # unexpected failure: the Panic-stage event (audit/types.go
+        # StagePanic) — without it the trail under-reports exactly the
+        # requests that blew up
+        if audit_pkg.STAGE_PANIC not in rule.omit_stages:
+            logger.emit(event(audit_pkg.STAGE_PANIC, 500))
+        raise
+    if omit_complete:
+        return out
+    resp = None
+    if audit_pkg.includes_response(rule.level) and out is not None:
+        try:
+            resp = serde.to_dict(out)
+        except Exception:  # noqa: BLE001 — lists/streams: metadata only
+            resp = None
+    logger.emit(event(audit_pkg.STAGE_RESPONSE_COMPLETE, 200, resp))
+    return out
+
+
 class _AuthorizedResourceClient:
     """clientset-compatible per-resource facade: the secured chain in the
     reference's handler order — authn happened at as_user; each verb then
-    runs APF (seat held for the call) and RBAC authorization."""
+    runs audit, APF (seat held for the call), and RBAC authorization."""
 
     def __init__(self, secure: "SecureAPIServer", user: UserInfo, resource: str):
         self._s = secure
@@ -159,27 +232,37 @@ class _AuthorizedResourceClient:
                 + (f' in namespace "{namespace}"' if namespace else "")
             )
 
-    def _gated(self, verb: str, namespace: str, name: str, fn):
-        fc = self._s.flow_controller
-        if fc is None:
-            self._check(verb, namespace, name)
-            return fn()
-        from .flowcontrol import RequestInfo
+    def _gated(self, verb: str, namespace: str, name: str, fn, body=None):
+        """The secured chain for one verb, in the reference's handler
+        order (config.go:719-745): audit OUTSIDE flow control OUTSIDE
+        authorization — so APF 429s and authz 403s are both recorded."""
 
-        req = RequestInfo(
-            user=self._user.name,
-            groups=self._user.groups,
-            verb=verb,
-            resource=self._resource,
+        def inner():
+            fc = self._s.flow_controller
+            if fc is None:
+                self._check(verb, namespace, name)
+                return fn()
+            from .flowcontrol import RequestInfo
+
+            req = RequestInfo(
+                user=self._user.name,
+                groups=self._user.groups,
+                verb=verb,
+                resource=self._resource,
+            )
+            with fc.dispatch(req):
+                self._check(verb, namespace, name)
+                return fn()
+
+        return _with_audit(
+            self._s.audit, self._user, verb, self._resource,
+            namespace, name, inner, body,
         )
-        with fc.dispatch(req):
-            self._check(verb, namespace, name)
-            return fn()
 
     def create(self, obj):
         return self._gated(
             "create", obj.metadata.namespace, "",
-            lambda: self._s.api.create(self._resource, obj),
+            lambda: self._s.api.create(self._resource, obj), body=obj,
         )
 
     def get(self, name: str, namespace: str = ""):
@@ -191,13 +274,13 @@ class _AuthorizedResourceClient:
     def update(self, obj):
         return self._gated(
             "update", obj.metadata.namespace, obj.metadata.name,
-            lambda: self._s.api.update(self._resource, obj),
+            lambda: self._s.api.update(self._resource, obj), body=obj,
         )
 
     def update_status(self, obj):
         return self._gated(
             "update", obj.metadata.namespace, obj.metadata.name,
-            lambda: self._s.api.update_status(self._resource, obj),
+            lambda: self._s.api.update_status(self._resource, obj), body=obj,
         )
 
     def delete(self, name: str, namespace: str = ""):
@@ -213,11 +296,28 @@ class _AuthorizedResourceClient:
         )
 
     def watch(self, namespace=None, since_revision=None):
-        # watches are long-lived: classify/authorize but do NOT hold a
-        # seat for the stream's lifetime (the reference accounts watch
-        # setup, not the stream)
-        self._check("watch", namespace or "")
-        return self._s.api.watch(self._resource, namespace, since_revision)
+        # watches are long-lived: audit + classify + authorize the SETUP
+        # only — the seat is released before the stream is returned (the
+        # reference accounts watch setup, not the stream)
+        def inner():
+            fc = self._s.flow_controller
+            if fc is None:
+                self._check("watch", namespace or "")
+            else:
+                from .flowcontrol import RequestInfo
+
+                req = RequestInfo(
+                    user=self._user.name, groups=self._user.groups,
+                    verb="watch", resource=self._resource,
+                )
+                with fc.dispatch(req):
+                    self._check("watch", namespace or "")
+            return self._s.api.watch(self._resource, namespace, since_revision)
+
+        return _with_audit(
+            self._s.audit, self._user, "watch", self._resource,
+            namespace or "", "", inner,
+        )
 
 
 class _AuthorizedClientset:
@@ -228,6 +328,40 @@ class _AuthorizedClientset:
     def resource(self, name: str) -> _AuthorizedResourceClient:
         return _AuthorizedResourceClient(self._secure, self.user, name)
 
+    def impersonate(
+        self, username: str, groups: Optional[List[str]] = None
+    ) -> "_AuthorizedClientset":
+        """WithImpersonation (endpoints/filters/impersonation.go): the
+        real user must hold the `impersonate` verb on users (name =
+        target) and on groups (name = each group); subsequent requests
+        run as the target, with the real identity kept for audit."""
+        authz = self._secure.authorizer
+
+        def inner():
+            if not authz.authorize(self.user, "impersonate", "users", "", username):
+                raise Forbidden(
+                    f'user "{self.user.name}" cannot impersonate user "{username}"'
+                )
+            for g in groups or []:
+                if not authz.authorize(self.user, "impersonate", "groups", "", g):
+                    raise Forbidden(
+                        f'user "{self.user.name}" cannot impersonate group "{g}"'
+                    )
+            return None
+
+        # audited like any other request: repeated denied impersonation
+        # probes are exactly what the forensic trail exists for
+        _with_audit(
+            self._secure.audit, self.user, "impersonate", "users",
+            "", username, inner,
+        )
+        target = UserInfo(
+            username,
+            tuple(groups or ()) + (GROUP_AUTHENTICATED,),
+            impersonated_by=self.user.name,
+        )
+        return _AuthorizedClientset(self._secure, target)
+
     def __getattr__(self, name: str):
         # pods/nodes/... attribute access like Clientset
         if name.startswith("_"):
@@ -236,17 +370,21 @@ class _AuthorizedClientset:
 
 
 class SecureAPIServer:
-    """APIServer + authn + APF + RBAC authz (the secured handler chain,
-    in the reference's order: WithAuthentication →
-    WithPriorityAndFairness → WithAuthorization)."""
+    """APIServer + authn + audit + APF + RBAC authz (the secured handler
+    chain in the reference's order: WithAuthentication → WithAudit →
+    WithImpersonation → WithPriorityAndFairness → WithAuthorization,
+    pkg/server/config.go:719-745)."""
 
-    def __init__(self, api: Optional[APIServer] = None, flow_controller=None):
+    def __init__(
+        self, api: Optional[APIServer] = None, flow_controller=None, audit=None
+    ):
         self.api = api or APIServer()
         for info in RBAC_RESOURCES:
             self.api.register_resource(info)
         self.authenticator = TokenAuthenticator()
         self.authorizer = RBACAuthorizer(self.api)
         self.flow_controller = flow_controller
+        self.audit = audit  # audit.AuditLogger or None
 
     def as_user(self, token: str) -> _AuthorizedClientset:
         """Authenticate a bearer token -> authorized clientset facade."""

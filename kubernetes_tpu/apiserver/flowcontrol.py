@@ -35,6 +35,8 @@ ALL = "*"
 class TooManyRequests(APIError):
     """Queue for the priority level is full (HTTP 429 analog)."""
 
+    code = 429
+
 
 @dataclass
 class PriorityLevelLimited:

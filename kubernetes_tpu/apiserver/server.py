@@ -36,23 +36,23 @@ from ..utils import serde
 
 
 class APIError(Exception):
-    pass
+    code = 500  # HTTP status the reference would serve for this error
 
 
 class NotFound(APIError):
-    pass
+    code = 404
 
 
 class AlreadyExists(APIError):
-    pass
+    code = 409
 
 
 class Conflict(APIError):
-    pass
+    code = 409
 
 
 class Invalid(APIError):
-    pass
+    code = 422
 
 
 @dataclass(frozen=True)

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 from ..api import types as v1
 from ..apiserver.server import APIError
 from ..client.informer import EventHandler
+from .cm import AdmissionError
 from .cri import (
     CONTAINER_CREATED,
     CONTAINER_EXITED,
@@ -73,10 +74,14 @@ class Kubelet:
         config: Optional[KubeletConfig] = None,
         runtime: Optional[FakeRuntimeService] = None,
         stats_provider=None,  # () -> memory usage fraction [0,1]
+        device_manager=None,  # kubelet.cm.DeviceManager
+        cpu_manager=None,  # kubelet.cm.CPUManager
     ):
         self.client = clientset
         self.config = config or KubeletConfig()
         self.runtime = runtime or FakeRuntimeService()
+        self.device_manager = device_manager
+        self.cpu_manager = cpu_manager
         self.pleg = PLEG(self.runtime)
         self.stats_provider = stats_provider or (lambda: 0.0)
         self.pod_informer = informer_factory.informer_for("pods")
@@ -130,6 +135,9 @@ class Kubelet:
         """kubelet_node_status.go registerWithAPIServer."""
         cfg = self.config
         capacity = {"cpu": cfg.cpu, "memory": cfg.memory, "pods": str(cfg.max_pods)}
+        if self.device_manager is not None:
+            dev_cap, _, _ = self.device_manager.get_capacity()
+            capacity.update(dev_cap)
         labels = {v1.LABEL_HOSTNAME: cfg.node_name}
         labels.update(cfg.labels)
         node = v1.Node(
@@ -213,6 +221,15 @@ class Kubelet:
         except APIError:
             return
         node.status.conditions = self._conditions(memory_pressure=pressure)
+        if self.device_manager is not None:
+            # setNodeStatusAllocatable: plugin resources join capacity;
+            # removed resources are zeroed, not dropped (kubelet_node_status.go)
+            dev_cap, dev_alloc, removed = self.device_manager.get_capacity()
+            node.status.capacity.update(dev_cap)
+            node.status.allocatable.update(dev_alloc)
+            for res in removed:
+                node.status.capacity[res] = "0"
+                node.status.allocatable[res] = "0"
         try:
             self.client.nodes.update(node)
         except APIError:
@@ -341,6 +358,12 @@ class Kubelet:
                     break
             try:
                 if pod is None:
+                    if self._stop.is_set():
+                        # kubelet shutdown, NOT pod deletion: leave runtime
+                        # state and device/cpu allocations intact — they are
+                        # checkpointed and reconciled on restart (the reason
+                        # the checkpoint files exist at all)
+                        return
                     self._terminate_pod(uid)
                     # remove self only if no new work raced in (the _dispatch
                     # enqueue happens under _workers_lock, so this is exact)
@@ -372,6 +395,11 @@ class Kubelet:
         """kuberuntime_manager.go SyncPod: computePodActions diff then act."""
         uid = self._pod_uid(pod)
         restart_policy = pod.spec.restart_policy or "Always"
+        if pod.status.phase == "Failed" and pod.status.reason == "UnexpectedAdmissionError":
+            # a rejected pod is terminal with no runtime state; without
+            # this the rejection status-write's own watch event would
+            # re-dispatch it and admission would re-run forever
+            return
         sandbox, containers = self._pod_runtime_state(uid)
         by_name = {c.name: c for c in containers}
 
@@ -381,6 +409,25 @@ class Kubelet:
             return
 
         if sandbox is None:
+            # admit: device + exclusive-CPU allocation happen before any
+            # runtime state exists (the reference's admit handlers run
+            # before syncPod; failure is terminal, not retried)
+            try:
+                if self.device_manager is not None:
+                    self.device_manager.allocate(pod)
+                if self.cpu_manager is not None:
+                    for spec in pod.spec.containers:
+                        self.cpu_manager.add_container(pod, spec.name)
+            except AdmissionError as e:
+                # roll back partial allocations (devices committed before
+                # the CPU manager rejected, or some containers before an
+                # exhausted one) — a rejected pod must hold nothing
+                if self.device_manager is not None:
+                    self.device_manager.remove_pod(uid)
+                if self.cpu_manager is not None:
+                    self.cpu_manager.remove_pod(uid)
+                self._reject_pod(pod, str(e))
+                return
             sid = self.runtime.run_pod_sandbox(
                 pod.metadata.name, pod.metadata.namespace, uid
             )
@@ -413,8 +460,26 @@ class Kubelet:
         _, containers = self._pod_runtime_state(uid)
         self._update_pod_status(pod, sandbox, containers, restart_policy)
 
+    def _reject_pod(self, pod: v1.Pod, message: str) -> None:
+        """Admission failure: terminal Failed status (kubelet.go
+        rejectPod, reason UnexpectedAdmissionError)."""
+        try:
+            live = self.client.pods.get(pod.metadata.name, pod.metadata.namespace)
+            if live.status.phase == "Failed":
+                return  # already rejected: no-op, don't churn watch events
+            live.status.phase = "Failed"
+            live.status.reason = "UnexpectedAdmissionError"
+            live.status.message = message
+            self.client.pods.update_status(live)
+        except APIError:
+            pass
+
     def _terminate_pod(self, uid: str) -> None:
         """Pod removed from desired state: tear down runtime state."""
+        if self.device_manager is not None:
+            self.device_manager.remove_pod(uid)
+        if self.cpu_manager is not None:
+            self.cpu_manager.remove_pod(uid)
         for sb in self.runtime.list_pod_sandboxes():
             if sb.pod_uid == uid:
                 try:

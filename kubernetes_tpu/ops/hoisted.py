@@ -371,6 +371,23 @@ def _run(c_all: Dict, tp: Dict, batch_self: Dict, xs: Dict, weights_key):
     return jax.lax.scan(step, carry, xs)
 
 
+def _batch_inputs(pod_arrays_list: List[Dict], tmpl_ids: np.ndarray) -> Tuple[Dict, Dict]:
+    """(batch_self, xs) for one scan over these pods (shared by
+    prepare_batch and HoistedSession.schedule — the scan's xs contract
+    lives here and nowhere else)."""
+    b = len(pod_arrays_list)
+    batch_self = {
+        k: jnp.asarray(np.stack([np.asarray(pa[k]) for pa in pod_arrays_list]))
+        for k in ("self_ppair", "self_pkey", "self_ns")
+    }
+    xs = {
+        "tmpl": jnp.asarray(tmpl_ids),
+        "j": jnp.arange(b, dtype=jnp.int32),
+        "valid": jnp.ones(b, bool),
+    }
+    return batch_self, xs
+
+
 def prepare_batch(pod_arrays_list: List[Dict]) -> Tuple[Dict, Dict, Dict]:
     """Group the batch by template and build the scan inputs:
     (stacked templates, batch self-rows, xs). Asserts hoisting
@@ -393,22 +410,7 @@ def prepare_batch(pod_arrays_list: List[Dict]) -> Tuple[Dict, Dict, Dict]:
             templates.append(pa)
         tmpl_ids[i] = t
     tp = _stack_templates(templates)
-    batch_self = {
-        "self_ppair": jnp.asarray(
-            np.stack([np.asarray(pa["self_ppair"]) for pa in pod_arrays_list])
-        ),
-        "self_pkey": jnp.asarray(
-            np.stack([np.asarray(pa["self_pkey"]) for pa in pod_arrays_list])
-        ),
-        "self_ns": jnp.asarray(
-            np.stack([np.asarray(pa["self_ns"]) for pa in pod_arrays_list])
-        ),
-    }
-    xs = {
-        "tmpl": jnp.asarray(tmpl_ids),
-        "j": jnp.arange(b, dtype=jnp.int32),
-        "valid": jnp.ones(b, bool),
-    }
+    batch_self, xs = _batch_inputs(pod_arrays_list, tmpl_ids)
     return tp, batch_self, xs
 
 
@@ -426,3 +428,118 @@ def schedule_batch_hoisted(
     key = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     _, ys = _run(cluster, tp, batch_self, xs, key)
     return [int(v) for v in np.asarray(ys["best"])], ys
+
+
+# ---------------------------------------------------------------------------
+# cross-batch session: carry lives on-device, prologue runs ONCE
+
+
+@jax.jit
+def _session_prologue(c_all: Dict, tp: Dict) -> Dict:
+    return _prologue(c_all, tp)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("weights_key",), donate_argnames=("carry",)
+)
+def _session_scan(S, c_static, tp, carry, batch_self, xs, weights_key):
+    weights = dict(weights_key)
+    S = dict(S)
+    S["Mf"], S["Ms"] = _match_matrices(tp, batch_self)
+    step = functools.partial(_step, S, c_static, weights)
+    return jax.lax.scan(step, carry, xs)
+
+
+class HoistedSession:
+    """Hoisted scheduling with the carry kept ON-DEVICE across batches.
+
+    schedule_batch_hoisted pays the prologue (per-template pod-table
+    sweeps + count bases) and a full cluster upload on EVERY dispatch
+    because the host syncs assumed pods into the pod table between
+    batches. That sync is redundant for batchable pods: a batchable pod
+    (no affinity terms, no host ports — ops/batch.py pod_batchable) has
+    no term/port rows, so assuming it changes exactly (a) node
+    utilization (requested / nz_requested / pod_count — NodeResourcesFit,
+    Balanced, LeastAllocated inputs) and (b) PodTopologySpread pair
+    counts. Both are *already* the scan's carry. Every other prologue
+    product — IPA raw scores and anti-affinity masks (driven by TERM
+    rows, which batchable pods don't add), taint/affinity/ports/
+    unschedulable masks, image and prefer-avoid scores (node-side) — is
+    invariant under batchable assumes.
+
+    So the session computes the prologue once, keeps carry + statics
+    device-resident, and schedules batch after batch with ZERO host
+    round-trips on the critical path. Dispatch is async: schedule()
+    returns device arrays immediately, so the host can encode batch k+1
+    while the device scans batch k (the pipelining bench.py exploits).
+
+    Decision parity with the per-batch hoisted path (host-synced between
+    batches) — and therefore with the generic scan and the Go oracle —
+    is pinned by tests/test_hoisted.py::TestHoistedSession.
+
+    The template set is fixed at construction: a batch pod whose
+    fingerprint is unknown raises KeyError, and the caller falls back to
+    a host sync + fresh session (or the generic path).
+
+    Reference frame: this is the assume-cache discipline of the
+    reference's scheduler cache (pkg/scheduler/internal/cache/cache.go:361
+    AssumePod — mutate the in-memory view, confirm later) applied to the
+    device-resident arrays: the device carry IS the assume cache.
+    """
+
+    def __init__(
+        self,
+        cluster: Dict,
+        template_arrays_list: List[Dict],
+        weights: Optional[Dict[str, int]] = None,
+    ):
+        from .batch import pod_batchable
+
+        for pa in template_arrays_list:
+            if not pod_batchable(pa):
+                raise ValueError("session templates must be batchable "
+                                 "(no affinity terms / host ports)")
+        self._weights_key = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+        self._fps = {
+            template_fingerprint(t): i for i, t in enumerate(template_arrays_list)
+        }
+        tp = _stack_templates(template_arrays_list)
+        S = dict(_session_prologue(cluster, tp))
+        # copies: _session_scan donates the carry, and the cluster arrays
+        # are also held by the encoder's device-state cache
+        self._carry = {
+            "requested": jnp.array(cluster["requested"], copy=True),
+            "nz_requested": jnp.array(cluster["nz_requested"], copy=True),
+            "pod_count": jnp.array(cluster["pod_count"], copy=True),
+            "f_cnt": S.pop("f_cnt0"),
+            "s_cnt": S.pop("s_cnt0"),
+            "h_cnt": S.pop("h_cnt0"),
+        }
+        for k in ("req", "req_check", "req_has_any", "nz_req"):
+            S[k] = tp[k]
+        self._S = S
+        self._tp = tp
+        self._c_static = {k: v for k, v in cluster.items() if k not in CARRY_KEYS}
+
+    def schedule(self, pod_arrays_list: List[Dict]) -> Dict:
+        """Enqueue one batch; returns ys (device arrays) WITHOUT blocking.
+
+        Call decisions(ys) to synchronize. Raises KeyError on a pod whose
+        template was not registered at construction."""
+        b = len(pod_arrays_list)
+        tmpl_ids = np.zeros(b, np.int32)
+        for i, pa in enumerate(pod_arrays_list):
+            if bool(np.asarray(pa["has_node_name"])):
+                raise ValueError("session pods must be unbound")
+            tmpl_ids[i] = self._fps[template_fingerprint(pa)]
+        batch_self, xs = _batch_inputs(pod_arrays_list, tmpl_ids)
+        self._carry, ys = _session_scan(
+            self._S, self._c_static, self._tp, self._carry,
+            batch_self, xs, self._weights_key,
+        )
+        return ys
+
+    @staticmethod
+    def decisions(ys: Dict) -> List[int]:
+        """Block on a batch's results and return node indices (-1 = unschedulable)."""
+        return [int(v) for v in np.asarray(ys["best"])]

@@ -1,0 +1,243 @@
+"""Audit trail + impersonation in the secured chain.
+
+Reference: staging/src/k8s.io/apiserver/pkg/audit (policy levels, stages)
+wired as WithAudit (pkg/server/config.go:737); impersonation filter
+(pkg/endpoints/filters/impersonation.go) requires the `impersonate` verb
+on users/groups and keeps the real identity for audit.
+"""
+
+import pytest
+
+from kubernetes_tpu.api import rbac
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.audit import (
+    LEVEL_METADATA,
+    LEVEL_NONE,
+    LEVEL_REQUEST_RESPONSE,
+    STAGE_REQUEST_RECEIVED,
+    STAGE_RESPONSE_COMPLETE,
+    AuditLogger,
+    Policy,
+    PolicyRule,
+)
+from kubernetes_tpu.apiserver.auth import Forbidden, SecureAPIServer
+
+from .util import make_pod
+
+
+def _secure(policy=None):
+    s = SecureAPIServer(audit=AuditLogger(policy=policy))
+    s.authenticator.add_token("admin-token", "admin", ["system:masters"])
+    s.authenticator.add_token("dev-token", "dev")
+    return s
+
+
+def _grant_cluster(s, name, rules, user):
+    s.api.create("clusterroles", rbac.ClusterRole(
+        metadata=v1.ObjectMeta(name=name), rules=rules))
+    s.api.create("clusterrolebindings", rbac.ClusterRoleBinding(
+        metadata=v1.ObjectMeta(name=name),
+        subjects=[rbac.Subject(kind="User", name=user)],
+        role_ref=rbac.RoleRef(kind="ClusterRole", name=name)))
+
+
+class TestAuditTrail:
+    def test_request_and_response_stages(self):
+        s = _secure()
+        cs = s.as_user("admin-token")
+        cs.pods.create(make_pod("p1"))
+        received = s.audit.events(stage=STAGE_REQUEST_RECEIVED)
+        complete = s.audit.events(stage=STAGE_RESPONSE_COMPLETE)
+        assert len(received) == 1 and len(complete) == 1
+        ev = complete[0]
+        assert (ev.user, ev.verb, ev.resource, ev.response_code) == (
+            "admin", "create", "pods", 200)
+        assert ev.audit_id == received[0].audit_id
+
+    def test_forbidden_recorded_with_403(self):
+        s = _secure()
+        cs = s.as_user("dev-token")
+        with pytest.raises(Forbidden):
+            cs.pods.list(namespace="default")
+        done = s.audit.events(user="dev", stage=STAGE_RESPONSE_COMPLETE)
+        assert len(done) == 1 and done[0].response_code == 403
+
+    def test_not_found_recorded_with_404(self):
+        s = _secure()
+        cs = s.as_user("admin-token")
+        with pytest.raises(Exception):
+            cs.pods.get("ghost", "default")
+        done = s.audit.events(stage=STAGE_RESPONSE_COMPLETE)
+        assert done[-1].response_code == 404
+
+    def test_policy_first_match_wins(self):
+        # None for pods, Metadata default: pod requests drop out entirely
+        policy = Policy(rules=[
+            PolicyRule(level=LEVEL_NONE, resources=["pods"]),
+            PolicyRule(level=LEVEL_METADATA),
+        ])
+        s = _secure(policy)
+        cs = s.as_user("admin-token")
+        cs.pods.create(make_pod("p1"))
+        cs.nodes.list()
+        assert s.audit.events(resource="pods") == []
+        assert len(s.audit.events(resource="nodes")) == 2
+
+    def test_request_response_level_captures_objects(self):
+        policy = Policy(rules=[PolicyRule(level=LEVEL_REQUEST_RESPONSE)])
+        s = _secure(policy)
+        cs = s.as_user("admin-token")
+        cs.pods.create(make_pod("p1"))
+        ev = s.audit.events(stage=STAGE_RESPONSE_COMPLETE)[0]
+        assert ev.request_object["metadata"]["name"] == "p1"
+        assert ev.response_object["metadata"]["name"] == "p1"
+        # the stored response carries the assigned resourceVersion
+        assert ev.response_object["metadata"]["resourceVersion"]
+
+    def test_metadata_level_omits_objects(self):
+        s = _secure()  # default Metadata
+        cs = s.as_user("admin-token")
+        cs.pods.create(make_pod("p1"))
+        ev = s.audit.events(stage=STAGE_RESPONSE_COMPLETE)[0]
+        assert ev.request_object is None and ev.response_object is None
+
+
+class TestImpersonation:
+    def test_requires_impersonate_verb(self):
+        s = _secure()
+        cs = s.as_user("dev-token")
+        with pytest.raises(Forbidden):
+            cs.impersonate("someone-else")
+
+    def test_impersonated_identity_used_for_authz(self):
+        s = _secure()
+        _grant_cluster(
+            s, "impersonator",
+            [rbac.PolicyRule(verbs=["impersonate"], resources=["users"])],
+            "dev",
+        )
+        _grant_cluster(
+            s, "viewer-can-list",
+            [rbac.PolicyRule(verbs=["list"], resources=["pods"])],
+            "viewer",
+        )
+        cs = s.as_user("dev-token")
+        # dev cannot list pods itself...
+        with pytest.raises(Forbidden):
+            cs.pods.list(namespace="default")
+        # ...but can as viewer, who holds list
+        as_viewer = cs.impersonate("viewer")
+        as_viewer.pods.list(namespace="default")
+        # and the audit trail pins BOTH identities
+        ev = s.audit.events(user="viewer")[-1]
+        assert ev.impersonated_by == "dev"
+
+    def test_group_impersonation_checked(self):
+        s = _secure()
+        _grant_cluster(
+            s, "user-only",
+            [rbac.PolicyRule(verbs=["impersonate"], resources=["users"])],
+            "dev",
+        )
+        cs = s.as_user("dev-token")
+        with pytest.raises(Forbidden):
+            cs.impersonate("viewer", groups=["system:masters"])
+
+    def test_masters_can_impersonate_anyone(self):
+        s = _secure()
+        cs = s.as_user("admin-token")
+        as_dev = cs.impersonate("dev")
+        with pytest.raises(Forbidden):
+            as_dev.pods.list(namespace="default")  # dev has no grants
+
+
+class TestAuditChainOrder:
+    def test_apf_429_recorded(self):
+        """Audit wraps flow control (config.go:737 vs :726): throttled
+        requests must appear in the trail with code 429."""
+        import threading
+
+        from kubernetes_tpu.apiserver.flowcontrol import (
+            FlowController,
+            FlowSchema,
+            FlowSchemaRule,
+            FlowSchemaSpec,
+            FlowSchemaSubject,
+            PriorityLevelConfiguration,
+            PriorityLevelConfigurationSpec,
+            PriorityLevelLimited,
+            RequestInfo,
+            TooManyRequests,
+        )
+
+        s = SecureAPIServer(audit=AuditLogger())
+        fc = FlowController(s.api, default_timeout=0.5)
+        s.flow_controller = fc
+        fc.api.create("prioritylevelconfigurations", PriorityLevelConfiguration(
+            metadata=v1.ObjectMeta(name="tiny"),
+            spec=PriorityLevelConfigurationSpec(
+                limited=PriorityLevelLimited(
+                    assured_concurrency_shares=1, queue_length_limit=0)
+            ),
+        ))
+        fc.api.create("flowschemas", FlowSchema(
+            metadata=v1.ObjectMeta(name="devs"),
+            spec=FlowSchemaSpec(
+                priority_level_configuration="tiny",
+                matching_precedence=1,
+                rules=[FlowSchemaRule(
+                    subjects=[FlowSchemaSubject(kind="User", name="dev")]
+                )],
+            ),
+        ))
+        s.authenticator.add_token("dev-token", "dev")
+        cs = s.as_user("dev-token")
+        # saturate the single seat from another thread, then overflow
+        gate = threading.Event()
+        release = threading.Event()
+
+        def hold_seat():
+            with fc.dispatch(RequestInfo(user="dev", groups=(), verb="get",
+                                         resource="pods")):
+                gate.set()
+                release.wait(5)
+
+        t = threading.Thread(target=hold_seat, daemon=True)
+        t.start()
+        assert gate.wait(5)
+        try:
+            with pytest.raises(TooManyRequests):
+                cs.pods.list(namespace="default")
+        finally:
+            release.set()
+            t.join()
+        done = s.audit.events(stage=STAGE_RESPONSE_COMPLETE)
+        assert done and done[-1].response_code == 429
+
+    def test_omit_response_complete_stage(self):
+        from kubernetes_tpu.apiserver.audit import PolicyRule as PR
+        policy = Policy(rules=[PR(level=LEVEL_METADATA,
+                                  omit_stages=[STAGE_RESPONSE_COMPLETE])])
+        s = _secure(policy)
+        cs = s.as_user("admin-token")
+        cs.pods.create(make_pod("p1"))
+        assert s.audit.events(stage=STAGE_REQUEST_RECEIVED)
+        assert s.audit.events(stage=STAGE_RESPONSE_COMPLETE) == []
+
+    def test_denied_impersonation_is_audited(self):
+        s = _secure()
+        cs = s.as_user("dev-token")
+        with pytest.raises(Forbidden):
+            cs.impersonate("admin")
+        done = s.audit.events(user="dev", stage=STAGE_RESPONSE_COMPLETE)
+        assert done and done[-1].verb == "impersonate"
+        assert done[-1].response_code == 403
+        assert done[-1].name == "admin"
+
+    def test_watch_denial_is_audited(self):
+        s = _secure()
+        cs = s.as_user("dev-token")
+        with pytest.raises(Forbidden):
+            cs.pods.watch(namespace="default")
+        done = s.audit.events(user="dev", stage=STAGE_RESPONSE_COMPLETE)
+        assert done and done[-1].verb == "watch" and done[-1].response_code == 403

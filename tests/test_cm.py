@@ -1,0 +1,321 @@
+"""Kubelet container-manager subsystems: checkpoint manager (CRC files),
+device plugin manager, CPU manager static policy, pod-resources API, and
+kubelet wiring (node capacity, admit-time allocation, rejection).
+
+Reference: pkg/kubelet/checkpointmanager/checkpoint_manager.go,
+pkg/kubelet/cm/devicemanager/manager.go, cpumanager/policy_static.go,
+staging/src/k8s.io/kubelet/pkg/apis/podresources.
+"""
+
+import json
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.kubelet.cm import (
+    AdmissionError,
+    CheckpointManager,
+    CorruptCheckpointError,
+    CPUManager,
+    Device,
+    DeviceManager,
+    DevicePlugin,
+    PodResourcesServer,
+)
+from kubernetes_tpu.kubelet.cri import FakeRuntimeService
+from kubernetes_tpu.kubelet.kubelet import Kubelet, KubeletConfig
+
+from .util import FAST_KUBELET as FAST, make_pod, wait_until as _wait
+
+
+class TestCheckpointManager:
+    def test_roundtrip(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        cm.create_checkpoint("state", {"a": [1, 2], "b": "x"})
+        assert cm.get_checkpoint("state") == {"a": [1, 2], "b": "x"}
+        assert cm.list_checkpoints() == ["state"]
+        cm.remove_checkpoint("state")
+        assert cm.list_checkpoints() == []
+
+    def test_corrupt_detected(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        cm.create_checkpoint("state", {"a": 1})
+        p = tmp_path / "state"
+        obj = json.loads(p.read_text())
+        obj["data"]["a"] = 2  # flip payload, keep stale checksum
+        p.write_text(json.dumps(obj))
+        with pytest.raises(CorruptCheckpointError):
+            cm.get_checkpoint("state")
+
+    def test_garbage_file_is_corrupt(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        (tmp_path / "state").write_text("not json")
+        with pytest.raises(CorruptCheckpointError):
+            cm.get_checkpoint("state")
+
+    def test_missing_raises_filenotfound(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        with pytest.raises(FileNotFoundError):
+            cm.get_checkpoint("absent")
+
+
+def _plugin(n=4, resource="vendor.example/accel"):
+    return DevicePlugin(resource, [Device(f"dev-{i}") for i in range(n)])
+
+
+class TestDeviceManager:
+    def test_capacity_counts_healthy_only(self):
+        dm = DeviceManager()
+        pl = _plugin(4)
+        dm.register_plugin(pl)
+        cap, alloc, removed = dm.get_capacity()
+        assert cap == {"vendor.example/accel": "4"}
+        assert alloc == {"vendor.example/accel": "4"}
+        pl.set_health("dev-2", False)  # ListAndWatch update
+        cap, alloc, _ = dm.get_capacity()
+        assert (cap, alloc) == ({"vendor.example/accel": "4"}, {"vendor.example/accel": "3"})
+
+    def test_allocate_and_free(self):
+        dm = DeviceManager()
+        dm.register_plugin(_plugin(2))
+        pod = make_pod("p1", extended={"vendor.example/accel": "2"})
+        resp = dm.allocate(pod)
+        assert set(resp) == {"c0"}
+        assert len(resp["c0"].envs) == 2
+        uid = "default/p1"
+        assert dm.pod_devices(uid) == {"c0": {"vendor.example/accel": ["dev-0", "dev-1"]}}
+        # exhausted: a second pod must be rejected
+        with pytest.raises(AdmissionError):
+            dm.allocate(make_pod("p2", extended={"vendor.example/accel": "1"}))
+        dm.remove_pod(uid)
+        dm.allocate(make_pod("p3", extended={"vendor.example/accel": "1"}))
+
+    def test_unhealthy_devices_not_allocated(self):
+        dm = DeviceManager()
+        pl = _plugin(2)
+        dm.register_plugin(pl)
+        pl.set_health("dev-0", False)
+        with pytest.raises(AdmissionError):
+            dm.allocate(make_pod("p", extended={"vendor.example/accel": "2"}))
+
+    def test_checkpoint_restore(self, tmp_path):
+        ckpt = CheckpointManager(str(tmp_path))
+        dm = DeviceManager(ckpt)
+        dm.register_plugin(_plugin(3))
+        dm.allocate(make_pod("p1", extended={"vendor.example/accel": "2"}))
+        # kubelet restart: a fresh manager over the same checkpoint dir
+        dm2 = DeviceManager(ckpt)
+        dm2.register_plugin(_plugin(3))
+        assert dm2.pod_devices("default/p1") == {
+            "c0": {"vendor.example/accel": ["dev-0", "dev-1"]}
+        }
+        # only dev-2 is still free
+        with pytest.raises(AdmissionError):
+            dm2.allocate(make_pod("p2", extended={"vendor.example/accel": "2"}))
+        dm2.allocate(make_pod("p3", extended={"vendor.example/accel": "1"}))
+
+    def test_corrupt_checkpoint_starts_clean(self, tmp_path):
+        ckpt = CheckpointManager(str(tmp_path))
+        dm = DeviceManager(ckpt)
+        dm.register_plugin(_plugin(2))
+        dm.allocate(make_pod("p1", extended={"vendor.example/accel": "1"}))
+        (tmp_path / DeviceManager.CHECKPOINT).write_text("garbage")
+        dm2 = DeviceManager(ckpt)
+        assert dm2.pod_devices("default/p1") == {}
+
+    def test_unregister_reports_removed(self):
+        dm = DeviceManager()
+        dm.register_plugin(_plugin(2))
+        dm.get_capacity()
+        dm.unregister_plugin("vendor.example/accel")
+        cap, alloc, removed = dm.get_capacity()
+        assert removed == ["vendor.example/accel"]
+        assert cap == {} and alloc == {}
+
+
+class TestCPUManager:
+    def _guaranteed_pod(self, name, cpus="2"):
+        pod = make_pod(name, cpu=cpus, memory="1Gi")
+        c = pod.spec.containers[0]
+        c.resources.limits = dict(c.resources.requests)
+        return pod
+
+    def test_guaranteed_integral_gets_exclusive(self):
+        cm = CPUManager(4)
+        pod = self._guaranteed_pod("g1")
+        cpus = cm.add_container(pod, "c0")
+        assert len(cpus) == 2
+        assert sorted(cm.shared_pool() + cpus) == [0, 1, 2, 3]
+
+    def test_burstable_uses_shared_pool(self):
+        cm = CPUManager(4)
+        pod = make_pod("b1", cpu="2")  # requests only: Burstable
+        assert cm.add_container(pod, "c0") == [0, 1, 2, 3]
+        assert cm.assignments() == {}
+
+    def test_fractional_cpu_uses_shared_pool(self):
+        cm = CPUManager(4)
+        pod = self._guaranteed_pod("f1", cpus="1500m")
+        assert cm.add_container(pod, "c0") == [0, 1, 2, 3]
+
+    def test_exhaustion_rejects(self):
+        cm = CPUManager(2)
+        cm.add_container(self._guaranteed_pod("g1"), "c0")
+        with pytest.raises(AdmissionError):
+            cm.add_container(self._guaranteed_pod("g2"), "c0")
+
+    def test_checkpoint_restore(self, tmp_path):
+        ckpt = CheckpointManager(str(tmp_path))
+        cm = CPUManager(4, ckpt)
+        cm.add_container(self._guaranteed_pod("g1"), "c0")
+        cm2 = CPUManager(4, ckpt)
+        assert cm2.assignments() == cm.assignments()
+        cm2.remove_pod("default/g1")
+        assert cm2.assignments() == {}
+
+
+class TestPodResourcesServer:
+    def test_list(self):
+        dm = DeviceManager()
+        dm.register_plugin(_plugin(2))
+        cpu = CPUManager(4)
+        pod = make_pod("p1", extended={"vendor.example/accel": "1"}, cpu="1", memory="1Gi")
+        pod.spec.containers[0].resources.limits = dict(
+            pod.spec.containers[0].resources.requests
+        )
+        dm.allocate(pod)
+        cpu.add_container(pod, "c0")
+        srv = PodResourcesServer(lambda: [pod], dm, cpu)
+        out = srv.list()
+        assert len(out) == 1
+        assert out[0].containers[0].devices == {"vendor.example/accel": ["dev-0"]}
+        assert len(out[0].containers[0].cpu_ids) == 1
+
+
+class TestKubeletDeviceWiring:
+    def _cluster(self, device_manager):
+        api = APIServer()
+        cs = Clientset(api)
+        factory = SharedInformerFactory(cs)
+        kl = Kubelet(
+            cs,
+            factory,
+            config=KubeletConfig(node_name="node-0", **FAST),
+            runtime=FakeRuntimeService(),
+            device_manager=device_manager,
+        )
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        kl.run()
+        return cs, kl
+
+    def test_node_advertises_plugin_resources(self):
+        dm = DeviceManager()
+        dm.register_plugin(_plugin(4))
+        cs, kl = self._cluster(dm)
+        try:
+            node = cs.nodes.get("node-0")
+            assert node.status.capacity["vendor.example/accel"] == "4"
+            assert node.status.allocatable["vendor.example/accel"] == "4"
+        finally:
+            kl.stop()
+
+    def test_admission_failure_fails_pod(self):
+        dm = DeviceManager()
+        dm.register_plugin(_plugin(1))
+        cs, kl = self._cluster(dm)
+        try:
+            ok = make_pod("ok", extended={"vendor.example/accel": "1"},
+                          node_name="node-0")
+            cs.pods.create(ok)
+            bad = make_pod("bad", extended={"vendor.example/accel": "1"},
+                           node_name="node-0")
+            cs.pods.create(bad)
+
+            def settled():
+                a = cs.pods.get("ok", "default").status.phase
+                b = cs.pods.get("bad", "default")
+                return a == "Running" and b.status.phase == "Failed" and (
+                    b.status.reason == "UnexpectedAdmissionError"
+                )
+
+            _wait(settled, timeout=10)
+        finally:
+            kl.stop()
+
+    def test_kubelet_stop_preserves_allocations(self, tmp_path):
+        """Shutdown is not deletion: device allocations must survive a
+        kubelet restart via the checkpoint (the reason checkpoint files
+        exist); only real pod deletion frees devices."""
+        ckpt = CheckpointManager(str(tmp_path))
+        dm = DeviceManager(ckpt)
+        dm.register_plugin(_plugin(2))
+        cs, kl = self._cluster(dm)
+        try:
+            p = make_pod("keep", extended={"vendor.example/accel": "1"},
+                         node_name="node-0")
+            cs.pods.create(p)
+            _wait(lambda: cs.pods.get("keep", "default").status.phase == "Running",
+                  timeout=10)
+        finally:
+            kl.stop()
+        uid = cs.pods.get("keep", "default").metadata.uid
+        dm2 = DeviceManager(ckpt)
+        assert dm2.pod_devices(uid), "restart lost the device allocation"
+
+
+class TestAdmissionRollback:
+    def test_partial_failure_frees_devices(self):
+        """Devices committed before a later AdmissionError must be rolled
+        back by the kubelet so a rejected pod holds nothing."""
+        dm = DeviceManager()
+        dm.register_plugin(_plugin(2))
+        cpu = CPUManager(2)
+        api = APIServer()
+        cs = Clientset(api)
+        factory = SharedInformerFactory(cs)
+        kl = Kubelet(
+            cs, factory,
+            config=KubeletConfig(node_name="node-0", **FAST),
+            runtime=FakeRuntimeService(),
+            device_manager=dm, cpu_manager=cpu,
+        )
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        kl.run()
+        try:
+            # guaranteed pod wanting 2 devices (fine) + 4 exclusive CPUs
+            # (pool has 2): device allocation succeeds, CPU rejects
+            bad = make_pod("bad", extended={"vendor.example/accel": "2"},
+                           cpu="4", memory="1Gi", node_name="node-0")
+            bad.spec.containers[0].resources.limits = dict(
+                bad.spec.containers[0].resources.requests)
+            cs.pods.create(bad)
+            _wait(lambda: cs.pods.get("bad", "default").status.phase == "Failed",
+                  timeout=10)
+            uid = cs.pods.get("bad", "default").metadata.uid
+            assert dm.pod_devices(uid) == {}, "rejected pod still holds devices"
+            # the freed devices are usable by the next pod
+            ok = make_pod("ok", extended={"vendor.example/accel": "2"},
+                          node_name="node-0")
+            cs.pods.create(ok)
+            _wait(lambda: cs.pods.get("ok", "default").status.phase == "Running",
+                  timeout=10)
+        finally:
+            kl.stop()
+
+    def test_removed_signal_idempotent(self):
+        dm = DeviceManager()
+        dm.register_plugin(_plugin(2))
+        dm.get_capacity()
+        dm.unregister_plugin("vendor.example/accel")
+        assert dm.get_capacity()[2] == ["vendor.example/accel"]
+        # a discarded read does NOT consume the signal
+        assert dm.get_capacity()[2] == ["vendor.example/accel"]
+        # re-registration clears it
+        dm.register_plugin(_plugin(2))
+        assert dm.get_capacity()[2] == []

@@ -177,3 +177,87 @@ class TestShardedHoisted:
         sharded, _ = ShardedScheduler(mesh=mesh).schedule_batch_hoisted(c, arrays)
         assert sharded == single
         assert all(d < 26 for d in sharded)  # real node indices only
+
+
+class TestHoistedSession:
+    """Cross-batch device-resident carry vs per-batch hoisted + host sync.
+
+    The session never syncs assumed pods back into the pod table between
+    batches; the reference path does after every batch. For batchable
+    pods the decisions must be bit-identical."""
+
+    def _reference_path(self, nodes, init_pods, pending, batch):
+        """schedule_batch_hoisted per batch, host add_pod sync between."""
+        enc, pe = _presized_encoding(nodes, init_pods, pending)
+        arrays = _encode_all(enc, pe, pending)
+        out = []
+        for i in range(0, len(pending), batch):
+            c = enc.device_state()
+            decisions, _ = schedule_batch_hoisted(c, arrays[i : i + batch])
+            out.extend(decisions)
+            for pod, best in zip(pending[i : i + batch], decisions):
+                if best >= 0:
+                    pod.spec.node_name = enc.node_names[best]
+                    enc.add_pod(pod, enc.node_names[best])
+        return out
+
+    def _session_path(self, nodes, init_pods, pending, batch):
+        from kubernetes_tpu.ops.hoisted import HoistedSession
+
+        enc, pe = _presized_encoding(nodes, init_pods, pending)
+        arrays = _encode_all(enc, pe, pending)
+        templates, seen = [], set()
+        for a in arrays:
+            fp = template_fingerprint(a)
+            if fp not in seen:
+                seen.add(fp)
+                templates.append(a)
+        sess = HoistedSession(enc.device_state(), templates)
+        ys_all = [
+            sess.schedule(arrays[i : i + batch])
+            for i in range(0, len(pending), batch)
+        ]
+        out = []
+        for ys in ys_all:
+            out.extend(HoistedSession.decisions(ys))
+        return out
+
+    def test_multi_batch_parity_spread(self):
+        nodes, init_pods = synth_cluster(16, pods_per_node=2)
+        pending = synth_pending_pods(36, spread=True)
+        import copy
+
+        ref = self._reference_path(nodes, copy.deepcopy(init_pods),
+                                   copy.deepcopy(pending), batch=12)
+        got = self._session_path(nodes, init_pods, pending, batch=12)
+        assert got == ref
+        assert all(d >= 0 for d in got)
+
+    def test_capacity_exhaustion_across_batches(self):
+        # carry must track utilization across batch boundaries: the tail
+        # becomes infeasible at exactly the same pod as the synced path
+        nodes, init_pods = synth_cluster(3, pods_per_node=0)
+        for node in nodes:
+            node.status.allocatable["cpu"] = "350m"
+            node.status.capacity["cpu"] = "350m"
+        pending = synth_pending_pods(15, spread=True)  # 100m each
+        import copy
+
+        ref = self._reference_path(nodes, copy.deepcopy(init_pods),
+                                   copy.deepcopy(pending), batch=5)
+        got = self._session_path(nodes, init_pods, pending, batch=5)
+        assert got == ref
+        assert -1 in got
+
+    def test_unknown_template_raises(self):
+        from kubernetes_tpu.ops.hoisted import HoistedSession
+
+        nodes, init_pods = synth_cluster(4, pods_per_node=1)
+        pending = synth_pending_pods(4, spread=True)
+        other = synth_pending_pods(2, spread=False)
+        enc, pe = _presized_encoding(nodes, init_pods, pending + other)
+        arrays = _encode_all(enc, pe, pending)
+        other_arrays = _encode_all(enc, pe, other)
+        sess = HoistedSession(enc.device_state(), [arrays[0]])
+        with pytest.raises(KeyError):
+            sess.schedule(other_arrays)
