@@ -27,7 +27,7 @@ from ..api import types as v1
 from ..models.encoding import ClusterEncoding
 from ..models.pod_encoder import PodEncoder
 from ..ops.batch import pod_batchable, schedule_batch, shape_signature
-from ..ops.hoisted import schedule_batch_hoisted
+from ..ops.hoisted import HoistedSession, template_fingerprint
 from ..ops.kernel import DEFAULT_WEIGHTS, schedule_pod_jit
 from .core import ScheduleResult
 from .framework.interface import FitError, Status
@@ -60,27 +60,53 @@ class TPUBackend(CacheListener):
         self.weights = weights or DEFAULT_WEIGHTS
         self.rng = rng or random.Random()
         self._lock = threading.RLock()
+        # cross-cycle hoisted session (ops/hoisted.py HoistedSession): the
+        # device-resident carry survives between schedule_many calls as
+        # long as the ONLY cluster mutations are the assumes the session
+        # itself produced (tracked in _session_assumed — the cache.assume
+        # confirmation arrives later through on_add_pod and must not
+        # invalidate). Any other mutation tears the session down; the next
+        # batch rebuilds it from the synced encoding.
+        self._session: Optional[HoistedSession] = None
+        self._session_assumed: set = set()
+        self._known_templates: Dict = {}  # fingerprint -> pod arrays
+        self.MAX_SESSION_TEMPLATES = 8
+
+    def _invalidate_session(self) -> None:
+        self._session = None
+        self._session_assumed = set()
 
     # -- CacheListener (called under the cache lock) -----------------------
 
     def on_add_pod(self, pod: v1.Pod, node_name: str) -> None:
         with self._lock:
+            key = (pod.metadata.namespace, pod.metadata.name, node_name)
+            if key in self._session_assumed:
+                # the cache confirming an assume the session already
+                # applied on-device: host bookkeeping only
+                self._session_assumed.discard(key)
+            else:
+                self._invalidate_session()
             self.enc.add_pod(pod, node_name)
 
     def on_remove_pod(self, pod: v1.Pod, node_name: str) -> None:
         with self._lock:
+            self._invalidate_session()
             self.enc.remove_pod(pod)
 
     def on_add_node(self, node: v1.Node) -> None:
         with self._lock:
+            self._invalidate_session()
             self.enc.add_node(node)
 
     def on_update_node(self, node: v1.Node) -> None:
         with self._lock:
+            self._invalidate_session()
             self.enc.update_node(node)
 
     def on_remove_node(self, node_name: str) -> None:
         with self._lock:
+            self._invalidate_session()
             self.enc.remove_node(node_name)
 
     # -- scheduling --------------------------------------------------------
@@ -121,6 +147,7 @@ class TPUBackend(CacheListener):
                         # NOTE: never mutate the caller's pod (it aliases the
                         # informer cache); the node rides the result tuple and
                         # enc.add_pod takes the node explicitly
+                        self._invalidate_session()  # term/port tables mutate
                         self.enc.add_pod(pod, node)
                         results.append((pod, node))
                     except FitError:
@@ -149,9 +176,11 @@ class TPUBackend(CacheListener):
                     ]
 
                 if all(not g.spec.node_name for g in group):
-                    # pending pods: the template-hoisted scan (no in-scan
-                    # pod-table writes, ~4x faster step) — the default path
-                    decisions, _ = schedule_batch_hoisted(c, _clean(), self.weights)
+                    # pending pods: the template-hoisted SESSION — carry
+                    # stays on-device across batches and scheduler cycles;
+                    # prologue is paid only when the session is torn down
+                    # by a foreign cluster mutation or a new template
+                    decisions = self._session_schedule(_clean())
                 elif len(self.enc._pod_free) < len(group):
                     # pod table full: schedule singly (each add triggers
                     # its own rebuild/growth)
@@ -166,16 +195,43 @@ class TPUBackend(CacheListener):
                     continue
                 else:
                     slots = [self.enc._pod_free[-1 - k] for k in range(len(group))]
+                    self._invalidate_session()  # in-scan pod-table writes
                     decisions, _ = schedule_batch(c, _clean(), slots, self.weights)
                 for g, best in zip(group, decisions):
                     if best < 0:
                         results.append((g, None))
                     else:
                         node = self.enc.node_names[best]
+                        if self._session is not None:
+                            # remember before cache.assume echoes it back
+                            self._session_assumed.add(
+                                (g.metadata.namespace, g.metadata.name, node)
+                            )
                         self.enc.add_pod(g, node)
                         results.append((g, node))
                 i = j
         return results
+
+    def _session_schedule(self, arrays: List[Dict]) -> List[int]:
+        """Schedule a batchable pending group through the cross-cycle
+        session, (re)building it when torn down or when a new template
+        fingerprint appears."""
+        fps = [template_fingerprint(a) for a in arrays]
+        new = {fp for fp in fps if fp not in self._known_templates}
+        if new:
+            if len(self._known_templates) + len(new) > self.MAX_SESSION_TEMPLATES:
+                self._known_templates = {}
+            for fp, a in zip(fps, arrays):
+                self._known_templates.setdefault(fp, a)
+            self._invalidate_session()
+        if self._session is None:
+            self._session = HoistedSession(
+                self.enc.device_state(),
+                list(self._known_templates.values()),
+                self.weights,
+            )
+            self._session_assumed = set()
+        return HoistedSession.decisions(self._session.schedule(arrays))
 
     # -- helpers -----------------------------------------------------------
 

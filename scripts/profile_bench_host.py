@@ -1,0 +1,58 @@
+"""Where does bench.py's 1.1ms/pod go? Split: encode / schedule(dispatch)
+/ device wait / harvest (add_pod)."""
+import os, sys, time
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import copy
+import numpy as np
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops.hoisted import HoistedSession, template_fingerprint
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+N = int(os.environ.get("BENCH_NODES", "5000"))
+B = 1024
+nodes, init_pods = synth_cluster(N, pods_per_node=2)
+pending = synth_pending_pods(3 * B, spread=True)
+phantoms = []
+for i, p in enumerate(pending):
+    q = synth_pending_pods(1, spread=True)[0]
+    q.metadata.name = f"ph-{i}"
+    q.metadata.labels = dict(p.metadata.labels or {})
+    q.spec.node_name = nodes[i % len(nodes)].metadata.name
+    phantoms.append(q)
+enc = ClusterEncoding(); enc.set_cluster(nodes, init_pods + phantoms)
+pe = PodEncoder(enc)
+for p in pending[:8]: pe.encode(p)
+enc.device_state()
+for q in phantoms: enc.remove_pod(q)
+
+def encode_batch(pods):
+    return [{k: v for k, v in pe.encode(p).items() if not k.startswith("_")} for p in pods]
+
+arrays0 = encode_batch(pending)
+templates, seen = [], set()
+for a in arrays0:
+    fp = template_fingerprint(a)
+    if fp not in seen: seen.add(fp); templates.append(a)
+sess = HoistedSession(enc.device_state(), templates)
+# warm compile + state
+ys = sess.schedule(encode_batch(pending[:B]))
+for p, b in zip(pending[:B], HoistedSession.decisions(ys)):
+    if b >= 0: enc.add_pod(p, enc.node_names[b])
+
+for it in range(2):
+    batch = pending[(it+1)*B:(it+2)*B]
+    t0 = time.perf_counter(); arrays = encode_batch(batch); t_enc = time.perf_counter()-t0
+    t0 = time.perf_counter(); ys = sess.schedule(arrays); t_disp = time.perf_counter()-t0
+    t0 = time.perf_counter(); dec = HoistedSession.decisions(ys); t_wait = time.perf_counter()-t0
+    t0 = time.perf_counter()
+    for p, b in zip(batch, dec):
+        if b >= 0: enc.add_pod(p, enc.node_names[b])
+    t_harv = time.perf_counter()-t0
+    tot = t_enc+t_disp+t_wait+t_harv
+    print(f"iter{it}: encode={t_enc*1e3:6.1f}ms dispatch={t_disp*1e3:6.1f}ms "
+          f"wait={t_wait*1e3:6.1f}ms harvest={t_harv*1e3:6.1f}ms "
+          f"total={tot*1e3:6.1f}ms ({tot/B*1e3:.2f} ms/pod)")

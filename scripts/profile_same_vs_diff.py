@@ -1,0 +1,43 @@
+import os, sys, time
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops.hoisted import HoistedSession, template_fingerprint
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+N, B = 5000, 1024
+nodes, init_pods = synth_cluster(N, pods_per_node=2)
+pending = synth_pending_pods(3 * B, spread=True)
+phantoms = []
+for i, p in enumerate(pending):
+    q = synth_pending_pods(1, spread=True)[0]
+    q.metadata.name = f"ph-{i}"
+    q.metadata.labels = dict(p.metadata.labels or {})
+    q.spec.node_name = nodes[i % len(nodes)].metadata.name
+    phantoms.append(q)
+enc = ClusterEncoding(); enc.set_cluster(nodes, init_pods + phantoms)
+pe = PodEncoder(enc)
+for p in pending[:8]: pe.encode(p)
+enc.device_state()
+for q in phantoms: enc.remove_pod(q)
+arrays = [{k: v for k, v in pe.encode(p).items() if not k.startswith("_")} for p in pending]
+templates, seen = [], set()
+for a in arrays:
+    fp = template_fingerprint(a)
+    if fp not in seen: seen.add(fp); templates.append(a)
+print("templates:", len(templates))
+sess = HoistedSession(enc.device_state(), templates)
+def run(sl):
+    t0 = time.perf_counter()
+    ys = sess.schedule(sl)
+    jax.block_until_ready(ys["best"])
+    return time.perf_counter() - t0
+run(arrays[:B])  # warm/compile
+for tag, sl in [("slice0 again", arrays[:B]), ("slice0 3rd", arrays[:B]),
+                ("slice1", arrays[B:2*B]), ("slice1 again", arrays[B:2*B]),
+                ("slice2", arrays[2*B:3*B]), ("slice0 4th", arrays[:B])]:
+    print(f"{tag:14s} {run(sl)*1e3:8.1f}ms")

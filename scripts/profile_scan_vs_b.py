@@ -1,0 +1,43 @@
+import os, sys, time
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops.hoisted import HoistedSession, template_fingerprint
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+N = int(os.environ.get("BENCH_NODES", "5000"))
+NB = int(os.environ.get("NPODS", "3072"))
+nodes, init_pods = synth_cluster(N, pods_per_node=2)
+pending = synth_pending_pods(NB, spread=True)
+phantoms = []
+for i, p in enumerate(pending):
+    q = synth_pending_pods(1, spread=True)[0]
+    q.metadata.name = f"ph-{i}"
+    q.metadata.labels = dict(p.metadata.labels or {})
+    q.spec.node_name = nodes[i % len(nodes)].metadata.name
+    phantoms.append(q)
+enc = ClusterEncoding(); enc.set_cluster(nodes, init_pods + phantoms)
+pe = PodEncoder(enc)
+for p in pending[:8]: pe.encode(p)
+enc.device_state()
+for q in phantoms: enc.remove_pod(q)
+arrays = [{k: v for k, v in pe.encode(p).items() if not k.startswith("_")} for p in pending]
+templates, seen = [], set()
+for a in arrays:
+    fp = template_fingerprint(a)
+    if fp not in seen: seen.add(fp); templates.append(a)
+print("templates:", len(templates), "pod cap:", enc.device_state()["pvalid"].shape)
+sess = HoistedSession(enc.device_state(), templates)
+for B in (128, 512, 1024):
+    def run():
+        ys = sess.schedule(arrays[:B])
+        jax.block_until_ready(ys["best"])
+    run(); run()
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); run(); ts.append(time.perf_counter()-t0)
+    print(f"B={B:5d}  {min(ts)*1e3:8.1f}ms  {min(ts)/B*1e3:6.3f} ms/pod")

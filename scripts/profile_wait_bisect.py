@@ -1,0 +1,65 @@
+import os, sys, time
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+from kubernetes_tpu.models.encoding import ClusterEncoding
+from kubernetes_tpu.models.pod_encoder import PodEncoder
+from kubernetes_tpu.ops.hoisted import HoistedSession, template_fingerprint
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+N = 5000
+B = 1024
+nodes, init_pods = synth_cluster(N, pods_per_node=2)
+pending = synth_pending_pods(5 * B, spread=True)
+phantoms = []
+for i, p in enumerate(pending):
+    q = synth_pending_pods(1, spread=True)[0]
+    q.metadata.name = f"ph-{i}"
+    q.metadata.labels = dict(p.metadata.labels or {})
+    q.spec.node_name = nodes[i % len(nodes)].metadata.name
+    phantoms.append(q)
+enc = ClusterEncoding(); enc.set_cluster(nodes, init_pods + phantoms)
+pe = PodEncoder(enc)
+for p in pending[:8]: pe.encode(p)
+enc.device_state()
+for q in phantoms: enc.remove_pod(q)
+
+def encode_batch(pods):
+    return [{k: v for k, v in pe.encode(p).items() if not k.startswith("_")} for p in pods]
+
+all_arrays = [encode_batch(pending[i*B:(i+1)*B]) for i in range(5)]
+templates, seen = [], set()
+for a in all_arrays[0]:
+    fp = template_fingerprint(a)
+    if fp not in seen: seen.add(fp); templates.append(a)
+sess = HoistedSession(enc.device_state(), templates)
+ys = sess.schedule(all_arrays[0]); dec0 = HoistedSession.decisions(ys)  # warm
+
+def timed(tag, arrays, harvest_pods=None, reencode=False):
+    if reencode:
+        t0 = time.perf_counter(); arrays = encode_batch(reencode); t = time.perf_counter()-t0
+        print(f"  (re-encode {t*1e3:.0f}ms)", end="")
+    t0 = time.perf_counter()
+    ys = sess.schedule(arrays)
+    t_d = time.perf_counter()-t0
+    t0 = time.perf_counter()
+    dec = HoistedSession.decisions(ys)
+    t_w = time.perf_counter()-t0
+    print(f" {tag}: dispatch={t_d*1e3:6.1f}ms wait={t_w*1e3:7.1f}ms")
+    if harvest_pods is not None:
+        t0 = time.perf_counter()
+        for p, b in zip(harvest_pods, dec):
+            if b >= 0: enc.add_pod(p, enc.node_names[b])
+        print(f"   harvest={1e3*(time.perf_counter()-t0):.0f}ms")
+    return dec
+
+# 1: plain repeat (pre-encoded, no harvest)
+timed("pre-encoded, no harvest", all_arrays[1])
+# 2: pre-encoded + harvest of batch 1's pods
+timed("pre-encoded, harvest prev", all_arrays[2], harvest_pods=pending[2*B:3*B])
+# 3: after harvest, schedule pre-encoded again
+timed("pre-encoded, after harvest", all_arrays[3])
+# 4: re-encode then schedule
+timed("re-encoded", None, reencode=pending[4*B:5*B])
