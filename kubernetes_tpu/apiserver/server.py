@@ -157,9 +157,48 @@ class APIServer:
         # registration) must not fire for writes the store rejects
         self._post_write: List[AdmissionFunc] = []
         self._lock = threading.Lock()
+        # node-name -> kubelet node API (logs/exec proxying: the
+        # reference's apiserver→kubelet connection behind
+        # pods/{name}/log and pods/{name}/exec, registry/core/pod/rest)
+        self._node_proxies: Dict[str, Any] = {}
 
     def register_resource(self, info: ResourceInfo) -> None:
         self._resources[info.name] = info
+
+    # -- node proxy (kubelet API) ------------------------------------------
+
+    def register_node_proxy(self, node_name: str, handler: Any) -> None:
+        with self._lock:
+            self._node_proxies[node_name] = handler
+
+    def unregister_node_proxy(self, node_name: str) -> None:
+        with self._lock:
+            self._node_proxies.pop(node_name, None)
+
+    def pod_logs(self, name: str, namespace: str = "", container: str = "",
+                 tail: Optional[int] = None) -> List[str]:
+        """GET pods/{name}/log: resolve the pod's node, proxy to its
+        kubelet (handlers in registry/core/pod/rest/log.go)."""
+        pod = self.get("pods", name, namespace)
+        if not pod.spec.node_name:
+            raise Invalid(f"pod {name} is not scheduled yet")
+        with self._lock:
+            h = self._node_proxies.get(pod.spec.node_name)
+        if h is None:
+            raise NotFound(f"no kubelet connection for node {pod.spec.node_name}")
+        return h.container_logs(name, namespace, container, tail)
+
+    def pod_exec(self, name: str, namespace: str, cmd: List[str],
+                 container: str = "") -> Tuple[str, int]:
+        """POST pods/{name}/exec → kubelet → CRI ExecSync."""
+        pod = self.get("pods", name, namespace)
+        if not pod.spec.node_name:
+            raise Invalid(f"pod {name} is not scheduled yet")
+        with self._lock:
+            h = self._node_proxies.get(pod.spec.node_name)
+        if h is None:
+            raise NotFound(f"no kubelet connection for node {pod.spec.node_name}")
+        return h.exec_in_pod(name, namespace, cmd, container)
 
     # -- keys --------------------------------------------------------------
 

@@ -191,6 +191,16 @@ class Kubectl:
         p = sub.add_parser("top")
         p.add_argument("resource", choices=["nodes", "node", "pods", "pod", "no", "po"])
 
+        p = sub.add_parser("logs")
+        p.add_argument("pod")
+        p.add_argument("-c", "--container", default="")
+        p.add_argument("--tail", type=int, default=None)
+
+        p = sub.add_parser("exec")
+        p.add_argument("pod")
+        p.add_argument("-c", "--container", default="")
+        p.add_argument("cmd", nargs="+")  # after `--` in real kubectl
+
         args = parser.parse_args(argv)
         try:
             getattr(self, f"cmd_{args.verb}")(args)
@@ -497,6 +507,31 @@ class Kubectl:
         tmpl_meta.annotations["kubectl.kubernetes.io/restartedAt"] = str(time.time())
         self.cs.deployments.update(dep)
         self._print(f"deployment.apps/{name} restarted")
+
+    def cmd_logs(self, args) -> None:
+        """kubectl logs: pods/{name}/log subresource → node proxy →
+        kubelet → CRI ReadLogs (registry/core/pod/rest/log.go)."""
+        try:
+            lines = self.cs.api.pod_logs(
+                args.pod, args.namespace, args.container, args.tail
+            )
+        except KeyError as e:
+            raise APIError(str(e))
+        for line in lines:
+            self._print(line)
+
+    def cmd_exec(self, args) -> None:
+        """kubectl exec: pods/{name}/exec → node proxy → CRI ExecSync."""
+        try:
+            out, code = self.cs.api.pod_exec(
+                args.pod, args.namespace, list(args.cmd), args.container
+            )
+        except KeyError as e:
+            raise APIError(str(e))
+        if out:
+            self._print(out.rstrip("\n"))
+        if code != 0:
+            raise APIError(f"command terminated with exit code {code}")
 
     def cmd_top(self, args) -> None:
         """kubectl top nodes|pods from the metrics API (metrics.k8s.io;

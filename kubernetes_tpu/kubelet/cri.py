@@ -15,7 +15,7 @@ import threading
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 SANDBOX_READY = "SANDBOX_READY"
 SANDBOX_NOTREADY = "SANDBOX_NOTREADY"
@@ -48,6 +48,9 @@ class RuntimeContainer:
     finished_at: float = 0.0
     exit_code: int = 0
     restart_count: int = 0
+    # log lines (the runtime's per-container log file; ReadLogs in the
+    # reference streams these from the CRI log path, kuberuntime_logs.go)
+    logs: List[str] = field(default_factory=list)
 
 
 class CRIError(Exception):
@@ -156,6 +159,7 @@ class FakeRuntimeService:
                 state=CONTAINER_CREATED,
                 created_at=time.time(),
                 restart_count=restart_count,
+                logs=[],
             )
             return cid
 
@@ -173,6 +177,7 @@ class FakeRuntimeService:
                 return
             c.state = CONTAINER_RUNNING
             c.started_at = time.time()
+            c.logs.append(f"{time.time():.3f} starting {c.name} ({c.image})")
 
     def stop_container(self, container_id: str, exit_code: int = 0) -> None:
         self._latency()
@@ -184,6 +189,7 @@ class FakeRuntimeService:
                 c.state = CONTAINER_EXITED
                 c.exit_code = exit_code
                 c.finished_at = time.time()
+                c.logs.append(f"{time.time():.3f} exited with code {exit_code}")
 
     def remove_container(self, container_id: str) -> None:
         self._latency()
@@ -192,7 +198,37 @@ class FakeRuntimeService:
 
     def list_containers(self) -> List[RuntimeContainer]:
         with self._lock:
-            return [RuntimeContainer(**vars(c)) for c in self._containers.values()]
+            return [
+                RuntimeContainer(**{**vars(c), "logs": list(c.logs)})
+                for c in self._containers.values()
+            ]
+
+    def container_logs(self, container_id: str, tail: Optional[int] = None) -> List[str]:
+        """ReadLogs (kuberuntime_logs.go): the container's log lines."""
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c is None:
+                raise CRIError(f"container {container_id} not found")
+            lines = list(c.logs)
+        if tail is not None:
+            return lines[-tail:] if tail > 0 else []
+        return lines
+
+    def exec_in_container(self, container_id: str, cmd: List[str]) -> Tuple[str, int]:
+        """ExecSync: the fake runtime reports its own state (enough to
+        give kubectl exec a real transport + state machine to test)."""
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c is None:
+                raise CRIError(f"container {container_id} not found")
+            if c.state != CONTAINER_RUNNING:
+                raise CRIError(f"container {c.name} is not running")
+            c.logs.append(f"{time.time():.3f} exec: {' '.join(cmd)}")
+            return (
+                f"pid 1: {c.name} ({c.image}) uptime "
+                f"{time.time() - c.started_at:.1f}s\n",
+                0,
+            )
 
     # -- test helpers ------------------------------------------------------
 

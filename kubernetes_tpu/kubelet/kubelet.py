@@ -34,6 +34,7 @@ from .cri import (
     CONTAINER_EXITED,
     CONTAINER_RUNNING,
     SANDBOX_READY,
+    CRIError,
     FakeRuntimeService,
 )
 from .pleg import PLEG
@@ -105,6 +106,12 @@ class Kubelet:
     def run(self) -> None:
         """Kubelet.Run: register node, start heartbeats + syncLoop."""
         self._register_node()
+        # kubelet node API: logs/exec served to the apiserver's pod
+        # subresource proxy (the reference's kubelet server, pkg/kubelet/
+        # server/server.go, reached via registry/core/pod/rest)
+        api = getattr(self.client, "api", None)
+        if api is not None and hasattr(api, "register_node_proxy"):
+            api.register_node_proxy(self.config.node_name, self)
         for target, name in (
             (self._lease_loop, "lease"),
             (self._node_status_loop, "nodestatus"),
@@ -118,6 +125,9 @@ class Kubelet:
 
     def stop(self) -> None:
         self._stop.set()
+        api = getattr(self.client, "api", None)
+        if api is not None and hasattr(api, "unregister_node_proxy"):
+            api.unregister_node_proxy(self.config.node_name)
         # deregister from the shared informer: a dead kubelet must not
         # keep receiving (and queueing) pod events
         self.pod_informer.remove_event_handler(self._handler)
@@ -459,6 +469,60 @@ class Kubelet:
                 self.runtime.start_container(existing.id)
         _, containers = self._pod_runtime_state(uid)
         self._update_pod_status(pod, sandbox, containers, restart_policy)
+
+    # -- kubelet node API (logs/exec, served to the apiserver proxy) -------
+
+    def _find_container(self, pod_name: str, namespace: str, container: str):
+        # READY sandboxes only: a dead sandbox lingering beside a
+        # recreated one must not shadow the live containers
+        for sb in self.runtime.list_pod_sandboxes():
+            if (
+                sb.pod_name != pod_name
+                or sb.pod_namespace != namespace
+                or sb.state != SANDBOX_READY
+            ):
+                continue
+            cs = [
+                c for c in self.runtime.list_containers()
+                if c.sandbox_id == sb.id
+            ]
+            if not container and cs:
+                return cs[0]
+            for c in cs:
+                if c.name == container:
+                    return c
+        return None
+
+    def container_logs(self, pod_name: str, namespace: str,
+                       container: str = "", tail=None):
+        """GetKubeletContainerLogs (kubelet_pods.go) → CRI ReadLogs."""
+        c = self._find_container(pod_name, namespace, container)
+        if c is None:
+            raise KeyError(
+                f"container {container or '<first>'} of pod "
+                f"{namespace}/{pod_name} not found on {self.config.node_name}"
+            )
+        try:
+            return self.runtime.container_logs(c.id, tail)
+        except CRIError as e:
+            # container vanished between lookup and read
+            raise KeyError(str(e))
+
+    def exec_in_pod(self, pod_name: str, namespace: str, cmd,
+                    container: str = ""):
+        """Exec handler → CRI ExecSync; CRI errors surface as the HTTP
+        error the reference's kubelet would serve (KeyError → APIError at
+        the kubectl boundary)."""
+        c = self._find_container(pod_name, namespace, container)
+        if c is None:
+            raise KeyError(
+                f"container {container or '<first>'} of pod "
+                f"{namespace}/{pod_name} not found on {self.config.node_name}"
+            )
+        try:
+            return self.runtime.exec_in_container(c.id, list(cmd))
+        except CRIError as e:
+            raise KeyError(str(e))
 
     def _reject_pod(self, pod: v1.Pod, message: str) -> None:
         """Admission failure: terminal Failed status (kubelet.go

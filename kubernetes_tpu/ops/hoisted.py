@@ -31,6 +31,7 @@ TEMPLATE per BATCH.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -447,7 +448,11 @@ def _session_scan(S, c_static, tp, carry, batch_self, xs, weights_key):
     S = dict(S)
     S["Mf"], S["Ms"] = _match_matrices(tp, batch_self)
     step = functools.partial(_step, S, c_static, weights)
-    return jax.lax.scan(step, carry, xs)
+    # unroll: the tunnel pays a fixed cost per fused-kernel launch, and
+    # launches scale with scan iterations; unrolling trades compile time
+    # for fewer iterations (semantics identical) — see PERF_NOTES.md
+    unroll = int(os.environ.get("KTPU_SCAN_UNROLL", "1"))
+    return jax.lax.scan(step, carry, xs, unroll=unroll)
 
 
 class HoistedSession:

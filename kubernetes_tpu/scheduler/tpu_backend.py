@@ -73,8 +73,11 @@ class TPUBackend(CacheListener):
         self.MAX_SESSION_TEMPLATES = 8
 
     def _invalidate_session(self) -> None:
+        # _session_assumed survives invalidation deliberately: an assume
+        # echo (cache confirming a pod the torn-down session scheduled)
+        # is host-bookkeeping either way and must not tear down the NEXT
+        # session too
         self._session = None
-        self._session_assumed = set()
 
     # -- CacheListener (called under the cache lock) -----------------------
 
@@ -217,12 +220,32 @@ class TPUBackend(CacheListener):
         session, (re)building it when torn down or when a new template
         fingerprint appears."""
         fps = [template_fingerprint(a) for a in arrays]
-        new = {fp for fp in fps if fp not in self._known_templates}
+        uniq: Dict = {}
+        for fp, a in zip(fps, arrays):
+            uniq.setdefault(fp, a)
+        if len(uniq) > self.MAX_SESSION_TEMPLATES:
+            # one batch alone exceeds the session template budget: a
+            # one-shot hoisted dispatch, session left untouched
+            from ..ops.hoisted import schedule_batch_hoisted
+
+            decisions, _ = schedule_batch_hoisted(
+                self.enc.device_state(), arrays, self.weights
+            )
+            return decisions
+        new = [fp for fp in uniq if fp not in self._known_templates]
         if new:
-            if len(self._known_templates) + len(new) > self.MAX_SESSION_TEMPLATES:
-                self._known_templates = {}
-            for fp, a in zip(fps, arrays):
-                self._known_templates.setdefault(fp, a)
+            for fp in new:
+                self._known_templates[fp] = uniq[fp]
+            # evict oldest templates NOT used by this batch (keeps the
+            # hot set; clearing everything would thrash a workload that
+            # alternates template sets)
+            while len(self._known_templates) > self.MAX_SESSION_TEMPLATES:
+                for old in list(self._known_templates):
+                    if old not in uniq:
+                        del self._known_templates[old]
+                        break
+                else:
+                    break
             self._invalidate_session()
         if self._session is None:
             self._session = HoistedSession(
@@ -230,7 +253,6 @@ class TPUBackend(CacheListener):
                 list(self._known_templates.values()),
                 self.weights,
             )
-            self._session_assumed = set()
         return HoistedSession.decisions(self._session.schedule(arrays))
 
     # -- helpers -----------------------------------------------------------

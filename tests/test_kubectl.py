@@ -231,3 +231,67 @@ class TestNodeOps:
         out.truncate(0), out.seek(0)
         assert k.run(["rollout", "status", "deploy/web"]) == 0
         assert "successfully rolled out" in out.getvalue()
+
+
+class TestLogsExec:
+    """kubectl logs/exec: apiserver pod subresource → node proxy →
+    kubelet → CRI (registry/core/pod/rest/{log,exec}; kubelet server)."""
+
+    def _cluster(self):
+        from kubernetes_tpu.client.informer import SharedInformerFactory
+        from kubernetes_tpu.kubelet.cri import FakeRuntimeService
+        from kubernetes_tpu.kubelet.kubelet import Kubelet, KubeletConfig
+
+        from .util import FAST_KUBELET as FAST, wait_until
+
+        api = APIServer()
+        cs = Clientset(api)
+        factory = SharedInformerFactory(cs)
+        kl = Kubelet(cs, factory,
+                     config=KubeletConfig(node_name="node-0", **FAST),
+                     runtime=FakeRuntimeService())
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        kl.run()
+        cs.pods.create(make_pod("web", node_name="node-0"))
+        wait_until(
+            lambda: cs.pods.get("web", "default").status.phase == "Running",
+            timeout=10,
+        )
+        return api, cs, kl
+
+    def test_logs_and_exec(self):
+        api, cs, kl = self._cluster()
+        try:
+            out = io.StringIO()
+            assert Kubectl(cs, out=out).run(["logs", "web"]) == 0
+            assert "starting c0" in out.getvalue()
+
+            out = io.StringIO()
+            assert Kubectl(cs, out=out).run(["exec", "web", "ps"]) == 0
+            assert "pid 1: c0" in out.getvalue()
+        finally:
+            kl.stop()
+
+    def test_logs_unscheduled_pod_errors(self):
+        api = APIServer()
+        cs = Clientset(api)
+        cs.pods.create(make_pod("pending-pod"))
+        out = io.StringIO()
+        assert Kubectl(cs, out=out).run(["logs", "pending-pod"]) == 1
+        assert "not scheduled" in out.getvalue()
+
+    def test_logs_no_kubelet_connection(self):
+        api = APIServer()
+        cs = Clientset(api)
+        cs.pods.create(make_pod("orphan", node_name="gone-node"))
+        out = io.StringIO()
+        assert Kubectl(cs, out=out).run(["logs", "orphan"]) == 1
+        assert "no kubelet connection" in out.getvalue()
+
+    def test_logs_after_kubelet_stop(self):
+        api, cs, kl = self._cluster()
+        kl.stop()
+        out = io.StringIO()
+        assert Kubectl(cs, out=out).run(["logs", "web"]) == 1
+        assert "no kubelet connection" in out.getvalue()
