@@ -1,0 +1,765 @@
+"""Pallas mega-kernel for the hoisted scheduling session: the WHOLE batch
+scan runs as ONE kernel launch with the carry held in registers.
+
+Why: the tunnel runtime pays a fixed cost per fused-kernel launch, and
+the lax.scan step compiles to dozens of fusions — per-pod cost ~1ms
+regardless of the math (PERF_NOTES.md). Inside one pallas kernel the
+per-op cost is VPU cycles, so a fori_loop over pods turns 1024 steps x
+~25 launches into ONE launch.
+
+Design notes (vs ops/hoisted.py _step, whose semantics this mirrors):
+
+- **int64-free**: Mosaic has no 64-bit types. Resource quantities
+  (milli-CPU, memory bytes, ...) are rescaled per dimension by the GCD
+  of every value in the session. This is EXACT, not approximate: the
+  fit comparisons, least-allocated's `(cap-req)*100 // cap`, and
+  balanced's fractions are invariant under a common rescale (floors of
+  equal rationals are equal). Falls back (PallasUnsupported) if the
+  rescaled magnitudes overflow the int32 headroom.
+- **gather-free PTS counts**: pair-count tables [C, Vnp] (Vnp ~ 11k,
+  dominated by per-node hostname pairs) become (a) per-node count rows
+  for constraints whose pairs are node-distinct (hostname), and (b)
+  compact Vz<=128-lane tables for shared-value keys (zone, ...), with a
+  static one-hot [N, Vz] so count-to-node expansion and scored-set
+  registration are MXU matvecs instead of gathers (unsupported in
+  Mosaic).
+- float64 score math (PTS topology weights, IPA/balanced normalization)
+  runs in float32 in-kernel. Decision parity with the f64 path is pinned
+  by tests on every workload shape we ship; divergence is only possible
+  where two nodes' scores straddle an f32 rounding boundary, in which
+  case either choice is a max-score node.
+- jnp.argmax tie semantics (first max) are reproduced manually (min
+  index among maxima) — Mosaic's argmax lane order is unspecified.
+
+Reference frame: same as ops/hoisted.py — this replaces
+findNodesThatPassFilters + RunScorePlugins (generic_scheduler.go:235,
+framework.go:723) for template-stamped batchable pods, restructured as a
+single accelerator program.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .batch import pod_batchable
+from .hoisted import (
+    _batch_inputs,
+    _match_matrices,
+    _session_prologue,
+    _stack_templates,
+    template_fingerprint,
+)
+from .kernel import DEFAULT_WEIGHTS, MAX_NODE_SCORE
+
+VZ = 128          # compact pair-value lanes per shared-value key
+LANE = 128
+SUB = 8
+POS_BIG = 2 ** 30
+NEG_BIG = -(2 ** 30)
+
+CARRY_KEYS = ("requested", "nzpc", "zcnt_f", "hcnt_f", "zcnt_s", "hcnt_h")
+
+
+class PallasUnsupported(Exception):
+    """This cluster/template shape can't ride the pallas path; callers
+    fall back to the jnp HoistedSession."""
+
+
+def _ceil(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _pad2(a: np.ndarray, rows: int = SUB, lanes: int = LANE) -> np.ndarray:
+    """Pad the last two dims up to multiples of (rows, lanes)."""
+    r, c = a.shape[-2], a.shape[-1]
+    widths = [(0, 0)] * (a.ndim - 2) + [
+        (0, _ceil(r, rows) - r), (0, _ceil(c, lanes) - c)]
+    return np.pad(a, widths)
+
+
+def _gcd_all(*arrays) -> int:
+    g = 0
+    for a in arrays:
+        for v in np.unique(np.abs(np.asarray(a, dtype=np.int64))):
+            g = math.gcd(g, int(v))
+            if g == 1:
+                return 1
+    return max(g, 1)
+
+
+class _Bundle:
+    """Hashable-by-identity bag of device statics + python config (used
+    as a jit static argument; one per session)."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class PallasSession:
+    """HoistedSession-compatible API over the single-launch kernel.
+
+    Semantics: identical to ops/hoisted.py HoistedSession (same
+    prologue, same carry discipline) — parity pinned by
+    tests/test_pallas_scan.py. Raises PallasUnsupported when the cluster
+    shape needs a fallback (e.g. a shared-value topology key with more
+    than 128 distinct values).
+    """
+
+    def __init__(self, cluster: Dict, template_arrays_list: List[Dict],
+                 weights: Optional[Dict[str, int]] = None,
+                 interpret: bool = False):
+        for pa in template_arrays_list:
+            if not pod_batchable(pa):
+                raise ValueError("pallas session templates must be batchable")
+        self.weights = dict(weights or DEFAULT_WEIGHTS)
+        self.interpret = interpret
+        self._fps = {
+            template_fingerprint(t): i for i, t in enumerate(template_arrays_list)
+        }
+        tp = _stack_templates(template_arrays_list)
+        self._tp = tp
+        S = {k: np.asarray(v) for k, v in _session_prologue(cluster, tp).items()}
+        c = {k: np.asarray(v) for k, v in cluster.items()}
+        self._build(c, S)
+        self._carry = None
+        self._bundle = None
+
+    # -- host-side prologue remap ------------------------------------------
+
+    def _build(self, c: Dict, S: Dict) -> None:
+        T, N = S["static_mask"].shape
+        C = S["f_valid"].shape[1]
+        self.T, self.C, self.N = T, C, N
+        Np = _ceil(N, LANE)
+        self.Np = Np
+        TC = T * C
+        TCp = _ceil(TC, SUB)
+        self.TCp = TCp
+        R = c["alloc"].shape[1]
+        self.R = R
+        tp = self._tp
+
+        # ---- exact per-dimension GCD rescale to int32 ----
+        alloc = c["alloc"].astype(np.int64).T.copy()            # [R, N]
+        requested = c["requested"].astype(np.int64).T.copy()
+        req = np.asarray(tp["req"]).astype(np.int64)            # [T, R]
+        nz_requested = c["nz_requested"].astype(np.int64).T.copy()  # [2, N]
+        nz_req = np.asarray(tp["nz_req"]).astype(np.int64)      # [T, 2]
+        for r in range(R):
+            extra = [nz_requested[r], nz_req[:, r]] if r < 2 else []
+            g = _gcd_all(alloc[r], requested[r], req[:, r], *extra)
+            alloc[r] //= g
+            requested[r] //= g
+            req[:, r] //= g
+            if r < 2:
+                nz_requested[r] //= g
+                nz_req[:, r] //= g
+        hi = max((int(a.max(initial=0)) for a in
+                  (alloc, requested, req, nz_requested, nz_req)), default=0)
+        if hi * (MAX_NODE_SCORE + 1) >= 2 ** 31:
+            raise PallasUnsupported(
+                f"rescaled resource magnitude {hi} too large for int32")
+
+        self._alloc = _pad2(alloc.astype(np.int32))             # [Rp, Np]
+        self._requested0 = _pad2(requested.astype(np.int32))
+        nzpc = np.zeros((SUB, N), np.int64)
+        nzpc[0] = nz_requested[0]
+        nzpc[1] = nz_requested[1]
+        nzpc[2] = c["pod_count"].astype(np.int64)
+        nzpc[3] = c["allowed_pods"].astype(np.int64)
+        self._nzpc0 = _pad2(nzpc.astype(np.int32))              # [8, Np]
+        self._req_s = req.astype(np.int32)
+        self._nz_req_s = nz_req.astype(np.int32)
+        self._req_check_s = np.asarray(tp["req_check"]).astype(np.int32)
+        self._req_has_any_s = np.asarray(tp["req_has_any"]).astype(np.int32)
+
+        # ---- per-template [T, N] statics: row t*SR+i ----
+        stat_rows = [
+            S["static_mask"], S["raw_ipa"], S["cnt_taint"],
+            S["cnt_nodeaff"], S["sc_image"], S["sc_avoid"],
+            np.zeros_like(S["static_mask"]), S["s_src"],
+        ]
+        if any(np.abs(a.astype(np.int64)).max(initial=0) >= 2 ** 31
+               for a in stat_rows):
+            raise PallasUnsupported("static score magnitude exceeds int32")
+        SR = len(stat_rows)  # == 8
+        self.SR = SR
+        stat = np.stack([a.astype(np.int32) for a in stat_rows], axis=1)
+        self._stat = _pad2(stat.reshape(T * SR, N))             # [T*SR, Np]
+
+        # ---- PTS: per-constraint representation ----
+        valid_nodes = c["valid"].astype(bool)
+
+        def col(side, t, cc):
+            return S[f"{side}_pair_cn"][t, :, cc]
+
+        def node_distinct(column):
+            real = column[valid_nodes]
+            return len(real) == 0 or len(np.unique(real)) == len(real)
+
+        uid_of: Dict[bytes, int] = {}
+        uids: List[np.ndarray] = []
+
+        def classify(side, force_host=None):
+            """-> (keyid [T,C], perno [T,C] bool): perno = per-node count
+            representation; otherwise compact key `keyid`."""
+            keyid = np.full((T, C), -1, np.int32)
+            perno = np.zeros((T, C), bool)
+            for t in range(T):
+                for cc in range(C):
+                    if not S[f"{side}_valid"][t, cc]:
+                        continue
+                    column = col(side, t, cc)
+                    is_host = (force_host[t, cc] if force_host is not None
+                               else node_distinct(column))
+                    if is_host:
+                        perno[t, cc] = True
+                        continue
+                    key = column.tobytes()
+                    u = uid_of.get(key)
+                    if u is None:
+                        u = len(uids)
+                        uid_of[key] = u
+                        uids.append(column.copy())
+                    keyid[t, cc] = u
+            return keyid, perno
+
+        # score side MUST follow the prologue's hostname flag (it selects
+        # the log(n_scored) weight semantics, not just a representation)
+        s_hostflag = S["s_hostname"].astype(bool)
+        fk, fh = classify("f")
+        sk, sh = classify("s", force_host=s_hostflag)
+        # a non-hostname score constraint whose pairs are node-distinct
+        # would blow the 128-lane vocab — unsupported
+        self._f_keyid, self._f_perno = fk, fh
+        self._s_keyid, self._s_perno = sk, sh
+
+        K = max(len(uids), 1)
+        if len(uids) > 4:
+            raise PallasUnsupported(f"{len(uids)} distinct shared-value keys")
+        self.K = K
+        onehot = np.zeros((K, Np, VZ), np.float32)
+        zof: List[Dict[int, int]] = []
+        for u, column in enumerate(uids):
+            vals = np.unique(column[valid_nodes])
+            vals = vals[vals > 0]
+            if len(vals) > VZ:
+                raise PallasUnsupported(
+                    f"topology key {u} has {len(vals)} values > {VZ}")
+            m = {int(v): z for z, v in enumerate(vals)}
+            zof.append(m)
+            zid = np.array([m.get(int(v), -1) for v in column], np.int32)
+            ok = (zid >= 0) & valid_nodes
+            onehot[u, np.arange(N)[ok], zid[ok]] = 1.0
+        self._onehot = onehot
+
+        def remap(side, cnt_tcv, keyid, perno):
+            z = np.zeros((TCp, VZ), np.int32)
+            h = np.zeros((TCp, Np), np.int32)
+            for t in range(T):
+                for cc in range(C):
+                    row = t * C + cc
+                    if perno[t, cc]:
+                        h[row, :N] = cnt_tcv[t, cc][col(side, t, cc)]
+                    elif keyid[t, cc] >= 0:
+                        for pair, zz in zof[keyid[t, cc]].items():
+                            z[row, zz] = cnt_tcv[t, cc, pair]
+            return z, h
+
+        self._zcnt_f0, self._hcnt_f0 = remap("f", S["f_cnt0"], fk, fh)
+        self._zcnt_s0, _ = remap("s", S["s_cnt0"], sk, sh)
+        hh = np.zeros((TCp, Np), np.int32)
+        hh[:TC, :N] = S["h_cnt0"].astype(np.int64).reshape(TC, N)
+        self._hcnt_h0 = hh
+
+        zreg_f = np.zeros((TCp, VZ), np.int32)
+        felig = np.zeros((TCp, Np), np.int32)
+        zvalid_s = np.zeros((TCp, VZ), np.int32)
+        for t in range(T):
+            for cc in range(C):
+                row = t * C + cc
+                if fh[t, cc]:
+                    felig[row, :N] = S["f_reg_real"][t, cc][col("f", t, cc)]
+                elif fk[t, cc] >= 0:
+                    for pair, zz in zof[fk[t, cc]].items():
+                        zreg_f[row, zz] = S["f_reg_real"][t, cc, pair]
+                if not sh[t, cc] and sk[t, cc] >= 0:
+                    for pair, zz in zof[sk[t, cc]].items():
+                        zvalid_s[row, zz] = 1
+        self._zreg_f = zreg_f
+        self._felig = felig
+        self._zvalid_s = zvalid_s
+
+        def tcn(a):  # [T, N, C] bool -> [TCp, Np] i32
+            out = np.zeros((TCp, Np), np.int32)
+            out[:TC, :N] = np.transpose(a, (0, 2, 1)).reshape(TC, N)
+            return out
+
+        self._konn_f = tcn(S["f_key_on_node"])
+        self._konn_s = tcn(S["s_key_on_node"])
+        sha = np.zeros((_ceil(T, SUB), Np), np.int32)
+        sha[:T, :N] = S["s_has_all"].astype(np.int32)
+        self._shasall = sha
+        vn = np.zeros((SUB, Np), np.int32)
+        vn[:, :N] = c["valid"].astype(np.int32)[None, :]
+        self._valid_n = vn
+
+        # row -> template one-hot [T, TCp, VZ] and identity [TCp, LANE]
+        if TC > LANE:
+            raise PallasUnsupported(f"T*C={TC} exceeds {LANE} match lanes")
+        rowt = np.zeros((T, TCp, VZ), np.int32)
+        for t in range(T):
+            rowt[t, t * C:(t + 1) * C, :] = 1
+        self._rowt = rowt
+        eye = np.zeros((TCp, LANE), np.float32)
+        for i in range(TC):
+            eye[i, i] = 1.0
+        self._eye = eye
+
+        # SMEM scalar table
+        self._scalars = self._pack_scalars(S)
+
+    def _pack_scalars(self, S) -> np.ndarray:
+        T, C, R = self.T, self.C, self.R
+        per_t = np.concatenate([
+            self._req_s, self._req_check_s,
+            self._req_has_any_s[:, None], self._nz_req_s,
+            S["ipa_present"].astype(np.int32)[:, None]], axis=1)  # [T, 2R+4]
+        tc = np.stack([
+            S["f_valid"].astype(np.int32), S["s_valid"].astype(np.int32),
+            S["f_skew"].astype(np.int32), S["s_skew"].astype(np.int32),
+            S["f_self_match"].astype(np.int32), S["s_first"].astype(np.int32),
+            self._f_keyid, self._s_keyid,
+            self._f_perno.astype(np.int32), self._s_perno.astype(np.int32),
+        ], axis=0)  # [10, T, C]
+        return np.concatenate([
+            per_t.reshape(-1), tc.reshape(-1),
+            S["f_same_key"].astype(np.int32).reshape(-1),
+            S["s_same_key"].astype(np.int32).reshape(-1),
+        ]).astype(np.int32)
+
+    # -- scheduling --------------------------------------------------------
+
+    def _initial_carry(self):
+        z = jnp.asarray
+        return {
+            "requested": z(self._requested0), "nzpc": z(self._nzpc0),
+            "zcnt_f": z(self._zcnt_f0), "hcnt_f": z(self._hcnt_f0),
+            "zcnt_s": z(self._zcnt_s0), "hcnt_h": z(self._hcnt_h0),
+        }
+
+    def _get_bundle(self) -> _Bundle:
+        if self._bundle is None:
+            z = jnp.asarray
+            self._bundle = _Bundle(
+                alloc=z(self._alloc), stat=z(self._stat),
+                onehot=z(self._onehot), zreg_f=z(self._zreg_f),
+                felig=z(self._felig), zvalid_s=z(self._zvalid_s),
+                konn_f=z(self._konn_f), konn_s=z(self._konn_s),
+                shasall=z(self._shasall), valid_n=z(self._valid_n),
+                rowt=z(self._rowt), eye=z(self._eye),
+                scalars=z(self._scalars),
+                shapes=(self.T, self.C, self.Np, self.R, self.SR,
+                        self.TCp, self.K),
+                weights=tuple(sorted(self.weights.items())),
+                interpret=self.interpret,
+            )
+        return self._bundle
+
+    def schedule(self, pod_arrays_list: List[Dict]):
+        """Enqueue one batch; returns the (8, Bp) device result rows —
+        row 0 best / row 1 score / row 2 n_feasible. decisions() blocks."""
+        B = len(pod_arrays_list)
+        Bp = _ceil(B, LANE)
+        tmpl = np.zeros(Bp, np.int32)
+        for i, pa in enumerate(pod_arrays_list):
+            if bool(np.asarray(pa["has_node_name"])):
+                raise ValueError("session pods must be unbound")
+            tmpl[i] = self._fps[template_fingerprint(pa)]
+        batch_self, _ = _batch_inputs(pod_arrays_list, tmpl[:B])
+        mf, ms = _match_matrices(self._tp, batch_self)
+        T, C = self.T, self.C
+        # [Bp, LANE]: lane r = constraint-row r (read per-pod as one row)
+        mfT = np.zeros((Bp, LANE), np.int32)
+        msT = np.zeros((Bp, LANE), np.int32)
+        mfT[:B, :T * C] = np.asarray(mf).transpose(1, 0, 2).reshape(B, T * C)
+        msT[:B, :T * C] = np.asarray(ms).transpose(1, 0, 2).reshape(B, T * C)
+        if self._carry is None:
+            self._carry = self._initial_carry()
+        out, self._carry = _dispatch(
+            self._get_bundle(), B, self._carry,
+            jnp.asarray(tmpl), jnp.asarray(mfT), jnp.asarray(msT))
+        return {"rows": out, "n": B}
+
+    @staticmethod
+    def decisions(ys) -> List[int]:
+        return [int(v) for v in np.asarray(ys["rows"])[0, :ys["n"]]]
+
+
+# ---------------------------------------------------------------------------
+# kernel
+
+
+def _build_kernel(shapes, weights, Bp: int, B_real: int):
+    T, C, Np, R, SR, TCp, K = shapes
+    W = dict(weights)
+    row_len = 2 * R + 4
+    off_tc = T * row_len
+    off_fsame = off_tc + 10 * T * C
+    off_ssame = off_fsame + T * C * C
+    (W_F_VALID, W_S_VALID, W_F_SKEW, W_S_SKEW, W_F_SELF, W_S_FIRST,
+     W_F_KEY, W_S_KEY, W_F_PERNO, W_S_PERNO) = range(10)
+
+    def kernel(tmpl_ref, sc_ref, mf_ref, ms_ref,
+               alloc_ref, stat_ref, onehot_ref, zreg_ref, felig_ref,
+               zvalid_ref, konnf_ref, konns_ref, shasall_ref, validn_ref,
+               rowt_ref, eye_ref,
+               requested_in, nzpc_in, zcntf_in, hcntf_in, zcnts_in, hcnth_in,
+               out_ref,
+               requested_ref, nzpc_ref, zcntf_ref, hcntf_ref,
+               zcnts_ref, hcnth_ref):
+        # carries live in the OUTPUT refs (initialized from the inputs);
+        # refs — unlike loop-carried values — support dynamic row reads
+        requested_ref[:] = requested_in[:]
+        nzpc_ref[:] = nzpc_in[:]
+        zcntf_ref[:] = zcntf_in[:]
+        hcntf_ref[:] = hcntf_in[:]
+        zcnts_ref[:] = zcnts_in[:]
+        hcnth_ref[:] = hcnth_in[:]
+        out_ref[:] = jnp.full((SUB, Bp), -1, jnp.int32)
+
+        sc = sc_ref
+        lane_n = jax.lax.broadcasted_iota(jnp.int32, (1, Np), 1)
+        valid_n = validn_ref[0:1, :]
+        alloc = alloc_ref[:]
+        allowed = nzpc_in[3:4, :]
+        f32 = jnp.float32
+
+        def sm_t(t, i):
+            return sc[t * row_len + i]
+
+        def sm_tc(which, t, cc):
+            return sc[off_tc + which * T * C + t * C + cc]
+
+        def sm_fsame(t, ci, cj):
+            return sc[off_fsame + (t * C + ci) * C + cj]
+
+        def sm_ssame(t, ci, cj):
+            return sc[off_ssame + (t * C + ci) * C + cj]
+
+        def dotz(mat_1v, k):
+            """(1, VZ) . onehot[k]^T -> (1, Np)."""
+            return jax.lax.dot_general(
+                mat_1v, onehot_ref[k], (((1,), (1,)), ((), ())),
+                preferred_element_type=f32)
+
+        def dotn(mat_1n, k):
+            """(1, Np) . onehot[k] -> (1, VZ)."""
+            return jax.lax.dot_general(
+                mat_1n, onehot_ref[k], (((1,), (0,)), ((), ())),
+                preferred_element_type=f32)
+
+        def body(b, _):
+            b = b.astype(jnp.int32)
+            t = tmpl_ref[b]
+
+            def trow(i):
+                return stat_ref[pl.ds(t * SR + i, 1), :]
+
+            static_mask = trow(0)
+            raw_ipa = trow(1)
+            cnt_taint = trow(2)
+            cnt_nodeaff = trow(3)
+            sc_image = trow(4)
+            sc_avoid = trow(5)
+            ipa_present = sm_t(t, 2 * R + 3)
+
+            requested = requested_ref[:]
+            nzpc = nzpc_ref[:]
+
+            # ---- NodeResourcesFit (exact int32 after GCD rescale) ----
+            over = jnp.zeros((1, Np), jnp.bool_)
+            for r in range(R):
+                free = alloc[r:r + 1, :] - requested[r:r + 1, :]
+                over = over | ((sm_t(t, r) > free) & (sm_t(t, R + r) != 0))
+            fail_dims = (sm_t(t, 2 * R) != 0) & over
+            fail_count = (nzpc[2:3, :] + jnp.int32(1)) > allowed
+            mask_fit = jnp.logical_not(fail_count | fail_dims)
+
+            # ---- PTS filter ----
+            fail_pts = jnp.zeros((1, Np), jnp.bool_)
+            for cc in range(C):
+                row = t * C + cc
+                vld = sm_tc(W_F_VALID, t, cc) != 0
+                perno = sm_tc(W_F_PERNO, t, cc) != 0
+                key = sm_tc(W_F_KEY, t, cc)
+                sh_z = jnp.zeros((1, VZ), f32)
+                sh_h = jnp.zeros((1, Np), f32)
+                for cj in range(C):
+                    same = sm_fsame(t, cc, cj).astype(f32)
+                    rj = t * C + cj
+                    sh_z = sh_z + same * zcntf_ref[pl.ds(rj, 1), :].astype(f32)
+                    sh_h = sh_h + same * hcntf_ref[pl.ds(rj, 1), :].astype(f32)
+                zreg = zreg_ref[pl.ds(row, 1), :]
+                felig = felig_ref[pl.ds(row, 1), :]
+                big = f32(POS_BIG)
+                min_z = jnp.min(jnp.where(zreg != 0, sh_z, big))
+                min_z = jnp.where(min_z == big, f32(0.0), min_z)
+                min_h = jnp.min(jnp.where(felig != 0, sh_h, big))
+                min_h = jnp.where(min_h == big, f32(0.0), min_h)
+                min_c = jnp.where(perno, min_h, min_z)
+                cnt_z = jnp.zeros((1, Np), f32)
+                regn = jnp.zeros((1, Np), f32)
+                for k in range(K):
+                    use = jnp.logical_not(perno) & (key == k)
+                    cnt_z = cnt_z + jnp.where(use, dotz(sh_z, k), f32(0.0))
+                    regn = regn + jnp.where(
+                        use, dotz(zreg.astype(f32), k), f32(0.0))
+                cnt_n = jnp.where(
+                    perno, sh_h * (felig != 0),
+                    jnp.where(regn > 0, cnt_z, f32(0.0)))
+                konn = konnf_ref[pl.ds(row, 1), :]
+                fail_missing = vld & (konn == 0)
+                skew = cnt_n + sm_tc(W_F_SELF, t, cc).astype(f32) - min_c
+                fail_skew = (vld & (konn != 0)
+                             & (skew > sm_tc(W_F_SKEW, t, cc).astype(f32)))
+                fail_pts = fail_pts | fail_missing | fail_skew
+
+            feasible = ((static_mask != 0) & mask_fit
+                        & jnp.logical_not(fail_pts) & (valid_n != 0))
+            n_feasible = jnp.sum(feasible.astype(jnp.float32)).astype(jnp.int32)
+
+            # ---- resource scores ----
+            nz_cpu = (nzpc[0:1, :] + sm_t(t, 2 * R + 1)).astype(f32)
+            nz_mem = (nzpc[1:2, :] + sm_t(t, 2 * R + 2)).astype(f32)
+            cap_cpu = alloc[0:1, :].astype(f32)
+            cap_mem = alloc[1:2, :].astype(f32)
+            frac_c = jnp.where(cap_cpu == 0, f32(1.0), nz_cpu / cap_cpu)
+            frac_m = jnp.where(cap_mem == 0, f32(1.0), nz_mem / cap_mem)
+            balanced = ((f32(1.0) - jnp.abs(frac_c - frac_m))
+                        * MAX_NODE_SCORE).astype(jnp.int32)
+            balanced = jnp.where((frac_c >= 1) | (frac_m >= 1), jnp.int32(0), balanced)
+
+            def least_dim(cap, reqq):
+                s = ((cap - reqq) * MAX_NODE_SCORE
+                     // jnp.where(cap == 0, jnp.int32(1), cap))
+                return jnp.where((cap == 0) | (reqq > cap), jnp.int32(0), s)
+
+            least = (least_dim(alloc[0:1, :],
+                               nzpc[0:1, :] + sm_t(t, 2 * R + 1))
+                     + least_dim(alloc[1:2, :],
+                                 nzpc[1:2, :] + sm_t(t, 2 * R + 2))) // jnp.int32(2)
+
+            # ---- PTS score ----
+            shasall = shasall_ref[pl.ds(t, 1), :]
+            scored = feasible & (shasall != 0)
+            ignored = feasible & (shasall == 0)
+            scored_f32 = scored.astype(f32)
+            n_scored = jnp.sum(scored_f32)
+            raw = jnp.zeros((1, Np), f32)
+            have_s = jnp.int32(0)
+            for cc in range(C):
+                row = t * C + cc
+                vld = sm_tc(W_S_VALID, t, cc)
+                have_s = have_s | vld
+                perno = sm_tc(W_S_PERNO, t, cc) != 0
+                key = sm_tc(W_S_KEY, t, cc)
+                sh_z = jnp.zeros((1, VZ), f32)
+                for cj in range(C):
+                    same = sm_ssame(t, cc, cj).astype(f32)
+                    rj = t * C + cj
+                    sh_z = sh_z + same * zcnts_ref[pl.ds(rj, 1), :].astype(f32)
+                zval = zvalid_ref[pl.ds(row, 1), :].astype(f32)
+                topo = f32(0.0)
+                regn = jnp.zeros((1, Np), f32)
+                cnt_z = jnp.zeros((1, Np), f32)
+                for k in range(K):
+                    use = jnp.logical_not(perno) & (key == k)
+                    rz = (dotn(scored_f32, k) > 0).astype(f32) * zval
+                    rz = jnp.where(use, rz, f32(0.0))
+                    topo = topo + jnp.sum(rz)
+                    regn = regn + dotz(rz, k)
+                    cnt_z = cnt_z + jnp.where(use, dotz(sh_z, k), f32(0.0))
+                first = sm_tc(W_S_FIRST, t, cc)
+                topo_size = jnp.where(first != 0, topo, f32(0.0))
+                weight = jnp.log(jnp.where(perno, n_scored, topo_size)
+                                 + f32(2.0))
+                cnt_n = jnp.where(
+                    perno, hcnth_ref[pl.ds(row, 1), :].astype(f32),
+                    jnp.where(regn > 0, cnt_z, f32(0.0)))
+                konn = konns_ref[pl.ds(row, 1), :]
+                term = jnp.where(
+                    (vld != 0) & (konn != 0),
+                    cnt_n * weight + (sm_tc(W_S_SKEW, t, cc).astype(f32)
+                                      - f32(1.0)),
+                    f32(0.0))
+                raw = raw + term
+            raw_i = raw.astype(jnp.int32)
+            min_r = jnp.min(jnp.where(scored, raw_i, jnp.int32(POS_BIG)))
+            max_r = jnp.max(jnp.where(scored, raw_i, jnp.int32(0)))
+            min_r = jnp.where(min_r == POS_BIG, jnp.int32(0), min_r)
+            norm = (MAX_NODE_SCORE * (max_r + min_r - raw_i)
+                    // jnp.where(max_r == 0, jnp.int32(1), max_r))
+            norm = jnp.where(max_r == 0, jnp.int32(MAX_NODE_SCORE), norm)
+            norm = jnp.where(ignored, jnp.int32(0), norm)
+            sc_pts = jnp.where(have_s != 0, norm, jnp.int32(0))
+
+            # ---- IPA normalize ----
+            min_i = jnp.min(jnp.where(feasible, raw_ipa, jnp.int32(POS_BIG)))
+            max_i = jnp.max(jnp.where(feasible, raw_ipa, jnp.int32(NEG_BIG)))
+            diff = (max_i - min_i).astype(f32)
+            ipa = jnp.where(
+                diff > 0,
+                (MAX_NODE_SCORE * ((raw_ipa - min_i).astype(f32)
+                                   / jnp.where(diff > 0, diff, f32(1.0))))
+                .astype(jnp.int32),
+                jnp.zeros((1, Np), jnp.int32))
+            ipa = jnp.where(ipa_present != 0, ipa, jnp.zeros((1, Np), jnp.int32))
+
+            # ---- default-normalized taint / node-affinity ----
+            def norm_default(counts, reverse):
+                mx = jnp.max(jnp.where(feasible, counts, jnp.int32(0)))
+                scaled = (MAX_NODE_SCORE * counts
+                          // jnp.where(mx == 0, jnp.int32(1), mx))
+                if reverse:
+                    return jnp.where(mx == 0, jnp.int32(MAX_NODE_SCORE),
+                                     jnp.int32(MAX_NODE_SCORE) - scaled)
+                return jnp.where(mx == 0, counts, scaled)
+
+            sc_taint = norm_default(cnt_taint, True)
+            sc_nodeaff = norm_default(cnt_nodeaff, False)
+
+            total = (balanced * W["balanced"] + sc_image * W["image"]
+                     + ipa * W["ipa"] + least * W["least"]
+                     + sc_nodeaff * W["node_affinity"]
+                     + sc_avoid * W["prefer_avoid"]
+                     + sc_pts * W["pts"] + sc_taint * W["taint"])
+            total = jnp.where(feasible, total, jnp.int32(-1))
+
+            # first-max (jnp.argmax tie semantics; exact — scores < 2^24)
+            tf = total.astype(f32)
+            m = jnp.max(tf)
+            idx = jnp.where(tf >= m, lane_n, jnp.int32(POS_BIG))
+            best = jnp.min(idx).astype(jnp.int32)
+            ok = (m >= 0) & (b < B_real)
+            oki = ok.astype(jnp.int32)
+
+            # ---- carry updates (refs) ----
+            hot = (lane_n == best).astype(jnp.int32) * oki   # (1, Np)
+            for r in range(R):
+                requested_ref[r:r + 1, :] = (
+                    requested_ref[r:r + 1, :] + hot * sm_t(t, r))
+            nzpc_ref[0:1, :] = nzpc_ref[0:1, :] + hot * sm_t(t, 2 * R + 1)
+            nzpc_ref[1:2, :] = nzpc_ref[1:2, :] + hot * sm_t(t, 2 * R + 2)
+            nzpc_ref[2:3, :] = nzpc_ref[2:3, :] + hot
+
+            # per-row match weights: column b of mf/ms, via identity-dot
+            mf_vec = mf_ref[pl.ds(b, 1), :]                 # (1, LANE)
+            ms_vec = ms_ref[pl.ds(b, 1), :]
+            eye = eye_ref[:]                                 # (TCp, LANE)
+            mf_col = jax.lax.dot_general(
+                eye.astype(f32), mf_vec.astype(f32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=f32)                  # (TCp, 1)
+            ms_col = jax.lax.dot_general(
+                eye.astype(f32), ms_vec.astype(f32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=f32)
+            okf = oki.astype(f32)
+            hcntf_ref[:] = (hcntf_ref[:].astype(f32)
+                            + mf_col * hot.astype(f32) * okf
+                            ).astype(jnp.int32)
+            hcnth_ref[:] = (hcnth_ref[:].astype(f32)
+                            + ms_col * hot.astype(f32) * okf
+                            ).astype(jnp.int32)
+
+            # s_src at best, broadcast to each row's template
+            srcv = jnp.zeros((TCp, VZ), f32)
+            for tt in range(T):
+                srow = stat_ref[pl.ds(tt * SR + 7, 1), :]    # (1, Np)
+                v = jnp.sum(
+                    jnp.where(lane_n == best, srow, jnp.int32(0)).astype(f32))
+                srcv = srcv + rowt_ref[tt].astype(f32) * v
+            for k in range(K):
+                ohb = onehot_ref[k, pl.ds(best, 1), :]       # (1, VZ) f32
+                fg = _gate(sc, sm_tc, W_F_KEY, W_F_PERNO, T, C, TCp, k)
+                sg = _gate(sc, sm_tc, W_S_KEY, W_S_PERNO, T, C, TCp, k)
+                zcntf_ref[:] = (zcntf_ref[:].astype(f32)
+                                + fg * mf_col * ohb * okf).astype(jnp.int32)
+                zcnts_ref[:] = (zcnts_ref[:].astype(f32)
+                                + sg * ms_col * srcv * ohb * okf
+                                ).astype(jnp.int32)
+
+            subi = jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 0)
+            lanei = jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 1)
+            at_b = lanei == b
+            o = out_ref[:]
+            o = jnp.where(at_b & (subi == 0), jnp.where(ok, best, jnp.int32(-1)), o)
+            o = jnp.where(at_b & (subi == 1),
+                          jnp.where(ok, m.astype(jnp.int32), jnp.int32(-1)), o)
+            o = jnp.where(at_b & (subi == 2), n_feasible, o)
+            out_ref[:] = o
+            return jnp.int32(0)
+
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(B_real), body, jnp.int32(0))
+
+    return kernel
+
+
+def _gate(sc, sm_tc, which_key, which_perno, T, C, TCp, k):
+    """(TCp, 1) f32 gate: rows whose constraint uses shared-value key k.
+
+    The gate values are STATIC per session but live in SMEM scalars; we
+    rebuild the (TCp, 1) vector with static row writes (cheap, unrolled).
+    """
+    rows = []
+    for t in range(T):
+        for cc in range(C):
+            sel = ((sm_tc(which_key, t, cc) == k)
+                   & (sm_tc(which_perno, t, cc) == 0))
+            rows.append(sel.astype(jnp.float32))
+    rows += [jnp.float32(0.0)] * (TCp - T * C)
+    return jnp.stack(rows).reshape(TCp, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("bundle", "B_real"),
+                   donate_argnames=("carry",))
+def _dispatch(bundle: _Bundle, B_real: int, carry: Dict, tmpl, mfT, msT):
+    Bp = int(tmpl.shape[0])
+    kernel = _build_kernel(bundle.shapes, bundle.weights, Bp, B_real)
+    carry_in = [carry[k] for k in CARRY_KEYS]
+    out_shape = (
+        jax.ShapeDtypeStruct((SUB, Bp), jnp.int32),
+        *[jax.ShapeDtypeStruct(x.shape, x.dtype) for x in carry_in],
+    )
+    vm = pl.BlockSpec(memory_space=pltpu.VMEM)
+    sm = pl.BlockSpec(memory_space=pltpu.SMEM)
+    n_pre = 16  # inputs before the 6 carries
+    # trace the kernel with x64 OFF: every input is explicitly 32-bit,
+    # and weak python literals must not widen ops to i64/f64 (Mosaic has
+    # no 64-bit types)
+    from jax._src.config import enable_x64 as _x64_ctx
+
+    with _x64_ctx(False):
+        results = pl.pallas_call(
+            kernel,
+            out_shape=out_shape,
+            in_specs=[sm, sm, vm, vm] + [vm] * 12 + [vm] * 6,
+            out_specs=tuple([vm] * (1 + len(carry_in))),
+            input_output_aliases={n_pre + i: 1 + i
+                                  for i in range(len(carry_in))},
+            interpret=bundle.interpret,
+        )(tmpl, bundle.scalars, mfT, msT,
+          bundle.alloc, bundle.stat, bundle.onehot, bundle.zreg_f,
+          bundle.felig, bundle.zvalid_s, bundle.konn_f, bundle.konn_s,
+          bundle.shasall, bundle.valid_n, bundle.rowt, bundle.eye,
+          *carry_in)
+    return results[0], dict(zip(CARRY_KEYS, results[1:]))

@@ -1,0 +1,74 @@
+import os, sys, time
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, B = 5120, 64
+
+def try_kernel(name, kernel, out_shape, scratch):
+    try:
+        f = pl.pallas_call(
+            kernel, grid=(B,), out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=scratch,
+        )
+        req = jnp.ones((B, 128), jnp.float32) * 0.5
+        alloc = jnp.ones((1, N), jnp.float32) * 3.0
+        r = jax.block_until_ready(f(req, alloc))
+        print(f"{name}: OK")
+        return r
+    except Exception as e:
+        msg = str(e)
+        for line in msg.split("\n"):
+            if "legalize" in line or "NotImplemented" in line or "Mosaic" in line:
+                msg = line.strip(); break
+        print(f"{name}: FAIL {type(e).__name__}: {msg[:140]}")
+        return None
+
+# 1: grid + scratch init + plain vector write
+def k1(req_ref, alloc_ref, out_ref, util_ref):
+    b = pl.program_id(0)
+    @pl.when(b == 0)
+    def _():
+        util_ref[:] = jnp.zeros_like(util_ref)
+    out_ref[pl.ds(b, 1), :] = req_ref[pl.ds(b, 1), :] + util_ref[0, 0]
+try_kernel("k1 grid+scratch+dswrite", k1,
+           jax.ShapeDtypeStruct((B, 128), jnp.float32),
+           [pltpu.VMEM((1, N), jnp.float32)])
+
+# 2: + argmax int32
+def k2(req_ref, alloc_ref, out_ref, util_ref):
+    b = pl.program_id(0)
+    @pl.when(b == 0)
+    def _():
+        util_ref[:] = jnp.zeros_like(util_ref)
+    score = alloc_ref[0, :] - util_ref[0, :]
+    best = jax.lax.argmax(score, 0, jnp.int32)
+    out_ref[pl.ds(b, 1), :] = jnp.full((1, 128), best, jnp.float32)
+try_kernel("k2 +argmax", k2,
+           jax.ShapeDtypeStruct((B, 128), jnp.float32),
+           [pltpu.VMEM((1, N), jnp.float32)])
+
+# 3: + one-hot scratch update
+def k3(req_ref, alloc_ref, out_ref, util_ref):
+    b = pl.program_id(0)
+    @pl.when(b == 0)
+    def _():
+        util_ref[:] = jnp.zeros_like(util_ref)
+    util = util_ref[0, :]
+    score = alloc_ref[0, :] - util
+    best = jax.lax.argmax(score, 0, jnp.int32)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+    util_ref[:, :] = util[None, :] + jnp.where(lane == best, req_ref[b, 0], 0.0)
+    out_ref[pl.ds(b, 1), :] = jnp.full((1, 128), best, jnp.float32)
+r = try_kernel("k3 +onehot-update", k3,
+               jax.ShapeDtypeStruct((B, 128), jnp.float32),
+               [pltpu.VMEM((1, N), jnp.float32)])
+if r is not None:
+    print("decisions:", np.asarray(r)[:8, 0])

@@ -1,0 +1,117 @@
+"""PallasSession decision parity with the jnp HoistedSession (which is
+itself pinned to the generic scan and the Go oracle).
+
+Runs the kernel in interpreter mode on CPU — semantics only; the
+single-launch performance story is bench.py's job on real hardware.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.ops.hoisted import HoistedSession, template_fingerprint
+from kubernetes_tpu.ops.pallas_scan import PallasSession, PallasUnsupported
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+from .test_hoisted import _encode_all, _presized_encoding
+from .util import make_pod
+
+
+def _templates_of(arrays):
+    out, seen = [], set()
+    for a in arrays:
+        fp = template_fingerprint(a)
+        if fp not in seen:
+            seen.add(fp)
+            out.append(a)
+    return out
+
+
+def _run_pair(nodes, init_pods, pending, batch):
+    """(jnp session decisions, pallas session decisions) over batches."""
+    enc, pe = _presized_encoding(
+        copy.deepcopy(nodes), copy.deepcopy(init_pods), copy.deepcopy(pending))
+    arrays = _encode_all(enc, pe, pending)
+    templates = _templates_of(arrays)
+    jsess = HoistedSession(enc.device_state(), templates)
+    ref = []
+    for i in range(0, len(pending), batch):
+        ref.extend(HoistedSession.decisions(jsess.schedule(arrays[i:i + batch])))
+
+    enc2, pe2 = _presized_encoding(nodes, init_pods, pending)
+    arrays2 = _encode_all(enc2, pe2, pending)
+    psess = PallasSession(enc2.device_state(), _templates_of(arrays2),
+                          interpret=True)
+    got = []
+    for i in range(0, len(pending), batch):
+        got.extend(PallasSession.decisions(psess.schedule(arrays2[i:i + batch])))
+    return ref, got
+
+
+class TestPallasParity:
+    def test_spread_multi_batch(self):
+        nodes, init_pods = synth_cluster(16, pods_per_node=2)
+        pending = synth_pending_pods(36, spread=True)
+        ref, got = _run_pair(nodes, init_pods, pending, batch=12)
+        assert got == ref
+        assert all(d >= 0 for d in got)
+
+    def test_no_constraints(self):
+        nodes, init_pods = synth_cluster(10, pods_per_node=1)
+        pending = synth_pending_pods(16, spread=False)
+        ref, got = _run_pair(nodes, init_pods, pending, batch=8)
+        assert got == ref
+
+    def test_capacity_exhaustion(self):
+        nodes, init_pods = synth_cluster(3, pods_per_node=0)
+        for node in nodes:
+            node.status.allocatable["cpu"] = "350m"
+            node.status.capacity["cpu"] = "350m"
+        pending = synth_pending_pods(15, spread=True)
+        ref, got = _run_pair(nodes, init_pods, pending, batch=5)
+        assert got == ref
+        assert -1 in got
+
+    def test_hostname_hard_spread(self):
+        nodes, init_pods = synth_cluster(6, pods_per_node=1)
+        pending = []
+        for i in range(10):
+            pending.append(make_pod(
+                f"hard-{i}", cpu="50m", labels={"app": "hard"},
+                constraints=[v1.TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=v1.LABEL_HOSTNAME,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=v1.LabelSelector(
+                        match_labels={"app": "hard"}),
+                )]))
+        ref, got = _run_pair(nodes, init_pods, pending, batch=5)
+        assert got == ref
+        assert len(set(got[:6])) == 6
+
+    def test_mixed_templates_cross_counting(self):
+        nodes, init_pods = synth_cluster(8, pods_per_node=1)
+        pending = []
+        for i in range(12):
+            labels = {"tier": "web", "idx": f"t{i % 2}"}
+            pending.append(make_pod(
+                f"x-{i}", cpu="50m", labels=labels,
+                constraints=[v1.TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=v1.LABEL_ZONE,
+                    when_unsatisfiable="ScheduleAnyway",
+                    label_selector=v1.LabelSelector(
+                        match_labels={"tier": "web"}),
+                )]))
+        ref, got = _run_pair(nodes, init_pods, pending, batch=6)
+        assert got == ref
+
+    def test_tainted_and_labeled_cluster(self):
+        # synth_cluster taints some nodes and labels zones; spread pods
+        # exercise taint counts + zone spread together
+        nodes, init_pods = synth_cluster(12, pods_per_node=2)
+        pending = synth_pending_pods(24, spread=True)
+        ref, got = _run_pair(nodes, init_pods, pending, batch=24)
+        assert got == ref
