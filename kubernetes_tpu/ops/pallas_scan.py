@@ -65,7 +65,7 @@ SUB = 8
 POS_BIG = 2 ** 30
 NEG_BIG = -(2 ** 30)
 
-CARRY_KEYS = ("requested", "nzpc", "zcnt_f", "hcnt_f", "zcnt_s", "hcnt_h")
+CARRY_KEYS = ("requested", "nzpc", "cnt_fn", "cnt_sn")
 
 
 class PallasUnsupported(Exception):
@@ -261,42 +261,51 @@ class PallasSession:
             onehot[u, np.arange(N)[ok], zid[ok]] = 1.0
         self._onehot = onehot
 
-        def remap(side, cnt_tcv, keyid, perno):
-            z = np.zeros((TCp, VZ), np.int32)
-            h = np.zeros((TCp, Np), np.int32)
+        def gather_rows(side, cnt_tcv, perno, perno_src=None):
+            """[T, C, Vnp] pair counts -> per-NODE count rows [TCp, Np]:
+            row (t,c), lane n = count of the pair node n belongs to."""
+            out = np.zeros((TCp, Np), np.int32)
             for t in range(T):
                 for cc in range(C):
                     row = t * C + cc
-                    if perno[t, cc]:
-                        h[row, :N] = cnt_tcv[t, cc][col(side, t, cc)]
-                    elif keyid[t, cc] >= 0:
-                        for pair, zz in zof[keyid[t, cc]].items():
-                            z[row, zz] = cnt_tcv[t, cc, pair]
-            return z, h
+                    if perno[t, cc] and perno_src is not None:
+                        out[row, :N] = perno_src[t, cc]
+                    else:
+                        out[row, :N] = cnt_tcv[t, cc][col(side, t, cc)]
+            return out
 
-        self._zcnt_f0, self._hcnt_f0 = remap("f", S["f_cnt0"], fk, fh)
-        self._zcnt_s0, _ = remap("s", S["s_cnt0"], sk, sh)
-        hh = np.zeros((TCp, Np), np.int32)
-        hh[:TC, :N] = S["h_cnt0"].astype(np.int64).reshape(TC, N)
-        self._hcnt_h0 = hh
+        self._cnt_fn0 = gather_rows("f", S["f_cnt0"], fh)
+        self._cnt_sn0 = gather_rows(
+            "s", S["s_cnt0"], sh,
+            perno_src=S["h_cnt0"].astype(np.int64))
 
-        zreg_f = np.zeros((TCp, VZ), np.int32)
-        felig = np.zeros((TCp, Np), np.int32)
+        # static per-node structures
+        prow_f = np.full((TCp, Np), -1, np.int32)
+        prow_s = np.full((TCp, Np), -1, np.int32)
+        regrow_f = np.zeros((TCp, Np), np.int32)
+        zvalid_node_s = np.zeros((TCp, Np), np.int32)
         zvalid_s = np.zeros((TCp, VZ), np.int32)
         for t in range(T):
             for cc in range(C):
                 row = t * C + cc
-                if fh[t, cc]:
-                    felig[row, :N] = S["f_reg_real"][t, cc][col("f", t, cc)]
-                elif fk[t, cc] >= 0:
-                    for pair, zz in zof[fk[t, cc]].items():
-                        zreg_f[row, zz] = S["f_reg_real"][t, cc, pair]
-                if not sh[t, cc] and sk[t, cc] >= 0:
-                    for pair, zz in zof[sk[t, cc]].items():
-                        zvalid_s[row, zz] = 1
-        self._zreg_f = zreg_f
-        self._felig = felig
+                if S["f_valid"][t, cc]:
+                    column = col("f", t, cc)
+                    prow_f[row, :N] = np.where(valid_nodes, column, -1)
+                    regrow_f[row, :N] = S["f_reg_real"][t, cc][column]
+                if S["s_valid"][t, cc]:
+                    column = col("s", t, cc)
+                    prow_s[row, :N] = np.where(valid_nodes, column, -1)
+                    if not sh[t, cc] and sk[t, cc] >= 0:
+                        zvalid_node_s[row, :N] = (column > 0) & valid_nodes
+                        for pair, zz in zof[sk[t, cc]].items():
+                            zvalid_s[row, zz] = 1
+        self._prow_f = prow_f
+        self._prow_s = prow_s
+        self._regrow_f = regrow_f
+        self._zvalid_node_s = zvalid_node_s
         self._zvalid_s = zvalid_s
+        if max(prow_f.max(), prow_s.max()) >= 2 ** 24:
+            raise PallasUnsupported("pair ids exceed exact-f32 range")
 
         def tcn(a):  # [T, N, C] bool -> [TCp, Np] i32
             out = np.zeros((TCp, Np), np.int32)
@@ -352,8 +361,7 @@ class PallasSession:
         z = jnp.asarray
         return {
             "requested": z(self._requested0), "nzpc": z(self._nzpc0),
-            "zcnt_f": z(self._zcnt_f0), "hcnt_f": z(self._hcnt_f0),
-            "zcnt_s": z(self._zcnt_s0), "hcnt_h": z(self._hcnt_h0),
+            "cnt_fn": z(self._cnt_fn0), "cnt_sn": z(self._cnt_sn0),
         }
 
     def _get_bundle(self) -> _Bundle:
@@ -361,11 +369,13 @@ class PallasSession:
             z = jnp.asarray
             self._bundle = _Bundle(
                 alloc=z(self._alloc), stat=z(self._stat),
-                onehot=z(self._onehot), zreg_f=z(self._zreg_f),
-                felig=z(self._felig), zvalid_s=z(self._zvalid_s),
+                onehot=z(self._onehot), regrow_f=z(self._regrow_f),
+                zvalid_node_s=z(self._zvalid_node_s),
+                zvalid_s=z(self._zvalid_s),
                 konn_f=z(self._konn_f), konn_s=z(self._konn_s),
                 shasall=z(self._shasall), valid_n=z(self._valid_n),
                 rowt=z(self._rowt), eye=z(self._eye),
+                prow_f=z(self._prow_f), prow_s=z(self._prow_s),
                 scalars=z(self._scalars),
                 shapes=(self.T, self.C, self.Np, self.R, self.SR,
                         self.TCp, self.K),
@@ -409,6 +419,10 @@ class PallasSession:
 
 
 def _build_kernel(shapes, weights, Bp: int, B_real: int):
+    import os as _os
+
+    skip = frozenset(
+        _os.environ.get("KTPU_PALLAS_SKIP", "").split(","))  # profiling only
     T, C, Np, R, SR, TCp, K = shapes
     W = dict(weights)
     row_len = 2 * R + 4
@@ -419,21 +433,18 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
      W_F_KEY, W_S_KEY, W_F_PERNO, W_S_PERNO) = range(10)
 
     def kernel(tmpl_ref, sc_ref, mf_ref, ms_ref,
-               alloc_ref, stat_ref, onehot_ref, zreg_ref, felig_ref,
+               alloc_ref, stat_ref, onehot_ref, regrowf_ref, zvnode_ref,
                zvalid_ref, konnf_ref, konns_ref, shasall_ref, validn_ref,
-               rowt_ref, eye_ref,
-               requested_in, nzpc_in, zcntf_in, hcntf_in, zcnts_in, hcnth_in,
+               rowt_ref, eye_ref, prowf_ref, prows_ref,
+               requested_in, nzpc_in, cntfn_in, cntsn_in,
                out_ref,
-               requested_ref, nzpc_ref, zcntf_ref, hcntf_ref,
-               zcnts_ref, hcnth_ref):
+               requested_ref, nzpc_ref, cntfn_ref, cntsn_ref):
         # carries live in the OUTPUT refs (initialized from the inputs);
         # refs — unlike loop-carried values — support dynamic row reads
         requested_ref[:] = requested_in[:]
         nzpc_ref[:] = nzpc_in[:]
-        zcntf_ref[:] = zcntf_in[:]
-        hcntf_ref[:] = hcntf_in[:]
-        zcnts_ref[:] = zcnts_in[:]
-        hcnth_ref[:] = hcnth_in[:]
+        cntfn_ref[:] = cntfn_in[:]
+        cntsn_ref[:] = cntsn_in[:]
         out_ref[:] = jnp.full((SUB, Bp), -1, jnp.int32)
 
         sc = sc_ref
@@ -441,6 +452,8 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
         valid_n = validn_ref[0:1, :]
         alloc = alloc_ref[:]
         allowed = nzpc_in[3:4, :]
+        prow_f = prowf_ref[:]        # (TCp, Np) raw pair id per node
+        prow_s = prows_ref[:]
         f32 = jnp.float32
 
         def sm_t(t, i):
@@ -494,38 +507,21 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
             fail_count = (nzpc[2:3, :] + jnp.int32(1)) > allowed
             mask_fit = jnp.logical_not(fail_count | fail_dims)
 
-            # ---- PTS filter ----
+            # ---- PTS filter (per-node counts: zone and hostname unify) --
             fail_pts = jnp.zeros((1, Np), jnp.bool_)
-            for cc in range(C):
+            for cc in range(C) if "ptsf" not in skip else ():
                 row = t * C + cc
                 vld = sm_tc(W_F_VALID, t, cc) != 0
-                perno = sm_tc(W_F_PERNO, t, cc) != 0
-                key = sm_tc(W_F_KEY, t, cc)
-                sh_z = jnp.zeros((1, VZ), f32)
-                sh_h = jnp.zeros((1, Np), f32)
+                sh = jnp.zeros((1, Np), f32)
                 for cj in range(C):
                     same = sm_fsame(t, cc, cj).astype(f32)
                     rj = t * C + cj
-                    sh_z = sh_z + same * zcntf_ref[pl.ds(rj, 1), :].astype(f32)
-                    sh_h = sh_h + same * hcntf_ref[pl.ds(rj, 1), :].astype(f32)
-                zreg = zreg_ref[pl.ds(row, 1), :]
-                felig = felig_ref[pl.ds(row, 1), :]
+                    sh = sh + same * cntfn_ref[pl.ds(rj, 1), :].astype(f32)
+                reg = regrowf_ref[pl.ds(row, 1), :]
                 big = f32(POS_BIG)
-                min_z = jnp.min(jnp.where(zreg != 0, sh_z, big))
-                min_z = jnp.where(min_z == big, f32(0.0), min_z)
-                min_h = jnp.min(jnp.where(felig != 0, sh_h, big))
-                min_h = jnp.where(min_h == big, f32(0.0), min_h)
-                min_c = jnp.where(perno, min_h, min_z)
-                cnt_z = jnp.zeros((1, Np), f32)
-                regn = jnp.zeros((1, Np), f32)
-                for k in range(K):
-                    use = jnp.logical_not(perno) & (key == k)
-                    cnt_z = cnt_z + jnp.where(use, dotz(sh_z, k), f32(0.0))
-                    regn = regn + jnp.where(
-                        use, dotz(zreg.astype(f32), k), f32(0.0))
-                cnt_n = jnp.where(
-                    perno, sh_h * (felig != 0),
-                    jnp.where(regn > 0, cnt_z, f32(0.0)))
+                min_c = jnp.min(jnp.where(reg != 0, sh, big))
+                min_c = jnp.where(min_c == big, f32(0.0), min_c)
+                cnt_n = jnp.where(reg != 0, sh, f32(0.0))
                 konn = konnf_ref[pl.ds(row, 1), :]
                 fail_missing = vld & (konn == 0)
                 skew = cnt_n + sm_tc(W_F_SELF, t, cc).astype(f32) - min_c
@@ -535,7 +531,7 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
 
             feasible = ((static_mask != 0) & mask_fit
                         & jnp.logical_not(fail_pts) & (valid_n != 0))
-            n_feasible = jnp.sum(feasible.astype(jnp.float32)).astype(jnp.int32)
+            n_feasible = jnp.sum(feasible.astype(f32)).astype(jnp.int32)
 
             # ---- resource scores ----
             nz_cpu = (nzpc[0:1, :] + sm_t(t, 2 * R + 1)).astype(f32)
@@ -546,17 +542,19 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
             frac_m = jnp.where(cap_mem == 0, f32(1.0), nz_mem / cap_mem)
             balanced = ((f32(1.0) - jnp.abs(frac_c - frac_m))
                         * MAX_NODE_SCORE).astype(jnp.int32)
-            balanced = jnp.where((frac_c >= 1) | (frac_m >= 1), jnp.int32(0), balanced)
+            balanced = jnp.where((frac_c >= 1) | (frac_m >= 1),
+                                 jnp.int32(0), balanced)
 
             def least_dim(cap, reqq):
-                s = ((cap - reqq) * MAX_NODE_SCORE
+                d = ((cap - reqq) * MAX_NODE_SCORE
                      // jnp.where(cap == 0, jnp.int32(1), cap))
-                return jnp.where((cap == 0) | (reqq > cap), jnp.int32(0), s)
+                return jnp.where((cap == 0) | (reqq > cap), jnp.int32(0), d)
 
             least = (least_dim(alloc[0:1, :],
                                nzpc[0:1, :] + sm_t(t, 2 * R + 1))
                      + least_dim(alloc[1:2, :],
-                                 nzpc[1:2, :] + sm_t(t, 2 * R + 2))) // jnp.int32(2)
+                                 nzpc[1:2, :] + sm_t(t, 2 * R + 2))
+                     ) // jnp.int32(2)
 
             # ---- PTS score ----
             shasall = shasall_ref[pl.ds(t, 1), :]
@@ -564,37 +562,46 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
             ignored = feasible & (shasall == 0)
             scored_f32 = scored.astype(f32)
             n_scored = jnp.sum(scored_f32)
+            # zone-presence among scored nodes, per key: (1, VZ) and its
+            # per-node expansion — the ONLY matvecs in the step
+            zp = []
+            zpn = []
+            for k in range(K) if "zp" not in skip else ():
+                p = (dotn(scored_f32, k) > 0).astype(f32)
+                zp.append(p)
+                zpn.append(dotz(p, k))
+            if "zp" in skip:
+                zp = [jnp.zeros((1, VZ), f32)] * K
+                zpn = [jnp.zeros((1, Np), f32)] * K
             raw = jnp.zeros((1, Np), f32)
             have_s = jnp.int32(0)
-            for cc in range(C):
+            for cc in range(C) if "ptss" not in skip else ():
                 row = t * C + cc
                 vld = sm_tc(W_S_VALID, t, cc)
                 have_s = have_s | vld
                 perno = sm_tc(W_S_PERNO, t, cc) != 0
                 key = sm_tc(W_S_KEY, t, cc)
-                sh_z = jnp.zeros((1, VZ), f32)
+                sh = jnp.zeros((1, Np), f32)
                 for cj in range(C):
                     same = sm_ssame(t, cc, cj).astype(f32)
                     rj = t * C + cj
-                    sh_z = sh_z + same * zcnts_ref[pl.ds(rj, 1), :].astype(f32)
-                zval = zvalid_ref[pl.ds(row, 1), :].astype(f32)
+                    sh = sh + same * cntsn_ref[pl.ds(rj, 1), :].astype(f32)
+                zval_l = zvalid_ref[pl.ds(row, 1), :].astype(f32)  # (1, VZ)
+                zval_n = zvnode_ref[pl.ds(row, 1), :]              # (1, Np)
                 topo = f32(0.0)
                 regn = jnp.zeros((1, Np), f32)
-                cnt_z = jnp.zeros((1, Np), f32)
                 for k in range(K):
                     use = jnp.logical_not(perno) & (key == k)
-                    rz = (dotn(scored_f32, k) > 0).astype(f32) * zval
-                    rz = jnp.where(use, rz, f32(0.0))
-                    topo = topo + jnp.sum(rz)
-                    regn = regn + dotz(rz, k)
-                    cnt_z = cnt_z + jnp.where(use, dotz(sh_z, k), f32(0.0))
+                    topo = topo + jnp.where(use, jnp.sum(zp[k] * zval_l),
+                                            f32(0.0))
+                    regn = regn + jnp.where(use, zpn[k], f32(0.0))
+                regn = regn * (zval_n != 0)
                 first = sm_tc(W_S_FIRST, t, cc)
                 topo_size = jnp.where(first != 0, topo, f32(0.0))
                 weight = jnp.log(jnp.where(perno, n_scored, topo_size)
                                  + f32(2.0))
-                cnt_n = jnp.where(
-                    perno, hcnth_ref[pl.ds(row, 1), :].astype(f32),
-                    jnp.where(regn > 0, cnt_z, f32(0.0)))
+                cnt_n = jnp.where(perno, sh,
+                                  jnp.where(regn > 0, sh, f32(0.0)))
                 konn = konns_ref[pl.ds(row, 1), :]
                 term = jnp.where(
                     (vld != 0) & (konn != 0),
@@ -622,7 +629,8 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
                                    / jnp.where(diff > 0, diff, f32(1.0))))
                 .astype(jnp.int32),
                 jnp.zeros((1, Np), jnp.int32))
-            ipa = jnp.where(ipa_present != 0, ipa, jnp.zeros((1, Np), jnp.int32))
+            ipa = jnp.where(ipa_present != 0, ipa,
+                            jnp.zeros((1, Np), jnp.int32))
 
             # ---- default-normalized taint / node-affinity ----
             def norm_default(counts, reverse):
@@ -651,9 +659,19 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
             best = jnp.min(idx).astype(jnp.int32)
             ok = (m >= 0) & (b < B_real)
             oki = ok.astype(jnp.int32)
+            okf = oki.astype(f32)
 
+            if "updates" in skip:
+                o = out_ref[:]
+                o = jnp.where(
+                    (jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 1) == b)
+                    & (jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 0) == 0),
+                    jnp.where(ok, best, jnp.int32(-1)), o)
+                out_ref[:] = o
+                return jnp.int32(0)
             # ---- carry updates (refs) ----
             hot = (lane_n == best).astype(jnp.int32) * oki   # (1, Np)
+            hotf = hot.astype(f32)
             for r in range(R):
                 requested_ref[r:r + 1, :] = (
                     requested_ref[r:r + 1, :] + hot * sm_t(t, r))
@@ -661,50 +679,57 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
             nzpc_ref[1:2, :] = nzpc_ref[1:2, :] + hot * sm_t(t, 2 * R + 2)
             nzpc_ref[2:3, :] = nzpc_ref[2:3, :] + hot
 
-            # per-row match weights: column b of mf/ms, via identity-dot
-            mf_vec = mf_ref[pl.ds(b, 1), :]                 # (1, LANE)
-            ms_vec = ms_ref[pl.ds(b, 1), :]
+            # per-row match weights: column b of mf/ms via identity-dot
+            mf_vec = mf_ref[pl.ds(b, 1), :].astype(f32)      # (1, LANE)
+            ms_vec = ms_ref[pl.ds(b, 1), :].astype(f32)
             eye = eye_ref[:]                                 # (TCp, LANE)
             mf_col = jax.lax.dot_general(
-                eye.astype(f32), mf_vec.astype(f32),
-                (((1,), (1,)), ((), ())),
+                eye, mf_vec, (((1,), (1,)), ((), ())),
                 preferred_element_type=f32)                  # (TCp, 1)
             ms_col = jax.lax.dot_general(
-                eye.astype(f32), ms_vec.astype(f32),
-                (((1,), (1,)), ((), ())),
+                eye, ms_vec, (((1,), (1,)), ((), ())),
                 preferred_element_type=f32)
-            okf = oki.astype(f32)
-            hcntf_ref[:] = (hcntf_ref[:].astype(f32)
-                            + mf_col * hot.astype(f32) * okf
-                            ).astype(jnp.int32)
-            hcnth_ref[:] = (hcnth_ref[:].astype(f32)
-                            + ms_col * hot.astype(f32) * okf
-                            ).astype(jnp.int32)
 
-            # s_src at best, broadcast to each row's template
-            srcv = jnp.zeros((TCp, VZ), f32)
+            # pair id at best, per row (one matvec each side); same-pair
+            # lanes get the count delta — hostname rows degenerate to
+            # same-NODE exactly like the pair-space update they mirror
+            pf = prow_f.astype(f32)
+            ps_ = prow_s.astype(f32)
+            zb_f = jax.lax.dot_general(
+                pf, hotf, (((1,), (1,)), ((), ())),
+                preferred_element_type=f32)                  # (TCp, 1)
+            zb_s = jax.lax.dot_general(
+                ps_, hotf, (((1,), (1,)), ((), ())),
+                preferred_element_type=f32)
+            m_f = ((pf == zb_f) & (prow_f >= 0)).astype(f32) * okf
+            m_s = ((ps_ == zb_s) & (prow_s >= 0)).astype(f32) * okf
+
+            # s_src factor at best per row's template (zone rows only; the
+            # per-node/hostname update has no src gate, mirroring _step)
+            srcrow = jnp.zeros((TCp, 1), f32)
             for tt in range(T):
-                srow = stat_ref[pl.ds(tt * SR + 7, 1), :]    # (1, Np)
+                srow = stat_ref[pl.ds(tt * SR + 7, 1), :]
                 v = jnp.sum(
                     jnp.where(lane_n == best, srow, jnp.int32(0)).astype(f32))
-                srcv = srcv + rowt_ref[tt].astype(f32) * v
-            for k in range(K):
-                ohb = onehot_ref[k, pl.ds(best, 1), :]       # (1, VZ) f32
-                fg = _gate(sc, sm_tc, W_F_KEY, W_F_PERNO, T, C, TCp, k)
-                sg = _gate(sc, sm_tc, W_S_KEY, W_S_PERNO, T, C, TCp, k)
-                zcntf_ref[:] = (zcntf_ref[:].astype(f32)
-                                + fg * mf_col * ohb * okf).astype(jnp.int32)
-                zcnts_ref[:] = (zcnts_ref[:].astype(f32)
-                                + sg * ms_col * srcv * ohb * okf
-                                ).astype(jnp.int32)
+                srcrow = srcrow + rowt_ref[tt][:, 0:1].astype(f32) * v
+            pernosel = _stack_tc(
+                sc, sm_tc, W_S_PERNO, T, C, TCp)             # (TCp, 1)
+            factor = pernosel + (f32(1.0) - pernosel) * srcrow
+
+            cntfn_ref[:] = (cntfn_ref[:].astype(f32)
+                            + mf_col * m_f).astype(jnp.int32)
+            cntsn_ref[:] = (cntsn_ref[:].astype(f32)
+                            + ms_col * factor * m_s).astype(jnp.int32)
 
             subi = jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 0)
             lanei = jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 1)
             at_b = lanei == b
             o = out_ref[:]
-            o = jnp.where(at_b & (subi == 0), jnp.where(ok, best, jnp.int32(-1)), o)
+            o = jnp.where(at_b & (subi == 0),
+                          jnp.where(ok, best, jnp.int32(-1)), o)
             o = jnp.where(at_b & (subi == 1),
-                          jnp.where(ok, m.astype(jnp.int32), jnp.int32(-1)), o)
+                          jnp.where(ok, m.astype(jnp.int32), jnp.int32(-1)),
+                          o)
             o = jnp.where(at_b & (subi == 2), n_feasible, o)
             out_ref[:] = o
             return jnp.int32(0)
@@ -714,18 +739,12 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
     return kernel
 
 
-def _gate(sc, sm_tc, which_key, which_perno, T, C, TCp, k):
-    """(TCp, 1) f32 gate: rows whose constraint uses shared-value key k.
-
-    The gate values are STATIC per session but live in SMEM scalars; we
-    rebuild the (TCp, 1) vector with static row writes (cheap, unrolled).
-    """
+def _stack_tc(sc, sm_tc, which, T, C, TCp):
+    """(TCp, 1) f32 built from per-(t,c) SMEM scalars (static unroll)."""
     rows = []
     for t in range(T):
         for cc in range(C):
-            sel = ((sm_tc(which_key, t, cc) == k)
-                   & (sm_tc(which_perno, t, cc) == 0))
-            rows.append(sel.astype(jnp.float32))
+            rows.append((sm_tc(which, t, cc) != 0).astype(jnp.float32))
     rows += [jnp.float32(0.0)] * (TCp - T * C)
     return jnp.stack(rows).reshape(TCp, 1)
 
@@ -742,7 +761,7 @@ def _dispatch(bundle: _Bundle, B_real: int, carry: Dict, tmpl, mfT, msT):
     )
     vm = pl.BlockSpec(memory_space=pltpu.VMEM)
     sm = pl.BlockSpec(memory_space=pltpu.SMEM)
-    n_pre = 16  # inputs before the 6 carries
+    n_pre = 18  # inputs before the 4 carries
     # trace the kernel with x64 OFF: every input is explicitly 32-bit,
     # and weak python literals must not widen ops to i64/f64 (Mosaic has
     # no 64-bit types)
@@ -752,14 +771,15 @@ def _dispatch(bundle: _Bundle, B_real: int, carry: Dict, tmpl, mfT, msT):
         results = pl.pallas_call(
             kernel,
             out_shape=out_shape,
-            in_specs=[sm, sm, vm, vm] + [vm] * 12 + [vm] * 6,
+            in_specs=[sm, sm, vm, vm] + [vm] * 14 + [vm] * 4,
             out_specs=tuple([vm] * (1 + len(carry_in))),
             input_output_aliases={n_pre + i: 1 + i
                                   for i in range(len(carry_in))},
             interpret=bundle.interpret,
         )(tmpl, bundle.scalars, mfT, msT,
-          bundle.alloc, bundle.stat, bundle.onehot, bundle.zreg_f,
-          bundle.felig, bundle.zvalid_s, bundle.konn_f, bundle.konn_s,
-          bundle.shasall, bundle.valid_n, bundle.rowt, bundle.eye,
+          bundle.alloc, bundle.stat, bundle.onehot, bundle.regrow_f,
+          bundle.zvalid_node_s, bundle.zvalid_s, bundle.konn_f,
+          bundle.konn_s, bundle.shasall, bundle.valid_n, bundle.rowt,
+          bundle.eye, bundle.prow_f, bundle.prow_s,
           *carry_in)
     return results[0], dict(zip(CARRY_KEYS, results[1:]))

@@ -1,0 +1,8 @@
+import os, sys, subprocess
+base = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for skip in ("", "ptsf", "ptss", "zp", "updates", "ptsf,ptss,zp", "ptsf,ptss,zp,updates"):
+    env = dict(os.environ, KTPU_PALLAS_SKIP=skip, BENCH_BATCH="512")
+    r = subprocess.run([sys.executable, os.path.join(base, "scripts", "profile_pallas.py")],
+                       env=env, capture_output=True, text=True, timeout=1500)
+    line = [l for l in r.stdout.split("\n") if "steady" in l]
+    print(f"skip={skip or '<none>':24s} {line[0] if line else 'FAILED: ' + r.stderr.strip()[-120:]}")
