@@ -57,6 +57,7 @@ def main() -> None:
 
     hoisted = os.environ.get("BENCH_HOISTED", "1") == "1"
     session = hoisted and os.environ.get("BENCH_SESSION", "1") == "1"
+    use_pallas = session and os.environ.get("BENCH_PALLAS", "1") == "1"
 
     t0 = time.perf_counter()
     nodes, init_pods = synth_cluster(n_nodes, pods_per_node=2)
@@ -116,7 +117,7 @@ def main() -> None:
             ]
 
         def harvest(pods, ys):
-            for pod, best in zip(pods, HoistedSession.decisions(ys)):
+            for pod, best in zip(pods, type(sess).decisions(ys)):
                 if best < 0:
                     continue
                 pod.spec.node_name = enc.node_names[best]
@@ -133,7 +134,23 @@ def main() -> None:
             if fp not in seen:
                 seen.add(fp)
                 templates.append(pa)
-        sess = HoistedSession(enc.device_state(), templates)
+        if use_pallas:
+            # single-launch pallas kernel (ops/pallas_scan.py): the whole
+            # batch scan is ONE kernel; falls back to the jnp session if
+            # the cluster shape is unsupported
+            from kubernetes_tpu.ops.pallas_scan import (
+                PallasSession,
+                PallasUnsupported,
+            )
+
+            try:
+                sess = PallasSession(enc.device_state(), templates)
+                log("scan kernel: pallas single-launch")
+            except PallasUnsupported as e:
+                log(f"pallas unsupported ({e}); using jnp session")
+                sess = HoistedSession(enc.device_state(), templates)
+        else:
+            sess = HoistedSession(enc.device_state(), templates)
         for i in range(0, n_warm, batch):  # compile prologue + scan + harvest
             pods = pending[i : i + batch]
             harvest(pods, sess.schedule(encode_batch(pods)))

@@ -140,8 +140,13 @@ class PallasSession:
         self.T, self.C, self.N = T, C, N
         Np = _ceil(N, LANE)
         self.Np = Np
+        CP = SUB  # constraint rows padded to 8 per template: dynamic
+        # (CP, Np) block reads at t*CP are provably 8-aligned for Mosaic
+        if C > CP:
+            raise PallasUnsupported(f"{C} constraints > {CP} per template")
         TC = T * C
-        TCp = _ceil(TC, SUB)
+        TCp = T * CP
+        self.CP = CP
         self.TCp = TCp
         R = c["alloc"].shape[1]
         self.R = R
@@ -263,11 +268,11 @@ class PallasSession:
 
         def gather_rows(side, cnt_tcv, perno, perno_src=None):
             """[T, C, Vnp] pair counts -> per-NODE count rows [TCp, Np]:
-            row (t,c), lane n = count of the pair node n belongs to."""
+            row (t*CP+c), lane n = count of the pair node n belongs to."""
             out = np.zeros((TCp, Np), np.int32)
             for t in range(T):
                 for cc in range(C):
-                    row = t * C + cc
+                    row = t * CP + cc
                     if perno[t, cc] and perno_src is not None:
                         out[row, :N] = perno_src[t, cc]
                     else:
@@ -287,7 +292,7 @@ class PallasSession:
         zvalid_s = np.zeros((TCp, VZ), np.int32)
         for t in range(T):
             for cc in range(C):
-                row = t * C + cc
+                row = t * CP + cc
                 if S["f_valid"][t, cc]:
                     column = col("f", t, cc)
                     prow_f[row, :N] = np.where(valid_nodes, column, -1)
@@ -307,9 +312,11 @@ class PallasSession:
         if max(prow_f.max(), prow_s.max()) >= 2 ** 24:
             raise PallasUnsupported("pair ids exceed exact-f32 range")
 
-        def tcn(a):  # [T, N, C] bool -> [TCp, Np] i32
+        def tcn(a):  # [T, N, C] bool -> [TCp, Np] i32 (stride CP)
             out = np.zeros((TCp, Np), np.int32)
-            out[:TC, :N] = np.transpose(a, (0, 2, 1)).reshape(TC, N)
+            for t in range(T):
+                for cc in range(C):
+                    out[t * CP + cc, :N] = a[t, :, cc]
             return out
 
         self._konn_f = tcn(S["f_key_on_node"])
@@ -322,15 +329,17 @@ class PallasSession:
         self._valid_n = vn
 
         # row -> template one-hot [T, TCp, VZ] and identity [TCp, LANE]
-        if TC > LANE:
-            raise PallasUnsupported(f"T*C={TC} exceeds {LANE} match lanes")
+        if TCp > LANE:
+            raise PallasUnsupported(f"T*CP={TCp} exceeds {LANE} match lanes")
         rowt = np.zeros((T, TCp, VZ), np.int32)
         for t in range(T):
-            rowt[t, t * C:(t + 1) * C, :] = 1
+            rowt[t, t * CP:t * CP + C, :] = 1
         self._rowt = rowt
+        # identity mapping match-lane (t*CP+cc) -> row (t*CP+cc)
         eye = np.zeros((TCp, LANE), np.float32)
-        for i in range(TC):
-            eye[i, i] = 1.0
+        for i in range(TCp):
+            if i < LANE:
+                eye[i, i] = 1.0
         self._eye = eye
 
         # SMEM scalar table
@@ -378,7 +387,7 @@ class PallasSession:
                 prow_f=z(self._prow_f), prow_s=z(self._prow_s),
                 scalars=z(self._scalars),
                 shapes=(self.T, self.C, self.Np, self.R, self.SR,
-                        self.TCp, self.K),
+                        self.TCp, self.K, self.CP),
                 weights=tuple(sorted(self.weights.items())),
                 interpret=self.interpret,
             )
@@ -396,12 +405,15 @@ class PallasSession:
             tmpl[i] = self._fps[template_fingerprint(pa)]
         batch_self, _ = _batch_inputs(pod_arrays_list, tmpl[:B])
         mf, ms = _match_matrices(self._tp, batch_self)
-        T, C = self.T, self.C
-        # [Bp, LANE]: lane r = constraint-row r (read per-pod as one row)
+        T, C, CP = self.T, self.C, self.CP
+        # [Bp, LANE]: lane (t*CP+c) = that constraint row, per pod
         mfT = np.zeros((Bp, LANE), np.int32)
         msT = np.zeros((Bp, LANE), np.int32)
-        mfT[:B, :T * C] = np.asarray(mf).transpose(1, 0, 2).reshape(B, T * C)
-        msT[:B, :T * C] = np.asarray(ms).transpose(1, 0, 2).reshape(B, T * C)
+        mfa = np.asarray(mf)
+        msa = np.asarray(ms)
+        for t in range(T):
+            mfT[:B, t * CP:t * CP + C] = mfa[t].reshape(B, C)
+            msT[:B, t * CP:t * CP + C] = msa[t].reshape(B, C)
         if self._carry is None:
             self._carry = self._initial_carry()
         out, self._carry = _dispatch(
@@ -423,7 +435,7 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
 
     skip = frozenset(
         _os.environ.get("KTPU_PALLAS_SKIP", "").split(","))  # profiling only
-    T, C, Np, R, SR, TCp, K = shapes
+    T, C, Np, R, SR, TCp, K, CP = shapes
     W = dict(weights)
     row_len = 2 * R + 4
     off_tc = T * row_len
@@ -448,12 +460,6 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
         out_ref[:] = jnp.full((SUB, Bp), -1, jnp.int32)
 
         sc = sc_ref
-        lane_n = jax.lax.broadcasted_iota(jnp.int32, (1, Np), 1)
-        valid_n = validn_ref[0:1, :]
-        alloc = alloc_ref[:]
-        allowed = nzpc_in[3:4, :]
-        prow_f = prowf_ref[:]        # (TCp, Np) raw pair id per node
-        prow_s = prows_ref[:]
         f32 = jnp.float32
 
         def sm_t(t, i):
@@ -483,6 +489,12 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
         def body(b, _):
             b = b.astype(jnp.int32)
             t = tmpl_ref[b]
+            # NOTHING big is hoisted out of the loop: values live across
+            # iterations spill out of vector registers and the
+            # spill/restore swamps the step (measured; see PERF_NOTES)
+            lane_n = jax.lax.broadcasted_iota(jnp.int32, (1, Np), 1)
+            valid_n = validn_ref[0:1, :]
+            allowed = nzpc_in[3:4, :]
 
             def trow(i):
                 return stat_ref[pl.ds(t * SR + i, 1), :]
@@ -495,49 +507,58 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
             sc_avoid = trow(5)
             ipa_present = sm_t(t, 2 * R + 3)
 
-            requested = requested_ref[:]
-            nzpc = nzpc_ref[:]
 
             # ---- NodeResourcesFit (exact int32 after GCD rescale) ----
             over = jnp.zeros((1, Np), jnp.bool_)
             for r in range(R):
-                free = alloc[r:r + 1, :] - requested[r:r + 1, :]
+                free = alloc_ref[r:r + 1, :] - requested_ref[r:r + 1, :]
                 over = over | ((sm_t(t, r) > free) & (sm_t(t, R + r) != 0))
             fail_dims = (sm_t(t, 2 * R) != 0) & over
-            fail_count = (nzpc[2:3, :] + jnp.int32(1)) > allowed
+            fail_count = (nzpc_ref[2:3, :] + jnp.int32(1)) > allowed
             mask_fit = jnp.logical_not(fail_count | fail_dims)
 
-            # ---- PTS filter (per-node counts: zone and hostname unify) --
-            fail_pts = jnp.zeros((1, Np), jnp.bool_)
-            for cc in range(C) if "ptsf" not in skip else ():
-                row = t * C + cc
-                vld = sm_tc(W_F_VALID, t, cc) != 0
-                sh = jnp.zeros((1, Np), f32)
-                for cj in range(C):
-                    same = sm_fsame(t, cc, cj).astype(f32)
-                    rj = t * C + cj
-                    sh = sh + same * cntfn_ref[pl.ds(rj, 1), :].astype(f32)
-                reg = regrowf_ref[pl.ds(row, 1), :]
+            # ---- PTS filter (per-node counts; all C constraints as one
+            # (C, Np) block — fewer dynamic reads, wider VPU ops) ----
+            if "ptsf" in skip:
+                fail_pts = jnp.zeros((1, Np), jnp.bool_)
+            else:
+                base = pl.multiple_of(t * CP, SUB)
+                cntf = cntfn_ref[pl.ds(base, CP), :].astype(f32)   # (CP, Np)
+                sameM = _sq_from_smem(sm_fsame, t, C, CP)          # (CP, CP)
+                sh = jax.lax.dot_general(
+                    sameM, cntf, (((1,), (0,)), ((), ())),
+                    preferred_element_type=f32,
+                    precision=jax.lax.Precision.HIGHEST)           # (CP, Np)
+                reg = regrowf_ref[pl.ds(base, CP), :]
                 big = f32(POS_BIG)
-                min_c = jnp.min(jnp.where(reg != 0, sh, big))
+                min_c = jnp.min(jnp.where(reg != 0, sh, big),
+                                axis=1, keepdims=True)             # (C, 1)
                 min_c = jnp.where(min_c == big, f32(0.0), min_c)
                 cnt_n = jnp.where(reg != 0, sh, f32(0.0))
-                konn = konnf_ref[pl.ds(row, 1), :]
-                fail_missing = vld & (konn == 0)
-                skew = cnt_n + sm_tc(W_F_SELF, t, cc).astype(f32) - min_c
-                fail_skew = (vld & (konn != 0)
-                             & (skew > sm_tc(W_F_SKEW, t, cc).astype(f32)))
-                fail_pts = fail_pts | fail_missing | fail_skew
+                konn = konnf_ref[pl.ds(base, CP), :]
+                vld = _col_tc(sc, sm_tc, W_F_VALID, t, C, CP)      # (CP, 1)
+                selfm = _col_tc(sc, sm_tc, W_F_SELF, t, C, CP)
+                maxskew = _col_tc(sc, sm_tc, W_F_SKEW, t, C, CP)
+                fail_missing = (vld != 0) & (konn == 0)
+                skew = cnt_n + selfm - min_c
+                fail_skew = (vld != 0) & (konn != 0) & (skew > maxskew)
+                # axis-0 reduction via ones-dot (Mosaic can't lower
+                # multi_reduction over the sublane axis here)
+                onesC = jnp.ones((1, CP), f32)
+                fail_pts = jax.lax.dot_general(
+                    onesC, (fail_missing | fail_skew).astype(f32),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=f32) > 0                # (1, Np)
 
             feasible = ((static_mask != 0) & mask_fit
                         & jnp.logical_not(fail_pts) & (valid_n != 0))
             n_feasible = jnp.sum(feasible.astype(f32)).astype(jnp.int32)
 
             # ---- resource scores ----
-            nz_cpu = (nzpc[0:1, :] + sm_t(t, 2 * R + 1)).astype(f32)
-            nz_mem = (nzpc[1:2, :] + sm_t(t, 2 * R + 2)).astype(f32)
-            cap_cpu = alloc[0:1, :].astype(f32)
-            cap_mem = alloc[1:2, :].astype(f32)
+            nz_cpu = (nzpc_ref[0:1, :] + sm_t(t, 2 * R + 1)).astype(f32)
+            nz_mem = (nzpc_ref[1:2, :] + sm_t(t, 2 * R + 2)).astype(f32)
+            cap_cpu = alloc_ref[0:1, :].astype(f32)
+            cap_mem = alloc_ref[1:2, :].astype(f32)
             frac_c = jnp.where(cap_cpu == 0, f32(1.0), nz_cpu / cap_cpu)
             frac_m = jnp.where(cap_mem == 0, f32(1.0), nz_mem / cap_mem)
             balanced = ((f32(1.0) - jnp.abs(frac_c - frac_m))
@@ -550,10 +571,10 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
                      // jnp.where(cap == 0, jnp.int32(1), cap))
                 return jnp.where((cap == 0) | (reqq > cap), jnp.int32(0), d)
 
-            least = (least_dim(alloc[0:1, :],
-                               nzpc[0:1, :] + sm_t(t, 2 * R + 1))
-                     + least_dim(alloc[1:2, :],
-                                 nzpc[1:2, :] + sm_t(t, 2 * R + 2))
+            least = (least_dim(alloc_ref[0:1, :],
+                               nzpc_ref[0:1, :] + sm_t(t, 2 * R + 1))
+                     + least_dim(alloc_ref[1:2, :],
+                                 nzpc_ref[1:2, :] + sm_t(t, 2 * R + 2))
                      ) // jnp.int32(2)
 
             # ---- PTS score ----
@@ -573,42 +594,54 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
             if "zp" in skip:
                 zp = [jnp.zeros((1, VZ), f32)] * K
                 zpn = [jnp.zeros((1, Np), f32)] * K
-            raw = jnp.zeros((1, Np), f32)
-            have_s = jnp.int32(0)
-            for cc in range(C) if "ptss" not in skip else ():
-                row = t * C + cc
-                vld = sm_tc(W_S_VALID, t, cc)
-                have_s = have_s | vld
-                perno = sm_tc(W_S_PERNO, t, cc) != 0
-                key = sm_tc(W_S_KEY, t, cc)
-                sh = jnp.zeros((1, Np), f32)
-                for cj in range(C):
-                    same = sm_ssame(t, cc, cj).astype(f32)
-                    rj = t * C + cj
-                    sh = sh + same * cntsn_ref[pl.ds(rj, 1), :].astype(f32)
-                zval_l = zvalid_ref[pl.ds(row, 1), :].astype(f32)  # (1, VZ)
-                zval_n = zvnode_ref[pl.ds(row, 1), :]              # (1, Np)
-                topo = f32(0.0)
-                regn = jnp.zeros((1, Np), f32)
+            zval_l = None  # (set in the vectorized score block)
+            if "ptss" in skip:
+                raw = jnp.zeros((1, Np), f32)
+                have_s = jnp.int32(0)
+            else:
+                base = pl.multiple_of(t * CP, SUB)
+                cnts = cntsn_ref[pl.ds(base, CP), :].astype(f32)   # (CP, Np)
+                sameS = _sq_from_smem(sm_ssame, t, C, CP)
+                sh = jax.lax.dot_general(
+                    sameS, cnts, (((1,), (0,)), ((), ())),
+                    preferred_element_type=f32,
+                    precision=jax.lax.Precision.HIGHEST)           # (CP, Np)
+                vld = _col_tc(sc, sm_tc, W_S_VALID, t, C, CP)      # (CP, 1)
+                perno = _col_tc(sc, sm_tc, W_S_PERNO, t, C, CP)
+                key = _col_tc(sc, sm_tc, W_S_KEY, t, C, CP)
+                first = _col_tc(sc, sm_tc, W_S_FIRST, t, C, CP)
+                sskew = _col_tc(sc, sm_tc, W_S_SKEW, t, C, CP)
+                have_s = (jnp.sum(
+                    jax.lax.dot_general(
+                        jnp.ones((1, CP), f32), vld,
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=f32)) > 0).astype(jnp.int32)
+                zval_l = zvalid_ref[pl.ds(base, CP), :].astype(f32)  # (CP, VZ)
+                zval_n = zvnode_ref[pl.ds(base, CP), :]              # (CP, Np)
+                topo = jnp.zeros((CP, 1), f32)
+                regn = jnp.zeros((CP, Np), f32)
                 for k in range(K):
-                    use = jnp.logical_not(perno) & (key == k)
-                    topo = topo + jnp.where(use, jnp.sum(zp[k] * zval_l),
-                                            f32(0.0))
-                    regn = regn + jnp.where(use, zpn[k], f32(0.0))
+                    use = (jnp.logical_not(perno != 0)
+                           & (key == k)).astype(f32)               # (C, 1)
+                    topo = topo + use * jnp.sum(zp[k] * zval_l, axis=1,
+                                                keepdims=True)
+                    regn = regn + use * zpn[k]
                 regn = regn * (zval_n != 0)
-                first = sm_tc(W_S_FIRST, t, cc)
                 topo_size = jnp.where(first != 0, topo, f32(0.0))
-                weight = jnp.log(jnp.where(perno, n_scored, topo_size)
-                                 + f32(2.0))
-                cnt_n = jnp.where(perno, sh,
+                weight = jnp.log(jnp.where(perno != 0, n_scored, topo_size)
+                                 + f32(2.0))                       # (C, 1)
+                cnt_n = jnp.where(perno != 0, sh,
                                   jnp.where(regn > 0, sh, f32(0.0)))
-                konn = konns_ref[pl.ds(row, 1), :]
+                konn = konns_ref[pl.ds(base, CP), :]
                 term = jnp.where(
                     (vld != 0) & (konn != 0),
-                    cnt_n * weight + (sm_tc(W_S_SKEW, t, cc).astype(f32)
-                                      - f32(1.0)),
+                    cnt_n * weight + (sskew - f32(1.0)),
                     f32(0.0))
-                raw = raw + term
+                raw = jax.lax.dot_general(
+                    jnp.ones((1, CP), f32), term,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=f32,
+                    precision=jax.lax.Precision.HIGHEST)           # (1, Np)
             raw_i = raw.astype(jnp.int32)
             min_r = jnp.min(jnp.where(scored, raw_i, jnp.int32(POS_BIG)))
             max_r = jnp.max(jnp.where(scored, raw_i, jnp.int32(0)))
@@ -682,27 +715,28 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
             # per-row match weights: column b of mf/ms via identity-dot
             mf_vec = mf_ref[pl.ds(b, 1), :].astype(f32)      # (1, LANE)
             ms_vec = ms_ref[pl.ds(b, 1), :].astype(f32)
-            eye = eye_ref[:]                                 # (TCp, LANE)
             mf_col = jax.lax.dot_general(
-                eye, mf_vec, (((1,), (1,)), ((), ())),
+                eye_ref[:], mf_vec, (((1,), (1,)), ((), ())),
                 preferred_element_type=f32)                  # (TCp, 1)
             ms_col = jax.lax.dot_general(
-                eye, ms_vec, (((1,), (1,)), ((), ())),
+                eye_ref[:], ms_vec, (((1,), (1,)), ((), ())),
                 preferred_element_type=f32)
 
             # pair id at best, per row (one matvec each side); same-pair
             # lanes get the count delta — hostname rows degenerate to
             # same-NODE exactly like the pair-space update they mirror
-            pf = prow_f.astype(f32)
-            ps_ = prow_s.astype(f32)
+            pf = prowf_ref[:].astype(f32)
             zb_f = jax.lax.dot_general(
                 pf, hotf, (((1,), (1,)), ((), ())),
-                preferred_element_type=f32)                  # (TCp, 1)
+                preferred_element_type=f32,
+                precision=jax.lax.Precision.HIGHEST)         # (TCp, 1)
+            m_f = ((pf == zb_f) & (prowf_ref[:] >= 0)).astype(f32) * okf
+            ps_ = prows_ref[:].astype(f32)
             zb_s = jax.lax.dot_general(
                 ps_, hotf, (((1,), (1,)), ((), ())),
-                preferred_element_type=f32)
-            m_f = ((pf == zb_f) & (prow_f >= 0)).astype(f32) * okf
-            m_s = ((ps_ == zb_s) & (prow_s >= 0)).astype(f32) * okf
+                preferred_element_type=f32,
+                precision=jax.lax.Precision.HIGHEST)
+            m_s = ((ps_ == zb_s) & (prows_ref[:] >= 0)).astype(f32) * okf
 
             # s_src factor at best per row's template (zone rows only; the
             # per-node/hostname update has no src gate, mirroring _step)
@@ -739,14 +773,41 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
     return kernel
 
 
+def _sq_from_smem(sm_pair, t, C, CP):
+    """(CP, CP) f32 same-key matrix from SMEM scalars.
+
+    Built as a sum of scalar x static-one-hot constants — Mosaic cannot
+    shape-cast stacked scalars into 2D."""
+    i0 = jax.lax.broadcasted_iota(jnp.int32, (CP, CP), 0)
+    i1 = jax.lax.broadcasted_iota(jnp.int32, (CP, CP), 1)
+    out = jnp.zeros((CP, CP), jnp.float32)
+    for ci in range(C):
+        for cj in range(C):
+            e = ((i0 == ci) & (i1 == cj)).astype(jnp.float32)
+            out = out + sm_pair(t, ci, cj).astype(jnp.float32) * e
+    return out
+
+
+def _col_tc(sc, sm_tc, which, t, C, CP):
+    """(CP, 1) f32 column of per-(t, c) SMEM scalars (one-hot sums)."""
+    i0 = jax.lax.broadcasted_iota(jnp.int32, (CP, 1), 0)
+    out = jnp.zeros((CP, 1), jnp.float32)
+    for cc in range(C):
+        e = (i0 == cc).astype(jnp.float32)
+        out = out + sm_tc(which, t, cc).astype(jnp.float32) * e
+    return out
+
+
 def _stack_tc(sc, sm_tc, which, T, C, TCp):
-    """(TCp, 1) f32 built from per-(t,c) SMEM scalars (static unroll)."""
-    rows = []
+    """(TCp, 1) f32 from per-(t,c) SMEM scalars (one-hot sums)."""
+    CP = TCp // T
+    i0 = jax.lax.broadcasted_iota(jnp.int32, (TCp, 1), 0)
+    out = jnp.zeros((TCp, 1), jnp.float32)
     for t in range(T):
         for cc in range(C):
-            rows.append((sm_tc(which, t, cc) != 0).astype(jnp.float32))
-    rows += [jnp.float32(0.0)] * (TCp - T * C)
-    return jnp.stack(rows).reshape(TCp, 1)
+            e = (i0 == (t * CP + cc)).astype(jnp.float32)
+            out = out + (sm_tc(which, t, cc) != 0).astype(jnp.float32) * e
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("bundle", "B_real"),
