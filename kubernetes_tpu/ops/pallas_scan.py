@@ -124,6 +124,11 @@ class PallasSession:
         self._fps = {
             template_fingerprint(t): i for i, t in enumerate(template_arrays_list)
         }
+        # first-max tie-break + score output rely on f32-exact totals:
+        # every plugin score is <= MAX_NODE_SCORE after normalization
+        if sum(abs(int(v)) for v in self.weights.values()) \
+                * (MAX_NODE_SCORE + 1) >= 2 ** 24:
+            raise PallasUnsupported("weights too large for exact f32 totals")
         tp = _stack_templates(template_arrays_list)
         self._tp = tp
         S = {k: np.asarray(v) for k, v in _session_prologue(cluster, tp).items()}
@@ -192,9 +197,11 @@ class PallasSession:
             S["cnt_nodeaff"], S["sc_image"], S["sc_avoid"],
             np.zeros_like(S["static_mask"]), S["s_src"],
         ]
-        if any(np.abs(a.astype(np.int64)).max(initial=0) >= 2 ** 31
+        if any(np.abs(a.astype(np.int64)).max(initial=0) >= POS_BIG
                for a in stat_rows):
-            raise PallasUnsupported("static score magnitude exceeds int32")
+            # POS_BIG (2^30), not 2^31: the kernel's min/max sentinels must
+            # stay strictly above any genuine value
+            raise PallasUnsupported("static score magnitude exceeds sentinel")
         SR = len(stat_rows)  # == 8
         self.SR = SR
         stat = np.stack([a.astype(np.int32) for a in stat_rows], axis=1)
@@ -213,9 +220,12 @@ class PallasSession:
         uid_of: Dict[bytes, int] = {}
         uids: List[np.ndarray] = []
 
-        def classify(side, force_host=None):
+        def classify(side, force_host=None, intern=True):
             """-> (keyid [T,C], perno [T,C] bool): perno = per-node count
-            representation; otherwise compact key `keyid`."""
+            representation; otherwise compact key `keyid`. With
+            intern=False only perno is computed (the filter path works
+            entirely per-node and must not consume the key/value budgets
+            that exist for score-side registration)."""
             keyid = np.full((T, C), -1, np.int32)
             perno = np.zeros((T, C), bool)
             for t in range(T):
@@ -227,6 +237,8 @@ class PallasSession:
                                else node_distinct(column))
                     if is_host:
                         perno[t, cc] = True
+                        continue
+                    if not intern:
                         continue
                     key = column.tobytes()
                     u = uid_of.get(key)
@@ -240,7 +252,7 @@ class PallasSession:
         # score side MUST follow the prologue's hostname flag (it selects
         # the log(n_scored) weight semantics, not just a representation)
         s_hostflag = S["s_hostname"].astype(bool)
-        fk, fh = classify("f")
+        fk, fh = classify("f", intern=False)
         sk, sh = classify("s", force_host=s_hostflag)
         # a non-hostname score constraint whose pairs are node-distinct
         # would blow the 128-lane vocab — unsupported
@@ -417,7 +429,7 @@ class PallasSession:
         if self._carry is None:
             self._carry = self._initial_carry()
         out, self._carry = _dispatch(
-            self._get_bundle(), B, self._carry,
+            self._get_bundle(), jnp.asarray([B], jnp.int32), self._carry,
             jnp.asarray(tmpl), jnp.asarray(mfT), jnp.asarray(msT))
         return {"rows": out, "n": B}
 
@@ -430,7 +442,7 @@ class PallasSession:
 # kernel
 
 
-def _build_kernel(shapes, weights, Bp: int, B_real: int):
+def _build_kernel(shapes, weights, Bp: int):
     import os as _os
 
     skip = frozenset(
@@ -444,7 +456,7 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
     (W_F_VALID, W_S_VALID, W_F_SKEW, W_S_SKEW, W_F_SELF, W_S_FIRST,
      W_F_KEY, W_S_KEY, W_F_PERNO, W_S_PERNO) = range(10)
 
-    def kernel(tmpl_ref, sc_ref, mf_ref, ms_ref,
+    def kernel(breal_ref, tmpl_ref, sc_ref, mf_ref, ms_ref,
                alloc_ref, stat_ref, onehot_ref, regrowf_ref, zvnode_ref,
                zvalid_ref, konnf_ref, konns_ref, shasall_ref, validn_ref,
                rowt_ref, eye_ref, prowf_ref, prows_ref,
@@ -536,9 +548,9 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
                 min_c = jnp.where(min_c == big, f32(0.0), min_c)
                 cnt_n = jnp.where(reg != 0, sh, f32(0.0))
                 konn = konnf_ref[pl.ds(base, CP), :]
-                vld = _col_tc(sc, sm_tc, W_F_VALID, t, C, CP)      # (CP, 1)
-                selfm = _col_tc(sc, sm_tc, W_F_SELF, t, C, CP)
-                maxskew = _col_tc(sc, sm_tc, W_F_SKEW, t, C, CP)
+                vld = _col_tc(sm_tc, W_F_VALID, t, C, CP)      # (CP, 1)
+                selfm = _col_tc(sm_tc, W_F_SELF, t, C, CP)
+                maxskew = _col_tc(sm_tc, W_F_SKEW, t, C, CP)
                 fail_missing = (vld != 0) & (konn == 0)
                 skew = cnt_n + selfm - min_c
                 fail_skew = (vld != 0) & (konn != 0) & (skew > maxskew)
@@ -606,11 +618,11 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
                     sameS, cnts, (((1,), (0,)), ((), ())),
                     preferred_element_type=f32,
                     precision=jax.lax.Precision.HIGHEST)           # (CP, Np)
-                vld = _col_tc(sc, sm_tc, W_S_VALID, t, C, CP)      # (CP, 1)
-                perno = _col_tc(sc, sm_tc, W_S_PERNO, t, C, CP)
-                key = _col_tc(sc, sm_tc, W_S_KEY, t, C, CP)
-                first = _col_tc(sc, sm_tc, W_S_FIRST, t, C, CP)
-                sskew = _col_tc(sc, sm_tc, W_S_SKEW, t, C, CP)
+                vld = _col_tc(sm_tc, W_S_VALID, t, C, CP)      # (CP, 1)
+                perno = _col_tc(sm_tc, W_S_PERNO, t, C, CP)
+                key = _col_tc(sm_tc, W_S_KEY, t, C, CP)
+                first = _col_tc(sm_tc, W_S_FIRST, t, C, CP)
+                sskew = _col_tc(sm_tc, W_S_SKEW, t, C, CP)
                 have_s = (jnp.sum(
                     jax.lax.dot_general(
                         jnp.ones((1, CP), f32), vld,
@@ -690,7 +702,7 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
             m = jnp.max(tf)
             idx = jnp.where(tf >= m, lane_n, jnp.int32(POS_BIG))
             best = jnp.min(idx).astype(jnp.int32)
-            ok = (m >= 0) & (b < B_real)
+            ok = m >= 0  # b < B_real: loop bound is dynamic
             oki = ok.astype(jnp.int32)
             okf = oki.astype(f32)
 
@@ -746,8 +758,7 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
                 v = jnp.sum(
                     jnp.where(lane_n == best, srow, jnp.int32(0)).astype(f32))
                 srcrow = srcrow + rowt_ref[tt][:, 0:1].astype(f32) * v
-            pernosel = _stack_tc(
-                sc, sm_tc, W_S_PERNO, T, C, TCp)             # (TCp, 1)
+            pernosel = _stack_tc(sm_tc, W_S_PERNO, T, C, TCp)             # (TCp, 1)
             factor = pernosel + (f32(1.0) - pernosel) * srcrow
 
             cntfn_ref[:] = (cntfn_ref[:].astype(f32)
@@ -768,7 +779,7 @@ def _build_kernel(shapes, weights, Bp: int, B_real: int):
             out_ref[:] = o
             return jnp.int32(0)
 
-        jax.lax.fori_loop(jnp.int32(0), jnp.int32(B_real), body, jnp.int32(0))
+        jax.lax.fori_loop(jnp.int32(0), breal_ref[0], body, jnp.int32(0))
 
     return kernel
 
@@ -788,7 +799,7 @@ def _sq_from_smem(sm_pair, t, C, CP):
     return out
 
 
-def _col_tc(sc, sm_tc, which, t, C, CP):
+def _col_tc(sm_tc, which, t, C, CP):
     """(CP, 1) f32 column of per-(t, c) SMEM scalars (one-hot sums)."""
     i0 = jax.lax.broadcasted_iota(jnp.int32, (CP, 1), 0)
     out = jnp.zeros((CP, 1), jnp.float32)
@@ -798,7 +809,7 @@ def _col_tc(sc, sm_tc, which, t, C, CP):
     return out
 
 
-def _stack_tc(sc, sm_tc, which, T, C, TCp):
+def _stack_tc(sm_tc, which, T, C, TCp):
     """(TCp, 1) f32 from per-(t,c) SMEM scalars (one-hot sums)."""
     CP = TCp // T
     i0 = jax.lax.broadcasted_iota(jnp.int32, (TCp, 1), 0)
@@ -810,11 +821,13 @@ def _stack_tc(sc, sm_tc, which, T, C, TCp):
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("bundle", "B_real"),
+@functools.partial(jax.jit, static_argnames=("bundle",),
                    donate_argnames=("carry",))
-def _dispatch(bundle: _Bundle, B_real: int, carry: Dict, tmpl, mfT, msT):
+def _dispatch(bundle: _Bundle, B_real, carry: Dict, tmpl, mfT, msT):
+    # B_real is a DYNAMIC (SMEM) scalar: variable batch lengths must not
+    # recompile the kernel (only the padded width Bp is static)
     Bp = int(tmpl.shape[0])
-    kernel = _build_kernel(bundle.shapes, bundle.weights, Bp, B_real)
+    kernel = _build_kernel(bundle.shapes, bundle.weights, Bp)
     carry_in = [carry[k] for k in CARRY_KEYS]
     out_shape = (
         jax.ShapeDtypeStruct((SUB, Bp), jnp.int32),
@@ -822,7 +835,7 @@ def _dispatch(bundle: _Bundle, B_real: int, carry: Dict, tmpl, mfT, msT):
     )
     vm = pl.BlockSpec(memory_space=pltpu.VMEM)
     sm = pl.BlockSpec(memory_space=pltpu.SMEM)
-    n_pre = 18  # inputs before the 4 carries
+    n_pre = 19  # inputs before the 4 carries
     # trace the kernel with x64 OFF: every input is explicitly 32-bit,
     # and weak python literals must not widen ops to i64/f64 (Mosaic has
     # no 64-bit types)
@@ -832,12 +845,12 @@ def _dispatch(bundle: _Bundle, B_real: int, carry: Dict, tmpl, mfT, msT):
         results = pl.pallas_call(
             kernel,
             out_shape=out_shape,
-            in_specs=[sm, sm, vm, vm] + [vm] * 14 + [vm] * 4,
+                in_specs=[sm, sm, sm, vm, vm] + [vm] * 14 + [vm] * 4,
             out_specs=tuple([vm] * (1 + len(carry_in))),
             input_output_aliases={n_pre + i: 1 + i
                                   for i in range(len(carry_in))},
             interpret=bundle.interpret,
-        )(tmpl, bundle.scalars, mfT, msT,
+        )(B_real, tmpl, bundle.scalars, mfT, msT,
           bundle.alloc, bundle.stat, bundle.onehot, bundle.regrow_f,
           bundle.zvalid_node_s, bundle.zvalid_s, bundle.konn_f,
           bundle.konn_s, bundle.shasall, bundle.valid_n, bundle.rowt,

@@ -115,3 +115,40 @@ class TestPallasParity:
         pending = synth_pending_pods(24, spread=True)
         ref, got = _run_pair(nodes, init_pods, pending, batch=24)
         assert got == ref
+
+
+class TestPallasGuards:
+    def test_large_weights_unsupported(self):
+        from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+        nodes, init_pods = synth_cluster(4, pods_per_node=1)
+        pending = synth_pending_pods(4, spread=True)
+        enc, pe = _presized_encoding(nodes, init_pods, pending)
+        arrays = _encode_all(enc, pe, pending)
+        with pytest.raises(PallasUnsupported):
+            PallasSession(enc.device_state(), _templates_of(arrays),
+                          weights={"balanced": 1, "image": 1, "ipa": 1,
+                                   "least": 1, "node_affinity": 1,
+                                   "prefer_avoid": 10 ** 6, "pts": 2,
+                                   "taint": 1}, interpret=True)
+
+    def test_variable_batch_lengths_share_one_compile(self):
+        """B_real is dynamic: batches of different lengths (same padded
+        width) must hit the same compiled kernel and stay exact."""
+        import copy
+        nodes, init_pods = synth_cluster(8, pods_per_node=1)
+        pending = synth_pending_pods(20, spread=True)
+        ref, got = [], []
+        enc, pe = _presized_encoding(
+            copy.deepcopy(nodes), copy.deepcopy(init_pods),
+            copy.deepcopy(pending))
+        arrays = _encode_all(enc, pe, pending)
+        js = HoistedSession(enc.device_state(), _templates_of(arrays))
+        for lo, hi in ((0, 7), (7, 12), (12, 20)):  # lengths 7, 5, 8
+            ref.extend(HoistedSession.decisions(js.schedule(arrays[lo:hi])))
+        enc2, pe2 = _presized_encoding(nodes, init_pods, pending)
+        arrays2 = _encode_all(enc2, pe2, pending)
+        ps = PallasSession(enc2.device_state(), _templates_of(arrays2),
+                           interpret=True)
+        for lo, hi in ((0, 7), (7, 12), (12, 20)):
+            got.extend(PallasSession.decisions(ps.schedule(arrays2[lo:hi])))
+        assert got == ref
