@@ -67,10 +67,15 @@ class TPUBackend(CacheListener):
         # confirmation arrives later through on_add_pod and must not
         # invalidate). Any other mutation tears the session down; the next
         # batch rebuilds it from the synced encoding.
-        self._session: Optional[HoistedSession] = None
+        self._session = None  # HoistedSession or pallas PallasSession
         self._session_assumed: set = set()
         self._known_templates: Dict = {}  # fingerprint -> pod arrays
         self.MAX_SESSION_TEMPLATES = 8
+        # pallas rides only on real TPUs: on CPU (tests, dryruns) the
+        # interpreter would be pathologically slow and compile-heavy
+        import jax
+
+        self.use_pallas = jax.devices()[0].platform == "tpu"
 
     def _invalidate_session(self) -> None:
         # _session_assumed survives invalidation deliberately: an assume
@@ -248,12 +253,23 @@ class TPUBackend(CacheListener):
                     break
             self._invalidate_session()
         if self._session is None:
-            self._session = HoistedSession(
-                self.enc.device_state(),
-                list(self._known_templates.values()),
-                self.weights,
-            )
-        return HoistedSession.decisions(self._session.schedule(arrays))
+            self._session = self._build_session()
+        return type(self._session).decisions(self._session.schedule(arrays))
+
+    def _build_session(self):
+        """Pallas single-launch session when the cluster shape supports it
+        (ops/pallas_scan.py), else the jnp lax.scan session — identical
+        decisions either way (tests/test_pallas_scan.py)."""
+        templates = list(self._known_templates.values())
+        cluster = self.enc.device_state()
+        if self.use_pallas:
+            from ..ops.pallas_scan import PallasSession, PallasUnsupported
+
+            try:
+                return PallasSession(cluster, templates, self.weights)
+            except PallasUnsupported:
+                pass
+        return HoistedSession(cluster, templates, self.weights)
 
     # -- helpers -----------------------------------------------------------
 
