@@ -224,11 +224,25 @@ class ResourceRequirements:
 
 
 @dataclass
+class Probe:
+    """Liveness/readiness probe (core/v1 Probe; the exec handler is the
+    one with runtime behavior here — CRI ExecSync)."""
+
+    exec_command: Optional[List[str]] = None
+    initial_delay_seconds: float = 0.0
+    period_seconds: float = 10.0
+    failure_threshold: int = 3
+    success_threshold: int = 1
+
+
+@dataclass
 class Container:
     name: str = ""
     image: str = ""
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
     ports: Optional[List[ContainerPort]] = None
+    liveness_probe: Optional[Probe] = None
+    readiness_probe: Optional[Probe] = None
 
 
 @dataclass

@@ -72,6 +72,8 @@ class FakeRuntimeService:
         self._ip_counter = 0
         # test hooks: container name -> exit code to fail with on start
         self.fail_starts: Dict[str, int] = {}
+        # container name -> exit code ExecSync returns (probes use this)
+        self.exec_results: Dict[str, int] = {}
 
     def _latency(self) -> None:
         if self._op_latency > 0:
@@ -227,7 +229,7 @@ class FakeRuntimeService:
             return (
                 f"pid 1: {c.name} ({c.image}) uptime "
                 f"{time.time() - c.started_at:.1f}s\n",
-                0,
+                self.exec_results.get(c.name, 0),
             )
 
     # -- test helpers ------------------------------------------------------

@@ -29,6 +29,7 @@ from ..api import types as v1
 from ..apiserver.server import APIError
 from ..client.informer import EventHandler
 from .cm import AdmissionError
+from .prober import ProbeManager
 from .cri import (
     CONTAINER_CREATED,
     CONTAINER_EXITED,
@@ -84,6 +85,7 @@ class Kubelet:
         self.device_manager = device_manager
         self.cpu_manager = cpu_manager
         self.pleg = PLEG(self.runtime)
+        self.prober = ProbeManager(self.runtime)
         self.stats_provider = stats_provider or (lambda: 0.0)
         self.pod_informer = informer_factory.informer_for("pods")
         self._workers: Dict[str, _PodWorker] = {}
@@ -293,11 +295,54 @@ class Kubelet:
         except Exception:  # noqa: BLE001
             traceback.print_exc()
             return
+        self._probe_pass()
         touched = {e.pod_uid for e in events}
         with self._pods_lock:
             pods = {uid: p for uid, p in self._pods.items() if uid in touched}
         for pod in pods.values():
             self._dispatch(pod, deleting=False)
+
+    def _probe_pass(self) -> None:
+        """Run due probes for every desired pod (prober tick on the PLEG
+        cadence); readiness flips re-dispatch the pod so the status
+        manager publishes the change promptly. One runtime listing per
+        pass (not per pod), and probe-less pods are skipped outright."""
+        with self._pods_lock:
+            pods = list(self._pods.items())
+        self.prober.prune(uid for uid, _ in pods)
+        probed = [
+            (uid, pod) for uid, pod in pods
+            if any(sp.liveness_probe or sp.readiness_probe
+                   for sp in pod.spec.containers)
+        ]
+        if not probed:
+            return
+        ready_sandboxes = {
+            sb.id: sb.pod_uid
+            for sb in self.runtime.list_pod_sandboxes()
+            if sb.state == SANDBOX_READY
+        }
+        by_uid: Dict[str, list] = {}
+        for c in self.runtime.list_containers():
+            u = ready_sandboxes.get(c.sandbox_id)
+            if u is not None:
+                by_uid.setdefault(u, []).append(c)
+        for uid, pod in probed:
+            def readiness(p=pod, u=uid):
+                return {
+                    sp.name: self.prober.is_ready(
+                        u, sp.name, has_probe=sp.readiness_probe is not None)
+                    for sp in p.spec.containers
+                }
+
+            before = readiness()
+            try:
+                self.prober.tick(uid, pod, by_uid.get(uid, []))
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+                continue
+            if readiness() != before:
+                self._dispatch(pod, deleting=False)
 
     def _resync_all(self) -> None:
         with self._pods_lock:
@@ -540,6 +585,7 @@ class Kubelet:
 
     def _terminate_pod(self, uid: str) -> None:
         """Pod removed from desired state: tear down runtime state."""
+        self.prober.remove_pod(uid)
         if self.device_manager is not None:
             self.device_manager.remove_pod(uid)
         if self.cpu_manager is not None:
@@ -584,8 +630,15 @@ class Kubelet:
         phase = self._phase(pod, containers, restart_policy)
         statuses = []
         all_ready = bool(containers) and len(containers) == len(pod.spec.containers)
+        uid = self._pod_uid(pod)
+        spec_by_name = {sp.name: sp for sp in pod.spec.containers}
         for c in containers:
-            ready = c.state == CONTAINER_RUNNING
+            sp = spec_by_name.get(c.name)
+            ready = (c.state == CONTAINER_RUNNING
+                     and self.prober.is_ready(
+                         uid, c.name,
+                         has_probe=sp is not None
+                         and sp.readiness_probe is not None))
             all_ready = all_ready and ready
             statuses.append(
                 v1.ContainerStatus(

@@ -1,0 +1,170 @@
+"""Liveness/readiness probing: prober streaks, liveness restarts,
+readiness gating of the Ready condition and Endpoints membership.
+
+Reference: pkg/kubelet/prober (worker.go thresholds, results manager
+initial values), endpoints controller readiness split.
+"""
+
+import time
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.kubelet.cri import FakeRuntimeService
+from kubernetes_tpu.kubelet.kubelet import Kubelet, KubeletConfig
+
+from .util import FAST_KUBELET as FAST, make_pod, wait_until as _wait
+
+FAST_PROBE = v1.Probe(exec_command=["check"], period_seconds=0.1,
+                      failure_threshold=2, success_threshold=1)
+
+
+def _cluster(runtime=None):
+    api = APIServer()
+    cs = Clientset(api)
+    factory = SharedInformerFactory(cs)
+    kl = Kubelet(cs, factory,
+                 config=KubeletConfig(node_name="node-0", **FAST),
+                 runtime=runtime or FakeRuntimeService())
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    kl.run()
+    return cs, kl
+
+
+def _ready(cs, name):
+    pod = cs.pods.get(name, "default")
+    for c in pod.status.conditions or []:
+        if c.type == "Ready":
+            return c.status == "True"
+    return False
+
+
+class TestReadinessProbe:
+    def test_readiness_gates_ready_condition(self):
+        rt = FakeRuntimeService()
+        cs, kl = _cluster(rt)
+        try:
+            pod = make_pod("web", node_name="node-0")
+            pod.spec.containers[0].readiness_probe = FAST_PROBE
+            cs.pods.create(pod)
+            _wait(lambda: cs.pods.get("web", "default").status.phase == "Running",
+                  timeout=10)
+            # passing probe: becomes Ready
+            _wait(lambda: _ready(cs, "web"), timeout=10)
+            # probe starts failing: Ready flips False (pod stays Running)
+            rt.exec_results["c0"] = 1
+            _wait(lambda: not _ready(cs, "web"), timeout=10)
+            assert cs.pods.get("web", "default").status.phase == "Running"
+            # recovers
+            rt.exec_results["c0"] = 0
+            _wait(lambda: _ready(cs, "web"), timeout=10)
+        finally:
+            kl.stop()
+
+    def test_no_probe_ready_by_running(self):
+        cs, kl = _cluster()
+        try:
+            cs.pods.create(make_pod("plain", node_name="node-0"))
+            _wait(lambda: _ready(cs, "plain"), timeout=10)
+        finally:
+            kl.stop()
+
+
+class TestLivenessProbe:
+    def test_liveness_failure_restarts_container(self):
+        rt = FakeRuntimeService()
+        cs, kl = _cluster(rt)
+        try:
+            pod = make_pod("frail", node_name="node-0")
+            pod.spec.containers[0].liveness_probe = FAST_PROBE
+            cs.pods.create(pod)
+            _wait(lambda: cs.pods.get("frail", "default").status.phase == "Running",
+                  timeout=10)
+            rt.exec_results["c0"] = 1  # liveness starts failing
+
+            def restarted():
+                st = cs.pods.get("frail", "default").status.container_statuses
+                return bool(st) and st[0].restart_count >= 1
+
+            _wait(restarted, timeout=10)
+            # heal: settles back to Running with the restarted container
+            rt.exec_results["c0"] = 0
+            _wait(lambda: cs.pods.get("frail", "default").status.phase == "Running",
+                  timeout=10)
+        finally:
+            kl.stop()
+
+
+class TestEndpointsReadiness:
+    def test_unready_pod_moves_to_not_ready_addresses(self):
+        from kubernetes_tpu.controllers.endpoints import EndpointsController
+
+        rt = FakeRuntimeService()
+        api = APIServer()
+        cs = Clientset(api)
+        factory = SharedInformerFactory(cs)
+        kl = Kubelet(cs, factory,
+                     config=KubeletConfig(node_name="node-0", **FAST),
+                     runtime=rt)
+        ctrl = EndpointsController(cs, factory)
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        kl.run()
+        ctrl.run()
+        try:
+            cs.services.create(v1.Service(
+                metadata=v1.ObjectMeta(name="svc", namespace="default"),
+                spec=v1.ServiceSpec(
+                    selector={"app": "web"},
+                    ports=[v1.ServicePort(port=80)],
+                ),
+            ))
+            pod = make_pod("web-1", labels={"app": "web"}, node_name="node-0")
+            pod.spec.containers[0].readiness_probe = FAST_PROBE
+            cs.pods.create(pod)
+
+            def ready_addr():
+                try:
+                    ep = cs.endpoints.get("svc", "default")
+                except Exception:  # noqa: BLE001
+                    return False
+                return any(s.addresses for s in ep.subsets or [])
+
+            _wait(ready_addr, timeout=10)
+            rt.exec_results["c0"] = 1  # readiness fails
+
+            def not_ready_addr():
+                ep = cs.endpoints.get("svc", "default")
+                subsets = ep.subsets or []
+                return (subsets
+                        and not any(s.addresses for s in subsets)
+                        and any(s.not_ready_addresses for s in subsets))
+
+            _wait(not_ready_addr, timeout=10)
+        finally:
+            ctrl.stop()
+            kl.stop()
+
+
+class TestReadinessInitialValue:
+    def test_never_ready_pod_not_published_ready(self):
+        """A readiness-probed container must NOT be Ready before its first
+        probe success (results manager initial value) — even in the first
+        status write after start."""
+        rt = FakeRuntimeService()
+        rt.exec_results["c0"] = 1  # failing from the start
+        cs, kl = _cluster(rt)
+        try:
+            pod = make_pod("never", node_name="node-0")
+            pod.spec.containers[0].readiness_probe = FAST_PROBE
+            cs.pods.create(pod)
+            _wait(lambda: cs.pods.get("never", "default").status.phase == "Running",
+                  timeout=10)
+            # observe several status cycles: Ready must stay False
+            for _ in range(5):
+                assert not _ready(cs, "never")
+                time.sleep(0.1)
+        finally:
+            kl.stop()
