@@ -450,17 +450,20 @@ class Kubelet:
         """kuberuntime_manager.go SyncPod: computePodActions diff then act."""
         uid = self._pod_uid(pod)
         restart_policy = pod.spec.restart_policy or "Always"
-        if pod.status.phase == "Failed" and pod.status.reason == "UnexpectedAdmissionError":
+        init_names = {c.name for c in pod.spec.init_containers or []}
+        if pod.status.phase == "Failed" and pod.status.reason in (
+            "UnexpectedAdmissionError", "InitContainerFailed"):
             # a rejected pod is terminal with no runtime state; without
             # this the rejection status-write's own watch event would
             # re-dispatch it and admission would re-run forever
             return
         sandbox, containers = self._pod_runtime_state(uid)
         by_name = {c.name: c for c in containers}
+        app = [c for c in containers if c.name not in init_names]
 
         # terminal check: Never/OnFailure pods that finished stay finished
-        if self._phase(pod, containers, restart_policy) in ("Succeeded", "Failed") and sandbox is not None:
-            self._update_pod_status(pod, sandbox, containers, restart_policy)
+        if self._phase(pod, app, restart_policy) in ("Succeeded", "Failed") and sandbox is not None:
+            self._update_pod_status(pod, sandbox, app, restart_policy)
             return
 
         if sandbox is None:
@@ -490,6 +493,39 @@ class Kubelet:
             by_name = {}
             if sandbox is None:
                 return  # runtime failed; retried by next sync
+        # init containers run SEQUENTIALLY to completion before any app
+        # container starts (kuberuntime SyncPod: sandbox → init → app;
+        # findNextInitContainerToRun). Each sync pass advances at most one
+        # step; PLEG events re-trigger sync as inits exit.
+        for ispec in pod.spec.init_containers or []:
+            existing = by_name.get(ispec.name)
+            if existing is None:
+                cid = self.runtime.create_container(
+                    sandbox.id, ispec.name, ispec.image, restart_count=0
+                )
+                self.runtime.start_container(cid)
+                return
+            if existing.state == CONTAINER_CREATED:
+                self.runtime.start_container(existing.id)
+                return
+            if existing.state == CONTAINER_RUNNING:
+                return  # wait for this init to finish
+            if existing.exit_code != 0:
+                if restart_policy == "Never":
+                    # init failure is terminal (getPhase: init failed +
+                    # Never → Failed)
+                    self._fail_pod(pod, "InitContainerFailed",
+                                   f"init container {ispec.name} exited "
+                                   f"{existing.exit_code}")
+                    return
+                self.runtime.remove_container(existing.id)
+                cid = self.runtime.create_container(
+                    sandbox.id, ispec.name, ispec.image,
+                    restart_count=existing.restart_count + 1,
+                )
+                self.runtime.start_container(cid)
+                return
+            # exited 0: fall through to the next init / app containers
         for spec in pod.spec.containers:
             existing = by_name.get(spec.name)
             if existing is None:
@@ -513,7 +549,8 @@ class Kubelet:
             elif existing.state == CONTAINER_CREATED:
                 self.runtime.start_container(existing.id)
         _, containers = self._pod_runtime_state(uid)
-        self._update_pod_status(pod, sandbox, containers, restart_policy)
+        app = [c for c in containers if c.name not in init_names]
+        self._update_pod_status(pod, sandbox, app, restart_policy)
 
     # -- kubelet node API (logs/exec, served to the apiserver proxy) -------
 
@@ -572,12 +609,15 @@ class Kubelet:
     def _reject_pod(self, pod: v1.Pod, message: str) -> None:
         """Admission failure: terminal Failed status (kubelet.go
         rejectPod, reason UnexpectedAdmissionError)."""
+        self._fail_pod(pod, "UnexpectedAdmissionError", message)
+
+    def _fail_pod(self, pod: v1.Pod, reason: str, message: str) -> None:
         try:
             live = self.client.pods.get(pod.metadata.name, pod.metadata.namespace)
             if live.status.phase == "Failed":
-                return  # already rejected: no-op, don't churn watch events
+                return  # already failed: no-op, don't churn watch events
             live.status.phase = "Failed"
-            live.status.reason = "UnexpectedAdmissionError"
+            live.status.reason = reason
             live.status.message = message
             self.client.pods.update_status(live)
         except APIError:

@@ -286,3 +286,77 @@ class TestFakeCRIIPAM:
         ips = [s.ip for s in rt.list_pod_sandboxes()]
         assert len(ips) == len(set(ips)) == 2
         assert keep_ip in ips
+
+
+class TestInitContainers:
+    """Init containers run sequentially to completion before app
+    containers (kuberuntime SyncPod: sandbox -> init -> app)."""
+
+    def test_inits_gate_app_containers(self):
+        rt = FakeRuntimeService()
+        # inits "run to completion" instantly: exit 0 on start
+        rt.fail_starts["init-a"] = 0
+        rt.fail_starts["init-b"] = 0
+        _, cs, _, kl = _cluster_with_kubelet(runtime=rt)
+        try:
+            pod = make_pod("with-init", node_name="node-0")
+            pod.spec.init_containers = [
+                v1.Container(name="init-a", image="img"),
+                v1.Container(name="init-b", image="img"),
+            ]
+            cs.pods.create(pod)
+            _wait(lambda: cs.pods.get("with-init", "default").status.phase == "Running",
+                  timeout=10)
+            # both inits ran and exited 0; app container running
+            names = {c.name: c for c in rt.list_containers()}
+            assert names["init-a"].exit_code == 0
+            assert names["init-b"].exit_code == 0
+            assert names["c0"].state == CONTAINER_RUNNING
+            # ordering: init-a created before init-b before c0
+            assert (names["init-a"].created_at <= names["init-b"].created_at
+                    <= names["c0"].created_at)
+        finally:
+            kl.stop()
+
+    def test_failing_init_with_never_fails_pod(self):
+        rt = FakeRuntimeService()
+        rt.fail_starts["init-bad"] = 1
+        _, cs, _, kl = _cluster_with_kubelet(runtime=rt)
+        try:
+            pod = make_pod("doomed", node_name="node-0")
+            pod.spec.restart_policy = "Never"
+            pod.spec.init_containers = [v1.Container(name="init-bad", image="img")]
+            cs.pods.create(pod)
+
+            def failed():
+                p = cs.pods.get("doomed", "default")
+                return (p.status.phase == "Failed"
+                        and p.status.reason == "InitContainerFailed")
+
+            _wait(failed, timeout=10)
+            # app container never created
+            assert all(c.name != "c0" for c in rt.list_containers())
+        finally:
+            kl.stop()
+
+    def test_failing_init_retries_until_success(self):
+        rt = FakeRuntimeService()
+        rt.fail_starts["init-flaky"] = 1
+        _, cs, _, kl = _cluster_with_kubelet(runtime=rt)
+        try:
+            pod = make_pod("retry", node_name="node-0")
+            pod.spec.init_containers = [v1.Container(name="init-flaky", image="img")]
+            cs.pods.create(pod)
+
+            def retried():
+                for c in rt.list_containers():
+                    if c.name == "init-flaky" and c.restart_count >= 2:
+                        return True
+                return False
+
+            _wait(retried, timeout=10)
+            rt.fail_starts["init-flaky"] = 0  # heals
+            _wait(lambda: cs.pods.get("retry", "default").status.phase == "Running",
+                  timeout=10)
+        finally:
+            kl.stop()
