@@ -41,8 +41,8 @@ def main() -> None:
     n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
     # keep pods a multiple of batch: a ragged final batch changes the scan
     # shape and pays a fresh ~35s XLA compile inside the measured window
-    n_meas = int(os.environ.get("BENCH_PODS", "2048"))
-    batch = int(os.environ.get("BENCH_BATCH", "1024"))
+    n_meas = int(os.environ.get("BENCH_PODS", "8192"))
+    batch = int(os.environ.get("BENCH_BATCH", "4096"))
     n_warm = batch
 
     from kubernetes_tpu.models.encoding import ClusterEncoding

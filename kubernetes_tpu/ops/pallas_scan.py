@@ -418,9 +418,11 @@ class PallasSession:
         batch_self, _ = _batch_inputs(pod_arrays_list, tmpl[:B])
         mf, ms = _match_matrices(self._tp, batch_self)
         T, C, CP = self.T, self.C, self.CP
-        # [Bp, LANE]: lane (t*CP+c) = that constraint row, per pod
-        mfT = np.zeros((Bp, LANE), np.int32)
-        msT = np.zeros((Bp, LANE), np.int32)
+        # [Bp, LANE]: lane (t*CP+c) = that constraint row, per pod.
+        # int8 on the wire: match weights are 0/1 and the per-batch
+        # host->device transfer is part of the dispatch's fixed cost
+        mfT = np.zeros((Bp, LANE), np.int8)
+        msT = np.zeros((Bp, LANE), np.int8)
         mfa = np.asarray(mf)
         msa = np.asarray(ms)
         for t in range(T):
@@ -828,6 +830,10 @@ def _dispatch(bundle: _Bundle, B_real, carry: Dict, tmpl, mfT, msT):
     # recompile the kernel (only the padded width Bp is static)
     Bp = int(tmpl.shape[0])
     kernel = _build_kernel(bundle.shapes, bundle.weights, Bp)
+    # widen the int8 wire format on-device (i8 VMEM rows would need
+    # 32-sublane alignment in the kernel; one cheap convert avoids that)
+    mfT = mfT.astype(jnp.int32)
+    msT = msT.astype(jnp.int32)
     carry_in = [carry[k] for k in CARRY_KEYS]
     out_shape = (
         jax.ShapeDtypeStruct((SUB, Bp), jnp.int32),
