@@ -500,8 +500,7 @@ def _build_kernel(shapes, weights, Bp: int):
                 mat_1n, onehot_ref[k], (((1,), (0,)), ((), ())),
                 preferred_element_type=f32)
 
-        def body(b, _):
-            b = b.astype(jnp.int32)
+        def one_pod(b):
             t = tmpl_ref[b]
             # NOTHING big is hoisted out of the loop: values live across
             # iterations spill out of vector registers and the
@@ -704,7 +703,7 @@ def _build_kernel(shapes, weights, Bp: int):
             m = jnp.max(tf)
             idx = jnp.where(tf >= m, lane_n, jnp.int32(POS_BIG))
             best = jnp.min(idx).astype(jnp.int32)
-            ok = m >= 0  # b < B_real: loop bound is dynamic
+            ok = (m >= 0) & (b < breal_ref[0])
             oki = ok.astype(jnp.int32)
             okf = oki.astype(f32)
 
@@ -779,9 +778,22 @@ def _build_kernel(shapes, weights, Bp: int):
                           o)
             o = jnp.where(at_b & (subi == 2), n_feasible, o)
             out_ref[:] = o
+
+        # manual unroll: U pods per loop iteration amortizes Mosaic's
+        # per-iteration bookkeeping (the marginal-cost floor; partial
+        # `unroll=` is unsupported by the TPU lowering). b >= B_real
+        # iterations are no-ops via the ok gate.
+        U = int(_os.environ.get("KTPU_PALLAS_GROUP", "4"))
+        while Bp % U:
+            U //= 2
+
+        def body(j, _):
+            base = j.astype(jnp.int32) * jnp.int32(U)
+            for i in range(U):
+                one_pod(base + jnp.int32(i))
             return jnp.int32(0)
 
-        jax.lax.fori_loop(jnp.int32(0), breal_ref[0], body, jnp.int32(0))
+        jax.lax.fori_loop(0, Bp // U, body, jnp.int32(0))
 
     return kernel
 

@@ -175,7 +175,6 @@ class TPUBackend(CacheListener):
                     group.append(pods[j])
                     arrays.append(q)
                     j += 1
-                c = self.enc.device_state()
 
                 def _clean():
                     return [
@@ -187,7 +186,12 @@ class TPUBackend(CacheListener):
                     # pending pods: the template-hoisted SESSION — carry
                     # stays on-device across batches and scheduler cycles;
                     # prologue is paid only when the session is torn down
-                    # by a foreign cluster mutation or a new template
+                    # by a foreign cluster mutation or a new template.
+                    # NOTE: no device_state() here — with dirty rows the
+                    # fused scatter DONATES the old device arrays, which
+                    # are exactly the live session's statics (the session
+                    # is self-consistent without the sync; its exactness
+                    # argument is in ops/hoisted.py)
                     decisions = self._session_schedule(_clean())
                 elif len(self.enc._pod_free) < len(group):
                     # pod table full: schedule singly (each add triggers
@@ -204,7 +208,8 @@ class TPUBackend(CacheListener):
                 else:
                     slots = [self.enc._pod_free[-1 - k] for k in range(len(group))]
                     self._invalidate_session()  # in-scan pod-table writes
-                    decisions, _ = schedule_batch(c, _clean(), slots, self.weights)
+                    decisions, _ = schedule_batch(
+                        self.enc.device_state(), _clean(), slots, self.weights)
                 for g, best in zip(group, decisions):
                     if best < 0:
                         results.append((g, None))
@@ -230,13 +235,28 @@ class TPUBackend(CacheListener):
             uniq.setdefault(fp, a)
         if len(uniq) > self.MAX_SESSION_TEMPLATES:
             # one batch alone exceeds the session template budget: a
-            # one-shot hoisted dispatch, session left untouched
+            # one-shot hoisted dispatch. The device_state() sync may
+            # donate buffers a live session still references, so tear
+            # the session down first
             from ..ops.hoisted import schedule_batch_hoisted
 
+            self._invalidate_session()
             decisions, _ = schedule_batch_hoisted(
                 self.enc.device_state(), arrays, self.weights
             )
             return decisions
+        # an encoding rebuild (vocab/table growth) changes array shapes;
+        # cached templates from before the rebuild can no longer stack
+        # with the incoming batch — evict them
+        sig = shape_signature(arrays[0])
+        stale = [
+            fp for fp, a in self._known_templates.items()
+            if shape_signature(a) != sig
+        ]
+        if stale:
+            for fp in stale:
+                del self._known_templates[fp]
+            self._invalidate_session()
         new = [fp for fp in uniq if fp not in self._known_templates]
         if new:
             for fp in new:

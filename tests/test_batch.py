@@ -104,3 +104,48 @@ def test_unbatchable_detection():
     assert not pod_batchable(pe.encode(pod))
     plain = make_pod("q", cpu="100m")
     assert pod_batchable(pe.encode(plain))
+
+
+class TestSessionSurvivesDirtySync:
+    def test_two_cycles_with_dirty_rows(self):
+        """Two schedule_many calls with host-side add_pod dirt between:
+        the live session's device statics must NOT be invalidated by a
+        fused-row-scatter donation (the scatter donates the old device
+        arrays; the session holds references to them). Regression for
+        the donated-buffer crash behind flaky preemption e2e runs."""
+        from kubernetes_tpu.scheduler.tpu_backend import TPUBackend
+        from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+        import copy
+
+        def presized_backend():
+            nodes, init_pods = synth_cluster(6, pods_per_node=1)
+            pending = synth_pending_pods(6, spread=True)
+            be = TPUBackend()
+            phantoms = []
+            for i, p in enumerate(pending):
+                q = copy.deepcopy(p)
+                q.metadata.name = f"ph-{i}"
+                q.spec.node_name = nodes[i % len(nodes)].metadata.name
+                phantoms.append(q)
+            be.enc.set_cluster(nodes, init_pods + phantoms)
+            for p in pending:  # pre-intern vocab so shapes stay stable
+                be.pe.encode(p)
+            be.enc.device_state()
+            for q in phantoms:
+                be.enc.remove_pod(q)
+            return be, pending
+
+        be, pending = presized_backend()
+        out1 = be.schedule_many(pending[:2])   # session built; add_pod dirties
+        assert all(n for _, n in out1)
+        sess = be._session
+        assert sess is not None
+        # same templates as batch 1 (synth stamps 4 templates round-robin)
+        out2 = be.schedule_many(pending[4:6])  # previously: donated-buffer crash
+        assert all(n for _, n in out2)
+        assert be._session is sess, "session must survive the second cycle"
+        # decisions still match a fresh backend scheduling the same stream
+        be2, pending2 = presized_backend()
+        ref = be2.schedule_many(pending2[:2] + pending2[4:6])
+        assert [n for _, n in out1 + out2] == [n for _, n in ref]
