@@ -362,6 +362,29 @@ class _AuthorizedClientset:
         )
         return _AuthorizedClientset(self._secure, target)
 
+    def pod_logs(self, name: str, namespace: str = "", container: str = "",
+                 tail: Optional[int] = None):
+        """GET pods/{name}/log through the secured chain. The reference
+        gates this on the pods/log subresource (registry/core/pod/rest/
+        log.go behind installer-registered subresource routes) — without
+        it, log reads would be the one request class with no audit trail."""
+        sub = _AuthorizedResourceClient(self._secure, self.user, "pods/log")
+        return sub._gated(
+            "get", namespace, name,
+            lambda: self._secure.api.pod_logs(name, namespace, container, tail),
+        )
+
+    def pod_exec(self, name: str, namespace: str, cmd: List[str],
+                 container: str = ""):
+        """POST pods/{name}/exec through the secured chain (pods/exec
+        subresource, verb=create — matching the reference's SPDY exec
+        handshake authorization)."""
+        sub = _AuthorizedResourceClient(self._secure, self.user, "pods/exec")
+        return sub._gated(
+            "create", namespace, name,
+            lambda: self._secure.api.pod_exec(name, namespace, cmd, container),
+        )
+
     def __getattr__(self, name: str):
         # pods/nodes/... attribute access like Clientset
         if name.startswith("_"):

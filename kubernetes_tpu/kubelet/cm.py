@@ -312,8 +312,10 @@ class DeviceManager:
         if self._ckpt is None:
             return
         with self._lock:
-            # deep-copy under the lock: create_checkpoint serializes after
-            # we release it, and per-pod workers mutate these dicts
+            # snapshot AND persist under the lock: two racing writers
+            # releasing between snapshot and write could persist
+            # checkpoints out of order, restoring stale allocations after
+            # a kubelet restart
             data = {
                 "podDeviceEntries": {
                     uid: {
@@ -326,7 +328,7 @@ class DeviceManager:
                     res: sorted(devs) for res, devs in self._devices.items()
                 },
             }
-        self._ckpt.create_checkpoint(self.CHECKPOINT, data)
+            self._ckpt.create_checkpoint(self.CHECKPOINT, data)
 
     def _restore(self) -> None:
         try:
@@ -428,11 +430,13 @@ class CPUManager:
         if self._ckpt is None:
             return
         with self._lock:
+            # persist under the lock so racing writers can't commit
+            # out-of-order checkpoints (same discipline as DeviceManager)
             data = {
                 "entries": {k: list(v) for k, v in self._assignments.items()},
                 "policyName": "static",
             }
-        self._ckpt.create_checkpoint(self.CHECKPOINT, data)
+            self._ckpt.create_checkpoint(self.CHECKPOINT, data)
 
     def _restore(self) -> None:
         try:

@@ -96,27 +96,36 @@ class ProbeManager:
                         st = _WorkerState(
                             result=(kind == LIVENESS), container_id=c.id)
                         self._state[key] = st
-                now = time.time()
-                if now - st.started_at < probe.initial_delay_seconds:
-                    continue
-                if now - st.last_probe < probe.period_seconds:
-                    continue
-                st.last_probe = now
+                    now = time.time()
+                    if now - st.started_at < probe.initial_delay_seconds:
+                        continue
+                    if now - st.last_probe < probe.period_seconds:
+                        continue
+                    st.last_probe = now
+                # the probe itself (an exec round-trip) runs outside the
+                # lock; the streak update re-acquires it so is_ready()/
+                # status readers never observe torn streak/result state
                 ok = self._run_probe(c, probe)
-                if ok:
-                    st.successes += 1
-                    st.failures = 0
-                    if st.successes >= probe.success_threshold:
-                        st.result = True
-                else:
-                    st.failures += 1
-                    st.successes = 0
-                    if st.failures >= probe.failure_threshold:
-                        st.result = False
-                        if kind == LIVENESS:
-                            # prober liveness failure → container killed;
-                            # restart policy decides what happens next
-                            self.runtime.stop_container(c.id, exit_code=137)
+                kill = False
+                with self._lock:
+                    if self._state.get(key) is not st:
+                        # container replaced mid-probe: stale result
+                        continue
+                    if ok:
+                        st.successes += 1
+                        st.failures = 0
+                        if st.successes >= probe.success_threshold:
+                            st.result = True
+                    else:
+                        st.failures += 1
+                        st.successes = 0
+                        if st.failures >= probe.failure_threshold:
+                            st.result = False
+                            kill = kind == LIVENESS
+                if kill:
+                    # prober liveness failure → container killed;
+                    # restart policy decides what happens next
+                    self.runtime.stop_container(c.id, exit_code=137)
 
     def _run_probe(self, c, probe: v1.Probe) -> bool:
         cmd = probe.exec_command or ["true"]

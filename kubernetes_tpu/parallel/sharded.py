@@ -19,7 +19,8 @@ lowers to an all-reduce over the mesh.
 
 This is data parallelism over cluster nodes — the analog of "DP over the
 batch" in an ML workload; the pod axis (batching many pending pods per
-dispatch) is the second axis, used by gang scheduling (parallel/gang.py).
+dispatch) is the second axis, used by the batch/session paths (ops/batch.py,
+ops/hoisted.py) and by gang scheduling (scheduler/plugins/coscheduling.py).
 """
 
 from __future__ import annotations

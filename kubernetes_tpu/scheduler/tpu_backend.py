@@ -123,6 +123,13 @@ class TPUBackend(CacheListener):
         """One pod against every node; raises FitError when none fit
         (generic_scheduler.go:95 Schedule semantics)."""
         with self._lock:
+            # device_state() with dirty rows DONATES the previous device
+            # buffers (encoding.py fused scatter) — exactly the statics a
+            # live session still references. Tear the session down first;
+            # this also covers the FitError re-dispatch and pod-table-full
+            # paths in schedule_many, whose enc.add_pod()s would otherwise
+            # leave a surviving session's carry missing those pods.
+            self._invalidate_session()
             p = {k: v for k, v in self.pe.encode(pod).items() if not k.startswith("_")}
             c = self.enc.device_state()
             out = schedule_pod_jit(c, p, self.weights)

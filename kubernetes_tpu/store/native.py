@@ -3,7 +3,7 @@
 Reference: the reference's store is etcd, a native process beside the
 apiserver (SURVEY.md §2.4.2; staging/src/k8s.io/apiserver/pkg/storage/
 etcd3). `NativeKVStore` is drop-in for store.kv.KVStore (same methods,
-exceptions, and Watch surface — tests/test_native_store.py runs the same
+exceptions, and Watch surface — tests/test_store.py runs the same
 suite over both), backed by native/kvstore.cpp:
 
   * values cross the boundary as JSON bytes, so callers can never alias

@@ -1,63 +1,77 @@
-/* pause: the pod-sandbox holder process.
+/* sandbox-hold: the pod-sandbox holder process (original implementation).
  *
- * Reference: build/pause/linux/pause.c — the single compiled-C component
- * in the reference tree. It is the first process of every pod sandbox:
- * it holds the pod's shared namespaces open and, as PID 1 of the pod,
- * reaps orphaned zombies (sigreap), exiting on SIGINT/SIGTERM.
- * Faithful equivalent for the TPU build's runtime (SURVEY.md §2.4.1).
+ * Role (behavioral spec, cf. the reference's pause container described in
+ * SURVEY.md §2.4.1): run as the first process of a pod sandbox, keep the
+ * pod's shared kernel namespaces alive by simply existing, reap any
+ * orphaned children re-parented onto it (it is PID 1 inside the sandbox),
+ * and terminate promptly on SIGINT or SIGTERM.
+ *
+ * Design: rather than installing async signal handlers and spinning on
+ * pause(), this implementation blocks the signals of interest and drives
+ * everything from a synchronous sigwaitinfo() loop — no handler
+ * re-entrancy to reason about, and zombie reaping happens in ordinary
+ * program context.
  */
 
+#include <errno.h>
 #include <signal.h>
 #include <stdio.h>
-#include <stdlib.h>
 #include <string.h>
-#include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
 
-#define STRINGIFY(x) #x
-#define VERSION_STRING(x) STRINGIFY(x)
-
-#ifndef VERSION
-#define VERSION HEAD
+#ifndef SANDBOX_HOLD_VERSION
+#define SANDBOX_HOLD_VERSION "dev"
 #endif
 
-static void sigdown(int signo) {
-  psignal(signo, "Shutting down, got signal");
-  exit(0);
-}
-
-static void sigreap(int signo) {
-  (void)signo;
-  while (waitpid(-1, NULL, WNOHANG) > 0)
-    ;
+/* Collect every terminated child without blocking; called whenever a
+ * SIGCHLD is delivered (and once at startup, in case children exited
+ * before our mask was in place). */
+static void reap_children(void) {
+  pid_t done;
+  do {
+    done = waitpid(-1, NULL, WNOHANG);
+  } while (done > 0 || (done < 0 && errno == EINTR));
 }
 
 int main(int argc, char **argv) {
-  int i;
-  for (i = 1; i < argc; ++i) {
-    if (!strcasecmp(argv[i], "-v")) {
-      printf("pause.c %s\n", VERSION_STRING(VERSION));
-      return 0;
-    }
+  sigset_t interest;
+  int signo;
+
+  if (argc > 1 && strcmp(argv[1], "--version") == 0) {
+    puts("sandbox-hold " SANDBOX_HOLD_VERSION);
+    return 0;
   }
 
   if (getpid() != 1)
-    /* Not an error because pause sees use outside of infra containers. */
-    fprintf(stderr, "Warning: pause should be the first process\n");
+    fprintf(stderr,
+            "sandbox-hold: note: not PID 1; orphan reaping only covers "
+            "direct children\n");
 
-  if (sigaction(SIGINT, &(struct sigaction){.sa_handler = sigdown}, NULL) < 0)
+  sigemptyset(&interest);
+  sigaddset(&interest, SIGINT);
+  sigaddset(&interest, SIGTERM);
+  sigaddset(&interest, SIGCHLD);
+  if (sigprocmask(SIG_BLOCK, &interest, NULL) != 0) {
+    perror("sandbox-hold: sigprocmask");
     return 1;
-  if (sigaction(SIGTERM, &(struct sigaction){.sa_handler = sigdown}, NULL) < 0)
-    return 2;
-  if (sigaction(SIGCHLD,
-                &(struct sigaction){.sa_handler = sigreap,
-                                    .sa_flags = SA_NOCLDSTOP},
-                NULL) < 0)
-    return 3;
+  }
 
-  for (;;)
-    pause();
-  fprintf(stderr, "Error: infinite loop terminated\n");
-  return 42;
+  reap_children();
+
+  for (;;) {
+    signo = sigwaitinfo(&interest, NULL);
+    if (signo < 0) {
+      if (errno == EINTR)
+        continue;
+      perror("sandbox-hold: sigwaitinfo");
+      return 1;
+    }
+    if (signo == SIGCHLD) {
+      reap_children();
+    } else {
+      /* SIGINT / SIGTERM: orderly sandbox teardown. */
+      return 0;
+    }
+  }
 }

@@ -1,0 +1,42 @@
+"""The sandbox-hold (pause-equivalent) binary: builds, ignores SIGCHLD,
+exits 0 on SIGTERM/SIGINT (behavioral spec in native/pause.c; role of the
+reference's pause container per SURVEY.md §2.4.1)."""
+
+import signal
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+NATIVE = Path(__file__).resolve().parent.parent / "native"
+
+
+@pytest.fixture(scope="module")
+def pause_bin():
+    subprocess.run(["make", "build/pause"], cwd=NATIVE, check=True,
+                   capture_output=True)
+    return NATIVE / "build" / "pause"
+
+
+def test_version_flag(pause_bin):
+    out = subprocess.run([str(pause_bin), "--version"], capture_output=True,
+                         text=True, timeout=10)
+    assert out.returncode == 0
+    assert "sandbox-hold" in out.stdout
+
+
+@pytest.mark.parametrize("sig", [signal.SIGTERM, signal.SIGINT])
+def test_exits_cleanly_on_signal(pause_bin, sig):
+    proc = subprocess.Popen([str(pause_bin)], stderr=subprocess.DEVNULL)
+    try:
+        time.sleep(0.2)  # let it install its signal mask
+        assert proc.poll() is None, "holder must keep running unprompted"
+        proc.send_signal(signal.SIGCHLD)
+        time.sleep(0.2)
+        assert proc.poll() is None, "SIGCHLD must not terminate the holder"
+        proc.send_signal(sig)
+        assert proc.wait(timeout=10) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
