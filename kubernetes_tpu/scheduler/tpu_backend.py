@@ -157,12 +157,14 @@ class TPUBackend(CacheListener):
                 p = self.pe.encode(pod)
                 if not pod_batchable(p):
                     try:
+                        # schedule() invalidates the session at entry, so the
+                        # term/port-table writes of this add_pod cannot leak
+                        # into a stale device carry.
                         r = self.schedule(pod)
                         node = r.suggested_host
                         # NOTE: never mutate the caller's pod (it aliases the
                         # informer cache); the node rides the result tuple and
                         # enc.add_pod takes the node explicitly
-                        self._invalidate_session()  # term/port tables mutate
                         self.enc.add_pod(pod, node)
                         results.append((pod, node))
                     except FitError:
