@@ -39,11 +39,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import kernel as K
-from .eval import eval_reqs, eval_reqs_single
+from .eval import eval_reqs, eval_reqs_single, ns_member
 from .kernel import _CNT, _F64, _I64, DEFAULT_WEIGHTS
 
 # carried cluster arrays (utilization only — pod-table rows are NOT
-# written in-scan; the host syncs them after the batch, as bench.py does)
+# written in-scan; the host syncs them after the batch, as bench.py does).
+# When session templates have host ports, copies of the node port tables
+# join the carry as cp_any/cp_wild/cp_trip (_init_dynamic_carries).
 CARRY_KEYS = ("requested", "nz_requested", "pod_count")
 
 TEMPLATE_KEYS_EXCLUDED = ("node_name_idx", "has_node_name")
@@ -73,6 +75,103 @@ def _stack_templates(templates: List[Dict]) -> Dict:
     out["has_node_name"] = jnp.zeros(t, bool)
     out["node_name_idx"] = jnp.full(t, -1, jnp.int32)
     return out
+
+
+# ---------------------------------------------------------------------------
+# template term machinery: what makes affinity/host-port pods batchable.
+#
+# A session-assumed pod of template u changes, for every LATER pod of
+# template t, exactly these InterPodAffinity quantities (filtering.go /
+# scoring.go semantics):
+#   D1 its required ANTI terms now repel t wherever t matches them;
+#   D2 it now counts toward t's own required-anti term counts;
+#   D3 it now counts toward t's required-affinity term counts (iff it
+#      matches ALL of t's terms);
+#   D4 its score terms (required-affinity at hardPodAffinityWeight,
+#      preferred ±weight) now contribute to t's raw IPA score;
+#   D5 it now counts toward t's preferred-term score counts.
+# All five reduce to TOPOLOGY-GROUP COUNTS of assumed pods — "how many
+# assumed u-pods sit on nodes sharing (key k, value of candidate node)" —
+# gated by STATIC template×term match booleans (a template's self labels
+# vs another template's term selector+namespaces). So the scan carries
+#   u_cnt[U, Vnp]  assumed-pod counts per template per (key,value) pair id
+#   k_cnt[U, K]    assumed-pod counts per template per topology key
+# and the step combines per-term gathers of u_cnt with the prologue's
+# static counts through kernel.ipa_compose — the same composition the
+# one-pod kernel uses, so parity is structural. Host ports ride the same
+# way: the node port tables join the carry and the step recomputes the
+# NodePorts mask against them (encoding._apply_ports semantics).
+
+
+def _term_gates(tp: Dict):
+    """Static template×term match tensors.
+
+    M_anti[a, τ, b]: template b's self row matches template a's required
+    anti-affinity term τ (selector + namespaces + validity). Same layout
+    for M_aff (required affinity) and M_pref (preferred, signed-weight
+    terms). match_all[a, b]: b matches ALL of a's required-affinity terms
+    (podMatchesAllAffinityTerms, filtering.go:357)."""
+
+    def vs_entity(pp, pk, ns):
+        def fam(prefix):
+            m = eval_reqs_single(
+                tp[f"{prefix}_op"], tp[f"{prefix}_rkey"], tp[f"{prefix}_pairs"],
+                pp, pk,
+            )  # [T, X]
+            return m & ns_member(tp[f"{prefix}_ns"], ns) & tp[f"{prefix}_valid"]
+
+        return fam("ipaaa"), fam("ipaa"), fam("ipap")
+
+    m_anti, m_aff, m_pref = jax.vmap(vs_entity, out_axes=-1)(
+        tp["self_ppair"], tp["self_pkey"], tp["self_ns"]
+    )  # each [T(owner), X, T(entity)]
+    has_aff = jnp.any(tp["ipaa_valid"], axis=1)  # [T]
+    match_all = (
+        jnp.all(jnp.where(tp["ipaa_valid"][:, :, None], m_aff, True), axis=1)
+        & has_aff[:, None]
+    )  # [T(owner), T(entity)]
+    return {
+        "M_anti": m_anti, "M_aff": m_aff, "M_pref": m_pref,
+        "match_all": match_all,
+    }
+
+
+def templates_have_terms(templates: List[Dict]) -> bool:
+    return any(
+        np.asarray(t["ipaa_valid"]).any()
+        or np.asarray(t["ipaaa_valid"]).any()
+        or np.asarray(t["ipap_valid"]).any()
+        for t in templates
+    )
+
+
+def templates_have_ports(templates: List[Dict]) -> bool:
+    return any(np.asarray(t["want_valid"]).any() for t in templates)
+
+
+def _port_add_vectors(templates: List[Dict], vp: int, vt: int):
+    """Per-template port-table increments for one assumed pod, with
+    HostPortInfo's per-(ip,proto,port) set semantics (dedup by triple id —
+    mirrors encoding._apply_ports exactly)."""
+    t_n = len(templates)
+    add_any = np.zeros((t_n, vp), np.int32)
+    add_wild = np.zeros((t_n, vp), np.int32)
+    add_trip = np.zeros((t_n, vt), np.int32)
+    for t, pa in enumerate(templates):
+        valid = np.asarray(pa["want_valid"])
+        trips = np.asarray(pa["want_triple"])[valid]
+        pairs = np.asarray(pa["want_pair"])[valid]
+        wild = np.asarray(pa["want_wild"])[valid]
+        seen = set()
+        for tr, pr, wl in zip(trips, pairs, wild):
+            if int(tr) in seen:
+                continue
+            seen.add(int(tr))
+            add_trip[t, tr] += 1
+            add_any[t, pr] += 1
+            if wl:
+                add_wild[t, pr] += 1
+    return add_any, add_wild, add_trip
 
 
 # ---------------------------------------------------------------------------
@@ -156,17 +255,23 @@ def _pts_template_static(c: Dict, p: Dict, node_match):
     )
 
 
-def _prologue(c: Dict, tp: Dict):
-    """Per-template static arrays, stacked over the template axis."""
+def _prologue(c: Dict, tp: Dict, dyn_ipa: bool = False, dyn_ports: bool = False):
+    """Per-template static arrays, stacked over the template axis.
+
+    dyn_ipa/dyn_ports: leave the InterPodAffinity mask / NodePorts mask
+    OUT of static_mask and expose their static parts separately, so the
+    scan step can recombine them with in-scan dynamic counts."""
 
     def one(p):
         node_match = K._node_match(c, p)
         _, mask_unsched, mask_taint, mask_ports, _ = K._filter_basics(c, p)
-        mask_ipa, _ = K._ipa_filter(c, p)
-        static_mask = (
-            c["valid"] & mask_unsched & mask_taint & mask_ports
-            & node_match & mask_ipa
-        )
+        parts = K._ipa_filter_parts(c, p)
+        mask_ipa, _ = K.ipa_compose(p, parts)
+        static_mask = c["valid"] & mask_unsched & mask_taint & node_match
+        if not dyn_ports:
+            static_mask = static_mask & mask_ports
+        if not dyn_ipa:
+            static_mask = static_mask & mask_ipa
         raw_ipa, ipa_present = K._score_ipa_raw(c, p)
         out = dict(
             static_mask=static_mask,
@@ -178,10 +283,15 @@ def _prologue(c: Dict, tp: Dict):
             sc_image=K._score_image(c, p),
             sc_avoid=K._score_prefer_avoid(c, p),
         )
+        if dyn_ipa:
+            out.update({f"ipa_{k}": v for k, v in parts.items()})
         out.update(_pts_template_static(c, p, node_match))
         return out
 
-    return jax.vmap(one)(tp)
+    S = jax.vmap(one)(tp)
+    if dyn_ipa:
+        S.update(_term_gates(tp))
+    return S
 
 
 def _match_matrices(tp: Dict, batch: Dict):
@@ -210,7 +320,8 @@ def _match_matrices(tp: Dict, batch: Dict):
 # the scan step
 
 
-def _step(S: Dict, c_static: Dict, weights: Dict, carry: Dict, x: Dict):
+def _step(S: Dict, c_static: Dict, weights: Dict, dyn_ipa: bool,
+          dyn_ports: bool, carry: Dict, x: Dict):
     tj = x["tmpl"]
     j = x["j"]
     n = c_static["valid"].shape[0]
@@ -226,6 +337,63 @@ def _step(S: Dict, c_static: Dict, weights: Dict, carry: Dict, x: Dict):
         carry["requested"], carry["pod_count"], c_static["alloc"],
         c_static["allowed_pods"], req, sel("req_check"), sel("req_has_any"),
     )
+
+    # -- NodePorts over the carried port tables (dyn_ports) -----------------
+    if dyn_ports:
+        mask_ports = K.ports_mask(
+            carry["cp_any"], carry["cp_wild"], carry["cp_trip"],
+            {k: sel(k) for k in _PORT_STEP_KEYS},
+        )
+    else:
+        mask_ports = True
+
+    # -- InterPodAffinity: static parts + in-scan assumed-pod counts --------
+    if dyn_ipa:
+        u_cnt, k_cnt = carry["u_cnt"], carry["k_cnt"]
+        pok, nk = c_static["pair_of_key"], c_static["nkey"]
+
+        # D1: assumed pods' required anti terms repel this pod where it
+        # matches them (filtering.go:162 existing-anti map, dynamic part)
+        kaa = S["ipaaa_key"]                          # [U, TAA]
+        cnt1 = jax.vmap(lambda uc, pv: uc[pv])(
+            u_cnt, pok[:, kaa].transpose(1, 0, 2)
+        )  # [U, N, TAA]
+        g1 = S["M_anti"][:, :, tj]                    # [U, TAA]
+        nk1 = nk[:, kaa].transpose(1, 0, 2)           # [U, N, TAA]
+        fail_existing_dyn = jnp.any(
+            g1[:, None, :] & nk1 & (cnt1 > 0), axis=(0, 2)
+        )  # [N]
+
+        # D2: assumed pods counting toward this pod's own anti terms
+        g2 = S["M_anti"][tj].astype(_CNT)             # [TAA, U]
+        w2 = g2 @ u_cnt                               # [TAA, Vnp]
+        p2 = pok[:, sel("ipaaa_key")]                 # [N, TAA]
+        anti_dyn = jax.vmap(
+            lambda wv, pv: wv[pv], in_axes=(0, 1), out_axes=1
+        )(w2, p2)                                     # [N, TAA]
+
+        # D3: assumed pods matching ALL of this pod's affinity terms
+        g3 = S["match_all"][tj].astype(_CNT)          # [U]
+        w3 = g3 @ u_cnt                               # [Vnp]
+        p3 = pok[:, sel("ipaa_key")]                  # [N, Ta]
+        aff_dyn = w3[p3]                              # [N, Ta]
+        aff_total_dyn = jnp.sum(
+            sel("ipaa_valid")[None, :] * g3[:, None] * k_cnt[:, sel("ipaa_key")]
+        )
+
+        p_t = {"ipaaa_valid": sel("ipaaa_valid"), "ipaa_valid": sel("ipaa_valid")}
+        parts_t = {
+            k: sel(f"ipa_{k}")
+            for k in ("fail_existing", "anti_cnt_n", "anti_key_on_node",
+                      "aff_cnt_n", "aff_all_keys", "aff_total",
+                      "self_match_all", "has_aff")
+        }
+        mask_ipa, _ = K.ipa_compose(
+            p_t, parts_t, anti_dyn=anti_dyn, aff_dyn=aff_dyn,
+            aff_total_dyn=aff_total_dyn, fail_existing_dyn=fail_existing_dyn,
+        )
+    else:
+        mask_ipa = True
 
     # -- PTS filter (dynamic counts) ---------------------------------------
     f_valid = sel("f_valid")
@@ -250,7 +418,7 @@ def _step(S: Dict, c_static: Dict, weights: Dict, carry: Dict, x: Dict):
     )
     mask_pts = ~(any_f & (fail_missing | fail_skew))
 
-    feasible = sel("static_mask") & mask_fit & mask_pts
+    feasible = sel("static_mask") & mask_fit & mask_pts & mask_ports & mask_ipa
 
     # -- scores -------------------------------------------------------------
     nz_req = sel("nz_req")
@@ -299,7 +467,52 @@ def _step(S: Dict, c_static: Dict, weights: Dict, carry: Dict, x: Dict):
     norm = jnp.where(ignored, 0, norm)
     sc_pts = jnp.where(any_s, norm, 0)
 
-    sc_ipa = K._score_ipa_normalize(sel("raw_ipa"), sel("ipa_present"), feasible)
+    # -- IPA score: static raw + assumed-pod contributions ------------------
+    raw_ipa = sel("raw_ipa")
+    ipa_present = sel("ipa_present")
+    if dyn_ipa:
+        hard_w = c_static["hard_pod_affinity_weight"].astype(_CNT)
+
+        def existing_terms(key_tbl, gate, w):
+            """D4: assumed pods' score terms vs this pod. key_tbl [U, X],
+            gate [U, X] (match+validity), w [U, X] signed weights."""
+            cnt = jax.vmap(lambda uc, pv: uc[pv])(
+                u_cnt, pok[:, key_tbl].transpose(1, 0, 2)
+            )  # [U, N, X]
+            nkx = nk[:, key_tbl].transpose(1, 0, 2)
+            contrib = jnp.sum(
+                jnp.where(gate[:, None, :] & nkx, cnt, 0)
+                * w[:, None, :], axis=(0, 2),
+            )  # [N]
+            present = jnp.any(gate & (k_cnt[:, key_tbl] > 0))
+            return contrib, present
+
+        # required-affinity terms of assumed pods score at hardPodAffinityWeight
+        # (scoring.go:88 processExistingPod)
+        g4a = S["M_aff"][:, :, tj] & (hard_w > 0)
+        c4a, p4a = existing_terms(
+            S["ipaa_key"], g4a, jnp.broadcast_to(hard_w, g4a.shape)
+        )
+        # preferred terms of assumed pods, signed weight
+        g4p = S["M_pref"][:, :, tj]
+        c4p, p4p = existing_terms(
+            S["ipap_key"], g4p, S["ipap_weight"].astype(_CNT)
+        )
+        # D5: assumed pods vs this pod's own preferred terms
+        g5 = S["M_pref"][tj].astype(_CNT)             # [TP, U]
+        w5 = g5 @ u_cnt                               # [TP, Vnp]
+        p5 = pok[:, sel("ipap_key")]                  # [N, TP]
+        cnt5 = jax.vmap(
+            lambda wv, pv: wv[pv], in_axes=(0, 1), out_axes=1
+        )(w5, p5)                                     # [N, TP]
+        c5 = jnp.sum(
+            jnp.where(nk[:, sel("ipap_key")], cnt5, 0)
+            * sel("ipap_weight").astype(_CNT)[None, :], axis=1,
+        )
+        p5p = jnp.any((S["M_pref"][tj]) & (k_cnt[:, sel("ipap_key")].T > 0))
+        raw_ipa = raw_ipa + c4a + c4p + c5
+        ipa_present = ipa_present | p4a | p4p | p5p
+    sc_ipa = K._score_ipa_normalize(raw_ipa, ipa_present, feasible)
     sc_taint = K._normalize_default(sel("cnt_taint"), feasible, reverse=True)
     sc_nodeaff = K._normalize_default(sel("cnt_nodeaff"), feasible, reverse=False)
 
@@ -340,6 +553,16 @@ def _step(S: Dict, c_static: Dict, weights: Dict, carry: Dict, x: Dict):
         ms * src_b[:, None].astype(_CNT)
     )
     carry["h_cnt"] = carry["h_cnt"].at[:, :, best].add(ms)
+    if dyn_ipa:
+        # the assumed pod joins its node's topology groups for every key
+        # the node carries (pair id 0 rows get +0 via the nkey gate)
+        nb = (c_static["nkey"][best] & ok).astype(_CNT)  # [K]
+        carry["u_cnt"] = carry["u_cnt"].at[tj, c_static["pair_of_key"][best]].add(nb)
+        carry["k_cnt"] = carry["k_cnt"].at[tj].add(nb)
+    if dyn_ports:
+        carry["cp_any"] = carry["cp_any"].at[best].add(S["padd_any"][tj] * addc)
+        carry["cp_wild"] = carry["cp_wild"].at[best].add(S["padd_wild"][tj] * addc)
+        carry["cp_trip"] = carry["cp_trip"].at[best].add(S["padd_trip"][tj] * addc)
 
     y = {
         "best": jnp.where(ok, best, -1),
@@ -349,16 +572,56 @@ def _step(S: Dict, c_static: Dict, weights: Dict, carry: Dict, x: Dict):
     return carry, y
 
 
-@functools.partial(jax.jit, static_argnames=("weights_key",))
-def _run(c_all: Dict, tp: Dict, batch_self: Dict, xs: Dict, weights_key):
+# tp keys the step reads directly when the dynamic-IPA / dynamic-ports
+# machinery is on
+_TERM_STEP_KEYS = (
+    "ipaaa_key", "ipaaa_valid", "ipaa_key", "ipaa_valid",
+    "ipap_key", "ipap_weight",
+)
+_PORT_STEP_KEYS = ("want_pair", "want_triple", "want_wild", "want_valid")
+
+
+def _merge_step_inputs(S: Dict, tp: Dict, dyn_ipa: bool, dyn_ports: bool,
+                       port_adds) -> None:
+    for k in ("req", "req_check", "req_has_any", "nz_req"):
+        S[k] = tp[k]
+    if dyn_ipa:
+        for k in _TERM_STEP_KEYS:
+            S[k] = tp[k]
+    if dyn_ports:
+        for k in _PORT_STEP_KEYS:
+            S[k] = tp[k]
+        S["padd_any"], S["padd_wild"], S["padd_trip"] = port_adds
+
+
+def _init_dynamic_carries(carry: Dict, c_all: Dict, n_templates: int,
+                          dyn_ipa: bool, dyn_ports: bool) -> None:
+    """Zero-initialize the assumed-pod count carries and copy-adopt the
+    port tables. The copies are unconditional (not astype tricks): the
+    session scan DONATES its carry, and donating a buffer the encoder's
+    device-state cache still references is the session-killing bug class
+    fixed in commit ee84cbf."""
+    if dyn_ipa:
+        vnp = c_all["npair"].shape[1]
+        k_n = c_all["nkey"].shape[1]
+        carry["u_cnt"] = jnp.zeros((n_templates, vnp), _CNT)
+        carry["k_cnt"] = jnp.zeros((n_templates, k_n), _CNT)
+    if dyn_ports:
+        carry["cp_any"] = jnp.array(c_all["ports_pair_any"], dtype=_CNT)
+        carry["cp_wild"] = jnp.array(c_all["ports_pair_wild"], dtype=_CNT)
+        carry["cp_trip"] = jnp.array(c_all["ports_triple"], dtype=_CNT)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("weights_key", "dyn_ipa", "dyn_ports")
+)
+def _run(c_all: Dict, tp: Dict, batch_self: Dict, xs: Dict, weights_key,
+         dyn_ipa: bool = False, dyn_ports: bool = False, port_adds=None):
     weights = dict(weights_key)
-    S = _prologue(c_all, tp)
+    S = _prologue(c_all, tp, dyn_ipa, dyn_ports)
     mf, ms = _match_matrices(tp, batch_self)
     S["Mf"], S["Ms"] = mf, ms
-    S["req"] = tp["req"]
-    S["req_check"] = tp["req_check"]
-    S["req_has_any"] = tp["req_has_any"]
-    S["nz_req"] = tp["nz_req"]
+    _merge_step_inputs(S, tp, dyn_ipa, dyn_ports, port_adds)
     carry = {
         "requested": c_all["requested"],
         "nz_requested": c_all["nz_requested"],
@@ -367,8 +630,9 @@ def _run(c_all: Dict, tp: Dict, batch_self: Dict, xs: Dict, weights_key):
         "s_cnt": S.pop("s_cnt0"),
         "h_cnt": S.pop("h_cnt0"),
     }
+    _init_dynamic_carries(carry, c_all, tp["req"].shape[0], dyn_ipa, dyn_ports)
     c_static = {k: v for k, v in c_all.items() if k not in CARRY_KEYS}
-    step = functools.partial(_step, S, c_static, weights)
+    step = functools.partial(_step, S, c_static, weights, dyn_ipa, dyn_ports)
     return jax.lax.scan(step, carry, xs)
 
 
@@ -389,15 +653,16 @@ def _batch_inputs(pod_arrays_list: List[Dict], tmpl_ids: np.ndarray) -> Tuple[Di
     return batch_self, xs
 
 
-def prepare_batch(pod_arrays_list: List[Dict]) -> Tuple[Dict, Dict, Dict]:
-    """Group the batch by template and build the scan inputs:
-    (stacked templates, batch self-rows, xs). Asserts hoisting
-    preconditions (batchable + unbound)."""
-    from .batch import pod_batchable
-
+def prepare_batch(
+    pod_arrays_list: List[Dict],
+) -> Tuple[Dict, Dict, Dict, List[Dict]]:
+    """Group the batch by template and build the scan inputs: (stacked
+    templates, batch self-rows, xs, template list). Pods with affinity
+    terms and host ports ARE hoistable — the scan carries their dynamic
+    effects (see the term-machinery block above); only bound pods
+    (spec.nodeName) are excluded."""
     b = len(pod_arrays_list)
     for pa in pod_arrays_list:
-        assert pod_batchable(pa), "hoisted: pods must be batchable (no affinity terms/ports)"
         assert not bool(np.asarray(pa["has_node_name"])), "hoisted: pods must be unbound"
     fps: Dict[Tuple, int] = {}
     templates: List[Dict] = []
@@ -412,7 +677,18 @@ def prepare_batch(pod_arrays_list: List[Dict]) -> Tuple[Dict, Dict, Dict]:
         tmpl_ids[i] = t
     tp = _stack_templates(templates)
     batch_self, xs = _batch_inputs(pod_arrays_list, tmpl_ids)
-    return tp, batch_self, xs
+    return tp, batch_self, xs, templates
+
+
+def _port_adds_for(templates: List[Dict], cluster: Dict):
+    return tuple(
+        jnp.asarray(a)
+        for a in _port_add_vectors(
+            templates,
+            cluster["ports_pair_any"].shape[1],
+            cluster["ports_triple"].shape[1],
+        )
+    )
 
 
 def schedule_batch_hoisted(
@@ -420,14 +696,15 @@ def schedule_batch_hoisted(
     pod_arrays_list: List[Dict],
     weights: Optional[Dict[str, int]] = None,
 ) -> Tuple[List[int], Dict]:
-    """Schedule a batchable batch with template hoisting.
-
-    Requirements (assert; callers route through ops/batch.py otherwise):
-    every pod batchable (no affinity terms/ports) and unbound (no
-    spec.nodeName). Returns (decisions, ys)."""
-    tp, batch_self, xs = prepare_batch(pod_arrays_list)
+    """Schedule a batch with template hoisting (affinity/port pods
+    included — their assume effects ride the dynamic carries). Pods must
+    be unbound (no spec.nodeName). Returns (decisions, ys)."""
+    tp, batch_self, xs, templates = prepare_batch(pod_arrays_list)
+    dyn_ipa = templates_have_terms(templates)
+    dyn_ports = templates_have_ports(templates)
+    port_adds = _port_adds_for(templates, cluster) if dyn_ports else None
     key = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
-    _, ys = _run(cluster, tp, batch_self, xs, key)
+    _, ys = _run(cluster, tp, batch_self, xs, key, dyn_ipa, dyn_ports, port_adds)
     return [int(v) for v in np.asarray(ys["best"])], ys
 
 
@@ -435,19 +712,23 @@ def schedule_batch_hoisted(
 # cross-batch session: carry lives on-device, prologue runs ONCE
 
 
-@jax.jit
-def _session_prologue(c_all: Dict, tp: Dict) -> Dict:
-    return _prologue(c_all, tp)
+@functools.partial(jax.jit, static_argnames=("dyn_ipa", "dyn_ports"))
+def _session_prologue(c_all: Dict, tp: Dict, dyn_ipa: bool = False,
+                      dyn_ports: bool = False) -> Dict:
+    return _prologue(c_all, tp, dyn_ipa, dyn_ports)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("weights_key",), donate_argnames=("carry",)
+    jax.jit,
+    static_argnames=("weights_key", "dyn_ipa", "dyn_ports"),
+    donate_argnames=("carry",),
 )
-def _session_scan(S, c_static, tp, carry, batch_self, xs, weights_key):
+def _session_scan(S, c_static, tp, carry, batch_self, xs, weights_key,
+                  dyn_ipa: bool = False, dyn_ports: bool = False):
     weights = dict(weights_key)
     S = dict(S)
     S["Mf"], S["Ms"] = _match_matrices(tp, batch_self)
-    step = functools.partial(_step, S, c_static, weights)
+    step = functools.partial(_step, S, c_static, weights, dyn_ipa, dyn_ports)
     # unroll: the tunnel pays a fixed cost per fused-kernel launch, and
     # launches scale with scan iterations; unrolling trades compile time
     # for fewer iterations (semantics identical) — see PERF_NOTES.md
@@ -498,18 +779,18 @@ class HoistedSession:
         template_arrays_list: List[Dict],
         weights: Optional[Dict[str, int]] = None,
     ):
-        from .batch import pod_batchable
-
-        for pa in template_arrays_list:
-            if not pod_batchable(pa):
-                raise ValueError("session templates must be batchable "
-                                 "(no affinity terms / host ports)")
         self._weights_key = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
         self._fps = {
             template_fingerprint(t): i for i, t in enumerate(template_arrays_list)
         }
+        self._dyn_ipa = templates_have_terms(template_arrays_list)
+        self._dyn_ports = templates_have_ports(template_arrays_list)
+        port_adds = (
+            _port_adds_for(template_arrays_list, cluster)
+            if self._dyn_ports else None
+        )
         tp = _stack_templates(template_arrays_list)
-        S = dict(_session_prologue(cluster, tp))
+        S = dict(_session_prologue(cluster, tp, self._dyn_ipa, self._dyn_ports))
         # copies: _session_scan donates the carry, and the cluster arrays
         # are also held by the encoder's device-state cache
         self._carry = {
@@ -520,8 +801,11 @@ class HoistedSession:
             "s_cnt": S.pop("s_cnt0"),
             "h_cnt": S.pop("h_cnt0"),
         }
-        for k in ("req", "req_check", "req_has_any", "nz_req"):
-            S[k] = tp[k]
+        _init_dynamic_carries(
+            self._carry, cluster, len(template_arrays_list),
+            self._dyn_ipa, self._dyn_ports,
+        )
+        _merge_step_inputs(S, tp, self._dyn_ipa, self._dyn_ports, port_adds)
         self._S = S
         self._tp = tp
         self._c_static = {k: v for k, v in cluster.items() if k not in CARRY_KEYS}
@@ -541,6 +825,7 @@ class HoistedSession:
         self._carry, ys = _session_scan(
             self._S, self._c_static, self._tp, self._carry,
             batch_self, xs, self._weights_key,
+            self._dyn_ipa, self._dyn_ports,
         )
         return ys
 

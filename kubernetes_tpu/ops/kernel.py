@@ -97,6 +97,20 @@ def fit_mask(requested, pod_count, alloc, allowed_pods, req, req_check, req_has_
     return ~(fail_count | fail_dims)
 
 
+def ports_mask(pair_any, pair_wild, triple, p: Dict):
+    """NodePorts conflict mask over the given port tables (reference:
+    nodeports/node_ports.go HostPortInfo: a wildcard-ip want conflicts
+    with any same (proto,port); a specific-ip want conflicts with a
+    wildcard holder or the exact triple). Shared by the one-pod kernel
+    (static cluster tables) and the hoisted scan step (carried tables) so
+    the semantics cannot diverge."""
+    pa = pair_any[:, p["want_pair"]] > 0     # [N, MP]
+    pw = pair_wild[:, p["want_pair"]] > 0
+    tr = triple[:, p["want_triple"]] > 0
+    conflict = jnp.where(p["want_wild"][None, :], pa, pw | tr) & p["want_valid"][None, :]
+    return ~jnp.any(conflict, axis=1)
+
+
 def _filter_basics(c: Dict, p: Dict):
     """NodeName, NodeUnschedulable, TaintToleration, NodePorts,
     NodeResourcesFit masks. References: nodename/node_name.go,
@@ -110,11 +124,9 @@ def _filter_basics(c: Dict, p: Dict):
     eff = c["taint_effect"][None, :]
     hard_taint = (eff == EFFECT_NO_SCHEDULE) | (eff == EFFECT_NO_EXECUTE)
     mask_taint = ~jnp.any(c["taints"] & hard_taint & ~p["tol_ns"][None, :], axis=1)
-    pa = c["ports_pair_any"][:, p["want_pair"]] > 0     # [N, MP]
-    pw = c["ports_pair_wild"][:, p["want_pair"]] > 0
-    tr = c["ports_triple"][:, p["want_triple"]] > 0
-    conflict = jnp.where(p["want_wild"][None, :], pa, pw | tr) & p["want_valid"][None, :]
-    mask_ports = ~jnp.any(conflict, axis=1)
+    mask_ports = ports_mask(
+        c["ports_pair_any"], c["ports_pair_wild"], c["ports_triple"], p
+    )
     mask_fit = fit_mask(
         c["requested"], c["pod_count"], c["alloc"], c["allowed_pods"],
         p["req"], p["req_check"], p["req_has_any"],
@@ -203,13 +215,38 @@ def _pts_filter(c: Dict, p: Dict, node_match):
     return mask, unresolvable
 
 
-def _ipa_filter(c: Dict, p: Dict):
-    """InterPodAffinity PreFilter+Filter (reference:
-    pkg/scheduler/framework/plugins/interpodaffinity/filtering.go:162
-    existing anti-affinity map, :194 incoming maps, :374 Filter)."""
-    n = c["valid"].shape[0]
+def _ipa_term_matches(c: Dict, p: Dict, prefix: str):
+    """Per-term match of every existing pod: selector + namespaces."""
+    match_pt = eval_reqs(
+        p[f"{prefix}_op"], p[f"{prefix}_rkey"], p[f"{prefix}_pairs"],
+        c["ppair"], c["pkey"],
+    )  # [P, T]
+    return match_pt & ns_member(
+        p[f"{prefix}_ns"][None, :, :], c["pns"][:, None, None]
+    )
+
+
+def _ipa_scatter_terms(c: Dict, match_pt, keys, valid):
+    """Accumulate matches into the ONE (key,value)-keyed global map
+    (topologyToMatchedTermCount is shared across terms, filtering.go:60)."""
     vnp = c["npair"].shape[1]
+    pair_pt = c["pair_of_key"][c["pnode"][:, None], keys[None, :]]  # [P, T]
+    m = match_pt & c["pvalid"][:, None] & valid[None, :]
+    cnt = jax.vmap(
+        lambda mm, pids: _seg_sum(mm.astype(_CNT), pids, vnp), in_axes=(1, 1)
+    )(m, pair_pt)  # [T, Vnp]
+    return jnp.sum(cnt, axis=0).at[0].set(0)  # [Vnp]
+
+
+def _ipa_filter_parts(c: Dict, p: Dict) -> Dict:
+    """Static pieces of the InterPodAffinity Filter for one incoming pod
+    against the REAL pod/term tables. _ipa_filter composes them directly;
+    the hoisted session (ops/hoisted.py) adds in-scan dynamic counts from
+    session-assumed pods before composing, so the decomposition is the
+    single source of truth for the filtering.go math."""
     # existing pods' required anti-affinity terms vs the incoming pod
+    # (filtering.go:162 existing anti-affinity map)
+    vnp = c["npair"].shape[1]
     match_at = (
         eval_reqs_single(c["at_op"], c["at_rkey"], c["at_pairs"], p["self_ppair"], p["self_pkey"])
         & ns_member(c["at_ns"], p["self_ns"])
@@ -224,55 +261,31 @@ def _ipa_filter(c: Dict, p: Dict):
     hit_per_key = (existing_cnt > 0)[c["pair_of_key"]] & c["nkey"]  # [N, K]
     fail_existing = jnp.any(hit_per_key, axis=1)
 
-    def term_matches(prefix):
-        """Per-term match of every existing pod: selector + namespaces."""
-        match_pt = eval_reqs(
-            p[f"{prefix}_op"], p[f"{prefix}_rkey"], p[f"{prefix}_pairs"],
-            c["ppair"], c["pkey"],
-        )  # [P, T]
-        return match_pt & ns_member(
-            p[f"{prefix}_ns"][None, :, :], c["pns"][:, None, None]
-        )
-
-    def scatter_terms(match_pt, keys, valid):
-        """Accumulate matches into the ONE (key,value)-keyed global map
-        (topologyToMatchedTermCount is shared across terms,
-        filtering.go:60)."""
-        pair_pt = c["pair_of_key"][c["pnode"][:, None], keys[None, :]]  # [P, T]
-        m = match_pt & c["pvalid"][:, None] & valid[None, :]
-        cnt = jax.vmap(
-            lambda mm, pids: _seg_sum(mm.astype(_CNT), pids, vnp), in_axes=(1, 1)
-        )(m, pair_pt)  # [T, Vnp]
-        return jnp.sum(cnt, axis=0).at[0].set(0)  # [Vnp]
-
     # incoming required anti-affinity (filtering.go:341 satisfyPodAntiAffinity):
     # a pod matching ANY term contributes at that term's topology pair
     anti_valid = p["ipaaa_valid"]
-    anti_vec = scatter_terms(term_matches("ipaaa"), p["ipaaa_key"], anti_valid)
-    anti_key = p["ipaaa_key"]
-    pair_nt = c["pair_of_key"][:, anti_key]  # [N, Taa]
-    key_present = c["nkey"][:, anti_key]
-    fail_anti = jnp.any(
-        anti_valid[None, :] & key_present & (anti_vec[pair_nt] > 0), axis=1
+    anti_vec = _ipa_scatter_terms(
+        c, _ipa_term_matches(c, p, "ipaaa"), p["ipaaa_key"], anti_valid
     )
+    pair_nt = c["pair_of_key"][:, p["ipaaa_key"]]  # [N, Taa]
+    anti_key_on_node = c["nkey"][:, p["ipaaa_key"]]
+    anti_cnt_n = anti_vec[pair_nt]  # [N, Taa]
 
     # incoming required affinity (filtering.go:357 satisfyPodAffinity): a pod
     # must match ALL terms to contribute (podMatchesAllAffinityTerms)
     aff_valid = p["ipaa_valid"]
     has_aff = jnp.any(aff_valid)
     match_all = jnp.all(
-        jnp.where(aff_valid[None, :], term_matches("ipaa"), True), axis=1
+        jnp.where(aff_valid[None, :], _ipa_term_matches(c, p, "ipaa"), True), axis=1
     ) & has_aff  # [P]
-    aff_vec = scatter_terms(match_all[:, None], p["ipaa_key"], aff_valid)
-    aff_key = p["ipaa_key"]
-    pair_na = c["pair_of_key"][:, aff_key]
-    cnt_aff = aff_vec[pair_na]  # [N, Ta]
-    key_aff = c["nkey"][:, aff_key]
-    all_keys = jnp.all(jnp.where(aff_valid[None, :], key_aff, True), axis=1)
-    pods_exist = jnp.all(jnp.where(aff_valid[None, :], cnt_aff > 0, True), axis=1)
+    aff_vec = _ipa_scatter_terms(c, match_all[:, None], p["ipaa_key"], aff_valid)
+    pair_na = c["pair_of_key"][:, p["ipaa_key"]]
+    aff_cnt_n = aff_vec[pair_na]  # [N, Ta]
+    key_aff = c["nkey"][:, p["ipaa_key"]]
+    aff_all_keys = jnp.all(jnp.where(aff_valid[None, :], key_aff, True), axis=1)
     # first-pod-in-series escape hatch (filtering.go:357): the global map is
     # empty AND the incoming pod matches its own terms
-    counts_empty = jnp.sum(aff_vec) == 0
+    aff_total = jnp.sum(aff_vec)
     self_match_all = has_aff & jnp.all(
         jnp.where(
             aff_valid,
@@ -284,10 +297,50 @@ def _ipa_filter(c: Dict, p: Dict):
             True,
         )
     )
-    aff_ok = ~has_aff | (all_keys & (pods_exist | (counts_empty & self_match_all)))
-    mask = ~fail_existing & ~fail_anti & aff_ok
+    return dict(
+        fail_existing=fail_existing,
+        anti_cnt_n=anti_cnt_n,
+        anti_key_on_node=anti_key_on_node,
+        aff_cnt_n=aff_cnt_n,
+        aff_all_keys=aff_all_keys,
+        aff_total=aff_total,
+        self_match_all=self_match_all,
+        has_aff=has_aff,
+    )
+
+
+def ipa_compose(p: Dict, parts: Dict, anti_dyn=0, aff_dyn=0, aff_total_dyn=0,
+                fail_existing_dyn=False):
+    """Compose the InterPodAffinity mask from static parts + dynamic
+    in-scan count deltas (all deltas default to the pure-static case).
+    anti_dyn/aff_dyn broadcast against [N, Taa]/[N, Ta]."""
+    anti_valid = p["ipaaa_valid"]
+    fail_anti = jnp.any(
+        anti_valid[None, :]
+        & parts["anti_key_on_node"]
+        & ((parts["anti_cnt_n"] + anti_dyn) > 0),
+        axis=1,
+    )
+    aff_valid = p["ipaa_valid"]
+    pods_exist = jnp.all(
+        jnp.where(aff_valid[None, :], (parts["aff_cnt_n"] + aff_dyn) > 0, True),
+        axis=1,
+    )
+    counts_empty = (parts["aff_total"] + aff_total_dyn) == 0
+    aff_ok = ~parts["has_aff"] | (
+        parts["aff_all_keys"]
+        & (pods_exist | (counts_empty & parts["self_match_all"]))
+    )
+    mask = ~(parts["fail_existing"] | fail_existing_dyn) & ~fail_anti & aff_ok
     unresolvable = ~aff_ok  # affinity miss is UnschedulableAndUnresolvable (:374)
     return mask, unresolvable
+
+
+def _ipa_filter(c: Dict, p: Dict):
+    """InterPodAffinity PreFilter+Filter (reference:
+    pkg/scheduler/framework/plugins/interpodaffinity/filtering.go:162
+    existing anti-affinity map, :194 incoming maps, :374 Filter)."""
+    return ipa_compose(p, _ipa_filter_parts(c, p))
 
 
 # ---------------------------------------------------------------------------

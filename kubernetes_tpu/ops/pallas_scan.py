@@ -70,7 +70,15 @@ CARRY_KEYS = ("requested", "nzpc", "cnt_fn", "cnt_sn")
 
 class PallasUnsupported(Exception):
     """This cluster/template shape can't ride the pallas path; callers
-    fall back to the jnp HoistedSession."""
+    fall back to the jnp HoistedSession.
+
+    `reason` is a FIXED slug per raise site (no interpolated shape
+    numbers) — it feeds the scheduler_tpu_session_builds_total metric's
+    reason label, where unbounded values would mint unbounded series."""
+
+    def __init__(self, message: str, reason: str = "other"):
+        super().__init__(message)
+        self.reason = reason
 
 
 def _ceil(n: int, m: int) -> int:
@@ -118,7 +126,13 @@ class PallasSession:
                  interpret: bool = False):
         for pa in template_arrays_list:
             if not pod_batchable(pa):
-                raise ValueError("pallas session templates must be batchable")
+                # the jnp HoistedSession carries affinity/port dynamics;
+                # the pallas kernel does not (yet) — signal a fallback,
+                # not an error
+                raise PallasUnsupported(
+                    "templates with affinity terms / host ports ride the "
+                    "jnp hoisted session", reason="affinity-terms-or-ports",
+                )
         self.weights = dict(weights or DEFAULT_WEIGHTS)
         self.interpret = interpret
         self._fps = {
@@ -128,7 +142,8 @@ class PallasSession:
         # every plugin score is <= MAX_NODE_SCORE after normalization
         if sum(abs(int(v)) for v in self.weights.values()) \
                 * (MAX_NODE_SCORE + 1) >= 2 ** 24:
-            raise PallasUnsupported("weights too large for exact f32 totals")
+            raise PallasUnsupported("weights too large for exact f32 totals",
+                                    reason="weights-exceed-f32")
         tp = _stack_templates(template_arrays_list)
         self._tp = tp
         S = {k: np.asarray(v) for k, v in _session_prologue(cluster, tp).items()}
@@ -148,7 +163,8 @@ class PallasSession:
         CP = SUB  # constraint rows padded to 8 per template: dynamic
         # (CP, Np) block reads at t*CP are provably 8-aligned for Mosaic
         if C > CP:
-            raise PallasUnsupported(f"{C} constraints > {CP} per template")
+            raise PallasUnsupported(f"{C} constraints > {CP} per template",
+                                    reason="too-many-constraints")
         TC = T * C
         TCp = T * CP
         self.CP = CP
@@ -176,7 +192,8 @@ class PallasSession:
                   (alloc, requested, req, nz_requested, nz_req)), default=0)
         if hi * (MAX_NODE_SCORE + 1) >= 2 ** 31:
             raise PallasUnsupported(
-                f"rescaled resource magnitude {hi} too large for int32")
+                f"rescaled resource magnitude {hi} too large for int32",
+                reason="resource-magnitude")
 
         self._alloc = _pad2(alloc.astype(np.int32))             # [Rp, Np]
         self._requested0 = _pad2(requested.astype(np.int32))
@@ -201,7 +218,8 @@ class PallasSession:
                for a in stat_rows):
             # POS_BIG (2^30), not 2^31: the kernel's min/max sentinels must
             # stay strictly above any genuine value
-            raise PallasUnsupported("static score magnitude exceeds sentinel")
+            raise PallasUnsupported("static score magnitude exceeds sentinel",
+                                    reason="score-magnitude")
         SR = len(stat_rows)  # == 8
         self.SR = SR
         stat = np.stack([a.astype(np.int32) for a in stat_rows], axis=1)
@@ -261,7 +279,8 @@ class PallasSession:
 
         K = max(len(uids), 1)
         if len(uids) > 4:
-            raise PallasUnsupported(f"{len(uids)} distinct shared-value keys")
+            raise PallasUnsupported(f"{len(uids)} distinct shared-value keys",
+                                    reason="too-many-topology-keys")
         self.K = K
         onehot = np.zeros((K, Np, VZ), np.float32)
         zof: List[Dict[int, int]] = []
@@ -270,7 +289,8 @@ class PallasSession:
             vals = vals[vals > 0]
             if len(vals) > VZ:
                 raise PallasUnsupported(
-                    f"topology key {u} has {len(vals)} values > {VZ}")
+                    f"topology key {u} has {len(vals)} values > {VZ}",
+                    reason="too-many-topology-values")
             m = {int(v): z for z, v in enumerate(vals)}
             zof.append(m)
             zid = np.array([m.get(int(v), -1) for v in column], np.int32)
@@ -322,7 +342,8 @@ class PallasSession:
         self._zvalid_node_s = zvalid_node_s
         self._zvalid_s = zvalid_s
         if max(prow_f.max(), prow_s.max()) >= 2 ** 24:
-            raise PallasUnsupported("pair ids exceed exact-f32 range")
+            raise PallasUnsupported("pair ids exceed exact-f32 range",
+                                    reason="pair-ids-exceed-f32")
 
         def tcn(a):  # [T, N, C] bool -> [TCp, Np] i32 (stride CP)
             out = np.zeros((TCp, Np), np.int32)
@@ -342,7 +363,8 @@ class PallasSession:
 
         # row -> template one-hot [T, TCp, VZ] and identity [TCp, LANE]
         if TCp > LANE:
-            raise PallasUnsupported(f"T*CP={TCp} exceeds {LANE} match lanes")
+            raise PallasUnsupported(f"T*CP={TCp} exceeds {LANE} match lanes",
+                                    reason="too-many-match-lanes")
         rowt = np.zeros((T, TCp, VZ), np.int32)
         for t in range(T):
             rowt[t, t * CP:t * CP + C, :] = 1

@@ -154,10 +154,19 @@ class ShardedScheduler:
         ops.hoisted.schedule_batch_hoisted, so callers are swappable."""
         from ..ops import hoisted
 
-        tp, batch_self, xs = hoisted.prepare_batch(pod_arrays_list)
+        tp, batch_self, xs, templates = hoisted.prepare_batch(pod_arrays_list)
+        dyn_ipa = hoisted.templates_have_terms(templates)
+        dyn_ports = hoisted.templates_have_ports(templates)
+        port_adds = (
+            hoisted._port_adds_for(templates, cluster) if dyn_ports else None
+        )
         c = shard_cluster(cluster, self.mesh)
         tp = replicate_pod(tp, self.mesh)
         batch_self = replicate_pod(batch_self, self.mesh)
         xs = replicate_pod(xs, self.mesh)
-        _, ys = hoisted._run(c, tp, batch_self, xs, self.weights_key)
+        if port_adds is not None:
+            port_adds = tuple(replicate_pod({"a": p}, self.mesh)["a"] for p in port_adds)
+        _, ys = hoisted._run(
+            c, tp, batch_self, xs, self.weights_key, dyn_ipa, dyn_ports, port_adds
+        )
         return [int(v) for v in np.asarray(ys["best"])], ys

@@ -79,3 +79,14 @@ batch_size = legacy_registry.register(
         buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
     )
 )
+session_builds = legacy_registry.register(
+    Counter(
+        "scheduler_tpu_session_builds_total",
+        "Device session (re)builds by kernel kind (TPU-build metric): "
+        "kind=pallas is the single-launch fast path; kind=hoisted is the "
+        "jnp lax.scan fallback. A pallas->hoisted downgrade on a workload "
+        "that previously rode pallas is a ~2.4x throughput cliff — alert "
+        "on it; the build also logs the downgrade reason.",
+        ("kind", "reason"),
+    )
+)

@@ -17,6 +17,7 @@ message-string level).
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -26,8 +27,10 @@ import numpy as np
 from ..api import types as v1
 from ..models.encoding import ClusterEncoding
 from ..models.pod_encoder import PodEncoder
-from ..ops.batch import pod_batchable, schedule_batch, shape_signature
+from ..ops.batch import shape_signature
 from ..ops.hoisted import HoistedSession, template_fingerprint
+
+logger = logging.getLogger(__name__)
 from ..ops.kernel import DEFAULT_WEIGHTS, schedule_pod_jit
 from .core import ScheduleResult
 from .framework.interface import FitError, Status
@@ -126,8 +129,9 @@ class TPUBackend(CacheListener):
             # device_state() with dirty rows DONATES the previous device
             # buffers (encoding.py fused scatter) — exactly the statics a
             # live session still references. Tear the session down first;
-            # this also covers the FitError re-dispatch and pod-table-full
-            # paths in schedule_many, whose enc.add_pod()s would otherwise
+            # this also covers schedule_many's bound-pod path and the
+            # scheduler core's unschedulable re-dispatch (scheduler.py
+            # _schedule_batch_tpu), whose enc.add_pod()s would otherwise
             # leave a surviving session's carry missing those pods.
             self._invalidate_session()
             p = {k: v for k, v in self.pe.encode(pod).items() if not k.startswith("_")}
@@ -155,7 +159,11 @@ class TPUBackend(CacheListener):
             while i < len(pods):
                 pod = pods[i]
                 p = self.pe.encode(pod)
-                if not pod_batchable(p):
+                # bound pods (spec.nodeName already set) go one-at-a-time;
+                # everything else — including affinity/host-port pods,
+                # whose assume effects the session carries dynamically
+                # (ops/hoisted.py term machinery) — rides the batch path
+                if pod.spec.node_name:
                     try:
                         # schedule() invalidates the session at entry, so the
                         # term/port-table writes of this add_pod cannot leak
@@ -171,54 +179,35 @@ class TPUBackend(CacheListener):
                         results.append((pod, None))
                     i += 1
                     continue
-                # group a maximal run of batchable, shape-identical pods
+                # group a maximal run of pending, shape-identical pods
                 group = [pod]
                 arrays = [p]
                 sig = shape_signature({k: v for k, v in p.items() if not k.startswith("_")})
                 j = i + 1
                 while j < len(pods):
+                    if pods[j].spec.node_name:
+                        break
                     q = self.pe.encode(pods[j])
                     qa = {k: v for k, v in q.items() if not k.startswith("_")}
-                    if not pod_batchable(q) or shape_signature(qa) != sig:
+                    if shape_signature(qa) != sig:
                         break
                     group.append(pods[j])
                     arrays.append(q)
                     j += 1
 
-                def _clean():
-                    return [
-                        {k: v for k, v in a.items() if not k.startswith("_")}
-                        for a in arrays
-                    ]
-
-                if all(not g.spec.node_name for g in group):
-                    # pending pods: the template-hoisted SESSION — carry
-                    # stays on-device across batches and scheduler cycles;
-                    # prologue is paid only when the session is torn down
-                    # by a foreign cluster mutation or a new template.
-                    # NOTE: no device_state() here — with dirty rows the
-                    # fused scatter DONATES the old device arrays, which
-                    # are exactly the live session's statics (the session
-                    # is self-consistent without the sync; its exactness
-                    # argument is in ops/hoisted.py)
-                    decisions = self._session_schedule(_clean())
-                elif len(self.enc._pod_free) < len(group):
-                    # pod table full: schedule singly (each add triggers
-                    # its own rebuild/growth)
-                    for g in group:
-                        try:
-                            r = self.schedule(g)
-                            self.enc.add_pod(g, r.suggested_host)
-                            results.append((g, r.suggested_host))
-                        except FitError:
-                            results.append((g, None))
-                    i = j
-                    continue
-                else:
-                    slots = [self.enc._pod_free[-1 - k] for k in range(len(group))]
-                    self._invalidate_session()  # in-scan pod-table writes
-                    decisions, _ = schedule_batch(
-                        self.enc.device_state(), _clean(), slots, self.weights)
+                # pending pods: the template-hoisted SESSION — carry
+                # stays on-device across batches and scheduler cycles;
+                # prologue is paid only when the session is torn down
+                # by a foreign cluster mutation or a new template.
+                # NOTE: no device_state() here — with dirty rows the
+                # fused scatter DONATES the old device arrays, which
+                # are exactly the live session's statics (the session
+                # is self-consistent without the sync; its exactness
+                # argument is in ops/hoisted.py)
+                decisions = self._session_schedule([
+                    {k: v for k, v in a.items() if not k.startswith("_")}
+                    for a in arrays
+                ])
                 for g, best in zip(group, decisions):
                     if best < 0:
                         results.append((g, None))
@@ -288,16 +277,29 @@ class TPUBackend(CacheListener):
     def _build_session(self):
         """Pallas single-launch session when the cluster shape supports it
         (ops/pallas_scan.py), else the jnp lax.scan session — identical
-        decisions either way (tests/test_pallas_scan.py)."""
+        decisions either way (tests/test_pallas_scan.py). Downgrades are
+        LOUD: a pallas->hoisted fallback costs ~2.4x throughput, so every
+        build is counted in scheduler_tpu_session_builds_total{kind,reason}
+        and downgrades are logged."""
+        from .metrics import session_builds
+
         templates = list(self._known_templates.values())
         cluster = self.enc.device_state()
         if self.use_pallas:
             from ..ops.pallas_scan import PallasSession, PallasUnsupported
 
             try:
-                return PallasSession(cluster, templates, self.weights)
-            except PallasUnsupported:
-                pass
+                s = PallasSession(cluster, templates, self.weights)
+                session_builds.inc(kind="pallas", reason="")
+                return s
+            except PallasUnsupported as e:
+                logger.warning(
+                    "pallas scan unsupported for this workload shape (%s); "
+                    "downgrading to the jnp hoisted session (~2.4x slower)", e,
+                )
+                session_builds.inc(kind="hoisted", reason=e.reason)
+        else:
+            session_builds.inc(kind="hoisted", reason="platform is not tpu")
         return HoistedSession(cluster, templates, self.weights)
 
     # -- helpers -----------------------------------------------------------
