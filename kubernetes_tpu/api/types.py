@@ -94,12 +94,23 @@ class NodeCondition:
 
 
 @dataclass
+class AttachedVolume:
+    """core/v1 AttachedVolume (node.status.volumesAttached entries, kept
+    by the attach/detach controller)."""
+
+    name: str = ""
+    device_path: str = ""
+
+
+@dataclass
 class NodeStatus:
     capacity: Optional[Dict[str, str]] = None
     allocatable: Optional[Dict[str, str]] = None
     conditions: Optional[List[NodeCondition]] = None
     images: Optional[List[ContainerImage]] = None
     phase: str = ""
+    volumes_attached: Optional[List[AttachedVolume]] = None
+    volumes_in_use: Optional[List[str]] = None
 
 
 @dataclass
@@ -270,6 +281,8 @@ class PodSpec:
     volumes: Optional[List[Volume]] = None
     restart_policy: str = "Always"
     termination_grace_period_seconds: Optional[int] = None
+    service_account_name: str = ""
+    automount_service_account_token: Optional[bool] = None
 
 
 @dataclass
@@ -409,6 +422,7 @@ class ReplicationControllerSpec:
     replicas: Optional[int] = None
     selector: Optional[Dict[str, str]] = None  # map selector (core/v1)
     template: Optional[PodTemplateSpec] = None
+    min_ready_seconds: int = 0
 
 
 @dataclass
@@ -502,6 +516,22 @@ class ConfigMap:
     data: Optional[Dict[str, str]] = None
     kind: str = "ConfigMap"
     api_version: str = "v1"
+
+
+@dataclass
+class Secret:
+    """core/v1 Secret (string data only; the service-account token
+    controller's token secrets are the load-bearing use)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Optional[Dict[str, str]] = None
+    type: str = "Opaque"
+    kind: str = "Secret"
+    api_version: str = "v1"
+
+
+SECRET_TYPE_SERVICE_ACCOUNT_TOKEN = "kubernetes.io/service-account-token"
+SERVICE_ACCOUNT_NAME_ANNOTATION = "kubernetes.io/service-account.name"
 
 
 # ---------------------------------------------------------------------------

@@ -231,16 +231,164 @@ def resource_quota(api: APIServer):
     return admit
 
 
+def service_account_admission(api: APIServer):
+    """ServiceAccount admission (plugin/pkg/admission/serviceaccount/
+    admission.go) — the load-bearing plugin that injects tokens:
+      * default spec.serviceAccountName to "default" (:228);
+      * reject pods referencing a ServiceAccount that doesn't exist
+        (:241 — the SA controller creates "default" per namespace);
+      * mount the SA's token secret as a pod volume unless automount is
+        disabled (:263 mountServiceAccountToken)."""
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "pods" or op != "CREATE":
+            return
+        if not obj.spec.service_account_name:
+            obj.spec.service_account_name = "default"
+        sa_name = obj.spec.service_account_name
+        ns = obj.metadata.namespace
+        sa = None
+        try:
+            sa = api.get("serviceaccounts", sa_name, ns)
+        except NotFound:
+            # the reference retries while the SA controller catches up;
+            # here "default" is implicit (admission must not deadlock
+            # bootstrap), any other missing SA is rejected
+            if sa_name != "default":
+                raise Invalid(
+                    f'service account {ns}/{sa_name} was not found'
+                )
+        if obj.spec.automount_service_account_token is False:
+            return
+        if any(
+            (vol.source or {}).get("secret", {}).get("secretName", "")
+            .startswith(f"{sa_name}-token-")
+            for vol in obj.spec.volumes or []
+        ):
+            return
+        # find the token controller's secret for this SA
+        token_secret = ""
+        try:
+            secrets, _ = api.list("secrets", ns)
+        except NotFound:
+            secrets = []
+        for s in secrets:
+            if (
+                s.type == v1.SECRET_TYPE_SERVICE_ACCOUNT_TOKEN
+                and (s.metadata.annotations or {}).get(
+                    v1.SERVICE_ACCOUNT_NAME_ANNOTATION) == sa_name
+            ):
+                token_secret = s.metadata.name
+                break
+        if not token_secret:
+            return  # no token yet: the kubelet remounts on restart
+        volumes = list(obj.spec.volumes or [])
+        volumes.append(v1.Volume(
+            name=f"{sa_name}-token",
+            source={"secret": {"secretName": token_secret}},
+        ))
+        obj.spec.volumes = volumes
+
+    return admit
+
+
+def node_restriction(api: APIServer):
+    """NodeRestriction (plugin/pkg/admission/noderestriction/admission.go):
+    a kubelet identity (user system:node:<name> in group system:nodes) may
+    only write objects tied to ITS node — its own Node object/status, its
+    own node-lease, and pods bound to it. Identity comes from the
+    request-context thread-local (requestcontext.py)."""
+
+    from .requestcontext import current_user
+
+    def node_of(user) -> str:
+        if user is None or "system:nodes" not in (user.groups or ()):
+            return ""
+        if not user.name.startswith("system:node:"):
+            return ""
+        return user.name[len("system:node:"):]
+
+    def admit(resource: str, op: str, obj) -> None:
+        node_name = node_of(current_user())
+        if not node_name:
+            return
+        if resource == "nodes":
+            if obj.metadata.name != node_name:
+                raise Invalid(
+                    f"node {node_name!r} is not allowed to modify node "
+                    f"{obj.metadata.name!r}"
+                )
+            return
+        if resource == "leases":
+            if obj.metadata.name != node_name:
+                raise Invalid(
+                    f"node {node_name!r} can only touch its own lease"
+                )
+            return
+        if resource == "pods":
+            bound = obj.spec.node_name
+            if bound != node_name:
+                raise Invalid(
+                    f"node {node_name!r} can only modify pods with "
+                    f"spec.nodeName set to itself"
+                )
+            return
+        if op in ("CREATE", "UPDATE", "DELETE") and resource in (
+            "events",
+        ):
+            return  # kubelets report events freely (rate-limited separately)
+        raise Invalid(
+            f"node {node_name!r} may not modify resource {resource!r}"
+        )
+
+    return admit
+
+
+def event_rate_limit(api: APIServer, qps: float = 50.0, burst: int = 100):
+    """EventRateLimit (plugin/pkg/admission/eventratelimit/admission.go):
+    token-bucket Event creates per namespace (the Namespace limit type —
+    a hot loop spamming events must not drown the store)."""
+
+    import threading
+    import time
+
+    buckets: Dict[str, Tuple[float, float]] = {}  # ns -> (tokens, stamp)
+    lock = threading.Lock()
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "events" or op != "CREATE":
+            return
+        ns = obj.metadata.namespace or "default"
+        now = time.monotonic()
+        with lock:
+            tokens, stamp = buckets.get(ns, (float(burst), now))
+            tokens = min(float(burst), tokens + (now - stamp) * qps)
+            if tokens < 1.0:
+                buckets[ns] = (tokens, now)
+                raise Invalid(
+                    f"event creation rate in namespace {ns!r} exceeds "
+                    f"{qps}/s (limit type: Namespace)"
+                )
+            buckets[ns] = (tokens - 1.0, now)
+
+    return admit
+
+
 def default_admission_chain(api: APIServer) -> Tuple[List, List]:
     """(mutating, validating) — reference default-enabled order
     (kubeapiserver/options/plugins.go)."""
     mutating = [
         namespace_lifecycle(api),
+        service_account_admission(api),
         priority_admission(api),
         default_toleration_seconds(api),
         limit_ranger(api),
     ]
-    validating = [resource_quota(api)]
+    validating = [
+        node_restriction(api),
+        event_rate_limit(api),
+        resource_quota(api),
+    ]
     return mutating, validating
 
 

@@ -238,10 +238,13 @@ class _AuthorizedResourceClient:
         authorization — so APF 429s and authz 403s are both recorded."""
 
         def inner():
+            from .requestcontext import request_user
+
             fc = self._s.flow_controller
             if fc is None:
                 self._check(verb, namespace, name)
-                return fn()
+                with request_user(self._user):
+                    return fn()
             from .flowcontrol import RequestInfo
 
             req = RequestInfo(
@@ -252,7 +255,8 @@ class _AuthorizedResourceClient:
             )
             with fc.dispatch(req):
                 self._check(verb, namespace, name)
-                return fn()
+                with request_user(self._user):
+                    return fn()
 
         return _with_audit(
             self._s.audit, self._user, verb, self._resource,
@@ -361,6 +365,28 @@ class _AuthorizedClientset:
             impersonated_by=self.user.name,
         )
         return _AuthorizedClientset(self._secure, target)
+
+    def bind_pod(self, namespace: str, pod_name: str, node_name: str):
+        """POST pods/{name}/binding through the secured chain (the
+        scheduler's bind verb — subresource pods/binding, verb=create,
+        as the reference's RBAC for system:kube-scheduler grants it)."""
+        sub = _AuthorizedResourceClient(self._secure, self.user, "pods/binding")
+        return sub._gated(
+            "create", namespace, pod_name,
+            lambda: self._secure.api.bind_pod(namespace, pod_name, node_name),
+        )
+
+    def remove_finalizer(self, resource: str, name: str, namespace: str,
+                         finalizer: str):
+        """Finalizer removal is an update on the resource (the reference
+        gates /finalize subresources on update)."""
+        sub = _AuthorizedResourceClient(self._secure, self.user, resource)
+        return sub._gated(
+            "update", namespace, name,
+            lambda: self._secure.api.remove_finalizer(
+                resource, name, namespace, finalizer
+            ),
+        )
 
     def pod_logs(self, name: str, namespace: str = "", container: str = "",
                  tail: Optional[int] = None):

@@ -63,10 +63,11 @@ class ResourceInfo:
 
 
 def _default_resources() -> Tuple["ResourceInfo", ...]:
-    from ..api import apps, autoscaling, batch, discovery, metrics, storage
+    from ..api import apps, autoscaling, batch, discovery, metrics, rbac, storage
     from ..client.events import Event
 
     return (
+        ResourceInfo("serviceaccounts", rbac.ServiceAccount, True),
         ResourceInfo("nodemetrics", metrics.NodeMetrics, False),
         ResourceInfo("podmetrics", metrics.PodMetrics, True),
         ResourceInfo("pods", v1.Pod, True),
@@ -84,6 +85,7 @@ def _default_resources() -> Tuple["ResourceInfo", ...]:
         ResourceInfo("endpoints", v1.Endpoints, True),
         ResourceInfo("namespaces", v1.Namespace, False),
         ResourceInfo("configmaps", v1.ConfigMap, True),
+        ResourceInfo("secrets", v1.Secret, True),
         ResourceInfo("persistentvolumes", v1.PersistentVolume, False),
         ResourceInfo("persistentvolumeclaims", v1.PersistentVolumeClaim, True),
         ResourceInfo("replicationcontrollers", v1.ReplicationController, True),
@@ -450,6 +452,13 @@ class APIServer:
         info = self._info(resource)
         meta = obj.metadata
         key = self._key(info, meta.namespace, meta.name)
+        # admission runs for status subresource writes too (the reference
+        # builds admission.Attributes with subresource="status"; e.g.
+        # NodeRestriction must gate kubelet status updates)
+        for admit in self._mutating:
+            admit(resource, "UPDATE", obj)
+        for admit in self._validating:
+            admit(resource, "UPDATE", obj)
         status_body = serde.to_dict(obj).get("status", {})
         final = {}
 

@@ -61,6 +61,8 @@ class Clientset:
         self.endpoints = _ResourceClient(api, "endpoints")
         self.namespaces = _ResourceClient(api, "namespaces")
         self.configmaps = _ResourceClient(api, "configmaps")
+        self.secrets = _ResourceClient(api, "secrets")
+        self.serviceaccounts = _ResourceClient(api, "serviceaccounts")
         self.persistentvolumes = _ResourceClient(api, "persistentvolumes")
         self.persistentvolumeclaims = _ResourceClient(api, "persistentvolumeclaims")
         self.replicationcontrollers = _ResourceClient(api, "replicationcontrollers")

@@ -152,6 +152,12 @@ class Informer:
             if ev is None:
                 if self._stop.is_set():
                     return
+                if getattr(self._watch, "closed", False):
+                    # dead stream (HTTP disconnect, server restart):
+                    # return to _run, which re-lists and re-watches —
+                    # reflector.go's ListAndWatch retry path. In-proc
+                    # watches never set this.
+                    return
                 continue
             key = meta_namespace_key(ev.object)
             with self._lock:

@@ -44,6 +44,14 @@ DEFAULT_CONTROLLERS = [
     "nodelifecycle",
     "disruption",
     "resourcequota",
+    "podgc",
+    "serviceaccount",
+    "serviceaccount-token",
+    "replicationcontroller",
+    "attachdetach",
+    "pvc-protection",
+    "pv-protection",
+    "ttl",
 ]
 
 FAST_NODE_CONFIG = dict(

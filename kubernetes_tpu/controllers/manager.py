@@ -13,6 +13,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..client.informer import SharedInformerFactory
 from ..client.leaderelection import LeaderElectionConfig, LeaderElector
+from .attachdetach import AttachDetachController
 from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .deployment import DeploymentController
@@ -23,12 +24,17 @@ from .garbagecollector import GarbageCollector
 from .job import JobController
 from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
+from .nodettl import TTLController
 from .persistentvolume import PersistentVolumeController
 from .podautoscaler import HorizontalController
+from .podgc import PodGCController
 from .replicaset import ReplicaSetController
+from .replication import ReplicationControllerController
 from .resourcequota import ResourceQuotaController
+from .serviceaccount import ServiceAccountController, TokensController
 from .statefulset import StatefulSetController
 from .ttlafterfinished import TTLAfterFinishedController
+from .volumeprotection import PVCProtectionController, PVProtectionController
 
 
 def _metrics_api_source(cs):
@@ -76,6 +82,24 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "resourcequota": lambda cs, inf, opts: ResourceQuotaController(
             cs, inf, sync_period=opts.get("quota_sync_period", 5.0)
         ),
+        "podgc": lambda cs, inf, opts: PodGCController(
+            cs, inf,
+            terminated_pod_threshold=opts.get("terminated_pod_threshold", 12500),
+            sync_period=opts.get("podgc_sync_period", 20.0),
+        ),
+        "serviceaccount": lambda cs, inf, opts: ServiceAccountController(cs, inf),
+        "serviceaccount-token": lambda cs, inf, opts: TokensController(
+            cs, inf, mint=opts.get("token_minter")
+        ),
+        "replicationcontroller": lambda cs, inf, opts: (
+            ReplicationControllerController(cs, inf)
+        ),
+        "attachdetach": lambda cs, inf, opts: AttachDetachController(
+            cs, inf, sync_period=opts.get("attach_detach_sync_period", 1.0)
+        ),
+        "pvc-protection": lambda cs, inf, opts: PVCProtectionController(cs, inf),
+        "pv-protection": lambda cs, inf, opts: PVProtectionController(cs, inf),
+        "ttl": lambda cs, inf, opts: TTLController(cs, inf),
     }
 
 

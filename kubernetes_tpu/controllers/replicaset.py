@@ -52,14 +52,20 @@ def pod_delete_cost(pod: v1.Pod) -> tuple:
 class ReplicaSetController(Controller):
     name = "replicaset"
     kind = "ReplicaSet"
+    resource = "replicasets"
 
     def __init__(self, clientset, informer_factory, workers: int = 2):
         super().__init__(workers=workers)
         self.client = clientset
-        self.rs_informer = informer_factory.informer_for("replicasets")
+        self.rs_informer = informer_factory.informer_for(self.resource)
         self.pod_informer = informer_factory.informer_for("pods")
         self.expectations = ControllerExpectations()
         self._wire_handlers()
+
+    def _selector(self, rs) -> Selector:
+        """Overridable: ReplicationController carries a map selector
+        (core/v1) instead of a LabelSelector."""
+        return selector_for(rs.spec.selector)
 
     # -- event handlers (replica_set.go:108-129 informer wiring) -----------
 
@@ -112,7 +118,7 @@ class ReplicaSetController(Controller):
     # -- sync ---------------------------------------------------------------
 
     def _claimed_pods(self, rs: apps.ReplicaSet) -> List[v1.Pod]:
-        sel = selector_for(rs.spec.selector)
+        sel = self._selector(rs)
         out = []
         for pod in self.pod_informer.list():
             if pod.metadata.namespace != rs.metadata.namespace:
@@ -189,7 +195,7 @@ class ReplicaSetController(Controller):
             return False
 
     def _update_status(self, rs: apps.ReplicaSet, pods: List[v1.Pod]) -> None:
-        sel = selector_for(rs.spec.selector)
+        sel = self._selector(rs)
         fully_labeled = sum(1 for p in pods if sel.matches(p.metadata.labels))
         ready = sum(1 for p in pods if is_pod_ready(p))
         min_ready = rs.spec.min_ready_seconds or 0
@@ -204,17 +210,20 @@ class ReplicaSetController(Controller):
             start = p.status.start_time or p.metadata.creation_timestamp or now
             if now - start >= min_ready:
                 available += 1
-        new = apps.ReplicaSetStatus(
+        new = self._make_status(rs, pods, fully_labeled, ready, available)
+        if serde.to_dict(new) != serde.to_dict(rs.status):
+            updated = copy.deepcopy(rs)
+            updated.status = new
+            try:
+                self.client.resource(self.resource).update_status(updated)
+            except Exception:  # noqa: BLE001 — next event retries
+                pass
+
+    def _make_status(self, rs, pods, fully_labeled, ready, available):
+        return apps.ReplicaSetStatus(
             replicas=len(pods),
             fully_labeled_replicas=fully_labeled,
             ready_replicas=ready,
             available_replicas=available,
             observed_generation=rs.metadata.generation,
         )
-        if serde.to_dict(new) != serde.to_dict(rs.status):
-            updated = copy.deepcopy(rs)
-            updated.status = new
-            try:
-                self.client.replicasets.update_status(updated)
-            except Exception:  # noqa: BLE001 — next event retries
-                pass

@@ -195,6 +195,35 @@ class ClusterEncoding:
         self._pod_free: List[int] = []
         self._anti_terms: Optional[_TermRows] = None
         self._score_terms: Optional[_TermRows] = None
+        # capacity floors (reserve()): rebuilds size rows to at least these
+        self._pod_reserve = 0
+        self._anti_reserve = 0
+        self._score_reserve = 0
+
+    def reserve(self, pods: int = 0, anti_terms: int = 0,
+                score_terms: int = 0) -> None:
+        """Pre-size row capacities for a workload of known scale.
+
+        Without a reserve, a workload that grows from 1k to 20k pods walks
+        the 1.5x capacity ladder (vocab.bucket_capacity) — each step is a
+        full rebuild AND, because array shapes change, a fresh XLA compile
+        of every kernel shape in flight. One reserve call up front
+        collapses that to a single rebuild. The floors are sticky
+        (max-accumulating) and apply to the pod table and the
+        anti/score affinity term tables."""
+        self._pod_reserve = max(self._pod_reserve, pods)
+        self._anti_reserve = max(self._anti_reserve, anti_terms)
+        self._score_reserve = max(self._score_reserve, score_terms)
+        A = self._arrays
+        if (
+            not A
+            or self._pod_reserve > A["pvalid"].shape[0]
+            or (self._anti_terms is not None
+                and self._anti_reserve > self._anti_terms.valid.shape[0])
+            or (self._score_terms is not None
+                and self._score_reserve > self._score_terms.valid.shape[0])
+        ):
+            self._rebuild_needed = True
 
     # -- object-level API ---------------------------------------------------
 
@@ -359,7 +388,9 @@ class ClusterEncoding:
 
         n = len(self._node_order)
         ncap = bucket_capacity(max(n, 1))
-        pcap = bucket_capacity(max(len(self._pods), 1), minimum=64)
+        pcap = bucket_capacity(
+            max(len(self._pods), self._pod_reserve, 1), minimum=64
+        )
         rw = self._res_width()
         tcap = self.taint_vocab.capacity
         p2cap = self.port_pair_vocab.capacity
@@ -447,11 +478,13 @@ class ClusterEncoding:
                     max_v = max(max_v, t.n_vals)
                     max_ns = max(max_ns, len(term.namespaces))
         self._anti_terms = _TermRows(
-            bucket_capacity(max(n_anti, 1), minimum=16), bucket_capacity(max_r, 2),
+            bucket_capacity(max(n_anti, self._anti_reserve, 1), minimum=16),
+            bucket_capacity(max_r, 2),
             bucket_capacity(max_v, 2), bucket_capacity(max_ns, 2), scored=False,
         )
         self._score_terms = _TermRows(
-            bucket_capacity(max(n_score, 1), minimum=16), bucket_capacity(max_r, 2),
+            bucket_capacity(max(n_score, self._score_reserve, 1), minimum=16),
+            bucket_capacity(max_r, 2),
             bucket_capacity(max_v, 2), bucket_capacity(max_ns, 2), scored=True,
         )
 

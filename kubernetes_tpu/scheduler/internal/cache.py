@@ -131,6 +131,18 @@ class SchedulerCache:
         with self._lock:
             return bool(self._assumed_pods.get(v1.pod_key(pod)))
 
+    def min_pod_priority(self) -> int:
+        """Lowest spec.priority among cached pods (0 when empty). A
+        preemption dry-run can only evict strictly-lower-priority victims
+        (defaultpreemption selectVictimsOnNode), so an incoming pod whose
+        priority is <= this floor provably finds none — callers use that
+        to skip the per-pod failure-status re-dispatch."""
+        with self._lock:
+            return min(
+                (ps.pod.spec.priority or 0 for ps in self._pod_states.values()),
+                default=0,
+            )
+
     # -- confirmed state from informers (cache.go:443-560) -----------------
 
     def add_pod(self, pod: v1.Pod) -> None:

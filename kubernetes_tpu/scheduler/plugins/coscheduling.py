@@ -34,13 +34,25 @@ DEFAULT_PERMIT_TIMEOUT = 60.0
 
 
 def pod_group(pod: v1.Pod) -> Tuple[str, int]:
-    """(group name, min available) — ("", 0) for non-gang pods."""
-    labels = pod.metadata.labels or {}
-    group = labels.get(GROUP_LABEL, "")
+    """(group name, min available) — ("", 0) for non-gang pods.
+
+    Annotations take precedence over labels. The label form matches the
+    out-of-tree coscheduling convention; the annotation form exists
+    because labels enter the pod's encoded self rows (models/pod_encoder)
+    — a per-gang label value makes every gang a distinct template and
+    defeats template hoisting, while gang identity itself is invisible to
+    filter/score (it only gates Permit, host-side)."""
+    meta = pod.metadata
+    sources = (meta.annotations or {}, meta.labels or {})
+    group = next((s[GROUP_LABEL] for s in sources if s.get(GROUP_LABEL)), "")
     if not group:
         return "", 0
+    raw = next(
+        (s[MIN_AVAILABLE_LABEL] for s in sources if s.get(MIN_AVAILABLE_LABEL)),
+        "0",
+    )
     try:
-        min_available = int(labels.get(MIN_AVAILABLE_LABEL, "0"))
+        min_available = int(raw)
     except ValueError:
         min_available = 0
     return group, min_available

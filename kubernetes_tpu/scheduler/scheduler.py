@@ -96,6 +96,7 @@ class Scheduler:
         else:
             self.tpu = None
         self._stop = threading.Event()
+        self._paused = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._binders = ThreadPoolExecutor(max_workers=8, thread_name_prefix="binder")
         self._inflight = 0  # scheduling batches + binds not yet finished
@@ -173,6 +174,16 @@ class Scheduler:
             self._thread = threading.Thread(target=self._run, daemon=True)
             self._thread.start()
 
+    def pause(self) -> None:
+        """Suspend popping (the queue keeps accumulating). Lets a caller
+        stage a large backlog so the batch path drains it at full
+        max_batch width instead of racing the producer with small ragged
+        batches (each distinct batch bucket is an XLA compile)."""
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
     def stop(self) -> None:
         self._stop.set()
         self.queue.close()
@@ -186,6 +197,9 @@ class Scheduler:
         last_cleanup = time.monotonic()
         while not self._stop.is_set():
             try:
+                if self._paused.is_set():
+                    time.sleep(0.02)
+                    continue
                 self.schedule_one(timeout=0.2)
                 now = time.monotonic()
                 if now - last_cleanup >= 1.0:  # cache.go:125 1s cleanup ticker
@@ -256,9 +270,25 @@ class Scheduler:
                     self._schedule_one_oracle(info)
         results = self.tpu.schedule_many([i.pod for i in todo])
         by_key = {v1.pod_key(p): node for p, node in results}
+        # per-node failure statuses only matter when a PostFilter
+        # (preemption) will consume them, and preemption can only evict
+        # strictly-lower-priority victims. The re-dispatch that recovers
+        # statuses costs one full kernel dispatch + status
+        # materialization PER POD — on saturation workloads (every node
+        # full, uniform priorities) that's a crawl for provably-empty
+        # dry-runs, so gate it on both conditions.
+        has_post_filter = bool(
+            self.framework is not None and self.framework.post_filter_plugins
+        )
+        min_prio: Optional[int] = None
         for info in todo:
             node = by_key.get(v1.pod_key(info.pod))
             if node is None:
+                if has_post_filter and min_prio is None:
+                    min_prio = self.cache.min_pod_priority()
+                if not has_post_filter or (info.pod.spec.priority or 0) <= min_prio:
+                    self._record_failure(info, cycle, {})
+                    continue
                 # re-dispatch singly to recover per-node failure statuses
                 # for the preemption dry-run (FitError carries them)
                 try:

@@ -305,13 +305,22 @@ class TPUBackend(CacheListener):
     # -- helpers -----------------------------------------------------------
 
     def _select_host(self, total: np.ndarray, feasible: np.ndarray) -> int:
-        """selectHost with reservoir sampling over max-score ties
-        (generic_scheduler.go:152)."""
-        max_score = total.max()
-        ties = np.nonzero((total == max_score) & feasible)[0]
-        if len(ties) == 1:
-            return int(ties[0])
-        return int(ties[self.rng.randrange(len(ties))])
+        """selectHost, FIRST-MAX tie-break — the TPU build's convention on
+        every kernel path (single-pod here; batch scan via jnp.argmax,
+        ops/batch.py; pallas via explicit min-index-among-maxima,
+        ops/pallas_scan.py:727; sharded via the same argmax under GSPMD).
+
+        The reference reservoir-samples ties (generic_scheduler.go:152) —
+        any tie member is a correct decision, but a randomized pick can
+        never be bit-reproducible across differently-batched paths, so
+        the deterministic lowest-index maximum is the A/B convention and
+        the oracle is pinned to it in the parity harnesses
+        (tests/test_kernel_parity.py first-max oracle,
+        tests/test_hoisted_terms.py _sequential_reference). The oracle
+        BACKEND (scheduler backend="oracle") keeps reference reservoir
+        semantics."""
+        masked = np.where(feasible, total, np.iinfo(np.int64).min)
+        return int(np.argmax(masked))
 
     def _statuses(self, out: Dict, n_nodes: int) -> Dict[str, Status]:
         statuses: Dict[str, Status] = {}

