@@ -240,6 +240,36 @@ def service_account_admission(api: APIServer):
       * mount the SA's token secret as a pod volume unless automount is
         disabled (:263 mountServiceAccountToken)."""
 
+    import time as _time
+
+    # (ns, sa) -> (secret name, stamp): pod creates are the apiserver's
+    # hottest write; a full secrets list per create would be O(secrets)
+    # serde work. Bounded staleness (like the reference's informer lag);
+    # "" entries (no token yet) also cache so bursts don't re-list.
+    token_cache: Dict[Tuple[str, str], Tuple[str, float]] = {}
+    TOKEN_CACHE_TTL = 10.0
+
+    def find_token_secret(ns: str, sa_name: str) -> str:
+        hit = token_cache.get((ns, sa_name))
+        now = _time.monotonic()
+        if hit is not None and now - hit[1] < TOKEN_CACHE_TTL:
+            return hit[0]
+        token_secret = ""
+        try:
+            secrets, _ = api.list("secrets", ns)
+        except NotFound:
+            secrets = []
+        for s in secrets:
+            if (
+                s.type == v1.SECRET_TYPE_SERVICE_ACCOUNT_TOKEN
+                and (s.metadata.annotations or {}).get(
+                    v1.SERVICE_ACCOUNT_NAME_ANNOTATION) == sa_name
+            ):
+                token_secret = s.metadata.name
+                break
+        token_cache[(ns, sa_name)] = (token_secret, now)
+        return token_secret
+
     def admit(resource: str, op: str, obj) -> None:
         if resource != "pods" or op != "CREATE":
             return
@@ -247,9 +277,8 @@ def service_account_admission(api: APIServer):
             obj.spec.service_account_name = "default"
         sa_name = obj.spec.service_account_name
         ns = obj.metadata.namespace
-        sa = None
         try:
-            sa = api.get("serviceaccounts", sa_name, ns)
+            api.get("serviceaccounts", sa_name, ns)
         except NotFound:
             # the reference retries while the SA controller catches up;
             # here "default" is implicit (admission must not deadlock
@@ -266,20 +295,7 @@ def service_account_admission(api: APIServer):
             for vol in obj.spec.volumes or []
         ):
             return
-        # find the token controller's secret for this SA
-        token_secret = ""
-        try:
-            secrets, _ = api.list("secrets", ns)
-        except NotFound:
-            secrets = []
-        for s in secrets:
-            if (
-                s.type == v1.SECRET_TYPE_SERVICE_ACCOUNT_TOKEN
-                and (s.metadata.annotations or {}).get(
-                    v1.SERVICE_ACCOUNT_NAME_ANNOTATION) == sa_name
-            ):
-                token_secret = s.metadata.name
-                break
+        token_secret = find_token_secret(ns, sa_name)
         if not token_secret:
             return  # no token yet: the kubelet remounts on restart
         volumes = list(obj.spec.volumes or [])

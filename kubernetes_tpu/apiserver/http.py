@@ -65,7 +65,12 @@ def _split_path(path: str) -> Tuple[str, str, str, str]:
     namespace = ""
     if parts and parts[0] == "namespaces" and len(parts) >= 2:
         # /namespaces/{ns}/... — but a bare /namespaces[/name] addresses
-        # the namespaces resource itself
+        # the namespaces resource itself, and /namespaces/{name}/status|
+        # finalize are SUBRESOURCES of a namespace (the reference
+        # registers those two routes explicitly; nothing else collides
+        # with the namespaced-collection shape)
+        if len(parts) == 3 and parts[2] in ("status", "finalize"):
+            return "namespaces", "", parts[1], parts[2]
         if len(parts) >= 3:
             namespace = parts[1]
             parts = parts[2:]
@@ -120,9 +125,14 @@ class _Handler(BaseHTTPRequestHandler):
     def _send_error(self, e: Exception) -> None:
         code = getattr(e, "code", 500)
         body = _status_body(code, str(e), reason=type(e).__name__)
+        # errors can fire BEFORE the request body was read (authn,
+        # routing); unread body bytes would desync the next keep-alive
+        # request on this socket, so always close after an error
+        self.close_connection = True
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
 
@@ -254,6 +264,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(200, {"output": out, "exitCode": code})
         info = self.hub.api._info(resource)
         obj = serde.from_dict(info.type, self._body())
+        if info.namespaced and ns and not obj.metadata.namespace:
+            # the reference defaults the object to the path namespace
+            # (handlers/create.go scope check + defaulting)
+            obj.metadata.namespace = ns
         created = self._resource_client(resource).create(obj)
         self._send_json(201, serde.to_dict(created))
 
@@ -265,6 +279,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(200, {"status": "Success"})
         info = self.hub.api._info(resource)
         obj = serde.from_dict(info.type, self._body())
+        if info.namespaced and ns and not obj.metadata.namespace:
+            obj.metadata.namespace = ns
         client = self._resource_client(resource)
         if sub == "status":
             updated = client.update_status(obj)
@@ -406,7 +422,9 @@ class RemoteWatch:
             ev = self.poll(timeout=0.5)
             if ev is not None:
                 yield ev
-            elif self._stopped.is_set():
+            elif self._stopped.is_set() or self.closed:
+                # queue drained and the stream is gone (poll returns None
+                # only when empty, so buffered events are never dropped)
                 return
 
     def stop(self) -> None:

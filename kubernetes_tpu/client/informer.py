@@ -66,6 +66,12 @@ class Informer:
         with self._lock:
             return list(self._cache.values())
 
+    def count(self) -> int:
+        """O(1) store size — callers that only need a count must not pay
+        a full list() copy on informer event threads."""
+        with self._lock:
+            return len(self._cache)
+
     def has_synced(self) -> bool:
         return self._synced.is_set()
 

@@ -65,6 +65,12 @@ class AttachDetachController(Controller):
         return desired
 
     def sync(self, key: str) -> None:
+        # an unsynced pod/PVC cache yields an EMPTY desired state — acting
+        # on it would mass-detach volumes under running pods
+        if not (self.pod_informer.has_synced()
+                and self.pvc_informer.has_synced()
+                and self.node_informer.has_synced()):
+            return
         desired = self._desired_state()
         for node in self.node_informer.list():
             name = node.metadata.name
@@ -74,7 +80,15 @@ class AttachDetachController(Controller):
             }
             if want == have:
                 continue
-            updated = copy.deepcopy(node)
+            try:
+                # re-GET before writing: update_status replaces the WHOLE
+                # status, and the informer copy may predate a kubelet
+                # heartbeat — writing the stale snapshot would revert
+                # fresh conditions/capacity (the kubelet's own status
+                # loop uses the same re-GET discipline)
+                updated = copy.deepcopy(self.client.nodes.get(name))
+            except Exception:  # noqa: BLE001 — node gone: next tick
+                continue
             updated.status.volumes_attached = [
                 v1.AttachedVolume(name=pv, device_path=f"/dev/disk/{pv}")
                 for pv in sorted(want)

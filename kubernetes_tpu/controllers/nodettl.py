@@ -41,7 +41,7 @@ class TTLController(Controller):
         ))
 
     def _on_count_change(self, node) -> None:
-        n = len(self.node_informer.list())
+        n = self.node_informer.count()  # O(1); no full-store copy per event
         b = self._boundary
         # hysteresis walk (ttl_controller.go updateNodeCount)
         while b < len(_BOUNDARIES) - 1 and n > _BOUNDARIES[b][1]:

@@ -82,6 +82,20 @@ class TokensController(Controller):
             on_add=lambda sa: self.enqueue(meta_namespace_key(sa)),
             on_delete=lambda sa: self.enqueue(meta_namespace_key(sa)),
         ))
+        # a deleted token secret must be re-minted (tokens_controller.go
+        # watches secrets for exactly this)
+        self.secret_informer.add_event_handler(EventHandler(
+            on_delete=self._on_secret_delete,
+        ))
+
+    def _on_secret_delete(self, secret) -> None:
+        if secret.type != v1.SECRET_TYPE_SERVICE_ACCOUNT_TOKEN:
+            return
+        sa = (secret.metadata.annotations or {}).get(
+            v1.SERVICE_ACCOUNT_NAME_ANNOTATION
+        )
+        if sa:
+            self.enqueue(f"{secret.metadata.namespace}/{sa}")
 
     def _token_secrets_of(self, namespace: str, name: str):
         return [

@@ -169,6 +169,14 @@ class TestServiceAccounts:
             v1.SERVICE_ACCOUNT_NAME_ANNOTATION] == "robot"
         assert minted == [("default", "robot")]
 
+        # a deleted token SECRET is re-minted (the secrets watch)
+        name0 = s.metadata.name
+        cs.secrets.delete(name0, "default")
+        assert wait_until(
+            lambda: token_secrets() and token_secrets()[0].metadata.name != name0,
+            timeout=10,
+        )
+
         cs.serviceaccounts.delete("robot", "default")
         assert wait_until(lambda: not token_secrets(), timeout=10)
 

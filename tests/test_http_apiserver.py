@@ -259,3 +259,43 @@ class TestHTTPClusterE2E:
             hollow.stop()
         finally:
             srv.stop()
+
+
+class TestWireRoutingEdges:
+    def test_namespace_subresources_route_to_namespaces(self, wire):
+        """/api/v1/namespaces/{name}/status and /finalize are namespace
+        SUBRESOURCES, not namespaced collections (installer registers
+        them explicitly in the reference) — the namespace controller's
+        Terminating drain depends on both working over the wire."""
+        _, remote = wire
+        remote.create("namespaces", v1.Namespace(
+            metadata=v1.ObjectMeta(name="doomed")))
+        ns = remote.get("namespaces", "doomed")
+        ns.status.phase = "Terminating"
+        remote.update_status("namespaces", ns)
+        assert remote.get("namespaces", "doomed").status.phase == "Terminating"
+        remote.delete("namespaces", "doomed")  # soft: kubernetes finalizer
+        remote.remove_finalizer("namespaces", "doomed", "", "kubernetes")
+        with pytest.raises(NotFound):
+            remote.get("namespaces", "doomed")
+
+    def test_create_defaults_to_path_namespace(self, wire):
+        """POST /api/v1/namespaces/team-a/pods with a body that omits
+        metadata.namespace lands in team-a (handlers/create.go scope
+        defaulting)."""
+        srv, remote = wire
+        import http.client
+        import json as _json
+
+        conn = http.client.HTTPConnection(remote._host, remote._port)
+        body = {"metadata": {"name": "bare"},
+                "spec": {"containers": [{"name": "c", "image": "i"}]}}
+        conn.request("POST", "/api/v1/namespaces/team-a/pods",
+                     body=_json.dumps(body).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        created = _json.loads(resp.read())
+        conn.close()
+        assert resp.status == 201
+        assert created["metadata"]["namespace"] == "team-a"
+        assert remote.get("pods", "bare", "team-a").metadata.name == "bare"
