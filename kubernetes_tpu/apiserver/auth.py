@@ -287,10 +287,14 @@ class _AuthorizedResourceClient:
             lambda: self._s.api.update_status(self._resource, obj), body=obj,
         )
 
-    def delete(self, name: str, namespace: str = ""):
+    def delete(self, name: str, namespace: str = "",
+               propagation_policy: Optional[str] = None):
         return self._gated(
             "delete", namespace, name,
-            lambda: self._s.api.delete(self._resource, name, namespace),
+            lambda: self._s.api.delete(
+                self._resource, name, namespace,
+                propagation_policy=propagation_policy,
+            ),
         )
 
     def list(self, namespace=None, label_selector=None):

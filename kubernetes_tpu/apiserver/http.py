@@ -291,7 +291,13 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(200, serde.to_dict(updated))
 
     def _verb_delete(self, resource, ns, name, sub, params) -> None:
-        self._resource_client(resource).delete(name, ns)
+        policy = params.get("propagationPolicy") or None
+        if policy:
+            self._resource_client(resource).delete(
+                name, ns, propagation_policy=policy
+            )
+        else:
+            self._resource_client(resource).delete(name, ns)
         self._send_json(200, {"status": "Success"})
 
 
@@ -321,7 +327,10 @@ class _RawFacade:
     def update_status(self, obj):
         return self._api.update_status(self._resource, obj)
 
-    def delete(self, name, namespace=""):
+    def delete(self, name, namespace="", propagation_policy=None):
+        if propagation_policy:
+            return self._api.delete(self._resource, name, namespace,
+                                    propagation_policy=propagation_policy)
         return self._api.delete(self._resource, name, namespace)
 
     def list(self, namespace=None, label_selector=None):
@@ -557,9 +566,14 @@ class RemoteAPIServer:
         )
         return serde.from_dict(info.type, data)
 
-    def delete(self, resource: str, name: str, namespace: str = "") -> None:
+    def delete(self, resource: str, name: str, namespace: str = "",
+               propagation_policy: Optional[str] = None) -> None:
         info = self._info(resource)
-        self._request("DELETE", self._path(info, namespace, name))
+        query = (
+            f"propagationPolicy={propagation_policy}"
+            if propagation_policy else ""
+        )
+        self._request("DELETE", self._path(info, namespace, name), query=query)
 
     def remove_finalizer(self, resource: str, name: str, namespace: str,
                          finalizer: str) -> None:

@@ -139,6 +139,11 @@ class TypedWatch:
 # admission plugin signature: (resource, operation, obj) -> None | raises
 AdmissionFunc = Callable[[str, str, Any], None]
 
+# GC propagation finalizers (apimachinery metav1.FinalizerDeleteDependents
+# / FinalizerOrphanDependents)
+FINALIZER_FOREGROUND = "foregroundDeletion"
+FINALIZER_ORPHAN = "orphan"
+
 
 class APIServer:
     def __init__(
@@ -193,6 +198,11 @@ class APIServer:
     def pod_exec(self, name: str, namespace: str, cmd: List[str],
                  container: str = "") -> Tuple[str, int]:
         """POST pods/{name}/exec → kubelet → CRI ExecSync."""
+        return self._node_handler_for(name, namespace).exec_in_pod(
+            name, namespace, cmd, container
+        )
+
+    def _node_handler_for(self, name: str, namespace: str):
         pod = self.get("pods", name, namespace)
         if not pod.spec.node_name:
             raise Invalid(f"pod {name} is not scheduled yet")
@@ -200,7 +210,26 @@ class APIServer:
             h = self._node_proxies.get(pod.spec.node_name)
         if h is None:
             raise NotFound(f"no kubelet connection for node {pod.spec.node_name}")
-        return h.exec_in_pod(name, namespace, cmd, container)
+        return h
+
+    def pod_exec_stream(self, name: str, namespace: str, cmd: List[str],
+                        container: str = ""):
+        """Streaming exec (the SPDY/remotecommand proxy path: the
+        apiserver connects the client stream to the kubelet's streaming
+        server; cri/streaming)."""
+        return self._node_handler_for(name, namespace).exec_stream_in_pod(
+            name, namespace, cmd, container
+        )
+
+    def pod_attach(self, name: str, namespace: str, container: str = ""):
+        return self._node_handler_for(name, namespace).attach_pod(
+            name, namespace, container
+        )
+
+    def pod_portforward(self, name: str, namespace: str, port: int):
+        return self._node_handler_for(name, namespace).portforward_pod(
+            name, namespace, port
+        )
 
     # -- keys --------------------------------------------------------------
 
@@ -293,13 +322,18 @@ class APIServer:
             hook(resource, op, updated)
         return updated
 
-    def delete(self, resource: str, name: str, namespace: str = "") -> None:
+    def delete(self, resource: str, name: str, namespace: str = "",
+               propagation_policy: Optional[str] = None) -> None:
         """Delete, honoring finalizers: an object with a non-empty
         metadata.finalizers list is soft-deleted (deletionTimestamp stamped,
         object kept) until the last finalizer is removed by its controller —
         the reference's graceful-deletion/finalization flow
         (apiserver/pkg/registry/generic/registry/store.go Delete →
-        deletionTimestamp + finalizer wait)."""
+        deletionTimestamp + finalizer wait).
+
+        propagation_policy: None/"Background" (default), "Foreground"
+        (block on dependents: the GC deletes blocking dependents first),
+        or "Orphan" (the GC strips ownerReferences from dependents)."""
         info = self._info(resource)
         key = self._key(info, namespace, name)
         # DELETE admission (validating webhooks guard deletions in the
@@ -313,6 +347,29 @@ class APIServer:
                 admit(resource, "DELETE", current)
             for admit in self._validating:
                 admit(resource, "DELETE", current)
+        # propagationPolicy (DeleteOptions): Foreground/Orphan stamp the
+        # matching GC finalizer so the garbage collector finishes the
+        # delete only after dependents are deleted / orphaned
+        # (apimachinery DeletionPropagation; registry/store.go
+        # deletionFinalizersForGarbageCollection)
+        gc_finalizer = {
+            "Foreground": FINALIZER_FOREGROUND,
+            "Orphan": FINALIZER_ORPHAN,
+        }.get(propagation_policy or "")
+        if gc_finalizer is not None:
+            def add_fin(body):
+                nb = dict(body)
+                meta = dict(nb.get("metadata", {}))
+                fins = list(meta.get("finalizers", []))
+                if gc_finalizer not in fins:
+                    meta["finalizers"] = fins + [gc_finalizer]
+                nb["metadata"] = meta
+                return nb
+
+            try:
+                self.store.guaranteed_update(key, add_fin)
+            except kv.KeyNotFound as e:
+                raise NotFound(str(e))
         # The finalizer check and the write are guarded by the same
         # mod_revision so a concurrent add/remove of the last finalizer
         # can't strand a soft-deleted object or bypass finalization

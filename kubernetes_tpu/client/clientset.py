@@ -33,8 +33,13 @@ class _ResourceClient:
     def update_status(self, obj: Any) -> Any:
         return self._api.update_status(self._resource, obj)
 
-    def delete(self, name: str, namespace: str = "") -> None:
-        self._api.delete(self._resource, name, namespace)
+    def delete(self, name: str, namespace: str = "",
+               propagation_policy: Optional[str] = None) -> None:
+        if propagation_policy:
+            self._api.delete(self._resource, name, namespace,
+                             propagation_policy=propagation_policy)
+        else:
+            self._api.delete(self._resource, name, namespace)
 
     def list(
         self, namespace: Optional[str] = None, label_selector: Optional[Selector] = None

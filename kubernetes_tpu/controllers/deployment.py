@@ -21,6 +21,15 @@ from ..utils import serde
 from .base import Controller, controller_ref, get_controller_of, retry_on_conflict
 
 POD_TEMPLATE_HASH = "pod-template-hash"
+REVISION_ANNOTATION = "deployment.kubernetes.io/revision"
+DEFAULT_REVISION_HISTORY_LIMIT = 10  # deployment_util.go / defaults
+
+
+def rs_revision(rs: apps.ReplicaSet) -> int:
+    try:
+        return int((rs.metadata.annotations or {}).get(REVISION_ANNOTATION, "0"))
+    except ValueError:
+        return 0
 
 
 def _template_hash(tmpl: v1.PodTemplateSpec) -> str:
@@ -163,12 +172,59 @@ class DeploymentController(Controller):
         old_rses = [
             rs for rs in rses if new_rs is None or rs.metadata.uid != new_rs.metadata.uid
         ]
+        if new_rs is not None:
+            new_rs = self._stamp_revision(new_rs, old_rses)
         if not d.spec.paused and new_rs is not None:
             if d.spec.strategy.type == "Recreate":
                 self._rollout_recreate(d, new_rs, old_rses)
             else:
                 self._rollout_rolling(d, new_rs, old_rses)
+            self._prune_history(d, new_rs, old_rses)
         self._update_status(d, new_rs, old_rses)
+
+    def _stamp_revision(self, new_rs, old_rses):
+        """SetNewReplicaSetAnnotations (deployment_util.go:307): the new
+        RS carries max(old revisions)+1 — a ROLLBACK re-activates an old
+        RS as the new one, so its stale revision number is bumped, which
+        is exactly what `rollout history` renders."""
+        max_old = max((rs_revision(rs) for rs in old_rses), default=0)
+        want = max_old + 1
+        cur = rs_revision(new_rs)
+        if cur >= want:
+            return new_rs
+        updated = copy.deepcopy(new_rs)
+        anns = dict(updated.metadata.annotations or {})
+        anns[REVISION_ANNOTATION] = str(want)
+        updated.metadata.annotations = anns
+        try:
+            return self.client.replicasets.update(updated)
+        except Exception:  # noqa: BLE001 — conflict: next sync retries
+            return new_rs
+
+    def _prune_history(self, d, new_rs, old_rses) -> None:
+        """cleanupDeployment (deployment_controller.go:632): inactive old
+        RSes beyond revisionHistoryLimit are deleted, oldest revision
+        first."""
+        limit = (
+            d.spec.revision_history_limit
+            if d.spec.revision_history_limit is not None
+            else DEFAULT_REVISION_HISTORY_LIMIT
+        )
+        inactive = [
+            rs for rs in old_rses
+            if (rs.spec.replicas or 0) == 0 and rs.status.replicas == 0
+        ]
+        excess = len(inactive) - limit
+        if excess <= 0:
+            return
+        inactive.sort(key=rs_revision)
+        for rs in inactive[:excess]:
+            try:
+                self.client.replicasets.delete(
+                    rs.metadata.name, rs.metadata.namespace
+                )
+            except Exception:  # noqa: BLE001 — already gone
+                pass
 
     # -- strategies ---------------------------------------------------------
 

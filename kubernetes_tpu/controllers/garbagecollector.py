@@ -6,18 +6,28 @@ builds a dependency graph from every resource's ownerReferences
 (attemptToDeleteItem, :501: an object is garbage when all its owner
 references point to non-existent objects).
 
-The reference also handles foreground deletion via the
-`foregroundDeletion` finalizer; here deletion is background-only (owner
-deleted → dependents collected on the next scan), which is the default
-propagation policy.
+All three propagation policies are handled:
+  Background (default): owner gone → dependents collected next scan;
+  Foreground (:609 processDeletingDependentsItem): the owner carries the
+    foregroundDeletion finalizer; the GC deletes dependents with
+    blockOwnerDeletion first and removes the finalizer when none remain;
+  Orphan (:673 orphanDependents): the GC strips the owner's
+    ownerReferences from every dependent, then removes the finalizer.
 """
 
 from __future__ import annotations
 
+import copy
 import threading
 from typing import Dict, Optional, Tuple
 
-from ..apiserver.server import APIError, APIServer, NotFound
+from ..apiserver.server import (
+    APIError,
+    APIServer,
+    FINALIZER_FOREGROUND,
+    FINALIZER_ORPHAN,
+    NotFound,
+)
 from .base import Controller
 
 KIND_TO_RESOURCE = {
@@ -91,24 +101,96 @@ class GarbageCollector(Controller):
         """One full-graph scan; returns number of objects deleted."""
         deleted = 0
         cache: Dict[Tuple[str, str, str], Optional[str]] = {}
+        # one pass to index everything (the graph builder's world view)
+        world = []  # (resource, obj)
         for info in self.api.resources():
             items, _ = self.api.list(info.name)
-            for obj in items:
-                refs = obj.metadata.owner_references or []
-                if not refs:
-                    continue
-                if any(
-                    self._owner_exists(obj.metadata.namespace, r, cache) for r in refs
-                ):
-                    continue
-                try:
-                    self.api.delete(
-                        info.name, obj.metadata.name, obj.metadata.namespace
+            world.extend((info.name, obj) for obj in items)
+        dependents_of: Dict[str, list] = {}  # owner uid -> [(resource, obj)]
+        for resource, obj in world:
+            for ref in obj.metadata.owner_references or []:
+                if ref.uid:
+                    dependents_of.setdefault(ref.uid, []).append((resource, obj))
+
+        # owners mid-foreground/orphan deletion (processDeletingDependentsItem)
+        for resource, obj in world:
+            meta = obj.metadata
+            if meta.deletion_timestamp is None:
+                continue
+            fins = meta.finalizers or []
+            deps = dependents_of.get(meta.uid, [])
+            if FINALIZER_FOREGROUND in fins:
+                blocking = [
+                    (r, d) for r, d in deps
+                    if any(
+                        ref.uid == meta.uid and ref.block_owner_deletion
+                        for ref in d.metadata.owner_references or []
                     )
-                    deleted += 1
-                except NotFound:
-                    pass
+                ]
+                for r, d in blocking:
+                    try:
+                        self.api.delete(r, d.metadata.name, d.metadata.namespace)
+                        deleted += 1
+                    except NotFound:
+                        pass
+                if not blocking:
+                    self._remove_finalizer(
+                        resource, meta.name, meta.namespace, FINALIZER_FOREGROUND
+                    )
+            elif FINALIZER_ORPHAN in fins:
+                all_stripped = True
+                for r, d in deps:
+                    orphaned = copy.deepcopy(d)
+                    orphaned.metadata.owner_references = [
+                        ref for ref in orphaned.metadata.owner_references or []
+                        if ref.uid != meta.uid
+                    ] or None
+                    try:
+                        self.api.update(r, orphaned)
+                    except NotFound:
+                        pass  # dependent already gone: nothing to orphan
+                    except APIError:
+                        # conflict: the finalizer must STAY until every
+                        # dependent is stripped — releasing the owner now
+                        # would hard-delete it and the next background
+                        # scan would collect this still-owned dependent
+                        all_stripped = False
+                if all_stripped:
+                    self._remove_finalizer(
+                        resource, meta.name, meta.namespace, FINALIZER_ORPHAN
+                    )
+
+        # background collection: dependents whose owners are all gone
+        for resource, obj in world:
+            refs = obj.metadata.owner_references or []
+            if not refs:
+                continue
+            if any(
+                self._owner_exists(obj.metadata.namespace, r, cache) for r in refs
+            ):
+                continue
+            # re-read before destroying: the orphan pass above may have
+            # stripped this object's refs within this very scan, and the
+            # world snapshot is stale (attemptToDeleteItem works from a
+            # live get for the same reason)
+            try:
+                live = self.api.get(resource, obj.metadata.name, obj.metadata.namespace)
+            except APIError:
+                continue
+            if not live.metadata.owner_references:
+                continue
+            try:
+                self.api.delete(resource, obj.metadata.name, obj.metadata.namespace)
+                deleted += 1
+            except NotFound:
+                pass
         return deleted
+
+    def _remove_finalizer(self, resource, name, namespace, finalizer) -> None:
+        try:
+            self.api.remove_finalizer(resource, name, namespace, finalizer)
+        except APIError:
+            pass  # finalized concurrently: the scan must keep going
 
     def sync(self, key: str) -> None:
         self.collect_once()

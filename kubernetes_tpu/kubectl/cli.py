@@ -152,6 +152,8 @@ class Kubectl:
         p.add_argument("resource", nargs="?")
         p.add_argument("name", nargs="?")
         p.add_argument("-f", "--filename")
+        p.add_argument("--cascade", default="background",
+                       choices=["background", "foreground", "orphan"])
 
         p = sub.add_parser("scale")
         p.add_argument("target")  # resource/name
@@ -185,8 +187,10 @@ class Kubectl:
         p.add_argument("--grace-period", type=int, default=-1)
 
         p = sub.add_parser("rollout")
-        p.add_argument("action", choices=["status", "restart"])
+        p.add_argument("action",
+                       choices=["status", "restart", "history", "undo"])
         p.add_argument("target")  # deployment/name
+        p.add_argument("--to-revision", type=int, default=0)
 
         p = sub.add_parser("top")
         p.add_argument("resource", choices=["nodes", "node", "pods", "pod", "no", "po"])
@@ -385,7 +389,10 @@ class Kubectl:
             raise APIError("delete requires RESOURCE NAME or -f FILE")
         resource = self._resource(args.resource)
         ns = args.namespace if self._namespaced(resource) else ""
-        self._client(resource).delete(args.name, ns)
+        policy = {"foreground": "Foreground", "orphan": "Orphan"}.get(
+            getattr(args, "cascade", "background")
+        )
+        self._client(resource).delete(args.name, ns, propagation_policy=policy)
         self._print(f"{resource}/{args.name} deleted")
 
     def cmd_scale(self, args) -> None:
@@ -500,6 +507,8 @@ class Kubectl:
                     f"{have} of {want} updated replicas are available..."
                 )
             return
+        if args.action in ("history", "undo"):
+            return self._rollout_history_undo(dep, name, args)
         # restart: stamp the pod template (kubectl rollout restart's
         # restartedAt annotation) to trigger a new rollout
         tmpl_meta = dep.spec.template.metadata
@@ -507,6 +516,60 @@ class Kubectl:
         tmpl_meta.annotations["kubectl.kubernetes.io/restartedAt"] = str(time.time())
         self.cs.deployments.update(dep)
         self._print(f"deployment.apps/{name} restarted")
+
+    def _owned_rs_by_revision(self, dep):
+        from ..controllers.deployment import rs_revision
+
+        out = []
+        for rs in self.cs.replicasets.list(namespace=dep.metadata.namespace)[0]:
+            for ref in rs.metadata.owner_references or []:
+                if ref.controller and ref.uid == dep.metadata.uid:
+                    out.append((rs_revision(rs), rs))
+        out.sort(key=lambda t: t[0])
+        return out
+
+    def _rollout_history_undo(self, dep, name, args) -> None:
+        """kubectl rollout history/undo (staging kubectl/pkg/polymorphichelpers
+        history.go / rollback.go): revisions are the owned ReplicaSets'
+        deployment.kubernetes.io/revision annotations; undo copies the
+        chosen revision's pod template back into the deployment spec
+        (client-side rollback, as kubectl does at this version)."""
+        from ..controllers.deployment import POD_TEMPLATE_HASH
+        from ..utils import serde as _serde
+
+        revisions = self._owned_rs_by_revision(dep)
+        if args.action == "history":
+            self._print(f"deployment.apps/{name}")
+            self._print("REVISION  CHANGE-CAUSE")
+            for rev, rs in revisions:
+                cause = (rs.metadata.annotations or {}).get(
+                    "kubernetes.io/change-cause", "<none>"
+                )
+                self._print(f"{rev:<9} {cause}")
+            return
+        if not revisions:
+            raise APIError(f"no rollout history found for deployment {name!r}")
+        if args.to_revision:
+            match = [rs for rev, rs in revisions if rev == args.to_revision]
+            if not match:
+                raise APIError(
+                    f"unable to find revision {args.to_revision} of "
+                    f"deployment {name!r}"
+                )
+            target = match[0]
+        else:
+            if len(revisions) < 2:
+                raise APIError(f"no previous revision to roll back to for {name!r}")
+            target = revisions[-2][1]  # latest-1
+        tmpl = _serde.from_dict(
+            v1.PodTemplateSpec, _serde.to_dict(target.spec.template)
+        )
+        labels = dict(tmpl.metadata.labels or {})
+        labels.pop(POD_TEMPLATE_HASH, None)
+        tmpl.metadata.labels = labels or None
+        dep.spec.template = tmpl
+        self.cs.deployments.update(dep)
+        self._print(f"deployment.apps/{name} rolled back")
 
     def cmd_logs(self, args) -> None:
         """kubectl logs: pods/{name}/log subresource → node proxy →

@@ -15,7 +15,7 @@ import threading
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 SANDBOX_READY = "SANDBOX_READY"
 SANDBOX_NOTREADY = "SANDBOX_NOTREADY"
@@ -67,6 +67,7 @@ class FakeRuntimeService:
         self._lock = threading.Lock()
         self._sandboxes: Dict[str, PodSandbox] = {}
         self._containers: Dict[str, RuntimeContainer] = {}
+        self._port_servers: Dict[Tuple[str, int], Callable[[bytes], bytes]] = {}
         self._op_latency = op_latency
         self._ip_prefix = ip_prefix
         self._ip_counter = 0
@@ -231,6 +232,104 @@ class FakeRuntimeService:
                 f"{time.time() - c.started_at:.1f}s\n",
                 self.exec_results.get(c.name, 0),
             )
+
+    # -- streaming (cri/streaming: Exec, Attach, PortForward) --------------
+
+    def exec_stream(self, container_id: str, cmd: List[str]):
+        """Exec (streaming): an interactive session against the fake
+        runtime's shell — echoes `echo` args, reports state for `ps`,
+        echoes back any stdin line prefixed with the container name.
+        The reference returns a streaming URL; in-proc the session IS
+        the stream."""
+        from .streaming import StreamSession, run_handler_thread
+
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c is None:
+                raise CRIError(f"container {container_id} not found")
+            if c.state != CONTAINER_RUNNING:
+                raise CRIError(f"container {c.name} is not running")
+            c.logs.append(f"{time.time():.3f} exec-stream: {' '.join(cmd)}")
+        session = StreamSession()
+
+        def shell(s) -> int:
+            if cmd and cmd[0] == "echo":
+                s.handler_write((" ".join(cmd[1:]) + "\n").encode())
+                return 0
+            if cmd and cmd[0] == "ps":
+                s.handler_write(f"pid 1: {c.name} ({c.image})\n".encode())
+                return 0
+            # interactive: echo stdin back until EOF
+            while True:
+                line = s.handler_read()
+                if line is None:
+                    return 0
+                s.handler_write(b"%s> %s" % (c.name.encode(), line))
+
+        run_handler_thread(session, shell)
+        return session
+
+    def attach_container(self, container_id: str):
+        """Attach: stream the container's output as it is produced
+        (existing log lines replayed, then follow until close)."""
+        from .streaming import StreamSession, run_handler_thread
+
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c is None:
+                raise CRIError(f"container {container_id} not found")
+        session = StreamSession()
+
+        def follow(s) -> int:
+            sent = 0
+            while not s.closed:
+                with self._lock:
+                    cc = self._containers.get(container_id)
+                    lines = list(cc.logs) if cc is not None else []
+                    running = cc is not None and cc.state == CONTAINER_RUNNING
+                for line in lines[sent:]:
+                    s.handler_write((line + "\n").encode())
+                sent = len(lines)
+                if not running:
+                    return 0
+                time.sleep(0.02)
+            return 0
+
+        run_handler_thread(session, follow)
+        return session
+
+    def register_port_server(self, sandbox_id: str, port: int,
+                             handler: Callable[[bytes], bytes]) -> None:
+        """Register the in-sandbox server a port-forward connects to (the
+        workload process listening on the port)."""
+        with self._lock:
+            self._port_servers[(sandbox_id, port)] = handler
+
+    def port_forward(self, sandbox_id: str, port: int):
+        """PortForward: a bidirectional byte channel to the sandbox's
+        port; each stdin chunk gets the server's response on stdout."""
+        from .streaming import StreamSession, run_handler_thread
+
+        with self._lock:
+            if sandbox_id not in self._sandboxes:
+                raise CRIError(f"sandbox {sandbox_id} not found")
+            handler = self._port_servers.get((sandbox_id, port))
+        if handler is None:
+            raise CRIError(
+                f"connection refused: nothing listening on {port} "
+                f"in sandbox {sandbox_id}"
+            )
+        session = StreamSession()
+
+        def proxy(s) -> int:
+            while True:
+                data = s.handler_read()
+                if data is None:
+                    return 0
+                s.handler_write(handler(data))
+
+        run_handler_thread(session, proxy)
+        return session
 
     # -- test helpers ------------------------------------------------------
 

@@ -606,6 +606,43 @@ class Kubelet:
         except CRIError as e:
             raise KeyError(str(e))
 
+    # -- streaming (cri/streaming: the kubelet's streaming server) ---------
+
+    def exec_stream_in_pod(self, pod_name: str, namespace: str, cmd,
+                           container: str = ""):
+        """Exec (interactive): returns a StreamSession — the reference's
+        kubelet returns a streaming URL the apiserver proxies; in-proc
+        the session is handed straight through the node proxy."""
+        c = self._find_container(pod_name, namespace, container)
+        if c is None:
+            raise KeyError(
+                f"container {container or '<first>'} of pod "
+                f"{namespace}/{pod_name} not found")
+        try:
+            return self.runtime.exec_stream(c.id, list(cmd))
+        except CRIError as e:
+            raise KeyError(str(e))
+
+    def attach_pod(self, pod_name: str, namespace: str, container: str = ""):
+        c = self._find_container(pod_name, namespace, container)
+        if c is None:
+            raise KeyError(
+                f"container {container or '<first>'} of pod "
+                f"{namespace}/{pod_name} not found")
+        try:
+            return self.runtime.attach_container(c.id)
+        except CRIError as e:
+            raise KeyError(str(e))
+
+    def portforward_pod(self, pod_name: str, namespace: str, port: int):
+        for sb in self.runtime.list_pod_sandboxes():
+            if sb.pod_name == pod_name and sb.pod_namespace == namespace:
+                try:
+                    return self.runtime.port_forward(sb.id, port)
+                except CRIError as e:
+                    raise KeyError(str(e))
+        raise KeyError(f"no sandbox for pod {namespace}/{pod_name}")
+
     def _reject_pod(self, pod: v1.Pod, message: str) -> None:
         """Admission failure: terminal Failed status (kubelet.go
         rejectPod, reason UnexpectedAdmissionError)."""

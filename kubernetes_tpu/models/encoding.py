@@ -491,7 +491,15 @@ class ClusterEncoding:
         self.pod_index = {}
         self._pod_free = list(range(pcap - 1, -1, -1))
         for key, (pod, node_name) in self._pods.items():
-            nidx = self.node_index[node_name]
+            nidx = self.node_index.get(node_name)
+            if nidx is None:
+                # pod bound to a DELETED node (node remove raced bound
+                # pods — the reference's cache keeps such pods on a ghost
+                # nodeInfo until they drain, cache.go removeNode). No row:
+                # a gone node contributes no capacity, ports, or topology
+                # pairs; the object stays in _pods so a re-added node
+                # re-encodes it on the next rebuild.
+                continue
             pidx = self._pod_free.pop()
             self.pod_index[key] = pidx
             self._encode_pod_row(pidx, pod, nidx, pod_infos[key])

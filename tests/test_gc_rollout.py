@@ -1,0 +1,215 @@
+"""GC propagation policies (foreground/orphan) and Deployment revision
+history + rollback.
+
+Reference: pkg/controller/garbagecollector (attemptToDeleteItem,
+processDeletingDependentsItem, orphanDependents), deployment_util.go
+revision annotations + cleanupDeployment, kubectl polymorphichelpers
+history/rollback."""
+
+import io
+
+import pytest
+
+from kubernetes_tpu.api import apps
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.server import APIServer, NotFound
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers.deployment import (
+    REVISION_ANNOTATION,
+    DeploymentController,
+    rs_revision,
+)
+from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
+from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+from kubernetes_tpu.kubectl.cli import Kubectl
+
+from .util import make_pod, wait_until
+
+
+def _owned_pod(name, owner_uid, block=True):
+    p = make_pod(name)
+    p.metadata.owner_references = [v1.OwnerReference(
+        api_version="apps/v1", kind="ReplicaSet", name="owner-rs",
+        uid=owner_uid, controller=True, block_owner_deletion=block,
+    )]
+    return p
+
+
+def _rs(name="owner-rs", replicas=0):
+    return apps.ReplicaSet(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        spec=apps.ReplicaSetSpec(
+            replicas=replicas,
+            selector=v1.LabelSelector(match_labels={"app": name}),
+            template=v1.PodTemplateSpec(
+                metadata=v1.ObjectMeta(labels={"app": name}),
+                spec=v1.PodSpec(containers=[v1.Container(name="c", image="i")]),
+            ),
+        ),
+    )
+
+
+class TestGCPropagation:
+    def _gc(self, api):
+        gc = GarbageCollector(Clientset(api), scan_interval=3600)
+        return gc
+
+    def test_foreground_blocks_until_dependents_gone(self):
+        api = APIServer()
+        cs = Clientset(api)
+        rs = cs.replicasets.create(_rs())
+        cs.pods.create(_owned_pod("dep-1", rs.metadata.uid, block=True))
+        cs.pods.create(_owned_pod("dep-2", rs.metadata.uid, block=True))
+        gc = self._gc(api)
+
+        cs.replicasets.delete("owner-rs", "default",
+                              propagation_policy="Foreground")
+        # soft-deleted, finalizer held, still visible
+        held = cs.replicasets.get("owner-rs", "default")
+        assert held.metadata.deletion_timestamp is not None
+        assert "foregroundDeletion" in (held.metadata.finalizers or [])
+
+        gc.collect_once()   # deletes the blocking dependents
+        assert not cs.pods.list(namespace="default")[0]
+        gc.collect_once()   # no blockers left -> finalizer removed
+        with pytest.raises(NotFound):
+            cs.replicasets.get("owner-rs", "default")
+
+    def test_orphan_strips_owner_refs(self):
+        api = APIServer()
+        cs = Clientset(api)
+        rs = cs.replicasets.create(_rs())
+        cs.pods.create(_owned_pod("kid", rs.metadata.uid))
+        gc = self._gc(api)
+
+        cs.replicasets.delete("owner-rs", "default",
+                              propagation_policy="Orphan")
+        gc.collect_once()
+        with pytest.raises(NotFound):
+            cs.replicasets.get("owner-rs", "default")
+        kid = cs.pods.get("kid", "default")
+        assert not kid.metadata.owner_references  # orphaned, NOT deleted
+        gc.collect_once()
+        assert cs.pods.get("kid", "default")  # still alive
+
+    def test_background_default_collects_dependents(self):
+        api = APIServer()
+        cs = Clientset(api)
+        rs = cs.replicasets.create(_rs())
+        cs.pods.create(_owned_pod("kid", rs.metadata.uid))
+        gc = self._gc(api)
+        cs.replicasets.delete("owner-rs", "default")  # background
+        gc.collect_once()
+        assert not cs.pods.list(namespace="default")[0]
+
+
+class TestDeploymentRevisions:
+    def _cluster(self):
+        api = APIServer()
+        cs = Clientset(api)
+        factory = SharedInformerFactory(cs)
+        dc = DeploymentController(cs, factory)
+        rc = ReplicaSetController(cs, factory)
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        dc.run()
+        rc.run()
+        return api, cs, factory, dc, rc
+
+    def _deployment(self, image="img:1", replicas=2):
+        return apps.Deployment(
+            metadata=v1.ObjectMeta(name="web", namespace="default"),
+            spec=apps.DeploymentSpec(
+                replicas=replicas,
+                selector=v1.LabelSelector(match_labels={"app": "web"}),
+                template=v1.PodTemplateSpec(
+                    metadata=v1.ObjectMeta(labels={"app": "web"}),
+                    spec=v1.PodSpec(containers=[v1.Container(
+                        name="c", image=image)]),
+                ),
+            ),
+        )
+
+    def test_revisions_stamp_and_undo(self):
+        api, cs, factory, dc, rc = self._cluster()
+        try:
+            cs.deployments.create(self._deployment("img:1"))
+
+            def rs_with_rev(rev):
+                return [
+                    rs for rs in cs.replicasets.list(namespace="default")[0]
+                    if rs_revision(rs) == rev
+                ]
+
+            assert wait_until(lambda: rs_with_rev(1), timeout=10)
+
+            dep = cs.deployments.get("web", "default")
+            dep.spec.template.spec.containers[0].image = "img:2"
+            cs.deployments.update(dep)
+            assert wait_until(lambda: rs_with_rev(2), timeout=10)
+            assert wait_until(
+                lambda: all(
+                    (rs.spec.replicas or 0) == 0 for rs in rs_with_rev(1)
+                ),
+                timeout=15,
+            )
+
+            # rollout history shows both revisions
+            buf = io.StringIO()
+            k = Kubectl(cs, out=buf)
+            k.run(["rollout", "history", "deployment/web"])
+            out = buf.getvalue()
+            assert "1 " in out and "2 " in out
+
+            # undo -> img:1 comes back as revision 3 (re-activated RS)
+            k.run(["rollout", "undo", "deployment/web"])
+            assert wait_until(
+                lambda: cs.deployments.get("web", "default")
+                .spec.template.spec.containers[0].image == "img:1",
+                timeout=10,
+            )
+            assert wait_until(lambda: rs_with_rev(3), timeout=15)
+        finally:
+            dc.stop()
+            rc.stop()
+            factory.stop()
+
+    def test_history_pruned_to_limit(self):
+        api, cs, factory, dc, rc = self._cluster()
+        try:
+            d = self._deployment("img:1")
+            d.spec.revision_history_limit = 1
+            cs.deployments.create(d)
+            for i in range(2, 5):
+                # the previous revision's RS must exist before updating,
+                # or revision numbers telescope and the waits deadlock
+                assert wait_until(
+                    lambda i=i: any(
+                        rs_revision(rs) == i - 1
+                        for rs in cs.replicasets.list(namespace="default")[0]
+                    ),
+                    timeout=15,
+                )
+                dep = cs.deployments.get("web", "default")
+                dep.spec.template.spec.containers[0].image = f"img:{i}"
+                cs.deployments.update(dep)
+                assert wait_until(
+                    lambda i=i: any(
+                        rs_revision(rs) == i
+                        for rs in cs.replicasets.list(namespace="default")[0]
+                    ),
+                    timeout=15,
+                )
+            # 4 revisions existed; limit=1 keeps the active RS + 1 old
+            def inactive():
+                return [
+                    rs for rs in cs.replicasets.list(namespace="default")[0]
+                    if (rs.spec.replicas or 0) == 0 and rs.status.replicas == 0
+                ]
+
+            assert wait_until(lambda: len(inactive()) <= 1, timeout=20)
+        finally:
+            dc.stop()
+            rc.stop()
+            factory.stop()

@@ -1,0 +1,117 @@
+"""kubeadm phases / join / bootstrap tokens / cert lifecycle.
+
+Reference shape: cmd/kubeadm/app/cmd/phases/init (ordered, skippable,
+individually runnable phases), app/discovery/token (join validation),
+kubeadm certs check-expiration / renew."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu import kubeadm
+from kubernetes_tpu.apiserver.auth import SecureAPIServer
+
+from .util import wait_until  # noqa: F401 (symmetry with sibling tests)
+
+
+@pytest.fixture()
+def secure():
+    return SecureAPIServer()
+
+
+class TestInitPhases:
+    def test_full_init(self, secure):
+        ctx = kubeadm.init(secure)
+        assert all(ctx.results.values())
+        # admin identity authenticates with system:masters power
+        cs = secure.as_user(ctx.admin_token)
+        cs.pods.list(namespace="default")
+        # control-plane node labeled + tainted
+        node = secure.api.get("nodes", "control-plane-0")
+        assert kubeadm.CONTROL_PLANE_LABEL in (node.metadata.labels or {})
+        assert any(
+            t.key == kubeadm.CONTROL_PLANE_TAINT for t in node.spec.taints or []
+        )
+        # kubeadm-config uploaded; bootstrap token secret exists
+        assert secure.api.get("configmaps", "kubeadm-config", "kube-system")
+        tid = ctx.bootstrap_token.split(".", 1)[0]
+        assert secure.api.get(
+            "secrets", f"bootstrap-token-{tid}", "kube-system")
+
+    def test_skip_phases(self, secure):
+        ctx = kubeadm.init(secure, skip_phases=["mark-control-plane"])
+        assert ctx.results["mark-control-plane"] is False
+        with pytest.raises(Exception):
+            secure.api.get("nodes", "control-plane-0")
+
+    def test_single_phase(self, secure):
+        ctx = kubeadm.init(secure, only_phase="certs")
+        assert ctx.results == {"certs": True}
+        assert "admin" in ctx.ca.issued
+
+    def test_phase_order_matches_reference(self):
+        names = [p.name for p in kubeadm.INIT_PHASES]
+        assert names == ["preflight", "certs", "kubeconfig",
+                         "upload-config", "mark-control-plane",
+                         "bootstrap-token"]
+
+
+class TestJoin:
+    def test_worker_join(self, secure):
+        ctx = kubeadm.init(secure)
+        cert = kubeadm.join(ctx, "worker-1", token=ctx.bootstrap_token)
+        # the minted kubelet identity authenticates as system:node:worker-1
+        cs = secure.as_user(cert.token)
+        assert cs.user.name == "system:node:worker-1"
+        assert "system:nodes" in cs.user.groups
+
+    def test_join_bad_token(self, secure):
+        ctx = kubeadm.init(secure)
+        with pytest.raises(kubeadm.InvalidToken):
+            kubeadm.join(ctx, "w", token="abcdef.0000000000000000")
+        with pytest.raises(kubeadm.InvalidToken):
+            kubeadm.join(ctx, "w", token="garbage")
+
+    def test_join_expired_token(self, secure):
+        ctx = kubeadm.init(secure)
+        tid = ctx.bootstrap_token.split(".", 1)[0]
+        s = secure.api.get("secrets", f"bootstrap-token-{tid}", "kube-system")
+        s.data["expiration"] = str(time.time() - 1)
+        secure.api.update("secrets", s)
+        with pytest.raises(kubeadm.InvalidToken):
+            kubeadm.join(ctx, "w", token=ctx.bootstrap_token)
+
+    def test_control_plane_join_marks_node(self, secure):
+        ctx = kubeadm.init(secure)
+        kubeadm.join(ctx, "cp-2", control_plane=True,
+                     token=ctx.bootstrap_token)
+        node = secure.api.get("nodes", "cp-2")
+        assert kubeadm.CONTROL_PLANE_LABEL in (node.metadata.labels or {})
+
+
+class TestCertLifecycle:
+    def test_issue_verify_expire(self):
+        ca = kubeadm.CertificateAuthority()
+        cert = ca.issue("kubelet-n1", "system:node:n1", ["system:nodes"],
+                        ttl=0.2)
+        assert ca.verify(cert)
+        time.sleep(0.25)
+        assert not ca.verify(cert)
+
+    def test_tamper_detected(self):
+        ca = kubeadm.CertificateAuthority()
+        cert = ca.issue("admin", "kubernetes-admin", ["system:masters"])
+        cert.organizations = ["system:nodes"]  # privilege rewrite
+        assert not ca.verify(cert)
+
+    def test_check_expiration_and_renew(self):
+        ca = kubeadm.CertificateAuthority()
+        ca.issue("short", "a", [], ttl=10.0)
+        ca.issue("long", "b", [], ttl=kubeadm.DEFAULT_CERT_TTL)
+        expiring = ca.check_expiration(within=60.0)
+        assert set(expiring) == {"short"}
+        old_token = ca.issued["short"].token
+        renewed = ca.renew("short")
+        assert ca.verify(renewed)
+        assert renewed.token == old_token  # live components keep working
+        assert not ca.check_expiration(within=60.0)

@@ -1,0 +1,186 @@
+"""Concurrency stress: the lock discipline in the scheduler cache and
+the TPU backend's CacheListener hooks is load-bearing (VERDICT r1 §5 —
+'heavily threaded code... untested for races'). These tests hammer the
+shared structures from many threads and assert the invariants that a
+torn update would break.
+
+Reference shape: the Go suite runs these paths under -race
+(hack/make-rules/test.sh KUBE_RACE); Python has no race detector, so
+the assertions target observable corruption instead."""
+
+import random
+import threading
+
+import pytest
+
+from kubernetes_tpu.scheduler.internal.cache import SchedulerCache
+from kubernetes_tpu.scheduler.tpu_backend import TPUBackend
+
+from .util import make_node, make_pod
+
+
+def _run_threads(workers, iterations=1):
+    errors = []
+
+    def wrap(fn):
+        def run():
+            try:
+                for _ in range(iterations):
+                    fn()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        return run
+
+    threads = [threading.Thread(target=wrap(fn)) for fn in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "worker deadlocked"
+    assert not errors, errors
+
+
+class TestCacheRaces:
+    def test_assume_confirm_remove_storm(self):
+        """4 writer threads × assume/confirm/update/remove on overlapping
+        pods; the cache must end exactly consistent with the last
+        surviving set (no orphaned assumes, no negative node stats)."""
+        cache = SchedulerCache()
+        for i in range(8):
+            cache.add_node(make_node(f"n{i}"))
+
+        def worker(tid):
+            rng = random.Random(tid)
+
+            def run():
+                for i in range(150):
+                    pod = make_pod(f"p-{tid}-{i}", cpu="10m",
+                                   node_name=f"n{rng.randrange(8)}")
+                    cache.assume_pod(pod)
+                    if rng.random() < 0.5:
+                        cache.add_pod(pod)       # confirm
+                        cache.remove_pod(pod)
+                    else:
+                        cache.forget_pod(pod)
+
+            return run
+
+        _run_threads([worker(t) for t in range(4)])
+        from kubernetes_tpu.scheduler.framework.snapshot import Snapshot
+
+        snap = cache.update_snapshot(Snapshot())
+        for ni in snap.list():
+            assert not ni.pods, f"leaked pods on {ni.node.metadata.name}"
+            assert ni.requested.milli_cpu == 0
+
+    def test_min_priority_under_churn(self):
+        cache = SchedulerCache()
+        cache.add_node(make_node("n0"))
+        stop = threading.Event()
+
+        def churn(tid):
+            def run():
+                for i in range(300):
+                    p = make_pod(f"c-{tid}-{i}", cpu="1m", node_name="n0",
+                                 priority=i % 7 - 3)
+                    cache.assume_pod(p)
+                    cache.forget_pod(p)
+
+            return run
+
+        def read():
+            while not stop.is_set():
+                v = cache.min_pod_priority()
+                assert -3 <= v <= 3 or v == 0
+
+        reader = threading.Thread(target=read, daemon=True)
+        reader.start()
+        try:
+            _run_threads([churn(0), churn(1)])
+        finally:
+            stop.set()
+        reader.join(timeout=10)
+        assert not reader.is_alive()
+
+
+class TestBackendListenerRaces:
+    def test_mutations_racing_schedule_many(self):
+        """Cluster mutations (node add/update, foreign pod adds) from
+        listener threads while schedule_many batches run: every returned
+        decision must name a node that existed, and the encoding must
+        stay internally consistent (the session teardown/rebuild path is
+        exactly what these mutations exercise)."""
+        backend = TPUBackend(rng=random.Random(0))
+        for i in range(12):
+            backend.on_add_node(make_node(f"n{i}"))
+
+        stop = threading.Event()
+        node_names = [f"n{i}" for i in range(12)]
+
+        def mutator():
+            # shape-stable mutations only (node UPDATES + foreign pod
+            # add/remove on pre-interned labels): each one still tears
+            # the session down and races the listener locks, but keeps
+            # array shapes fixed so jit caches hold — shape churn here
+            # turns the test into an XLA compile marathon, not a race test
+            import time as _time
+
+            rng = random.Random(99)
+            k = 0
+            while not stop.is_set():
+                k += 1
+                name = rng.choice(node_names)
+                backend.on_update_node(make_node(name))
+                foreign = make_pod(f"foreign-{k % 8}", cpu="5m",
+                                   labels={"app": "race"},
+                                   node_name=rng.choice(node_names))
+                backend.on_add_pod(foreign, foreign.spec.node_name)
+                backend.on_remove_pod(foreign, foreign.spec.node_name)
+                _time.sleep(0.005)
+
+        # warm every jit shape BEFORE the storm (compiles under mutation
+        # churn would serialize the test, not stress the locks)
+        warm = [make_pod(f"warm-{i}", cpu="10m", labels={"app": "race"})
+                for i in range(16)]
+        backend.schedule_many(warm)
+        for p in warm:
+            backend.on_remove_pod(p, p.spec.node_name or "n0")
+
+        mut = threading.Thread(target=mutator, daemon=True)
+        mut.start()
+        try:
+            for round_no in range(6):
+                pods = [
+                    make_pod(f"b{round_no}-{i}", cpu="10m",
+                             labels={"app": "race"})
+                    for i in range(16)
+                ]
+                results = backend.schedule_many(pods)
+                assert len(results) == 16
+                valid = set(backend.enc.node_names)
+                for pod, node in results:
+                    assert node is None or node in valid
+        finally:
+            stop.set()
+            mut.join(timeout=10)
+        assert not mut.is_alive()
+
+    def test_rebuild_survives_node_deletion_with_bound_pods(self):
+        """Regression (found by the racing version of this suite): a node
+        removed while pods were still bound to it crashed the next
+        encoding rebuild with KeyError — which would have killed the
+        scheduler loop on any real node deletion racing bound pods."""
+        backend = TPUBackend(rng=random.Random(0))
+        for i in range(4):
+            backend.on_add_node(make_node(f"n{i}"))
+        pod = make_pod("survivor", cpu="10m", node_name="n3")
+        backend.on_add_pod(pod, "n3")
+        backend.on_remove_node("n3")  # pod still referenced n3
+        # force a rebuild: must not raise, and n3 contributes nothing
+        state = backend.enc.device_state()
+        assert "n3" not in backend.enc.node_names
+        # the pod re-appears when its node comes back
+        backend.on_add_node(make_node("n3"))
+        backend.enc.device_state()
+        assert backend.enc.pod_index
