@@ -291,13 +291,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(200, serde.to_dict(updated))
 
     def _verb_delete(self, resource, ns, name, sub, params) -> None:
-        policy = params.get("propagationPolicy") or None
-        if policy:
-            self._resource_client(resource).delete(
-                name, ns, propagation_policy=policy
-            )
-        else:
-            self._resource_client(resource).delete(name, ns)
+        self._resource_client(resource).delete(
+            name, ns,
+            propagation_policy=params.get("propagationPolicy") or None,
+        )
         self._send_json(200, {"status": "Success"})
 
 
@@ -328,10 +325,8 @@ class _RawFacade:
         return self._api.update_status(self._resource, obj)
 
     def delete(self, name, namespace="", propagation_policy=None):
-        if propagation_policy:
-            return self._api.delete(self._resource, name, namespace,
-                                    propagation_policy=propagation_policy)
-        return self._api.delete(self._resource, name, namespace)
+        return self._api.delete(self._resource, name, namespace,
+                                propagation_policy=propagation_policy)
 
     def list(self, namespace=None, label_selector=None):
         return self._api.list(self._resource, namespace, label_selector)

@@ -35,11 +35,8 @@ class _ResourceClient:
 
     def delete(self, name: str, namespace: str = "",
                propagation_policy: Optional[str] = None) -> None:
-        if propagation_policy:
-            self._api.delete(self._resource, name, namespace,
-                             propagation_policy=propagation_policy)
-        else:
-            self._api.delete(self._resource, name, namespace)
+        self._api.delete(self._resource, name, namespace,
+                         propagation_policy=propagation_policy)
 
     def list(
         self, namespace: Optional[str] = None, label_selector: Optional[Selector] = None

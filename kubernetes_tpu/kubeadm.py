@@ -142,6 +142,22 @@ class InitContext:
 # -- the init phases (same order as app/cmd/phases/init) --------------------
 
 
+def _apply(api, resource: str, obj) -> None:
+    """Create-or-replace: phases are individually re-runnable (`kubeadm
+    init phase <name>` twice must succeed idempotently, as the
+    reference's phases do)."""
+    try:
+        api.create(resource, obj)
+    except Exception:
+        try:
+            live = api.get(resource, obj.metadata.name,
+                           obj.metadata.namespace)
+            obj.metadata.resource_version = live.metadata.resource_version
+            api.update(resource, obj)
+        except Exception:
+            raise
+
+
 def _phase_preflight(ctx: InitContext) -> None:
     # environment checks: store reachable, clean registry prefix
     ctx.secure.api.list("namespaces")
@@ -165,7 +181,7 @@ def _phase_certs(ctx: InitContext) -> None:
 def _phase_kubeconfig(ctx: InitContext) -> None:
     """Admin/component kubeconfigs: a ConfigMap holding the cluster
     coordinates + identity references (files in the reference)."""
-    ctx.secure.api.create("configmaps", v1.ConfigMap(
+    _apply(ctx.secure.api, "configmaps", v1.ConfigMap(
         metadata=v1.ObjectMeta(name="kubeconfig-admin", namespace="kube-system"),
         data={"cluster": ctx.cluster_name, "user": "kubernetes-admin"},
     ))
@@ -174,7 +190,7 @@ def _phase_kubeconfig(ctx: InitContext) -> None:
 def _phase_upload_config(ctx: InitContext) -> None:
     """kubeadm-config ConfigMap (uploadconfig phase) — what joining nodes
     read to discover cluster settings."""
-    ctx.secure.api.create("configmaps", v1.ConfigMap(
+    _apply(ctx.secure.api, "configmaps", v1.ConfigMap(
         metadata=v1.ObjectMeta(name="kubeadm-config", namespace="kube-system"),
         data={"clusterName": ctx.cluster_name},
     ))
@@ -206,7 +222,7 @@ def _phase_bootstrap_token(ctx: InitContext) -> None:
     (bootstraptoken phase; bootstrap.kubernetes.io/token type)."""
     token = ctx.bootstrap_token or generate_bootstrap_token()
     tid, tsec = token.split(".", 1)
-    ctx.secure.api.create("secrets", v1.Secret(
+    _apply(ctx.secure.api, "secrets", v1.Secret(
         metadata=v1.ObjectMeta(
             name=f"{TOKEN_SECRET_PREFIX}{tid}", namespace="kube-system"),
         type="bootstrap.kubernetes.io/token",

@@ -382,7 +382,12 @@ class Kubectl:
                     if self._namespaced(resource)
                     else ""
                 )
-                self.cs.resource(resource).delete(obj.metadata.name, ns)
+                policy = {"foreground": "Foreground", "orphan": "Orphan"}.get(
+                    getattr(args, "cascade", "background")
+                )
+                self.cs.resource(resource).delete(
+                    obj.metadata.name, ns, propagation_policy=policy
+                )
                 self._print(f"{resource}/{obj.metadata.name} deleted")
             return
         if not args.resource or not args.name:

@@ -97,6 +97,7 @@ class Scheduler:
             self.tpu = None
         self._stop = threading.Event()
         self._paused = threading.Event()
+        self._inflight_batch = None  # (todo, handle, cycle) awaiting harvest
         self._thread: Optional[threading.Thread] = None
         self._binders = ThreadPoolExecutor(max_workers=8, thread_name_prefix="binder")
         self._inflight = 0  # scheduling batches + binds not yet finished
@@ -189,6 +190,11 @@ class Scheduler:
         self.queue.close()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        if self.backend == "tpu":
+            try:
+                self._drain_inflight()  # loop is dead; land the tail batch
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                traceback.print_exc()
         self._binders.shutdown(wait=True)
 
     def _run(self) -> None:
@@ -198,6 +204,8 @@ class Scheduler:
         while not self._stop.is_set():
             try:
                 if self._paused.is_set():
+                    if self.backend == "tpu":
+                        self._drain_inflight()
                     time.sleep(0.02)
                     continue
                 self.schedule_one(timeout=0.2)
@@ -216,6 +224,8 @@ class Scheduler:
         dispatches with sequential assume semantics."""
         info = self.queue.pop(timeout=timeout)
         if info is None:
+            if self.backend == "tpu":
+                self._drain_inflight()  # idle: land the tail batch
             return False
         with self._inflight_lock:
             self._inflight += 1
@@ -268,7 +278,22 @@ class Scheduler:
                 todo = [i for i in todo if not self._needs_oracle(i.pod)]
                 for info in oracle_infos:
                     self._schedule_one_oracle(info)
-        results = self.tpu.schedule_many([i.pod for i in todo])
+        # 1-deep pipeline: dispatch this batch (async on the live session
+        # — the device scan chains on the previous batch's carry), then
+        # harvest/bind the PREVIOUS batch while the device works. The
+        # drain paths (_drain_inflight) flush on idle, pause, and stop.
+        handle = self.tpu.dispatch_many([i.pod for i in todo])
+        prev, self._inflight_batch = self._inflight_batch, (todo, handle, cycle)
+        if prev is not None:
+            self._complete_batch(*prev)
+
+    def _drain_inflight(self) -> None:
+        prev, self._inflight_batch = self._inflight_batch, None
+        if prev is not None:
+            self._complete_batch(*prev)
+
+    def _complete_batch(self, todo: List, handle, cycle: int) -> None:
+        results = self.tpu.harvest(handle)
         by_key = {v1.pod_key(p): node for p, node in results}
         # per-node failure statuses only matter when a PostFilter
         # (preemption) will consume them, and preemption can only evict
@@ -500,7 +525,11 @@ class Scheduler:
         while time.monotonic() < deadline:
             with self._inflight_lock:
                 inflight = self._inflight
-            if inflight == 0 and not self.queue.pending_pods():
+            if (
+                inflight == 0
+                and self._inflight_batch is None  # pipelined tail batch
+                and not self.queue.pending_pods()
+            ):
                 return True
             time.sleep(0.05)
         return False

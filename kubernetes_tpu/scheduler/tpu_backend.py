@@ -49,6 +49,25 @@ MASK_PLUGINS = (
 )
 
 
+class _BatchHandle:
+    """One dispatched batch: device outputs + how to decode them. The
+    decode fn is captured at dispatch time because the session may be
+    invalidated (by foreign cluster events) before harvest — the computed
+    ys stay valid either way."""
+
+    __slots__ = ("group", "ys", "decide", "node_names", "results")
+
+    def __init__(self, group: List[v1.Pod]):
+        self.group = group
+        self.ys = None
+        self.decide = None
+        # decisions are node INDICES into the cluster as of dispatch; a
+        # node remove/rebuild before harvest would shift enc.node_names,
+        # so the dispatch-time table rides the handle
+        self.node_names: Optional[List[str]] = None
+        self.results: Optional[List[Tuple[v1.Pod, Optional[str]]]] = None
+
+
 class TPUBackend(CacheListener):
     """Owns the dense encoding + kernel dispatch; registered as a cache
     listener so device state tracks the assume-cache at O(changed rows)."""
@@ -73,6 +92,7 @@ class TPUBackend(CacheListener):
         self._session = None  # HoistedSession or pallas PallasSession
         self._session_assumed: set = set()
         self._known_templates: Dict = {}  # fingerprint -> pod arrays
+        self._pending: Optional[_BatchHandle] = None  # one in-flight batch
         self.MAX_SESSION_TEMPLATES = 8
         # pallas rides only on real TPUs: on CPU (tests, dryruns) the
         # interpreter would be pathologically slow and compile-heavy
@@ -126,6 +146,10 @@ class TPUBackend(CacheListener):
         """One pod against every node; raises FitError when none fit
         (generic_scheduler.go:95 Schedule semantics)."""
         with self._lock:
+            # an outstanding pipelined batch must land in the encoding
+            # first (its decisions are part of the ground truth this
+            # dispatch evaluates against)
+            self._flush_pending()
             # device_state() with dirty rows DONATES the previous device
             # buffers (encoding.py fused scatter) — exactly the statics a
             # live session still references. Tear the session down first;
@@ -146,6 +170,82 @@ class TPUBackend(CacheListener):
             best = self._select_host(total, feasible)
             return ScheduleResult(self.enc.node_names[best], n_nodes, n_feasible)
 
+    # -- pipelined batch API -----------------------------------------------
+    # The session dispatch is ASYNC (HoistedSession.schedule returns device
+    # arrays without blocking; batch k+1's scan chains on k's carry as a
+    # pure data dependency). dispatch_many/harvest expose that to the
+    # scheduler loop: it dispatches batch k+1, then harvests/binds batch k
+    # while the device scans — the same overlap bench.py's kernel-direct
+    # pipeline exploits, now in the production loop.
+
+    def dispatch_many(self, pods: List[v1.Pod]) -> "_BatchHandle":
+        """Dispatch a batch; returns a handle for harvest(). One batch may
+        be outstanding — a second dispatch harvests the first. Falls back
+        to the synchronous path (ready handle) when the batch can't ride
+        the live session (bound pods, mixed shapes, unknown templates or
+        no session yet — the session builds on the synchronous path and
+        subsequent batches pipeline)."""
+        h = _BatchHandle(list(pods))
+        with self._lock:
+            if self._pending is not None:
+                self._harvest_locked()
+            if pods and self._session is not None and all(
+                not p.spec.node_name for p in pods
+            ):
+                clean = [
+                    {k: v for k, v in self.pe.encode(p).items()
+                     if not k.startswith("_")}
+                    for p in pods
+                ]
+                sig0 = shape_signature(clean[0])
+                if (
+                    all(shape_signature(a) == sig0 for a in clean[1:])
+                    and all(
+                        template_fingerprint(a) in self._session._fps
+                        for a in clean
+                    )
+                ):
+                    h.ys = self._session.schedule(clean)  # async, no block
+                    h.decide = type(self._session).decisions
+                    h.node_names = list(self.enc.node_names)
+                    self._pending = h
+                    return h
+            h.results = self.schedule_many(pods)  # re-entrant: RLock
+        return h
+
+    def harvest(self, handle: "_BatchHandle") -> List[Tuple[v1.Pod, Optional[str]]]:
+        with self._lock:
+            if handle.results is None and self._pending is handle:
+                self._harvest_locked()
+        assert handle.results is not None, "harvest of an abandoned handle"
+        return handle.results
+
+    def _flush_pending(self) -> None:
+        """Apply an outstanding batch's assumes to the host encoding.
+        MUST run (under the lock) before anything treats the encoding as
+        ground truth — session rebuilds and the one-pod schedule() path —
+        or the rebuilt carry would miss those pods."""
+        if self._pending is not None:
+            self._harvest_locked()
+
+    def _harvest_locked(self) -> None:
+        h = self._pending
+        self._pending = None
+        decisions = h.decide(h.ys)
+        results: List[Tuple[v1.Pod, Optional[str]]] = []
+        for g, best in zip(h.group, decisions):
+            if best < 0:
+                results.append((g, None))
+            else:
+                node = h.node_names[best]
+                if self._session is not None:
+                    self._session_assumed.add(
+                        (g.metadata.namespace, g.metadata.name, node)
+                    )
+                self.enc.add_pod(g, node)
+                results.append((g, node))
+        h.results = results
+
     def schedule_many(self, pods: List[v1.Pod]) -> List[Tuple[v1.Pod, Optional[str]]]:
         """Batched sequential scheduling: groups batchable same-shape pods
         into single scan dispatches (ops/batch.py); falls back to per-pod
@@ -155,6 +255,7 @@ class TPUBackend(CacheListener):
         re-syncs the same rows idempotently via the listener hooks)."""
         results: List[Tuple[v1.Pod, Optional[str]]] = []
         with self._lock:
+            self._flush_pending()
             i = 0
             while i < len(pods):
                 pod = pods[i]

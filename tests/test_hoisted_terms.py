@@ -441,3 +441,96 @@ class TestBackendRouting:
         )
         assert after == before + 1
         assert any("downgrading" in r.message for r in caplog.records)
+
+
+class TestPipelinedDispatch:
+    """dispatch_many/harvest (the scheduler loop's 1-deep pipeline) must
+    be decision-identical to synchronous schedule_many, including when
+    foreign mutations invalidate the session between dispatch and
+    harvest."""
+
+    def _backend(self, n_nodes=8):
+        import random as _random
+
+        from kubernetes_tpu.scheduler.tpu_backend import TPUBackend
+        from kubernetes_tpu.testing.synth import synth_cluster as sc
+
+        nodes, init_pods = sc(n_nodes, pods_per_node=1)
+        b = TPUBackend(rng=_random.Random(0))
+        for n in nodes:
+            b.on_add_node(n)
+        for p in init_pods:
+            b.on_add_pod(p, p.spec.node_name)
+        return b
+
+    def _pods(self, prefix, n):
+        return [
+            make_pod(f"{prefix}-{i}", cpu="50m", labels={"app": "pl"},
+                     affinity=_anti_affinity(v1.LABEL_HOSTNAME, {"app": "pl"}))
+            for i in range(n)
+        ]
+
+    def test_pipeline_matches_sync(self):
+        sync_b = self._backend()
+        pipe_b = self._backend()
+        batches = [self._pods(f"b{k}", 4) for k in range(3)]
+
+        import copy
+
+        sync_out = []
+        for batch in batches:
+            sync_out.extend(
+                n for _, n in sync_b.schedule_many(copy.deepcopy(batch))
+            )
+
+        handles = []
+        pipe_out = []
+        # warm: first dispatch takes the sync path (builds the session)
+        for batch in batches:
+            h = pipe_b.dispatch_many(batch)
+            handles.append((batch, h))
+        for batch, h in handles:
+            pipe_out.extend(n for _, n in pipe_b.harvest(h))
+        assert pipe_out == sync_out
+        placed = [n for n in pipe_out if n is not None]
+        assert len(placed) == len(set(placed)) == 8  # one per node
+
+    def test_mutation_between_dispatch_and_harvest(self):
+        b = self._backend()
+        # two warm batches: the first triggers the initial encoding
+        # rebuild (vocab growth re-widths the arrays), the second
+        # registers templates at the settled caps
+        b.schedule_many(self._pods("warm", 2))
+        b.schedule_many(self._pods("warm2", 2))
+        h = b.dispatch_many(self._pods("x", 3))
+        assert h.results is None, "post-warm batch should pipeline"
+        # foreign mutation invalidates the session mid-flight
+        foreign = make_pod("foreign", cpu="10m", node_name="n-0")
+        b.on_add_pod(foreign, b.enc.node_names[0])
+        assert b._session is None
+        results = b.harvest(h)  # ys stay valid; decode fn was captured
+        assert len(results) == 3
+        # the next batch rebuilds from an encoding that includes the
+        # harvested assumes: no node double-booked across the boundary
+        more = b.schedule_many(self._pods("y", 3))
+        placed = [n for _, n in results if n] + [n for _, n in more if n]
+        assert len(placed) == len(set(placed))
+
+    def test_schedule_flushes_pending(self):
+        b = self._backend()
+        b.schedule_many(self._pods("warm", 2))
+        b.schedule_many(self._pods("warm2", 2))
+        h = b.dispatch_many(self._pods("z", 2))
+        assert h.results is None
+        # the one-pod path must land the pending batch before evaluating
+        lone = make_pod("lone", cpu="50m", labels={"app": "pl"},
+                        affinity=_anti_affinity(v1.LABEL_HOSTNAME, {"app": "pl"}))
+        from kubernetes_tpu.scheduler.framework.interface import FitError
+
+        try:
+            r = b.schedule(lone)
+            taken = {n for _, n in h.results if n}
+            assert r.suggested_host not in taken
+        except FitError:
+            pass
+        assert h.results is not None, "schedule() must flush the pipeline"

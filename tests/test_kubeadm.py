@@ -115,3 +115,20 @@ class TestCertLifecycle:
         assert ca.verify(renewed)
         assert renewed.token == old_token  # live components keep working
         assert not ca.check_expiration(within=60.0)
+
+
+class TestPhaseIdempotence:
+    def test_full_init_twice(self, secure):
+        ctx = kubeadm.init(secure)
+        ctx2 = kubeadm.init(secure, node_name=ctx.node_name)
+        assert all(ctx2.results.values())
+        # single control-plane taint despite two mark runs
+        node = secure.api.get("nodes", "control-plane-0")
+        cp_taints = [t for t in node.spec.taints or []
+                     if t.key == kubeadm.CONTROL_PLANE_TAINT]
+        assert len(cp_taints) == 1
+
+    def test_single_phase_rerun(self, secure):
+        kubeadm.init(secure)
+        ctx = kubeadm.init(secure, only_phase="upload-config")
+        assert ctx.results == {"upload-config": True}
