@@ -20,6 +20,7 @@ from __future__ import annotations
 import math
 import re
 from fractions import Fraction
+from functools import lru_cache
 from typing import Union
 
 # Binary SI suffixes (quantity.go `BinarySI` format)
@@ -59,6 +60,15 @@ def parse_quantity(s: Union[str, int, float, "Quantity"]) -> Fraction:
         return Fraction(s)
     if isinstance(s, float):
         return Fraction(str(s))
+    return _parse_quantity_str(s)
+
+
+@lru_cache(maxsize=8192)
+def _parse_quantity_str(s: str) -> Fraction:
+    # Fractions are immutable, so the cached value is safe to share.
+    # Workloads repeat a handful of request strings ("100m", "128Mi", ...)
+    # across every pod; the uncached Fraction math showed up in scheduler
+    # hot-loop profiles (NodeInfo.add_pod -> calculate_resource).
     m = _QUANTITY_RE.match(s.strip())
     if not m:
         raise ValueError(f"invalid quantity: {s!r}")

@@ -144,6 +144,9 @@ class AggregatedAPIServer:
     def bind_pod(self, namespace, pod_name, node_name):
         return self.local.bind_pod(namespace, pod_name, node_name)
 
+    def bind_pods(self, bindings):
+        return self.local.bind_pods(bindings)
+
     @property
     def store(self):
         return self.local.store

@@ -625,6 +625,19 @@ class RemoteAPIServer:
             {"target": {"kind": "Node", "name": node_name}},
         )
 
+    def bind_pods(self, bindings):
+        """Bulk-bind parity with the in-proc APIServer: per-binding POSTs
+        over the wire (the reference has no bulk binding verb either),
+        per-binding outcomes."""
+        results = []
+        for namespace, pod_name, node_name in bindings:
+            try:
+                self.bind_pod(namespace, pod_name, node_name)
+                results.append(None)
+            except APIError as e:
+                results.append(e)
+        return results
+
     def pod_logs(self, name: str, namespace: str = "", container: str = "",
                  tail: Optional[int] = None) -> List[str]:
         info = self._info("pods")

@@ -503,6 +503,26 @@ class APIServer:
         except kv.KeyNotFound as e:
             raise NotFound(str(e))
 
+    def bind_pods(
+        self, bindings: List[Tuple[str, str, str]]
+    ) -> List[Optional[APIError]]:
+        """Bulk binding application: N pods/{name}/binding writes in one
+        call, per-binding outcomes (None = bound). Semantically identical
+        to N bind_pod calls; exists because the scheduler's batched cycle
+        lands thousands of bindings at once and the per-call overhead
+        (lock churn, method dispatch) was measurable in the full-loop
+        profile. The reference amortizes the same cost with 8 parallel
+        binder goroutines (pkg/scheduler/scheduler.go:540) — under a GIL,
+        batching is the equivalent lever."""
+        results: List[Optional[APIError]] = []
+        for namespace, pod_name, node_name in bindings:
+            try:
+                self.bind_pod(namespace, pod_name, node_name)
+                results.append(None)
+            except APIError as e:
+                results.append(e)
+        return results
+
     def update_status(self, resource: str, obj: Any) -> Any:
         """status subresource: replaces only .status (handlers for
         pods/status, nodes/status)."""

@@ -53,6 +53,11 @@ class _PodClient(_ResourceClient):
     def bind(self, namespace: str, pod_name: str, node_name: str) -> None:
         self._api.bind_pod(namespace, pod_name, node_name)
 
+    def bind_many(self, bindings: List[Tuple[str, str, str]]):
+        """Bulk bindings [(namespace, name, node)]; per-binding outcome
+        list (None = bound, APIError otherwise)."""
+        return self._api.bind_pods(bindings)
+
 
 class Clientset:
     def __init__(self, api: APIServer):

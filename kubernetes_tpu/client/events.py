@@ -5,15 +5,24 @@ EventRecorder stamps Events (reason, message, involved object) and a
 broadcaster sinks them to the apiserver; the scheduler emits "Scheduled" /
 "FailedScheduling" (pkg/scheduler/scheduler.go:423) and preemption events.
 
+Recording is ASYNCHRONOUS, like the reference's broadcaster (event.go
+StartRecordingToSink drains a buffered watch channel on its own
+goroutine; Event() never blocks the caller on the API write — a full
+buffer drops the event). Here: event() enqueues onto a bounded deque
+serviced by a daemon thread; overflow drops the oldest entry. flush()
+waits for the queue to drain (tests; Scheduler.stop).
+
 Events aggregate by (involved object, reason, message): a repeat bumps
 count instead of creating a new object (event_aggregator semantics).
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
@@ -44,21 +53,60 @@ class Event:
 
 
 class EventRecorder:
+    MAX_QUEUE = 4096  # event.go maxQueuedEvents-equivalent backpressure
+
     def __init__(self, clientset, component: str):
         self._client = clientset.resource("events")
         self._component = component
         self._lock = threading.Lock()
         self._known: Dict[tuple, str] = {}  # aggregation key -> event name
+        self._queue: deque = deque(maxlen=self.MAX_QUEUE)  # overflow drops oldest
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._thread: Optional[threading.Thread] = None
+        # unique-name suffix: one uuid per recorder + a counter, instead of
+        # a uuid4 per event (uuid4 was visible in bind-path profiles)
+        self._name_base = uuid.uuid4().hex[:6]
+        self._seq = itertools.count()
 
     def event(self, obj, event_type: str, reason: str, message: str) -> None:
+        """Enqueue; never blocks on the API (record never blocks callers)."""
         ref = ObjectReference(
             kind=getattr(obj, "kind", ""),
             namespace=obj.metadata.namespace,
             name=obj.metadata.name,
             uid=obj.metadata.uid,
         )
+        with self._lock:
+            self._idle.clear()
+            self._queue.append((ref, event_type, reason, message, time.time()))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="event-broadcaster"
+                )
+                self._thread.start()
+        self._wake.set()
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Wait until every queued event has been sunk (test/shutdown aid)."""
+        return self._idle.wait(timeout)
+
+    def _run(self) -> None:
+        while True:
+            self._wake.wait()
+            while True:
+                with self._lock:
+                    if not self._queue:
+                        self._wake.clear()
+                        self._idle.set()
+                        break
+                    item = self._queue.popleft()
+                self._sink(*item)
+
+    def _sink(self, ref: ObjectReference, event_type: str, reason: str,
+              message: str, now: float) -> None:
         key = (ref.kind, ref.namespace, ref.name, reason, message)
-        now = time.time()
         with self._lock:
             existing_name = self._known.get(key)
         try:
@@ -71,7 +119,7 @@ class EventRecorder:
                     return
                 except Exception:
                     pass  # fall through to create
-            name = f"{ref.name}.{uuid.uuid4().hex[:10]}"
+            name = f"{ref.name}.{self._name_base}{next(self._seq):x}"
             ev = Event(
                 metadata=v1.ObjectMeta(name=name, namespace=ref.namespace or "default"),
                 involved_object=ref,
@@ -86,4 +134,4 @@ class EventRecorder:
             with self._lock:
                 self._known[key] = name
         except Exception:
-            pass  # events are best-effort (record never blocks callers)
+            pass  # events are best-effort

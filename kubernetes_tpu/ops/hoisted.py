@@ -347,6 +347,69 @@ def _match_matrices(tp: Dict, batch: Dict):
     return mf, ms  # each [T, B, C]
 
 
+def _eval_reqs_batch_np(op, key, pairs, pair_vecs, key_vecs):
+    """numpy twin of eval_reqs_single over a pod batch: op/key [C, R],
+    pairs [C, R, V], pair_vecs [B, P] bool, key_vecs [B, K] bool ->
+    [B, C] bool. Pad ids are 0 = the never-present sentinel column, so
+    plain fancy indexing matches the device gather semantics."""
+    from ..models.selectors import (
+        OP_EXISTS, OP_FALSE, OP_GT, OP_IN, OP_LT, OP_NOT_EXISTS, OP_NOT_IN,
+    )
+
+    any_pair = pair_vecs[:, pairs].any(axis=-1)  # [B, C, R]
+    has_key = key_vecs[:, key]                   # [B, C, R]
+    res = np.ones_like(has_key, dtype=bool)      # OP_PAD -> True
+    res = np.where(op == OP_IN, any_pair, res)
+    res = np.where(op == OP_NOT_IN, ~any_pair, res)
+    res = np.where(op == OP_EXISTS, has_key, res)
+    res = np.where(op == OP_NOT_EXISTS, ~has_key, res)
+    res = np.where((op == OP_GT) | (op == OP_LT), False, res)
+    res = np.where(op == OP_FALSE, False, res)
+    return res.all(axis=-1)  # [B, C]
+
+
+def match_matrices_np(tp_np: Dict, pod_arrays_list: List[Dict]):
+    """Host-side Mf/Ms [T, B, C] — numpy twin of _match_matrices.
+
+    The pallas dispatch packs these into its int8 host->device transfer.
+    Computing them with the jnp vmap and then np.asarray-ing the result
+    blocks behind everything already enqueued on the device stream —
+    including the PREVIOUS batch's scan — which serializes the scheduler
+    loop's 1-deep pipeline. Pure-host numpy keeps the dispatch async.
+
+    tp_np: numpy template stacks (fields ptsf_*/ptss_*/self_ns, [T, ...]).
+    """
+    B = len(pod_arrays_list)
+    pair_vecs = np.stack(
+        [np.asarray(pa["self_ppair"]) for pa in pod_arrays_list]
+    ).astype(bool)
+    key_vecs = np.stack(
+        [np.asarray(pa["self_pkey"]) for pa in pod_arrays_list]
+    ).astype(bool)
+    ns = np.asarray(
+        [int(np.asarray(pa["self_ns"])) for pa in pod_arrays_list]
+    )
+    T = tp_np["self_ns"].shape[0]
+    C = tp_np["ptsf_op"].shape[1]
+    mf = np.zeros((T, B, C), _CNT)
+    ms = np.zeros((T, B, C), _CNT)
+    for t in range(T):
+        ns_ok = ns == int(tp_np["self_ns"][t])  # [B]
+        mf[t] = (
+            _eval_reqs_batch_np(
+                tp_np["ptsf_op"][t], tp_np["ptsf_rkey"][t],
+                tp_np["ptsf_pairs"][t], pair_vecs, key_vecs,
+            ) & ns_ok[:, None]
+        ).astype(_CNT)
+        ms[t] = (
+            _eval_reqs_batch_np(
+                tp_np["ptss_op"][t], tp_np["ptss_rkey"][t],
+                tp_np["ptss_pairs"][t], pair_vecs, key_vecs,
+            ) & ns_ok[:, None]
+        ).astype(_CNT)
+    return mf, ms
+
+
 # ---------------------------------------------------------------------------
 # the scan step
 

@@ -51,10 +51,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .batch import pod_batchable
 from .hoisted import (
-    _batch_inputs,
-    _match_matrices,
     _session_prologue,
     _stack_templates,
+    match_matrices_np,
     template_fingerprint,
 )
 from .kernel import DEFAULT_WEIGHTS, MAX_NODE_SCORE
@@ -146,6 +145,15 @@ class PallasSession:
                                     reason="weights-exceed-f32")
         tp = _stack_templates(template_arrays_list)
         self._tp = tp
+        # numpy copies of the selector tables schedule() evaluates on
+        # HOST per batch (match_matrices_np) — the jnp path would block
+        # the dispatch behind the previous batch's scan (device stream
+        # ordering), serializing the scheduler's 1-deep pipeline
+        self._tp_np = {
+            k: np.asarray(tp[k])
+            for k in ("ptsf_op", "ptsf_rkey", "ptsf_pairs",
+                      "ptss_op", "ptss_rkey", "ptss_pairs", "self_ns")
+        }
         S = {k: np.asarray(v) for k, v in _session_prologue(cluster, tp).items()}
         c = {k: np.asarray(v) for k, v in cluster.items()}
         self._build(c, S)
@@ -441,16 +449,16 @@ class PallasSession:
             if bool(np.asarray(pa["has_node_name"])):
                 raise ValueError("session pods must be unbound")
             tmpl[i] = self._fps[template_fingerprint(pa)]
-        batch_self, _ = _batch_inputs(pod_arrays_list, tmpl[:B])
-        mf, ms = _match_matrices(self._tp, batch_self)
+        # match matrices on HOST (match_matrices_np): an on-device
+        # compute + readback here would wait out the previous batch's
+        # scan and kill the dispatch/harvest overlap
+        mfa, msa = match_matrices_np(self._tp_np, pod_arrays_list)
         T, C, CP = self.T, self.C, self.CP
         # [Bp, LANE]: lane (t*CP+c) = that constraint row, per pod.
         # int8 on the wire: match weights are 0/1 and the per-batch
         # host->device transfer is part of the dispatch's fixed cost
         mfT = np.zeros((Bp, LANE), np.int8)
         msT = np.zeros((Bp, LANE), np.int8)
-        mfa = np.asarray(mf)
-        msa = np.asarray(ms)
         for t in range(T):
             mfT[:B, t * CP:t * CP + C] = mfa[t].reshape(B, C)
             msT[:B, t * CP:t * CP + C] = msa[t].reshape(B, C)

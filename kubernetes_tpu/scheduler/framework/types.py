@@ -249,6 +249,7 @@ class QueuedPodInfo:
         "attempts",
         "initial_attempt_timestamp",
         "last_failure_timestamp",
+        "pop_timestamp",
     )
 
     def __init__(self, pod: v1.Pod, timestamp: Optional[float] = None):
@@ -257,6 +258,10 @@ class QueuedPodInfo:
         self.attempts = 0
         self.initial_attempt_timestamp = self.timestamp
         self.last_failure_timestamp = 0.0
+        # stamped by the scheduler at queue pop; bind-sent minus this is
+        # the per-attempt latency (pod_scheduling_duration measures from
+        # initial_attempt_timestamp, i.e. includes queue wait)
+        self.pop_timestamp = 0.0
 
     @property
     def pod(self) -> v1.Pod:

@@ -106,6 +106,25 @@ class SchedulerCache:
             self._pod_states[key] = ps
             self._assumed_pods[key] = True
 
+    def assume_pods(self, pods: List[v1.Pod]) -> List[bool]:
+        """Batch AssumePod under ONE lock acquisition (the TPU batch path
+        assumes thousands of pods per cycle; per-pod locking ping-pongs
+        with the binder threads' finish_binding). Returns per-pod success;
+        False = already in the cache (informer raced us), same condition
+        assume_pod raises ValueError for."""
+        out: List[bool] = []
+        with self._lock:
+            for pod in pods:
+                key = v1.pod_key(pod)
+                if key in self._pod_states:
+                    out.append(False)
+                    continue
+                self._add_pod_locked(pod, pod.spec.node_name)
+                self._pod_states[key] = _PodState(pod)
+                self._assumed_pods[key] = True
+                out.append(True)
+        return out
+
     def finish_binding(self, pod: v1.Pod) -> None:
         key = v1.pod_key(pod)
         with self._lock:
@@ -113,6 +132,16 @@ class SchedulerCache:
             if ps is not None and self._assumed_pods.get(key):
                 ps.binding_finished = True
                 ps.deadline = self._now() + self._ttl
+
+    def finish_binding_many(self, pods: List[v1.Pod]) -> None:
+        """Batch FinishBinding under one lock acquisition."""
+        with self._lock:
+            deadline = self._now() + self._ttl
+            for pod in pods:
+                ps = self._pod_states.get(v1.pod_key(pod))
+                if ps is not None and self._assumed_pods.get(v1.pod_key(pod)):
+                    ps.binding_finished = True
+                    ps.deadline = deadline
 
     def forget_pod(self, pod: v1.Pod) -> None:
         key = v1.pod_key(pod)

@@ -79,6 +79,29 @@ batch_size = legacy_registry.register(
         buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
     )
 )
+pod_scheduling_duration = legacy_registry.register(
+    Histogram(
+        "scheduler_pod_scheduling_duration_seconds",
+        "E2e latency for a pod being scheduled, from first attempt "
+        "(queue admission) to bind sent — the metric scheduler_perf "
+        "extracts Perc50/90/99 from (reference: metrics.go "
+        "PodSchedulingDuration; test/integration/scheduler_perf/"
+        "util.go:177-218).",
+        ("attempts",),
+        # metrics.go PodSchedulingDuration: ExponentialBuckets(0.001, 2, 20)
+        buckets=tuple(0.001 * 2**i for i in range(20)),
+    )
+)
+scheduling_attempt_duration = legacy_registry.register(
+    Histogram(
+        "scheduler_pod_scheduling_attempt_duration_seconds",
+        "Latency of ONE scheduling attempt: queue pop to bind sent "
+        "(excludes queue wait; the per-attempt half of the north-star "
+        "latency metric).",
+        (),
+        buckets=tuple(0.001 * 2**i for i in range(20)),
+    )
+)
 session_builds = legacy_registry.register(
     Counter(
         "scheduler_tpu_session_builds_total",

@@ -54,6 +54,12 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
+    def items(self) -> List[Tuple[Tuple[str, ...], float]]:
+        """All (label-values, value) pairs — the public iteration surface
+        (consumers must not reach into _values/_lock)."""
+        with self._lock:
+            return list(self._values.items())
+
     def collect(self) -> List[str]:
         with self._lock:
             return [

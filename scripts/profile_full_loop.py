@@ -1,0 +1,134 @@
+"""Statistical all-thread profiler for the FULL scheduler loop.
+
+Samples sys._current_frames() every ~4ms during the measured window of a
+mid-scale workload (default: 2000 nodes / 4096 pods, batch 1024) and
+aggregates inclusive time per function per thread-role — a poor-man's
+py-spy (not installed here) that sees the scheduler thread, the binder
+pool, and the informer dispatch thread at once.
+
+Usage: python scripts/profile_full_loop.py [nodes] [pods] [batch]
+"""
+import collections
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+P = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+B = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+
+from kubernetes_tpu.perf import harness  # noqa: E402
+from kubernetes_tpu.perf.harness import PodTemplate, Workload  # noqa: E402
+
+samples = collections.Counter()  # (thread_name, func_id) -> count
+stack_samples = collections.Counter()  # leaf-up 4-frame stack -> count
+sampling = threading.Event()
+done = threading.Event()
+n_samples = [0]
+
+_names = {}
+
+
+def _thread_names():
+    for t in threading.enumerate():
+        _names[t.ident] = t.name
+    return _names
+
+
+def sampler():
+    while not done.is_set():
+        if not sampling.is_set():
+            time.sleep(0.01)
+            continue
+        names = _thread_names()
+        me = threading.get_ident()
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            name = names.get(tid, str(tid))
+            # normalize thread-pool/ephemeral names to roles
+            if name.startswith("binder"):
+                role = "binder"
+            elif name.startswith("Thread-"):
+                role = "informer/other"
+            else:
+                role = name
+            f = frame
+            leaf = f"{os.path.basename(f.f_code.co_filename)}:{f.f_code.co_name}"
+            stack = []
+            while f is not None and len(stack) < 5:
+                stack.append(
+                    f"{os.path.basename(f.f_code.co_filename)}:{f.f_code.co_name}"
+                )
+                f = f.f_back
+            samples[(role, leaf)] += 1
+            stack_samples[(role, tuple(stack))] += 1
+        n_samples[0] += 1
+        time.sleep(0.004)
+
+
+# hook the harness measured window: patch time.sleep-based loop by toggling
+# `sampling` around run_workload's measured phase. Simplest reliable hook:
+# wrap Scheduler.resume (second resume = measured phase start).
+from kubernetes_tpu.scheduler.scheduler import Scheduler  # noqa: E402
+
+_resumes = [0]
+_orig_resume = Scheduler.resume
+
+
+def patched_resume(self):
+    _resumes[0] += 1
+    if _resumes[0] >= 2:  # measured-phase resume
+        sampling.set()
+    return _orig_resume(self)
+
+
+Scheduler.resume = patched_resume
+
+t = threading.Thread(target=sampler, daemon=True)
+t.start()
+
+w = Workload(
+    f"profile-{N}n-{P}p", num_nodes=N, num_init_pods=min(2048, P),
+    num_pods=P, init_template=PodTemplate(spread_zone=True),
+    template=PodTemplate(spread_zone=True), max_batch=B, timeout=600.0,
+)
+t0 = time.perf_counter()
+r = harness.run_workload(w)
+sampling.clear()
+done.set()
+wall = time.perf_counter() - t0
+
+print(f"\n=== {r.name}: {r.throughput_avg} pods/s avg "
+      f"(p50 {r.throughput_p50}, p90 {r.throughput_p90}), "
+      f"{r.num_bound}/{P} bound, wall {wall:.1f}s, "
+      f"{n_samples[0]} sample sweeps")
+
+by_role = collections.Counter()
+for (role, leaf), c in samples.items():
+    by_role[role] += c
+total = sum(by_role.values()) or 1
+print("\n-- samples by thread role --")
+for role, c in by_role.most_common():
+    print(f"  {role:<18}{c:7d}  {100*c/total:5.1f}%")
+
+print("\n-- top leaves per role --")
+for role, _ in by_role.most_common(4):
+    print(f"  [{role}]")
+    role_total = by_role[role] or 1
+    leaves = collections.Counter(
+        {leaf: c for (rr, leaf), c in samples.items() if rr == role}
+    )
+    for leaf, c in leaves.most_common(14):
+        print(f"    {100*c/role_total:5.1f}%  {leaf}")
+
+print("\n-- top stacks (all roles) --")
+for (role, stack), c in stack_samples.most_common(25):
+    print(f"  {100*c/total:5.1f}% [{role}] {' < '.join(stack)}")

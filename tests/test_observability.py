@@ -43,10 +43,12 @@ def test_event_recorder_aggregates():
     pod = v1.Pod(metadata=v1.ObjectMeta(name="p", namespace="default"))
     rec.event(pod, "Normal", "Scheduled", "assigned default/p to n1")
     rec.event(pod, "Normal", "Scheduled", "assigned default/p to n1")
+    assert rec.flush()  # recording is async (broadcaster semantics)
     events, _ = cs.resource("events").list()
     assert len(events) == 1
     assert events[0].count == 2
     rec.event(pod, "Warning", "FailedScheduling", "0/3 nodes")
+    assert rec.flush()
     events, _ = cs.resource("events").list()
     assert len(events) == 2
 
