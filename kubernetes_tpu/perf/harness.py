@@ -325,6 +325,7 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         bound0 = bound_count()
         t0 = time.perf_counter()
         samples: List[float] = []
+        sample_times: List[float] = []
         last_bound, last_t = 0, t0
         stall_since = t0
         deadline = t0 + w.timeout
@@ -333,6 +334,7 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             bound = bound_count() - bound0
             now = time.perf_counter()
             samples.append((bound - last_bound) / (now - last_t))
+            sample_times.append(now)
             if bound != last_bound:
                 stall_since = now
             last_bound, last_t = bound, now
@@ -343,8 +345,13 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         sched.pause()  # no fresh dispatches while results are read
         dt = time.perf_counter() - t0
         if w.stall_stop and stall_since - t0 > 0 and last_bound < w.num_pods:
-            # drop the idle stall tail from the measured window
+            # drop the idle stall tail from the measured window — both the
+            # duration and the all-zero samples it contributed (filter by
+            # sample timestamp: loop iterations drift past 1s under load)
             dt = stall_since - t0
+            samples = [
+                s for s, ts in zip(samples, sample_times) if ts <= stall_since
+            ] or samples[:1]
         pods, _ = cs.pods.list(namespace="default")
         bound_measured = sum(1 for p in pods if p.spec.node_name) - w.num_init_pods
         # exact per-pod latency percentiles over the measured pods: the

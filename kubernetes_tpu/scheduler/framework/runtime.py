@@ -39,10 +39,15 @@ class WaitingPod:
         self._resolved = False
         self._status: Optional[Status] = None
         self._deadline = time.monotonic() + max(plugin_timeouts.values())
+        self._listeners: List[Callable[[], None]] = []
 
     def pending_plugins(self) -> List[str]:
         with self._cv:
             return sorted(self._pending)
+
+    @property
+    def deadline(self) -> float:
+        return self._deadline
 
     def allow(self, plugin_name: str) -> None:
         with self._cv:
@@ -51,6 +56,9 @@ class WaitingPod:
                 self._resolved = True
                 self._status = None  # success
             self._cv.notify_all()
+            fire = self._take_listeners_locked()
+        for fn in fire:
+            fn()
 
     def reject(self, plugin_name: str, msg: str) -> None:
         with self._cv:
@@ -62,6 +70,42 @@ class WaitingPod:
                 )
                 self._status.failed_plugin = plugin_name
             self._cv.notify_all()
+            fire = self._take_listeners_locked()
+        for fn in fire:
+            fn()
+
+    def add_listener(self, fn: Callable[[], None]) -> None:
+        """Call fn() once, when this pod resolves (allow-all / reject /
+        timeout); immediately if already resolved. Lets ONE drainer thread
+        service every parked pod instead of one blocked thread per pod —
+        a gang workload parks thousands at once."""
+        with self._cv:
+            if not self._resolved:
+                self._listeners.append(fn)
+                return
+        fn()
+
+    def timeout_if_due(self, now: float) -> bool:
+        """Resolve with the timeout status if the deadline passed (the
+        drainer's replacement for the per-thread wait loop's timeout)."""
+        with self._cv:
+            if self._resolved or now < self._deadline:
+                return self._resolved
+            self._resolved = True
+            self._status = Status.unschedulable(
+                f"pod {self.pod.metadata.name!r} timed out waiting at Permit"
+            )
+            self._cv.notify_all()
+            fire = self._take_listeners_locked()
+        for fn in fire:
+            fn()
+        return True
+
+    def _take_listeners_locked(self) -> List[Callable[[], None]]:
+        if not self._resolved or not self._listeners:
+            return []
+        fire, self._listeners = self._listeners, []
+        return fire
 
     def wait(self) -> Optional[Status]:
         with self._cv:
@@ -74,7 +118,11 @@ class WaitingPod:
                     )
                     break
                 self._cv.wait(timeout=min(remaining, 0.5))
-            return self._status
+            status = self._status
+            fire = self._take_listeners_locked()
+        for fn in fire:
+            fn()
+        return status
 
 PluginFactory = Callable[[Optional[dict], "Framework"], fwk.Plugin]
 

@@ -106,6 +106,13 @@ class Scheduler:
         # wants exact percentiles (scheduler_perf util.go:177 extracts
         # Perc50/90/99 from the live histogram — ours keeps the samples).
         self.latency_samples: deque = deque(maxlen=200_000)
+        # permit drainer state: pods parked at Permit (WAIT) register a
+        # listener and a single thread releases them in waves
+        self._permit_lock = threading.Lock()
+        self._permit_parked: Dict[str, Tuple] = {}
+        self._permit_released: List[Tuple] = []
+        self._permit_wake = threading.Event()
+        self._permit_thread: Optional[threading.Thread] = None
         self._thread: Optional[threading.Thread] = None
         self._binders = ThreadPoolExecutor(max_workers=8, thread_name_prefix="binder")
         self._inflight = 0  # scheduling batches + binds not yet finished
@@ -195,6 +202,7 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stop.set()
+        self._permit_wake.set()  # let the permit drainer exit
         self.queue.close()
         if self._thread is not None:
             self._thread.join(timeout=10)
@@ -398,17 +406,103 @@ class Scheduler:
             # gang larger than the pool would deadlock (every worker
             # blocked in wait_on_permit, the unblocking pod queued behind
             # them). The reference runs one goroutine per binding cycle
-            # (scheduler.go:540); give waiting pods their own thread.
-            with self._inflight_lock:
-                self._inflight += 1
-            threading.Thread(
-                target=self._bind,
-                args=(assumed, node_name, state, info),
-                name=f"binder-wait-{assumed.metadata.name}",
-                daemon=True,
-            ).start()
+            # (scheduler.go:540); a thread per parked pod at gang scale
+            # (thousands parked at once) thrashes the GIL, so parked pods
+            # register a resolution listener and ONE drainer thread
+            # releases them through the batched binding cycle.
+            self._park_waiting(assumed, node_name, state, info)
             return "handled"
         return "bind"
+
+    # -- permit drainer: WAIT pods without a thread each -------------------
+
+    def _park_waiting(
+        self, assumed: v1.Pod, node_name: str, state: CycleState, info
+    ) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+        key = v1.pod_key(assumed)
+        wp = self.framework.get_waiting_pod(key)
+        if wp is None:
+            # resolved before we could park (plugin allowed within
+            # run_permit_plugins' return): plain binding cycle
+            self._binders.submit(self._bind, assumed, node_name, state, info)
+            return
+        with self._permit_lock:
+            self._permit_parked[key] = (assumed, node_name, state, info, wp)
+            if self._permit_thread is None:
+                self._permit_thread = threading.Thread(
+                    target=self._permit_drain_loop,
+                    name="permit-drainer", daemon=True,
+                )
+                self._permit_thread.start()
+        wp.add_listener(lambda k=key: self._permit_release(k))
+
+    def _permit_release(self, key: str) -> None:
+        with self._permit_lock:
+            item = self._permit_parked.pop(key, None)
+            if item is not None:
+                self._permit_released.append(item)
+        self._permit_wake.set()
+
+    def _permit_drain_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._permit_drain_once()
+            except Exception:  # the drainer must outlive plugin bugs:
+                # its death would strand every parked pod forever
+                traceback.print_exc()
+
+    def _permit_drain_once(self) -> None:
+        # wake on releases, or in time for the nearest permit deadline
+        with self._permit_lock:
+            parked = list(self._permit_parked.values())
+        now = _time.monotonic()
+        next_deadline = min(
+            (wp.deadline for _, _, _, _, wp in parked), default=now + 0.5
+        )
+        self._permit_wake.wait(timeout=max(0.02, min(next_deadline - now, 0.5)))
+        self._permit_wake.clear()
+        now = _time.monotonic()
+        for _, _, _, _, wp in parked:
+            # deadline is immutable: the lock-free check skips the cv
+            # acquisition for the (vast) non-expired majority
+            if now >= wp.deadline:
+                wp.timeout_if_due(now)  # fires the release listener
+        with self._permit_lock:
+            released, self._permit_released = self._permit_released, []
+        if not released:
+            return
+        items: List[Tuple] = []
+        fwk = self.framework
+        for assumed, node_name, state, info, _wp in released:
+            try:
+                # resolved already — returns instantly and unparks the pod
+                st = fwk.wait_on_permit(assumed)
+                if st is not None and not st.is_success():
+                    fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
+                    self._abort_binding(assumed, f"Permit: {st.message()}")
+                    with self._inflight_lock:
+                        self._inflight -= 1
+                    continue
+            except Exception:
+                # release the inflight hold and requeue rather than
+                # stranding the assumed pod
+                traceback.print_exc()
+                with self._inflight_lock:
+                    self._inflight -= 1
+                try:
+                    self._retry_failed_bind(assumed)
+                except Exception:  # noqa: BLE001
+                    traceback.print_exc()
+                continue
+            items.append((assumed, node_name, state, info))
+        if items:
+            # hand the whole release wave to the batched binding cycle;
+            # swap the per-pod inflight holds for the batch's single one
+            with self._inflight_lock:
+                self._inflight -= len(items) - 1
+            self._binders.submit(self._bind_batch, items)
 
     def _bind_batch(self, items: List[Tuple]) -> None:
         """Binding cycle for a whole batch in one worker: PreBind per pod,

@@ -58,7 +58,7 @@ CONFIGS = {
         num_pods=20000,
         init_template=PodTemplate(spread_zone=True, spread_zone_hard=True),
         template=PodTemplate(spread_zone=True, spread_zone_hard=True),
-        max_batch=4096, timeout=1200.0,
+        max_batch=2048, timeout=1200.0,
     ),
     # InterPodAffinity churn: 2000 nodes, 5000 required-anti-affinity pods
     # (hostname terms: 2000 bindable, 3000 permanently pending -> the
