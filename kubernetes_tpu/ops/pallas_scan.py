@@ -49,12 +49,13 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .batch import pod_batchable
 from .hoisted import (
     _session_prologue,
     _stack_templates,
     match_matrices_np,
     template_fingerprint,
+    templates_have_ports,
+    templates_have_terms,
 )
 from .kernel import DEFAULT_WEIGHTS, MAX_NODE_SCORE
 
@@ -82,6 +83,13 @@ class PallasUnsupported(Exception):
 
 def _ceil(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
+
+
+def _pad_tc(a: np.ndarray, t_n: int) -> np.ndarray:
+    """[T, X<=8] -> [T, 8] zero-padded (per-term scalar tables)."""
+    out = np.zeros((t_n, SUB), a.dtype)
+    out[:, : a.shape[1]] = a
+    return out
 
 
 def _pad2(a: np.ndarray, rows: int = SUB, lanes: int = LANE) -> np.ndarray:
@@ -123,15 +131,18 @@ class PallasSession:
     def __init__(self, cluster: Dict, template_arrays_list: List[Dict],
                  weights: Optional[Dict[str, int]] = None,
                  interpret: bool = False):
-        for pa in template_arrays_list:
-            if not pod_batchable(pa):
-                # the jnp HoistedSession carries affinity/port dynamics;
-                # the pallas kernel does not (yet) — signal a fallback,
-                # not an error
-                raise PallasUnsupported(
-                    "templates with affinity terms / host ports ride the "
-                    "jnp hoisted session", reason="affinity-terms-or-ports",
-                )
+        if templates_have_ports(template_arrays_list):
+            # the jnp HoistedSession carries host-port tables; the pallas
+            # kernel does not (yet) — signal a fallback, not an error
+            raise PallasUnsupported(
+                "templates with host ports ride the jnp hoisted session",
+                reason="host-ports",
+            )
+        # affinity-term templates ARE supported: the D1-D5 deltas
+        # (ops/hoisted.py term-machinery block) ride per-(template, key)
+        # per-node count carries updated with the same same-pair-mask
+        # trick as the PTS counts — see _build_ipa below
+        self.dyn_ipa = templates_have_terms(template_arrays_list)
         self.weights = dict(weights or DEFAULT_WEIGHTS)
         self.interpret = interpret
         self._fps = {
@@ -154,9 +165,27 @@ class PallasSession:
             for k in ("ptsf_op", "ptsf_rkey", "ptsf_pairs",
                       "ptss_op", "ptss_rkey", "ptss_pairs", "self_ns")
         }
-        S = {k: np.asarray(v) for k, v in _session_prologue(cluster, tp).items()}
+        S = {
+            k: np.asarray(v)
+            for k, v in _session_prologue(
+                cluster, tp, dyn_ipa=self.dyn_ipa
+            ).items()
+        }
         c = {k: np.asarray(v) for k, v in cluster.items()}
         self._build(c, S)
+        self._ipa = self._build_ipa(c, S, tp) if self.dyn_ipa else None
+        if self._ipa is not None:
+            # SMEM scalar extension: [T,3] has_aff/self_match_all/aff_total,
+            # then anti_valid/aff_valid [T,8] each (offsets in _build_kernel)
+            extra = np.concatenate([
+                np.stack([
+                    self._ipa["has_aff"], self._ipa["self_match_all"],
+                    self._ipa["aff_total"],
+                ], axis=1).reshape(-1),
+                self._ipa["anti_valid"].reshape(-1),
+                self._ipa["aff_valid"].reshape(-1),
+            ]).astype(np.int32)
+            self._scalars = np.concatenate([self._scalars, extra])
         self._carry = None
         self._bundle = None
 
@@ -387,6 +416,179 @@ class PallasSession:
         # SMEM scalar table
         self._scalars = self._pack_scalars(S)
 
+    def _build_ipa(self, c: Dict, S: Dict, tp: Dict) -> Dict:
+        """InterPodAffinity term machinery for the single-launch kernel.
+
+        The hoisted scan's D1-D5 deltas (ops/hoisted.py term-machinery
+        block) all reduce to per-(assumed-template u, topology key ki)
+        counts gathered at each node's (ki, value) group. The pallas port
+        keeps those counts PER NODE (the same representation trick as the
+        PTS cnt_fn/cnt_sn rows): carry row (u*8 + ki) of `ucnt` holds,
+        for every node n, the number of session-assumed u-pods in n's
+        ki-group — updated on assume with a same-pair mask from `prow_ipa`
+        (pair id per node per key; -1 where the node lacks the key, which
+        makes the nkey gating implicit: rows never accumulate on keyless
+        nodes). `kcnt` row (u*8+ki) carries the scalar total (lanes all
+        equal). Every D1-D5 read then becomes a STATIC gate/weight matrix
+        (template x term match booleans from _term_gates, resolved host-
+        side) times ucnt — one MXU dot each:
+          D1 fail-existing  : g1[t] . (ucnt > 0) > 0
+          D2 own-anti counts: wanti[t-block] @ ucnt  (+ static anti rows)
+          D3 own-aff counts : waff[t-block] @ ucnt   (+ static aff rows)
+          D4+D5 score       : w45[t] @ ucnt  (weights pre-folded)
+          presence flags    : gpres[t] . rowany(ucnt > 0)
+          aff_total delta   : w3tot[t] . kcnt[:, 0]
+        Exactness: counts are integers in f32 (exact < 2^24); the 0/1
+        dots are bounded by 8 * count; the score dot is guarded below.
+        """
+        T, N, Np = self.T, self.N, self.Np
+        aa_key = np.asarray(tp["ipaaa_key"])
+        aa_valid = np.asarray(tp["ipaaa_valid"]).astype(bool)
+        a_key = np.asarray(tp["ipaa_key"])
+        a_valid = np.asarray(tp["ipaa_valid"]).astype(bool)
+        p_key = np.asarray(tp["ipap_key"])
+        p_valid = np.asarray(tp["ipap_valid"]).astype(bool)
+        p_w = np.asarray(tp["ipap_weight"]).astype(np.int64)
+        if aa_key.shape[1] > SUB or a_key.shape[1] > SUB:
+            raise PallasUnsupported(
+                f"{max(aa_key.shape[1], a_key.shape[1])} required "
+                f"(anti-)affinity terms > {SUB} per template",
+                reason="too-many-ipa-terms")
+        # distinct topology keys across every template's valid terms
+        keys: set = set()
+        for k_tbl, v_tbl in ((aa_key, aa_valid), (a_key, a_valid),
+                             (p_key, p_valid)):
+            keys.update(int(x) for x in k_tbl[v_tbl])
+        ki_list = sorted(keys)
+        if len(ki_list) > SUB:
+            raise PallasUnsupported(
+                f"{len(ki_list)} IPA topology keys > {SUB}",
+                reason="too-many-ipa-keys")
+        ki_of = {k: i for i, k in enumerate(ki_list)}
+        UR = T * SUB  # ucnt rows: (u * 8 + ki)
+        # rough VMEM budget: Np-wide blocks (anti/aff statics + ucnt +
+        # prow/ipa_stat) plus the T^2-scaling gate/weight matrices and
+        # the kcnt carry must not blow the 16MB scope
+        np_rows = 3 * T * SUB + UR + SUB + _ceil(2 * T, SUB)
+        t2_bytes = (2 * (T * SUB) * UR + 4 * _ceil(T, SUB) * UR
+                    + UR * LANE) * 4
+        if np_rows * Np * 4 + t2_bytes > 8 * 2 ** 20:
+            raise PallasUnsupported("IPA blocks exceed the VMEM budget",
+                                    reason="ipa-vmem-budget")
+
+        pok = c["pair_of_key"].astype(np.int64)  # [N, K]
+        nkey = c["nkey"].astype(bool)
+        valid_nodes = c["valid"].astype(bool)
+        prow_ipa = np.full((SUB, Np), -1, np.int32)
+        for i, key in enumerate(ki_list):
+            ok = nkey[:, key] & valid_nodes
+            prow_ipa[i, :N] = np.where(ok, pok[:, key], -1)
+        if prow_ipa.max(initial=0) >= 2 ** 24:
+            raise PallasUnsupported("IPA pair ids exceed exact-f32 range",
+                                    reason="pair-ids-exceed-f32")
+
+        M_anti = np.asarray(S["M_anti"]).astype(bool)   # [T, TAA, T]
+        M_aff = np.asarray(S["M_aff"]).astype(bool)     # [T, TA, T]
+        M_pref = np.asarray(S["M_pref"]).astype(bool)   # [T, TP, T]
+        match_all = np.asarray(S["match_all"]).astype(bool)  # [T, T]
+        hard_w = int(np.asarray(c["hard_pod_affinity_weight"]))
+
+        t_pad = _ceil(T, SUB)  # per-template matrices: row t (T can be >8)
+        g1 = np.zeros((t_pad, UR), np.float32)
+        wanti = np.zeros((T * SUB, UR), np.float32)
+        waff = np.zeros((T * SUB, UR), np.float32)
+        w3tot = np.zeros((t_pad, UR), np.float32)
+        w45_i = np.zeros((t_pad, UR), np.int64)
+        gpres = np.zeros((t_pad, UR), np.float32)
+
+        def cx(u, key):
+            return u * SUB + ki_of[int(key)]
+
+        for t in range(T):
+            # D1: assumed u-pods' anti terms repel t where t matches them
+            for u in range(T):
+                for tau in range(aa_key.shape[1]):
+                    if aa_valid[u, tau] and M_anti[u, tau, t]:
+                        g1[t, cx(u, aa_key[u, tau])] = 1.0
+            # D2: assumed pods counting toward t's own anti terms
+            for tau in range(aa_key.shape[1]):
+                if not aa_valid[t, tau]:
+                    continue
+                for u in range(T):
+                    if M_anti[t, tau, u]:
+                        wanti[t * SUB + tau, cx(u, aa_key[t, tau])] = 1.0
+            # D3: assumed pods matching ALL of t's affinity terms
+            for tau in range(a_key.shape[1]):
+                if not a_valid[t, tau]:
+                    continue
+                for u in range(T):
+                    if match_all[t, u]:
+                        waff[t * SUB + tau, cx(u, a_key[t, tau])] = 1.0
+                        w3tot[t, cx(u, a_key[t, tau])] += 1.0
+            # D4: assumed pods' score terms vs t (required-aff at
+            # hardPodAffinityWeight; preferred at signed weight) and
+            # D5: t's own preferred terms vs assumed pods
+            for u in range(T):
+                for tau in range(a_key.shape[1]):
+                    if a_valid[u, tau] and M_aff[u, tau, t] and hard_w > 0:
+                        w45_i[t, cx(u, a_key[u, tau])] += hard_w
+                        gpres[t, cx(u, a_key[u, tau])] = 1.0
+                for tau in range(p_key.shape[1]):
+                    if p_valid[u, tau] and M_pref[u, tau, t]:
+                        w45_i[t, cx(u, p_key[u, tau])] += int(p_w[u, tau])
+                        gpres[t, cx(u, p_key[u, tau])] = 1.0
+                for tau in range(p_key.shape[1]):
+                    if p_valid[t, tau] and M_pref[t, tau, u]:
+                        w45_i[t, cx(u, p_key[t, tau])] += int(p_w[t, tau])
+                        gpres[t, cx(u, p_key[t, tau])] = 1.0
+        # score-dot exactness: |w|.sum * count must stay < 2^24 in f32;
+        # cap session assumed counts at 2^16 (far above any bench window)
+        if int(np.abs(w45_i).sum(axis=1).max(initial=0)) >= 256:
+            raise PallasUnsupported(
+                "IPA score weights too large for exact f32 dot",
+                reason="ipa-score-weights")
+
+        # static per-term per-node blocks (rows t*8+term)
+        anti_static = np.zeros((T * SUB, Np), np.int32)
+        anti_konn = np.zeros((T * SUB, Np), np.int32)
+        aff_static = np.zeros((T * SUB, Np), np.int32)
+        anti_cnt_n = np.asarray(S["ipa_anti_cnt_n"])    # [T, N, TAA]
+        anti_kon = np.asarray(S["ipa_anti_key_on_node"])
+        aff_cnt_n = np.asarray(S["ipa_aff_cnt_n"])      # [T, N, TA]
+        for t in range(T):
+            for tau in range(aa_key.shape[1]):
+                anti_static[t * SUB + tau, :N] = anti_cnt_n[t, :, tau]
+                anti_konn[t * SUB + tau, :N] = anti_kon[t, :, tau]
+            for tau in range(a_key.shape[1]):
+                aff_static[t * SUB + tau, :N] = aff_cnt_n[t, :, tau]
+        # per-template per-node statics (rows t*2 / t*2+1)
+        ipa_stat = np.zeros((_ceil(2 * T, SUB), Np), np.int32)
+        fe = np.asarray(S["ipa_fail_existing"])         # [T, N]
+        aak = np.asarray(S["ipa_aff_all_keys"])
+        for t in range(T):
+            ipa_stat[2 * t, :N] = fe[t]
+            ipa_stat[2 * t + 1, :N] = aak[t]
+        if max(int(anti_static.max(initial=0)),
+               int(aff_static.max(initial=0))) >= POS_BIG:
+            raise PallasUnsupported("IPA static counts exceed sentinel",
+                                    reason="score-magnitude")
+        return dict(
+            UR=UR,
+            prow_ipa=prow_ipa, ipa_stat=ipa_stat,
+            anti_static=anti_static, anti_konn=anti_konn,
+            aff_static=aff_static,
+            g1=g1, wanti=wanti, waff=waff, w3tot=w3tot,
+            w45=w45_i.astype(np.float32), gpres=gpres,
+            # SMEM scalar extension: per-t has_aff/self_match_all/
+            # aff_total + per-term valid flags
+            has_aff=np.asarray(S["ipa_has_aff"]).astype(np.int32),
+            self_match_all=np.asarray(
+                S["ipa_self_match_all"]).astype(np.int32),
+            aff_total=np.asarray(S["ipa_aff_total"]).astype(np.int32),
+            anti_valid=_pad_tc(aa_valid.astype(np.int32), T),
+            aff_valid=_pad_tc(a_valid.astype(np.int32), T),
+        )
+
     def _pack_scalars(self, S) -> np.ndarray:
         T, C, R = self.T, self.C, self.R
         per_t = np.concatenate([
@@ -410,14 +612,30 @@ class PallasSession:
 
     def _initial_carry(self):
         z = jnp.asarray
-        return {
+        carry = {
             "requested": z(self._requested0), "nzpc": z(self._nzpc0),
             "cnt_fn": z(self._cnt_fn0), "cnt_sn": z(self._cnt_sn0),
         }
+        if self._ipa is not None:
+            # session starts with zero ASSUMED pods (existing pods live in
+            # the static tables) — mirrors _init_dynamic_carries
+            carry["ucnt"] = jnp.zeros((self._ipa["UR"], self.Np), jnp.int32)
+            carry["kcnt"] = jnp.zeros((self._ipa["UR"], LANE), jnp.int32)
+        return carry
 
     def _get_bundle(self) -> _Bundle:
         if self._bundle is None:
             z = jnp.asarray
+            ipa = None
+            carry_keys = CARRY_KEYS
+            if self._ipa is not None:
+                ipa = {
+                    k: z(self._ipa[k])
+                    for k in ("ipa_stat", "anti_static", "anti_konn",
+                              "aff_static", "prow_ipa", "g1", "wanti",
+                              "waff", "w3tot", "w45", "gpres")
+                }
+                carry_keys = CARRY_KEYS + ("ucnt", "kcnt")
             self._bundle = _Bundle(
                 alloc=z(self._alloc), stat=z(self._stat),
                 onehot=z(self._onehot), regrow_f=z(self._regrow_f),
@@ -428,6 +646,8 @@ class PallasSession:
                 rowt=z(self._rowt), eye=z(self._eye),
                 prow_f=z(self._prow_f), prow_s=z(self._prow_s),
                 scalars=z(self._scalars),
+                ipa=ipa, ur=(self._ipa["UR"] if self._ipa else 0),
+                carry_keys=carry_keys,
                 shapes=(self.T, self.C, self.Np, self.R, self.SR,
                         self.TCp, self.K, self.CP),
                 weights=tuple(sorted(self.weights.items())),
@@ -478,33 +698,48 @@ class PallasSession:
 # kernel
 
 
-def _build_kernel(shapes, weights, Bp: int):
+def _build_kernel(shapes, weights, Bp: int, ur: int = 0):
     import os as _os
 
     skip = frozenset(
         _os.environ.get("KTPU_PALLAS_SKIP", "").split(","))  # profiling only
     T, C, Np, R, SR, TCp, K, CP = shapes
     W = dict(weights)
+    dyn_ipa = ur > 0 and "ipa" not in skip
     row_len = 2 * R + 4
     off_tc = T * row_len
     off_fsame = off_tc + 10 * T * C
     off_ssame = off_fsame + T * C * C
+    # IPA scalar extension (appended when the session has term templates)
+    off_ipa_t = off_ssame + T * C * C
+    off_av = off_ipa_t + 3 * T
     (W_F_VALID, W_S_VALID, W_F_SKEW, W_S_SKEW, W_F_SELF, W_S_FIRST,
      W_F_KEY, W_S_KEY, W_F_PERNO, W_S_PERNO) = range(10)
 
-    def kernel(breal_ref, tmpl_ref, sc_ref, mf_ref, ms_ref,
-               alloc_ref, stat_ref, onehot_ref, regrowf_ref, zvnode_ref,
-               zvalid_ref, konnf_ref, konns_ref, shasall_ref, validn_ref,
-               rowt_ref, eye_ref, prowf_ref, prows_ref,
-               requested_in, nzpc_in, cntfn_in, cntsn_in,
-               out_ref,
-               requested_ref, nzpc_ref, cntfn_ref, cntsn_ref):
+    def kernel(*refs):
+        (breal_ref, tmpl_ref, sc_ref, mf_ref, ms_ref,
+         alloc_ref, stat_ref, onehot_ref, regrowf_ref, zvnode_ref,
+         zvalid_ref, konnf_ref, konns_ref, shasall_ref, validn_ref,
+         rowt_ref, eye_ref, prowf_ref, prows_ref) = refs[:19]
+        i = 19
+        if ur > 0:
+            (ipastat_ref, antic_ref, antik_ref, affc_ref, prowipa_ref,
+             g1_ref, wanti_ref, waff_ref, w3tot_ref, w45_ref,
+             gpres_ref) = refs[i:i + 11]
+            i += 11
+        ncarry = 6 if ur > 0 else 4
+        carry_in = refs[i:i + ncarry]
+        i += ncarry
+        out_ref = refs[i]
+        carry_refs = refs[i + 1:]
+        requested_in, nzpc_in = carry_in[0], carry_in[1]
+        requested_ref, nzpc_ref, cntfn_ref, cntsn_ref = carry_refs[:4]
+        if ur > 0:
+            ucnt_ref, kcnt_ref = carry_refs[4], carry_refs[5]
         # carries live in the OUTPUT refs (initialized from the inputs);
         # refs — unlike loop-carried values — support dynamic row reads
-        requested_ref[:] = requested_in[:]
-        nzpc_ref[:] = nzpc_in[:]
-        cntfn_ref[:] = cntfn_in[:]
-        cntsn_ref[:] = cntsn_in[:]
+        for cin, cref in zip(carry_in, carry_refs):
+            cref[:] = cin[:]
         out_ref[:] = jnp.full((SUB, Bp), -1, jnp.int32)
 
         sc = sc_ref
@@ -533,6 +768,27 @@ def _build_kernel(shapes, weights, Bp: int):
             return jax.lax.dot_general(
                 mat_1n, onehot_ref[k], (((1,), (0,)), ((), ())),
                 preferred_element_type=f32)
+
+        def doth(a, b, dims):
+            """Exact-f32 dot (counts/ids above 2^8 need HIGHEST)."""
+            return jax.lax.dot_general(
+                a, b, dims, preferred_element_type=f32,
+                precision=jax.lax.Precision.HIGHEST)
+
+        def sm_ipa_t(t, i):
+            return sc[off_ipa_t + t * 3 + i]
+
+        def sm_av(which, t, tau):
+            return sc[off_av + which * T * SUB + t * SUB + tau]
+
+        def _col_av(which, t):
+            """(SUB, 1) f32 column of per-(t, term) valid flags."""
+            i0 = jax.lax.broadcasted_iota(jnp.int32, (SUB, 1), 0)
+            out = jnp.zeros((SUB, 1), f32)
+            for tau in range(SUB):
+                e = (i0 == tau).astype(f32)
+                out = out + sm_av(which, t, tau).astype(f32) * e
+            return out
 
         def one_pod(b):
             t = tmpl_ref[b]
@@ -597,8 +853,56 @@ def _build_kernel(shapes, weights, Bp: int):
                     (((1,), (0,)), ((), ())),
                     preferred_element_type=f32) > 0                # (1, Np)
 
+            # ---- InterPodAffinity: static parts + assumed-pod counts
+            # (D1-D3 of the hoisted term machinery as gate-matrix dots
+            # over the per-node ucnt carry; see _build_ipa) ----
+            if dyn_ipa:
+                ucf = ucnt_ref[:].astype(f32)                  # (UR, Np)
+                pos = (ucnt_ref[:] > 0).astype(f32)
+                # D1: assumed pods' anti terms repel this pod
+                g1row = g1_ref[pl.ds(t, 1), :]                 # (1, UR)
+                fail1 = doth(g1row, pos, (((1,), (0,)), ((), ()))) > 0
+                fe_static = ipastat_ref[pl.ds(2 * t, 1), :]
+                aff_allk = ipastat_ref[pl.ds(2 * t + 1, 1), :]
+                base8 = pl.multiple_of(t * SUB, SUB)
+                # D2: assumed pods vs this pod's own anti terms
+                anti_dyn = doth(wanti_ref[pl.ds(base8, SUB), :], ucf,
+                                (((1,), (0,)), ((), ())))      # (SUB, Np)
+                a_stat = antic_ref[pl.ds(base8, SUB), :].astype(f32)
+                akonn = antik_ref[pl.ds(base8, SUB), :]
+                avld = _col_av(0, t)                           # (SUB, 1)
+                onesS = jnp.ones((1, SUB), f32)
+                fail_anti_rows = ((avld != 0) & (akonn != 0)
+                                  & ((a_stat + anti_dyn) > 0)).astype(f32)
+                fail_anti = doth(onesS, fail_anti_rows,
+                                 (((1,), (0,)), ((), ()))) > 0  # (1, Np)
+                # D3: assumed pods matching ALL of this pod's aff terms
+                aff_dyn = doth(waff_ref[pl.ds(base8, SUB), :], ucf,
+                               (((1,), (0,)), ((), ())))
+                f_stat = affc_ref[pl.ds(base8, SUB), :].astype(f32)
+                fvld = _col_av(1, t)
+                miss_rows = ((fvld != 0)
+                             & ((f_stat + aff_dyn) <= 0)).astype(f32)
+                pods_missing = doth(onesS, miss_rows,
+                                    (((1,), (0,)), ((), ()))) > 0
+                kc0 = kcnt_ref[:, 0:1].astype(f32)             # (UR, 1)
+                w3row = w3tot_ref[pl.ds(t, 1), :]
+                at_dyn = jnp.sum(doth(w3row, kc0, (((1,), (0,)), ((), ()))))
+                counts_empty = (sm_ipa_t(t, 2).astype(f32) + at_dyn) == 0
+                has_aff = sm_ipa_t(t, 0)
+                smatch = sm_ipa_t(t, 1)
+                aff_ok = ((has_aff == 0)
+                          | ((aff_allk != 0)
+                             & (jnp.logical_not(pods_missing)
+                                | (counts_empty & (smatch != 0)))))
+                mask_ipa = (jnp.logical_not((fe_static != 0) | fail1)
+                            & jnp.logical_not(fail_anti) & aff_ok)
+            else:
+                mask_ipa = jnp.ones((1, Np), jnp.bool_)
+
             feasible = ((static_mask != 0) & mask_fit
-                        & jnp.logical_not(fail_pts) & (valid_n != 0))
+                        & jnp.logical_not(fail_pts) & mask_ipa
+                        & (valid_n != 0))
             n_feasible = jnp.sum(feasible.astype(f32)).astype(jnp.int32)
 
             # ---- resource scores ----
@@ -699,6 +1003,19 @@ def _build_kernel(shapes, weights, Bp: int):
             norm = jnp.where(ignored, jnp.int32(0), norm)
             sc_pts = jnp.where(have_s != 0, norm, jnp.int32(0))
 
+            # ---- IPA score: static raw + assumed-pod terms (D4+D5) ----
+            if dyn_ipa:
+                w45row = w45_ref[pl.ds(t, 1), :]
+                dyn45 = doth(w45row, ucf, (((1,), (0,)), ((), ())))
+                raw_ipa = raw_ipa + dyn45.astype(jnp.int32)
+                rowany = jnp.max(pos, axis=1, keepdims=True)   # (UR, 1)
+                gp = gpres_ref[pl.ds(t, 1), :]
+                pres_dyn = jnp.sum(
+                    doth(gp, rowany, (((1,), (0,)), ((), ())))) > 0
+                present = (ipa_present != 0) | pres_dyn
+            else:
+                present = ipa_present != 0
+
             # ---- IPA normalize ----
             min_i = jnp.min(jnp.where(feasible, raw_ipa, jnp.int32(POS_BIG)))
             max_i = jnp.max(jnp.where(feasible, raw_ipa, jnp.int32(NEG_BIG)))
@@ -709,8 +1026,7 @@ def _build_kernel(shapes, weights, Bp: int):
                                    / jnp.where(diff > 0, diff, f32(1.0))))
                 .astype(jnp.int32),
                 jnp.zeros((1, Np), jnp.int32))
-            ipa = jnp.where(ipa_present != 0, ipa,
-                            jnp.zeros((1, Np), jnp.int32))
+            ipa = jnp.where(present, ipa, jnp.zeros((1, Np), jnp.int32))
 
             # ---- default-normalized taint / node-affinity ----
             def norm_default(counts, reverse):
@@ -801,6 +1117,26 @@ def _build_kernel(shapes, weights, Bp: int):
             cntsn_ref[:] = (cntsn_ref[:].astype(f32)
                             + ms_col * factor * m_s).astype(jnp.int32)
 
+            if dyn_ipa:
+                # the assumed pod joins its node's topology groups for
+                # every IPA key the node carries: same-pair mask from
+                # prow_ipa (-1 rows = node lacks key -> no-op), written
+                # into template t's own 8-row ucnt block
+                pi = prowipa_ref[:].astype(f32)                # (SUB, Np)
+                zb_i = doth(pi, hotf, (((1,), (1,)), ((), ())))  # (SUB, 1)
+                m_i = ((pi == zb_i)
+                       & (prowipa_ref[:] >= 0)).astype(f32) * okf
+                base_u = pl.multiple_of(t * SUB, SUB)
+                ucnt_ref[pl.ds(base_u, SUB), :] = (
+                    ucnt_ref[pl.ds(base_u, SUB), :].astype(f32) + m_i
+                ).astype(jnp.int32)
+                hask = doth((pi >= 0).astype(f32), hotf,
+                            (((1,), (1,)), ((), ())))          # (SUB, 1)
+                kcnt_ref[pl.ds(base_u, SUB), :] = (
+                    kcnt_ref[pl.ds(base_u, SUB), :].astype(f32)
+                    + hask * okf
+                ).astype(jnp.int32)
+
             subi = jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 0)
             lanei = jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 1)
             at_b = lanei == b
@@ -875,19 +1211,26 @@ def _dispatch(bundle: _Bundle, B_real, carry: Dict, tmpl, mfT, msT):
     # B_real is a DYNAMIC (SMEM) scalar: variable batch lengths must not
     # recompile the kernel (only the padded width Bp is static)
     Bp = int(tmpl.shape[0])
-    kernel = _build_kernel(bundle.shapes, bundle.weights, Bp)
+    kernel = _build_kernel(bundle.shapes, bundle.weights, Bp, bundle.ur)
     # widen the int8 wire format on-device (i8 VMEM rows would need
     # 32-sublane alignment in the kernel; one cheap convert avoids that)
     mfT = mfT.astype(jnp.int32)
     msT = msT.astype(jnp.int32)
-    carry_in = [carry[k] for k in CARRY_KEYS]
+    carry_keys = bundle.carry_keys
+    carry_in = [carry[k] for k in carry_keys]
+    ipa_in = []
+    if bundle.ipa is not None:
+        ipa_in = [bundle.ipa[k] for k in
+                  ("ipa_stat", "anti_static", "anti_konn", "aff_static",
+                   "prow_ipa", "g1", "wanti", "waff", "w3tot", "w45",
+                   "gpres")]
     out_shape = (
         jax.ShapeDtypeStruct((SUB, Bp), jnp.int32),
         *[jax.ShapeDtypeStruct(x.shape, x.dtype) for x in carry_in],
     )
     vm = pl.BlockSpec(memory_space=pltpu.VMEM)
     sm = pl.BlockSpec(memory_space=pltpu.SMEM)
-    n_pre = 19  # inputs before the 4 carries
+    n_pre = 19 + len(ipa_in)  # inputs before the carries
     # trace the kernel with x64 OFF: every input is explicitly 32-bit,
     # and weak python literals must not widen ops to i64/f64 (Mosaic has
     # no 64-bit types)
@@ -897,7 +1240,8 @@ def _dispatch(bundle: _Bundle, B_real, carry: Dict, tmpl, mfT, msT):
         results = pl.pallas_call(
             kernel,
             out_shape=out_shape,
-                in_specs=[sm, sm, sm, vm, vm] + [vm] * 14 + [vm] * 4,
+            in_specs=([sm, sm, sm, vm, vm] + [vm] * 14
+                      + [vm] * len(ipa_in) + [vm] * len(carry_in)),
             out_specs=tuple([vm] * (1 + len(carry_in))),
             input_output_aliases={n_pre + i: 1 + i
                                   for i in range(len(carry_in))},
@@ -907,5 +1251,5 @@ def _dispatch(bundle: _Bundle, B_real, carry: Dict, tmpl, mfT, msT):
           bundle.zvalid_node_s, bundle.zvalid_s, bundle.konn_f,
           bundle.konn_s, bundle.shasall, bundle.valid_n, bundle.rowt,
           bundle.eye, bundle.prow_f, bundle.prow_s,
-          *carry_in)
-    return results[0], dict(zip(CARRY_KEYS, results[1:]))
+          *ipa_in, *carry_in)
+    return results[0], dict(zip(carry_keys, results[1:]))

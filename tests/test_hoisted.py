@@ -8,7 +8,7 @@ import pytest
 from kubernetes_tpu.api import types as v1
 from kubernetes_tpu.models.encoding import ClusterEncoding
 from kubernetes_tpu.models.pod_encoder import PodEncoder
-from kubernetes_tpu.ops.batch import pod_batchable, schedule_batch
+from kubernetes_tpu.ops.batch import schedule_batch
 from kubernetes_tpu.ops.hoisted import schedule_batch_hoisted, template_fingerprint
 from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
 
@@ -16,12 +16,13 @@ from .util import make_pod
 
 
 def _encode_all(enc, pe, pods):
-    arrays = [
+    # NOTE: no pod_batchable assertion — the hoisted session (r2) and the
+    # pallas kernel (r3) both take term templates; only callers that
+    # exercise the plain batch path feed strictly batchable pods
+    return [
         {k: v for k, v in pe.encode(p).items() if not k.startswith("_")}
         for p in pods
     ]
-    assert all(pod_batchable(pa) for pa in arrays)
-    return arrays
 
 
 def _presized_encoding(nodes, init_pods, pending):

@@ -424,20 +424,22 @@ class TestBackendRouting:
             backend.on_add_node(n)
         for p in init_pods:
             backend.on_add_pod(p, p.spec.node_name)
+        # affinity templates ride pallas since r3 (TestPallasTerms); host
+        # PORTS are still a hoisted fallback and must downgrade loudly
         pending = [
             make_pod(
                 f"dl-{i}", cpu="50m", labels={"app": "dl"},
-                affinity=_anti_affinity(v1.LABEL_HOSTNAME, {"app": "dl"}),
+                host_port=8080 + i,
             )
             for i in range(3)
         ]
         before = sched_metrics.session_builds.value(
-            kind="hoisted", reason="affinity-terms-or-ports"
+            kind="hoisted", reason="host-ports"
         )
         with caplog.at_level(logging.WARNING):
             backend.schedule_many(pending)
         after = sched_metrics.session_builds.value(
-            kind="hoisted", reason="affinity-terms-or-ports"
+            kind="hoisted", reason="host-ports"
         )
         assert after == before + 1
         assert any("downgrading" in r.message for r in caplog.records)

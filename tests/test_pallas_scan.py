@@ -160,10 +160,11 @@ class TestPallasGuards:
 class TestPallasFuzz:
     """Random-shape fuzz of the pallas kernel (interpret mode) against
     the jnp session: the f32 in-kernel score math is fuzz-TESTED, not
-    asserted (VERDICT r1 item 10). Pallas takes only term-free
-    templates, so fuzz pods are stripped of (anti-)affinity; spread
-    constraints, taints, tolerations, priorities, images and extended
-    resources all vary."""
+    asserted (VERDICT r1 item 10). Since round 3 the kernel carries the
+    IPA term machinery (D1-D5 deltas), so fuzz pods KEEP their random
+    (anti-)affinity terms; only host ports are stripped (still a
+    hoisted-session fallback). Spread constraints, taints, tolerations,
+    priorities, images and extended resources all vary."""
 
     @pytest.mark.parametrize("seed", range(8))
     def test_fuzz_jnp_vs_pallas_interpret(self, seed):
@@ -177,9 +178,8 @@ class TestPallasFuzz:
         for i in range(10):
             p = random_pending(rng)
             p.metadata.name = f"fz-{seed}-{i}"
-            p.spec.affinity = None       # pallas: term-free templates only
             for c in p.spec.containers:
-                c.ports = None           # ...and port-free
+                c.ports = None           # pallas: port-free templates only
             p.spec.node_name = ""
             pending.append(p)
         try:
@@ -187,3 +187,117 @@ class TestPallasFuzz:
         except PallasUnsupported as e:
             pytest.skip(f"shape unsupported by pallas: {e}")
         assert got == ref, f"seed={seed}: {got} != {ref}"
+
+
+def _affinity(zone=False, anti=True, labels=None, pref=None):
+    term = v1.PodAffinityTerm(
+        label_selector=v1.LabelSelector(match_labels=dict(labels)),
+        topology_key=v1.LABEL_ZONE if zone else v1.LABEL_HOSTNAME,
+    )
+    kw = {}
+    if anti:
+        kw["pod_anti_affinity"] = v1.PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[term])
+    else:
+        kw["pod_affinity"] = v1.PodAffinity(
+            required_during_scheduling_ignored_during_execution=[term])
+    if pref:
+        w, plabels, pzone = pref
+        pterm = v1.WeightedPodAffinityTerm(
+            weight=w,
+            pod_affinity_term=v1.PodAffinityTerm(
+                label_selector=v1.LabelSelector(match_labels=dict(plabels)),
+                topology_key=v1.LABEL_ZONE if pzone else v1.LABEL_HOSTNAME,
+            ),
+        )
+        pa = kw.get("pod_affinity") or v1.PodAffinity()
+        pa.preferred_during_scheduling_ignored_during_execution = [pterm]
+        kw["pod_affinity"] = pa
+    return v1.Affinity(**kw)
+
+
+class TestPallasTerms:
+    """Decision parity for TERM templates riding the pallas kernel (the
+    r3 D1-D5 port): required anti-affinity (hostname + zone), required
+    affinity incl. the first-pod-in-series escape, preferred terms, and
+    cross-template D1 interactions — all vs the jnp hoisted session
+    (itself pinned to the Go-semantics oracle in test_hoisted_terms).
+    Existing bound pods with terms exercise the static parts."""
+
+    def _nodes(self, n=16):
+        from .util import make_node
+
+        return [
+            make_node(
+                f"n-{i}",
+                labels={
+                    v1.LABEL_HOSTNAME: f"n-{i}",
+                    "zone": f"zone-{i % 4}",
+                    v1.LABEL_ZONE: f"zone-{i % 4}",
+                },
+            )
+            for i in range(n)
+        ]
+
+    def _case(self, lbl, affinity, n_nodes=16, n_existing=6, n_pending=24,
+              batch=10):
+        nodes = self._nodes(n_nodes)
+        existing = [
+            make_pod(f"ex-{i}", labels=dict(lbl), affinity=affinity,
+                     node_name=f"n-{i * 2}")
+            for i in range(n_existing)
+        ]
+        pending = [
+            make_pod(f"p-{i}", labels=dict(lbl), affinity=affinity)
+            for i in range(n_pending)
+        ]
+        return _run_pair(nodes, existing, pending, batch)
+
+    def test_hostname_required_anti(self):
+        ref, got = self._case(
+            {"app": "a"}, _affinity(zone=False, anti=True, labels={"app": "a"}))
+        assert got == ref
+
+    def test_zone_required_anti(self):
+        ref, got = self._case(
+            {"app": "z"}, _affinity(zone=True, anti=True, labels={"app": "z"}))
+        assert got == ref
+
+    def test_required_affinity_first_pod_escape(self):
+        # no existing pods: the first pending pod only lands via the
+        # counts-empty + self-match escape (filtering.go:357)
+        ref, got = self._case(
+            {"svc": "b"}, _affinity(zone=True, anti=False, labels={"svc": "b"}),
+            n_existing=0)
+        assert got == ref
+        assert got[0] >= 0  # the escape must actually fire
+
+    def test_preferred_terms_score(self):
+        ref, got = self._case(
+            {"w": "c"},
+            _affinity(zone=False, anti=True, labels={"w": "c"},
+                      pref=(40, {"w": "c"}, True)))
+        assert got == ref
+
+    def test_cross_template_anti(self):
+        # template A's anti terms must repel template B pods assumed in
+        # the SAME session (D1 across templates)
+        nodes = self._nodes(12)
+        aff_a = _affinity(zone=True, anti=True, labels={"grp": "x"})
+        pending = []
+        for i in range(16):
+            if i % 2 == 0:
+                pending.append(make_pod(
+                    f"a-{i}", labels={"grp": "x"}, affinity=aff_a))
+            else:
+                # B pods carry the label A's terms select, but no terms
+                pending.append(make_pod(f"b-{i}", labels={"grp": "x"}))
+        ref, got = _run_pair(nodes, [], pending, batch=8)
+        assert got == ref
+
+    def test_term_session_survives_batches(self):
+        # carry correctness across MANY small batches (u_cnt/k_cnt chain)
+        ref, got = self._case(
+            {"app": "m"}, _affinity(zone=False, anti=True, labels={"app": "m"}),
+            n_nodes=10, n_existing=0, n_pending=20, batch=4)
+        assert got == ref
