@@ -10,7 +10,7 @@ plugin/pkg/auth/authorizer/rbac/rbac.go).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from .types import ObjectMeta
 
@@ -49,9 +49,22 @@ class Role:
 
 
 @dataclass
+class AggregationRule:
+    """rbac/v1 AggregationRule: label selectors over ClusterRoles whose
+    rules the aggregation controller unions into this role (types.go
+    AggregationRule; pkg/controller/clusterroleaggregation)."""
+
+    # match-labels dicts (one per selector; the reference uses full
+    # LabelSelectors — match_labels is the shape kube ships by default,
+    # e.g. rbac.authorization.k8s.io/aggregate-to-admin: "true")
+    cluster_role_selectors: Optional[List[Dict[str, str]]] = None
+
+
+@dataclass
 class ClusterRole:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     rules: Optional[List[PolicyRule]] = None
+    aggregation_rule: Optional[AggregationRule] = None
     kind: str = "ClusterRole"
     api_version: str = "rbac.authorization.k8s.io/v1"
 

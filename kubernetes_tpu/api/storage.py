@@ -22,6 +22,9 @@ class StorageClass:
     # TopologySelectorTerm shape provisioners honour (storage/v1 types.go
     # AllowedTopologies).
     allowed_topologies: Optional[List[dict]] = None
+    # storage/v1 StorageClass.AllowVolumeExpansion — gates the
+    # persistentvolume-expander controller
+    allow_volume_expansion: bool = False
     kind: str = "StorageClass"
     api_version: str = "storage.k8s.io/v1"
 

@@ -254,6 +254,10 @@ class Container:
     ports: Optional[List[ContainerPort]] = None
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
+    image_pull_policy: str = ""  # "" (default by tag) | Always | IfNotPresent | Never
+    # core/v1 SecurityContext subset, carried as a dict (privileged,
+    # runAsNonRoot, allowPrivilegeEscalation, capabilities, ...)
+    security_context: Optional[Dict[str, object]] = None
 
 
 @dataclass
@@ -278,6 +282,8 @@ class PodSpec:
     scheduler_name: str = ""
     overhead: Optional[Dict[str, str]] = None
     host_network: bool = False
+    host_pid: bool = False
+    host_ipc: bool = False
     volumes: Optional[List[Volume]] = None
     restart_policy: str = "Always"
     termination_grace_period_seconds: Optional[int] = None
@@ -580,6 +586,10 @@ class PersistentVolumeClaimSpec:
 @dataclass
 class PersistentVolumeClaimStatus:
     phase: str = ""  # Pending | Bound | Lost
+    # granted capacity (core/v1 PersistentVolumeClaimStatus.Capacity) —
+    # the expand controller reconciles spec.resources.requests against it
+    capacity: Optional[Dict[str, str]] = None
+    conditions: Optional[List[PodCondition]] = None  # e.g. Resizing
 
 
 @dataclass

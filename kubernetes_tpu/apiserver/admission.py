@@ -390,18 +390,186 @@ def event_rate_limit(api: APIServer, qps: float = 50.0, burst: int = 100):
     return admit
 
 
+DEFAULT_STORAGE_CLASS_ANNOTATION = "storageclass.kubernetes.io/is-default-class"
+PVC_PROTECTION_FINALIZER = "kubernetes.io/pvc-protection"
+PV_PROTECTION_FINALIZER = "kubernetes.io/pv-protection"
+POD_SECURITY_ENFORCE_LABEL = "pod-security.kubernetes.io/enforce"
+
+
+def default_storage_class(api: APIServer):
+    """DefaultStorageClass (plugin/pkg/admission/storage/storageclass/
+    setdefault/admission.go): a PVC created without storageClassName gets
+    the cluster's default class (the is-default-class annotation)."""
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "persistentvolumeclaims" or op != "CREATE":
+            return
+        # nil-only check (admission.go:87): storageClassName="" is the
+        # documented opt-out that pins the claim to classless static PVs
+        if obj.spec.storage_class_name is not None:
+            return
+        try:
+            classes, _ = api.list("storageclasses")
+        except NotFound:
+            return
+        defaults = [
+            sc for sc in classes
+            if (sc.metadata.annotations or {}).get(
+                DEFAULT_STORAGE_CLASS_ANNOTATION) == "true"
+        ]
+        if not defaults:
+            return
+        if len(defaults) > 1:
+            # admission.go:108: more than one default is a config error
+            raise Invalid(
+                f"{len(defaults)} default StorageClasses were found"
+            )
+        obj.spec.storage_class_name = defaults[0].metadata.name
+
+    return admit
+
+
+def storage_object_in_use_protection(api: APIServer):
+    """StorageObjectInUseProtection (plugin/pkg/admission/storage/
+    storageobjectinuse/admission.go): stamp the protection finalizers at
+    CREATE so the pvc/pv-protection controllers
+    (controllers/volumeprotection.py) can hold deletion while in use."""
+
+    def admit(resource: str, op: str, obj) -> None:
+        if op != "CREATE":
+            return
+        fin = {
+            "persistentvolumeclaims": PVC_PROTECTION_FINALIZER,
+            "persistentvolumes": PV_PROTECTION_FINALIZER,
+        }.get(resource)
+        if fin is None:
+            return
+        fins = list(obj.metadata.finalizers or [])
+        if fin not in fins:
+            obj.metadata.finalizers = fins + [fin]
+
+    return admit
+
+
+def always_pull_images(api: APIServer):
+    """AlwaysPullImages (plugin/pkg/admission/alwayspullimages/
+    admission.go): force imagePullPolicy=Always on every container so a
+    pod can never reuse another tenant's locally-cached private image."""
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "pods" or op not in ("CREATE", "UPDATE"):
+            return
+        for c in list(obj.spec.init_containers or []) + list(
+                obj.spec.containers or []):
+            c.image_pull_policy = "Always"
+
+    return admit
+
+
+def limit_pod_hard_anti_affinity_topology(api: APIServer):
+    """LimitPodHardAntiAffinityTopology (plugin/pkg/admission/antiaffinity/
+    admission.go): required anti-affinity terms may only use the hostname
+    topology key (cluster-wide anti-affinity at zone/region scale is a
+    scheduling-capacity foot-gun)."""
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "pods" or op != "CREATE":
+            return
+        aff = obj.spec.affinity
+        anti = aff.pod_anti_affinity if aff else None
+        for term in (
+            anti.required_during_scheduling_ignored_during_execution
+            if anti else None
+        ) or []:
+            if term.topology_key != v1.LABEL_HOSTNAME:
+                raise Invalid(
+                    "affinity.podAntiAffinity."
+                    "requiredDuringSchedulingIgnoredDuringExecution: "
+                    f"topologyKey {term.topology_key!r} is not allowed "
+                    f"(only {v1.LABEL_HOSTNAME})"
+                )
+
+    return admit
+
+
+def pod_security(api: APIServer):
+    """PodSecurity-lite: enforce the baseline/restricted profiles on
+    namespaces labeled pod-security.kubernetes.io/enforce (the PSP
+    successor, policy/pod-security-admission). Baseline rejects
+    privileged containers, host namespaces and hostPath volumes;
+    restricted additionally requires runAsNonRoot and disallows
+    privilege escalation."""
+
+    def violations(pod: v1.Pod, level: str) -> List[str]:
+        out = []
+        if pod.spec.host_network:
+            out.append("hostNetwork=true")
+        if pod.spec.host_pid:
+            out.append("hostPID=true")
+        if pod.spec.host_ipc:
+            out.append("hostIPC=true")
+        for vol in pod.spec.volumes or []:
+            if (vol.source or {}).get("hostPath"):
+                out.append(f"hostPath volume {vol.name!r}")
+        for c in list(pod.spec.init_containers or []) + list(
+                pod.spec.containers or []):
+            sc = c.security_context or {}
+            if sc.get("privileged"):
+                out.append(f"privileged container {c.name!r}")
+            if level == "restricted":
+                if sc.get("runAsNonRoot") is not True:
+                    out.append(
+                        f"container {c.name!r} must set runAsNonRoot=true"
+                    )
+                if sc.get("allowPrivilegeEscalation") is not False:
+                    out.append(
+                        f"container {c.name!r} must set "
+                        "allowPrivilegeEscalation=false"
+                    )
+        return out
+
+    def admit(resource: str, op: str, obj) -> None:
+        # CREATE only: the reference plugin exempts subresource writes,
+        # and this build's update_status runs the validating chain with
+        # op=UPDATE — enforcing there would freeze status reporting for
+        # pre-existing pods the moment a namespace gets labeled
+        if resource != "pods" or op != "CREATE":
+            return
+        ns = obj.metadata.namespace
+        if not ns:
+            return
+        try:
+            namespace = api.get("namespaces", ns)
+        except NotFound:
+            return
+        level = (namespace.metadata.labels or {}).get(
+            POD_SECURITY_ENFORCE_LABEL, "privileged")
+        if level not in ("baseline", "restricted"):
+            return
+        found = violations(obj, level)
+        if found:
+            raise Invalid(
+                f"pod violates PodSecurity \"{level}\": " + "; ".join(found)
+            )
+
+    return admit
+
+
 def default_admission_chain(api: APIServer) -> Tuple[List, List]:
     """(mutating, validating) — reference default-enabled order
-    (kubeapiserver/options/plugins.go)."""
+    (kubeapiserver/options/plugins.go:108-140, minus cloud/deprecated)."""
     mutating = [
         namespace_lifecycle(api),
         service_account_admission(api),
         priority_admission(api),
         default_toleration_seconds(api),
         limit_ranger(api),
+        default_storage_class(api),
+        storage_object_in_use_protection(api),
     ]
     validating = [
         node_restriction(api),
+        pod_security(api),
         event_rate_limit(api),
         resource_quota(api),
     ]

@@ -63,11 +63,32 @@ class ResourceInfo:
 
 
 def _default_resources() -> Tuple["ResourceInfo", ...]:
-    from ..api import apps, autoscaling, batch, discovery, metrics, rbac, storage
+    from ..api import (
+        apps,
+        autoscaling,
+        batch,
+        certificates,
+        discovery,
+        metrics,
+        rbac,
+        storage,
+    )
     from ..client.events import Event
 
     return (
         ResourceInfo("serviceaccounts", rbac.ServiceAccount, True),
+        ResourceInfo(
+            "certificatesigningrequests",
+            certificates.CertificateSigningRequest,
+            False,
+        ),
+        # RBAC objects are API resources whether or not the RBAC
+        # authorizer (SecureAPIServer) is active — the
+        # clusterrole-aggregation controller reconciles them either way
+        ResourceInfo("roles", rbac.Role, True),
+        ResourceInfo("clusterroles", rbac.ClusterRole, False),
+        ResourceInfo("rolebindings", rbac.RoleBinding, True),
+        ResourceInfo("clusterrolebindings", rbac.ClusterRoleBinding, False),
         ResourceInfo("nodemetrics", metrics.NodeMetrics, False),
         ResourceInfo("podmetrics", metrics.PodMetrics, True),
         ResourceInfo("pods", v1.Pod, True),
@@ -258,6 +279,20 @@ class APIServer:
         meta = obj.metadata
         if not meta.name:
             raise Invalid("metadata.name is required")
+        if resource == "certificatesigningrequests":
+            # stamp the requester identity server-side (certificates
+            # types.go:89-99: Username/Groups are set by the apiserver
+            # from the authenticated request, never trusted from the
+            # body) — otherwise any CSR-creating identity could assert a
+            # bootstrap identity and mint auto-approved node credentials.
+            # In-proc callers with no request context are the trusted
+            # local path (same trust level as writing the store directly).
+            from .requestcontext import current_user
+
+            user = current_user()
+            if user is not None:
+                obj.spec.username = user.name
+                obj.spec.groups = list(user.groups or ())
         # non-atomic admission runs OUTSIDE the lock — webhook plugins do
         # blocking HTTP here and may re-enter the server; only hooks
         # flagged `atomic` (quota: usage check must not race the write

@@ -14,7 +14,17 @@ from typing import Callable, Dict, List, Optional
 from ..client.informer import SharedInformerFactory
 from ..client.leaderelection import LeaderElectionConfig, LeaderElector
 from .attachdetach import AttachDetachController
+from .bootstrap import BootstrapSignerController, TokenCleanerController
+from .certificates import (
+    CSRApprovingController,
+    CSRCleanerController,
+    CSRSigningController,
+)
+from .clusterroleaggregation import ClusterRoleAggregationController
 from .cronjob import CronJobController
+from .endpointslicemirroring import EndpointSliceMirroringController
+from .ephemeral import EphemeralVolumeController, ExpandController
+from .rootcacertpublisher import RootCACertPublisher
 from .daemonset import DaemonSetController
 from .deployment import DeploymentController
 from .disruption import DisruptionController
@@ -100,7 +110,53 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "pvc-protection": lambda cs, inf, opts: PVCProtectionController(cs, inf),
         "pv-protection": lambda cs, inf, opts: PVProtectionController(cs, inf),
         "ttl": lambda cs, inf, opts: TTLController(cs, inf),
+        # round-3 long tail (controllermanager.go:391,406-428)
+        "csrsigning": lambda cs, inf, opts: CSRSigningController(
+            cs, inf, ca=opts.get("csr_ca") or _default_ca(opts)
+        ),
+        "csrapproving": lambda cs, inf, opts: CSRApprovingController(cs, inf),
+        "csrcleaner": lambda cs, inf, opts: CSRCleanerController(
+            cs, inf, sync_period=opts.get("csr_cleaner_period", 60.0)
+        ),
+        "bootstrapsigner": lambda cs, inf, opts: BootstrapSignerController(
+            cs, inf
+        ),
+        "tokencleaner": lambda cs, inf, opts: TokenCleanerController(
+            cs, inf, sync_period=opts.get("token_cleaner_period", 10.0)
+        ),
+        "clusterrole-aggregation": lambda cs, inf, opts: (
+            ClusterRoleAggregationController(cs, inf)
+        ),
+        "endpointslicemirroring": lambda cs, inf, opts: (
+            EndpointSliceMirroringController(cs, inf)
+        ),
+        "ephemeral-volume": lambda cs, inf, opts: EphemeralVolumeController(
+            cs, inf
+        ),
+        "persistentvolume-expander": lambda cs, inf, opts: ExpandController(
+            cs, inf
+        ),
+        # the published bundle must anchor the SAME CA the CSR signer
+        # uses: prefer an explicit root_ca, then the operator's csr_ca,
+        # then the shared per-manager default
+        "root-ca-cert-publisher": lambda cs, inf, opts: RootCACertPublisher(
+            cs, inf, root_ca=opts.get("root_ca", "")
+            or (opts.get("csr_ca") or _default_ca(opts)).public_bundle()
+        ),
     }
+
+
+def _default_ca(opts):
+    """One shared CertificateAuthority per manager options dict: the CSR
+    signer and the root-CA publisher must agree on the CA identity when
+    the operator supplies neither."""
+    ca = opts.get("_default_ca")
+    if ca is None:
+        from .. import kubeadm
+
+        ca = kubeadm.CertificateAuthority()
+        opts["_default_ca"] = ca
+    return ca
 
 
 class ControllerManager:

@@ -71,6 +71,13 @@ class CertificateAuthority:
         msg = f"{cn}|{','.join(sorted(orgs))}|{not_after:.3f}".encode()
         return hmac.new(self.key, msg, hashlib.sha256).hexdigest()
 
+    def public_bundle(self) -> str:
+        """The distributable CA identity (the ca.crt bundle analog):
+        clients pin this fingerprint to verify they're talking to the
+        cluster this CA anchors (root-ca-cert-publisher payload;
+        discovery's --discovery-token-ca-cert-hash)."""
+        return "sha256:" + hashlib.sha256(self.key).hexdigest()
+
     def issue(self, name: str, common_name: str, organizations: List[str],
               ttl: float = DEFAULT_CERT_TTL) -> Certificate:
         not_after = time.time() + ttl
@@ -234,6 +241,18 @@ def _phase_bootstrap_token(ctx: InitContext) -> None:
             "usage-bootstrap-signing": "true",
         },
     ))
+    # cluster-info in kube-public (bootstraptoken/clusterinfo phase):
+    # the anonymous discovery document joiners read; the bootstrapsigner
+    # controller maintains its jws-kubeconfig-<tokenID> signatures
+    _apply(ctx.secure.api, "configmaps", v1.ConfigMap(
+        metadata=v1.ObjectMeta(name="cluster-info", namespace="kube-public"),
+        data={
+            "kubeconfig": (
+                f"cluster={ctx.cluster_name};"
+                f"ca={ctx.ca.public_bundle()}"
+            ),
+        },
+    ))
     ctx.bootstrap_token = token
 
 
@@ -294,15 +313,27 @@ def _validate_token(api, token: str) -> None:
 
 
 def join(ctx: InitContext, node_name: str,
-         control_plane: bool = False, token: str = "") -> Certificate:
-    """kubeadm join: validate the bootstrap token, issue the node's
+         control_plane: bool = False, token: str = "",
+         via_csr: bool = False, csr_timeout: float = 30.0) -> Certificate:
+    """kubeadm join: validate the bootstrap token, obtain the node's
     kubelet identity (TLS bootstrap analog), and for --control-plane
-    joins mark the node and mint component identities too."""
+    joins mark the node and mint component identities too.
+
+    via_csr=True runs the real TLS-bootstrap shape: create a
+    CertificateSigningRequest as the bootstrap identity and wait for the
+    csrapproving + csrsigning controllers to approve and issue it
+    (kubelet/certificate/bootstrap; requires those controllers running
+    against the same apiserver with ctx.ca)."""
     api = ctx.secure.api
     _validate_token(api, token or ctx.bootstrap_token)
-    cert = ctx.ca.issue(
-        f"kubelet-{node_name}", f"system:node:{node_name}", ["system:nodes"]
-    )
+    if via_csr:
+        cert = _join_via_csr(ctx, node_name, token or ctx.bootstrap_token,
+                             csr_timeout)
+    else:
+        cert = ctx.ca.issue(
+            f"kubelet-{node_name}", f"system:node:{node_name}",
+            ["system:nodes"]
+        )
     ctx.secure.authenticator.add_token(
         cert.token, cert.common_name, cert.organizations
     )
@@ -313,3 +344,61 @@ def join(ctx: InitContext, node_name: str,
         )
         _phase_mark_control_plane(sub)
     return cert
+
+
+def _join_via_csr(ctx: InitContext, node_name: str, token: str,
+                  timeout: float) -> Certificate:
+    import json as _json
+
+    from .api import certificates as certsapi
+
+    api = ctx.secure.api
+    tid = token.split(".", 1)[0]
+    name = f"node-csr-{node_name}"
+    csr = certsapi.CertificateSigningRequest(
+        metadata=certsapi.ObjectMeta(name=name),
+        spec=certsapi.CertificateSigningRequestSpec(
+            request=certsapi.encode_request(
+                f"system:node:{node_name}", ["system:nodes"]
+            ),
+            signer_name=certsapi.SIGNER_KUBE_APISERVER_CLIENT_KUBELET,
+            usages=["client auth"],
+            username=f"system:bootstrap:{tid}",
+            groups=["system:bootstrappers"],
+        ),
+    )
+    try:
+        api.create("certificatesigningrequests", csr)
+    except Exception:  # noqa: BLE001 — re-join: an existing CSR may be ours
+        pass
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        cur = api.get("certificatesigningrequests", name)
+        # never adopt a pre-existing CSR for a DIFFERENT identity: an
+        # attacker could pre-create node-csr-<victim> and harvest the
+        # issued credential (the signer writes the bearer token into
+        # status.certificate)
+        if cur.spec.request != csr.spec.request:
+            raise InvalidToken(
+                f"existing CSR {name!r} requests a different identity; "
+                "refusing to adopt it"
+            )
+        if cur.status.certificate:
+            rec = _json.loads(cur.status.certificate)
+            if rec.get("commonName") != f"system:node:{node_name}":
+                raise InvalidToken(
+                    f"CSR {name!r} was issued for "
+                    f"{rec.get('commonName')!r}, not this node"
+                )
+            return Certificate(
+                common_name=rec["commonName"],
+                organizations=list(rec["organizations"]),
+                not_after=float(rec["notAfter"]),
+                signature=rec.get("signature", ""),
+                token=rec.get("token", ""),
+            )
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"CSR {name!r} was not approved+signed within {timeout}s "
+        "(are csrapproving/csrsigning running?)"
+    )

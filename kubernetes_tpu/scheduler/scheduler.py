@@ -206,6 +206,11 @@ class Scheduler:
         self.queue.close()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        # the drainer submits released waves to the binder pool — join it
+        # BEFORE the pool shuts down, or a mid-wave submit would raise and
+        # strand the wave's assumed pods
+        if self._permit_thread is not None:
+            self._permit_thread.join(timeout=10)
         if self.backend == "tpu":
             try:
                 self._drain_inflight()  # loop is dead; land the tail batch
@@ -426,7 +431,12 @@ class Scheduler:
         if wp is None:
             # resolved before we could park (plugin allowed within
             # run_permit_plugins' return): plain binding cycle
-            self._binders.submit(self._bind, assumed, node_name, state, info)
+            try:
+                self._binders.submit(self._bind, assumed, node_name, state, info)
+            except RuntimeError:  # pool shut down (stop() race)
+                with self._inflight_lock:
+                    self._inflight -= 1
+                self._retry_failed_bind(assumed)
             return
         with self._permit_lock:
             self._permit_parked[key] = (assumed, node_name, state, info, wp)
@@ -502,7 +512,18 @@ class Scheduler:
             # swap the per-pod inflight holds for the batch's single one
             with self._inflight_lock:
                 self._inflight -= len(items) - 1
-            self._binders.submit(self._bind_batch, items)
+            try:
+                self._binders.submit(self._bind_batch, items)
+            except RuntimeError:
+                # pool already shut down (stop() race): release the wave
+                # instead of stranding it assumed-in-cache
+                with self._inflight_lock:
+                    self._inflight -= 1
+                for assumed, _, _, _ in items:
+                    try:
+                        self._retry_failed_bind(assumed)
+                    except Exception:  # noqa: BLE001
+                        traceback.print_exc()
 
     def _bind_batch(self, items: List[Tuple]) -> None:
         """Binding cycle for a whole batch in one worker: PreBind per pod,

@@ -1,0 +1,490 @@
+"""Round-3 controllers long tail: csrsigning/csrapproving/csrcleaner,
+bootstrapsigner/tokencleaner, clusterrole-aggregation,
+endpointslicemirroring, ephemeral-volume, persistentvolume-expander,
+root-ca-cert-publisher — plus the kubeadm join-through-CSR flow.
+
+Reference: cmd/kube-controller-manager/app/controllermanager.go:391,
+406-428 initializers; pkg/controller/{certificates,bootstrap,
+clusterroleaggregation,endpointslicemirroring,volume/ephemeral,
+volume/expand}; rootcacertpublisher.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import certificates as certsapi
+from kubernetes_tpu.api import discovery, rbac, storage
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.server import APIServer, NotFound
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers.bootstrap import (
+    BootstrapSignerController,
+    TokenCleanerController,
+    sign_kubeconfig,
+)
+from kubernetes_tpu.controllers.certificates import (
+    CSRApprovingController,
+    CSRCleanerController,
+    CSRSigningController,
+)
+from kubernetes_tpu.controllers.clusterroleaggregation import (
+    ClusterRoleAggregationController,
+)
+from kubernetes_tpu.controllers.endpointslicemirroring import (
+    MANAGED_BY,
+    MANAGED_BY_LABEL,
+    EndpointSliceMirroringController,
+)
+from kubernetes_tpu.controllers.ephemeral import (
+    EphemeralVolumeController,
+    ExpandController,
+)
+from kubernetes_tpu.controllers.manager import new_controller_initializers
+from kubernetes_tpu.controllers.rootcacertpublisher import (
+    ROOT_CA_CONFIGMAP,
+    RootCACertPublisher,
+)
+from kubernetes_tpu.kubeadm import CertificateAuthority
+
+from .util import make_pod, wait_until
+
+
+@pytest.fixture()
+def cluster():
+    api = APIServer()
+    cs = Clientset(api)
+    factory = SharedInformerFactory(cs)
+    started = []
+
+    def start(*ctrls):
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        for c in ctrls:
+            c.run()
+            started.append(c)
+        return ctrls
+
+    yield api, cs, factory, start
+    for c in started:
+        c.stop()
+    factory.stop()
+
+
+def test_initializer_registry_has_r3_controllers():
+    inits = new_controller_initializers()
+    for name in ("csrsigning", "csrapproving", "csrcleaner",
+                 "bootstrapsigner", "tokencleaner",
+                 "clusterrole-aggregation", "endpointslicemirroring",
+                 "ephemeral-volume", "persistentvolume-expander",
+                 "root-ca-cert-publisher"):
+        assert name in inits, name
+    assert len(inits) >= 34
+
+
+def _bootstrap_csr(name="node-csr-w0", node="w0"):
+    return certsapi.CertificateSigningRequest(
+        metadata=v1.ObjectMeta(name=name),
+        spec=certsapi.CertificateSigningRequestSpec(
+            request=certsapi.encode_request(
+                f"system:node:{node}", ["system:nodes"]),
+            signer_name=certsapi.SIGNER_KUBE_APISERVER_CLIENT_KUBELET,
+            usages=["client auth"],
+            username="system:bootstrap:abcdef",
+            groups=["system:bootstrappers"],
+        ),
+    )
+
+
+class TestCSRControllers:
+    def test_approve_then_sign(self, cluster):
+        api, cs, factory, start = cluster
+        ca = CertificateAuthority()
+        start(CSRApprovingController(cs, factory),
+              CSRSigningController(cs, factory, ca=ca))
+        cs.resource("certificatesigningrequests").create(_bootstrap_csr())
+
+        def issued():
+            csr = cs.resource("certificatesigningrequests").get("node-csr-w0")
+            return bool(csr.status.certificate)
+
+        assert wait_until(issued), "CSR was not approved+signed"
+        csr = cs.resource("certificatesigningrequests").get("node-csr-w0")
+        assert certsapi.has_condition(csr, certsapi.APPROVED)
+        import json
+
+        rec = json.loads(csr.status.certificate)
+        assert rec["commonName"] == "system:node:w0"
+        # the issued record verifies against the same CA
+        from kubernetes_tpu.kubeadm import Certificate
+
+        assert ca.verify(Certificate(
+            common_name=rec["commonName"],
+            organizations=rec["organizations"],
+            not_after=rec["notAfter"], signature=rec["signature"],
+        ))
+
+    def test_authenticated_requester_identity_is_stamped(self):
+        """spec.username/groups come from the AUTHENTICATED requester
+        (certificates types.go:89-99), so an ordinary user cannot assert
+        a bootstrap identity in the body and mint auto-approved node
+        credentials (identity-hijack guard)."""
+        from kubernetes_tpu.apiserver.auth import SecureAPIServer
+        from kubernetes_tpu.apiserver.requestcontext import request_user
+        from kubernetes_tpu.apiserver.auth import UserInfo
+
+        secure = SecureAPIServer(APIServer())
+        csr = _bootstrap_csr(name="spoofed")
+        csr.spec.username = "system:bootstrap:abcdef"  # attacker-asserted
+        with request_user(UserInfo(name="mallory", groups=("devs",))):
+            created = secure.api.create("certificatesigningrequests", csr)
+        assert created.spec.username == "mallory"
+        assert created.spec.groups == ["devs"]
+        assert CSRApprovingController._recognize(created) is None
+
+    def test_join_refuses_foreign_csr(self):
+        """join(via_csr=True) must not adopt a pre-existing CSR for a
+        different identity (credential-harvest guard)."""
+        from kubernetes_tpu import kubeadm
+        from kubernetes_tpu.apiserver.auth import SecureAPIServer
+
+        secure = SecureAPIServer(APIServer())
+        ctx = kubeadm.init(secure, node_name="cp-0")
+        foreign = _bootstrap_csr(name="node-csr-victim", node="attacker")
+        secure.api.create("certificatesigningrequests", foreign)
+        with pytest.raises(kubeadm.InvalidToken, match="different identity"):
+            kubeadm.join(ctx, "victim", via_csr=True, csr_timeout=2.0)
+
+    def test_non_bootstrap_csr_not_auto_approved(self, cluster):
+        api, cs, factory, start = cluster
+        start(CSRApprovingController(cs, factory))
+        csr = _bootstrap_csr(name="rogue")
+        csr.spec.username = "random-user"
+        csr.spec.groups = []
+        cs.resource("certificatesigningrequests").create(csr)
+        time.sleep(0.5)
+        cur = cs.resource("certificatesigningrequests").get("rogue")
+        assert not certsapi.has_condition(cur, certsapi.APPROVED)
+
+    def test_cleaner_removes_stale(self, cluster):
+        api, cs, factory, start = cluster
+        old = _bootstrap_csr(name="stale")
+        created = cs.resource("certificatesigningrequests").create(old)
+        # age it: creation_timestamp in the past beyond the pending TTL
+        created.metadata.creation_timestamp = time.time() - 100000
+        cs.resource("certificatesigningrequests").update(created)
+        start(CSRCleanerController(cs, factory, sync_period=0.2))
+
+        def gone():
+            try:
+                cs.resource("certificatesigningrequests").get("stale")
+                return False
+            except NotFound:
+                return True
+
+        assert wait_until(gone), "stale CSR not cleaned"
+
+
+class TestBootstrapControllers:
+    def _token_secret(self, tid="abcdef", tsec="0123456789abcdef",
+                      expired=False):
+        return v1.Secret(
+            metadata=v1.ObjectMeta(
+                name=f"bootstrap-token-{tid}", namespace="kube-system"),
+            type="bootstrap.kubernetes.io/token",
+            data={
+                "token-id": tid, "token-secret": tsec,
+                "expiration": str(
+                    time.time() + (-10 if expired else 3600)),
+                "usage-bootstrap-authentication": "true",
+                "usage-bootstrap-signing": "true",
+            },
+        )
+
+    def test_signer_signs_cluster_info(self, cluster):
+        api, cs, factory, start = cluster
+        cs.configmaps.create(v1.ConfigMap(
+            metadata=v1.ObjectMeta(name="cluster-info",
+                                   namespace="kube-public"),
+            data={"kubeconfig": "cluster=test;ca=sha256:deadbeef"},
+        ))
+        cs.secrets.create(self._token_secret())
+        start(BootstrapSignerController(cs, factory))
+
+        def signed():
+            cm = cs.configmaps.get("cluster-info", "kube-public")
+            return "jws-kubeconfig-abcdef" in (cm.data or {})
+
+        assert wait_until(signed)
+        cm = cs.configmaps.get("cluster-info", "kube-public")
+        assert cm.data["jws-kubeconfig-abcdef"] == sign_kubeconfig(
+            cm.data["kubeconfig"], "abcdef", "0123456789abcdef")
+
+    def test_signer_removes_stale_signature(self, cluster):
+        api, cs, factory, start = cluster
+        cs.configmaps.create(v1.ConfigMap(
+            metadata=v1.ObjectMeta(name="cluster-info",
+                                   namespace="kube-public"),
+            data={"kubeconfig": "x", "jws-kubeconfig-zzzzzz": "stale"},
+        ))
+        start(BootstrapSignerController(cs, factory))
+
+        def unsigned():
+            cm = cs.configmaps.get("cluster-info", "kube-public")
+            return "jws-kubeconfig-zzzzzz" not in (cm.data or {})
+
+        assert wait_until(unsigned)
+
+    def test_token_cleaner(self, cluster):
+        api, cs, factory, start = cluster
+        cs.secrets.create(self._token_secret(tid="dead00", expired=True))
+        cs.secrets.create(self._token_secret(tid="live00"))
+        start(TokenCleanerController(cs, factory, sync_period=0.2))
+
+        def cleaned():
+            try:
+                cs.secrets.get("bootstrap-token-dead00", "kube-system")
+                return False
+            except NotFound:
+                return True
+
+        assert wait_until(cleaned)
+        assert cs.secrets.get("bootstrap-token-live00", "kube-system")
+
+
+class TestClusterRoleAggregation:
+    def test_union_and_update(self, cluster):
+        api, cs, factory, start = cluster
+        cs.resource("clusterroles").create(rbac.ClusterRole(
+            metadata=v1.ObjectMeta(name="admin"),
+            aggregation_rule=rbac.AggregationRule(
+                cluster_role_selectors=[
+                    {"rbac.example/aggregate-to-admin": "true"}]),
+        ))
+        cs.resource("clusterroles").create(rbac.ClusterRole(
+            metadata=v1.ObjectMeta(
+                name="edit-pods",
+                labels={"rbac.example/aggregate-to-admin": "true"}),
+            rules=[rbac.PolicyRule(verbs=["get", "update"],
+                                   resources=["pods"])],
+        ))
+        start(ClusterRoleAggregationController(cs, factory))
+
+        def aggregated():
+            role = cs.resource("clusterroles").get("admin")
+            return any("pods" in (r.resources or []) for r in role.rules or [])
+
+        assert wait_until(aggregated)
+        # a new matching role extends the union
+        cs.resource("clusterroles").create(rbac.ClusterRole(
+            metadata=v1.ObjectMeta(
+                name="view-secrets",
+                labels={"rbac.example/aggregate-to-admin": "true"}),
+            rules=[rbac.PolicyRule(verbs=["list"], resources=["secrets"])],
+        ))
+
+        def extended():
+            role = cs.resource("clusterroles").get("admin")
+            return any("secrets" in (r.resources or [])
+                       for r in role.rules or [])
+
+        assert wait_until(extended)
+
+
+class TestEndpointSliceMirroring:
+    def test_mirrors_custom_endpoints(self, cluster):
+        api, cs, factory, start = cluster
+        # selector-less Service + hand-made Endpoints = mirrorable
+        cs.services.create(v1.Service(
+            metadata=v1.ObjectMeta(name="ext", namespace="default"),
+            spec=v1.ServiceSpec(selector=None),
+        ))
+        cs.endpoints.create(v1.Endpoints(
+            metadata=v1.ObjectMeta(name="ext", namespace="default"),
+            subsets=[v1.EndpointSubset(
+                addresses=[v1.EndpointAddress(ip="10.0.0.9")],
+                ports=[v1.EndpointPort(name="http", port=80)],
+            )],
+        ))
+        start(EndpointSliceMirroringController(cs, factory))
+
+        def mirrored():
+            slices, _ = cs.resource("endpointslices").list(
+                namespace="default")
+            return any(
+                (s.metadata.labels or {}).get(MANAGED_BY_LABEL) == MANAGED_BY
+                and (s.metadata.labels or {}).get(
+                    discovery.LABEL_SERVICE_NAME) == "ext"
+                and s.endpoints and s.endpoints[0].addresses == ["10.0.0.9"]
+                for s in slices
+            )
+
+        assert wait_until(mirrored)
+
+    def test_selector_service_not_mirrored(self, cluster):
+        api, cs, factory, start = cluster
+        cs.services.create(v1.Service(
+            metadata=v1.ObjectMeta(name="sel", namespace="default"),
+            spec=v1.ServiceSpec(selector={"app": "x"}),
+        ))
+        cs.endpoints.create(v1.Endpoints(
+            metadata=v1.ObjectMeta(name="sel", namespace="default"),
+            subsets=[v1.EndpointSubset(
+                addresses=[v1.EndpointAddress(ip="10.0.0.1")])],
+        ))
+        start(EndpointSliceMirroringController(cs, factory))
+        time.sleep(0.5)
+        slices, _ = cs.resource("endpointslices").list(namespace="default")
+        assert not any(
+            (s.metadata.labels or {}).get(MANAGED_BY_LABEL) == MANAGED_BY
+            for s in slices
+        )
+
+
+class TestEphemeralVolume:
+    def test_creates_owned_pvc(self, cluster):
+        api, cs, factory, start = cluster
+        pod = make_pod("eph-pod")
+        pod.spec.volumes = [v1.Volume(
+            name="scratch",
+            source={"ephemeral": {"volumeClaimTemplate": {"spec": {
+                "accessModes": ["ReadWriteOnce"],
+                "resources": {"requests": {"storage": "1Gi"}},
+                "storageClassName": "standard",
+            }}}},
+        )]
+        created = cs.pods.create(pod)
+        start(EphemeralVolumeController(cs, factory))
+
+        def pvc_exists():
+            try:
+                pvc = cs.persistentvolumeclaims.get(
+                    "eph-pod-scratch", "default")
+            except NotFound:
+                return False
+            refs = pvc.metadata.owner_references or []
+            return any(r.uid == created.metadata.uid and r.controller
+                       for r in refs)
+
+        assert wait_until(pvc_exists)
+        pvc = cs.persistentvolumeclaims.get("eph-pod-scratch", "default")
+        assert (pvc.spec.resources.requests or {}).get("storage") == "1Gi"
+
+
+class TestExpandController:
+    def test_expands_bound_pvc(self, cluster):
+        api, cs, factory, start = cluster
+        cs.storageclasses.create(storage.StorageClass(
+            metadata=v1.ObjectMeta(name="exp"),
+            allow_volume_expansion=True,
+        ))
+        cs.persistentvolumes.create(v1.PersistentVolume(
+            metadata=v1.ObjectMeta(name="pv-1"),
+            spec=v1.PersistentVolumeSpec(
+                capacity={"storage": "1Gi"}, storage_class_name="exp"),
+        ))
+        pvc = v1.PersistentVolumeClaim(
+            metadata=v1.ObjectMeta(name="data", namespace="default"),
+            spec=v1.PersistentVolumeClaimSpec(
+                resources=v1.ResourceRequirements(
+                    requests={"storage": "2Gi"}),
+                storage_class_name="exp", volume_name="pv-1",
+            ),
+        )
+        pvc.status.phase = "Bound"
+        pvc.status.capacity = {"storage": "1Gi"}
+        cs.persistentvolumeclaims.create(pvc)
+        start(ExpandController(cs, factory))
+
+        def expanded():
+            pv = cs.persistentvolumes.get("pv-1")
+            claim = cs.persistentvolumeclaims.get("data", "default")
+            return ((pv.spec.capacity or {}).get("storage") == "2Gi"
+                    and (claim.status.capacity or {}).get("storage") == "2Gi")
+
+        assert wait_until(expanded)
+
+    def test_no_expansion_without_storageclass_permission(self, cluster):
+        api, cs, factory, start = cluster
+        cs.storageclasses.create(storage.StorageClass(
+            metadata=v1.ObjectMeta(name="fixed"),
+            allow_volume_expansion=False,
+        ))
+        cs.persistentvolumes.create(v1.PersistentVolume(
+            metadata=v1.ObjectMeta(name="pv-2"),
+            spec=v1.PersistentVolumeSpec(capacity={"storage": "1Gi"}),
+        ))
+        pvc = v1.PersistentVolumeClaim(
+            metadata=v1.ObjectMeta(name="fixed-data", namespace="default"),
+            spec=v1.PersistentVolumeClaimSpec(
+                resources=v1.ResourceRequirements(
+                    requests={"storage": "2Gi"}),
+                storage_class_name="fixed", volume_name="pv-2",
+            ),
+        )
+        pvc.status.phase = "Bound"
+        pvc.status.capacity = {"storage": "1Gi"}
+        cs.persistentvolumeclaims.create(pvc)
+        start(ExpandController(cs, factory))
+        time.sleep(0.5)
+        pv = cs.persistentvolumes.get("pv-2")
+        assert (pv.spec.capacity or {}).get("storage") == "1Gi"
+
+
+class TestRootCAPublisher:
+    def test_publishes_to_every_namespace(self, cluster):
+        api, cs, factory, start = cluster
+        cs.namespaces.create(v1.Namespace(
+            metadata=v1.ObjectMeta(name="team-a")))
+        start(RootCACertPublisher(cs, factory, root_ca="sha256:rootca"))
+
+        def published():
+            try:
+                cm = cs.configmaps.get(ROOT_CA_CONFIGMAP, "team-a")
+            except NotFound:
+                return False
+            return (cm.data or {}).get("ca.crt") == "sha256:rootca"
+
+        assert wait_until(published)
+        # tampering is reverted
+        cm = cs.configmaps.get(ROOT_CA_CONFIGMAP, "team-a")
+        cm.data = {"ca.crt": "tampered"}
+        cs.configmaps.update(cm)
+
+        def reverted():
+            cur = cs.configmaps.get(ROOT_CA_CONFIGMAP, "team-a")
+            return (cur.data or {}).get("ca.crt") == "sha256:rootca"
+
+        assert wait_until(reverted)
+
+
+class TestKubeadmJoinViaCSR:
+    def test_join_through_csr_approval(self):
+        from kubernetes_tpu import kubeadm
+        from kubernetes_tpu.apiserver.auth import SecureAPIServer
+
+        secure = SecureAPIServer(APIServer())
+        ctx = kubeadm.init(secure, node_name="cp-0")
+        cs = Clientset(secure.api)
+        factory = SharedInformerFactory(cs)
+        approver = CSRApprovingController(cs, factory)
+        signer = CSRSigningController(cs, factory, ca=ctx.ca)
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        approver.run()
+        signer.run()
+        try:
+            cert = kubeadm.join(ctx, "worker-9", via_csr=True,
+                                csr_timeout=10.0)
+            assert cert.common_name == "system:node:worker-9"
+            assert ctx.ca.verify(cert)
+            # the CSR object records the whole flow
+            csr = secure.api.get(
+                "certificatesigningrequests", "node-csr-worker-9")
+            assert certsapi.has_condition(csr, certsapi.APPROVED)
+            assert csr.status.certificate
+        finally:
+            approver.stop()
+            signer.stop()
+            factory.stop()
