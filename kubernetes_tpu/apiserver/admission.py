@@ -391,8 +391,13 @@ def event_rate_limit(api: APIServer, qps: float = 50.0, burst: int = 100):
 
 
 DEFAULT_STORAGE_CLASS_ANNOTATION = "storageclass.kubernetes.io/is-default-class"
-PVC_PROTECTION_FINALIZER = "kubernetes.io/pvc-protection"
-PV_PROTECTION_FINALIZER = "kubernetes.io/pv-protection"
+# single source of truth: the finalizer this plugin stamps is exactly the
+# one the protection controllers release
+from ..controllers.volumeprotection import (  # noqa: E402
+    PVC_PROTECTION_FINALIZER,
+    PV_PROTECTION_FINALIZER,
+)
+
 POD_SECURITY_ENFORCE_LABEL = "pod-security.kubernetes.io/enforce"
 
 

@@ -287,12 +287,18 @@ class APIServer:
             # bootstrap identity and mint auto-approved node credentials.
             # In-proc callers with no request context are the trusted
             # local path (same trust level as writing the store directly).
+            from ..api.certificates import CertificateSigningRequestStatus
             from .requestcontext import current_user
 
             user = current_user()
             if user is not None:
                 obj.spec.username = user.name
                 obj.spec.groups = list(user.groups or ())
+            # a CREATE never carries status: a caller-supplied Approved
+            # condition would let the signer mint credentials without
+            # any approver having acted (create.go drops status for
+            # every resource with a status subresource)
+            obj.status = CertificateSigningRequestStatus()
         # non-atomic admission runs OUTSIDE the lock — webhook plugins do
         # blocking HTTP here and may re-enter the server; only hooks
         # flagged `atomic` (quota: usage check must not race the write
@@ -340,6 +346,19 @@ class APIServer:
         meta = obj.metadata
         key = self._key(info, meta.namespace, meta.name)
         op = "UPDATE"
+        if resource == "certificatesigningrequests":
+            # CSR spec is immutable after create for authenticated
+            # callers (the reference's strategy.PrepareForUpdate copies
+            # the old spec): rewriting spec.username post-create would
+            # defeat the requester stamping above
+            from .requestcontext import current_user
+
+            if current_user() is not None:
+                try:
+                    old = self.get(resource, meta.name, meta.namespace)
+                    obj.spec = old.spec
+                except NotFound:
+                    pass
         for admit in self._mutating:
             admit(resource, op, obj)
         for admit in self._validating:

@@ -35,6 +35,22 @@ def _key(csr) -> str:
     return csr.metadata.name
 
 
+def _mark_failed(client, name: str, message: str) -> None:
+    def apply():
+        fresh = client.resource("certificatesigningrequests").get(name)
+        if certs.has_condition(fresh, certs.FAILED):
+            return
+        fresh.status.conditions = (fresh.status.conditions or []) + [
+            certs.CertificateSigningRequestCondition(
+                type=certs.FAILED, reason="SigningError", message=message,
+                last_update_time=time.time(),
+            )
+        ]
+        client.resource("certificatesigningrequests").update_status(fresh)
+
+    retry_on_conflict(apply)
+
+
 class CSRSigningController(Controller):
     """certificates/signer: sign Approved, unissued CSRs for the
     well-known kube-apiserver-client signers using the cluster CA."""
@@ -65,9 +81,17 @@ class CSRSigningController(Controller):
             return
         if csr.status.certificate or not certs.has_condition(csr, certs.APPROVED):
             return
-        if certs.has_condition(csr, certs.DENIED):
+        if certs.has_condition(csr, certs.DENIED) or \
+                certs.has_condition(csr, certs.FAILED):
             return
-        req = certs.decode_request(csr.spec.request)
+        try:
+            req = certs.decode_request(csr.spec.request)
+        except (ValueError, TypeError):
+            # malformed request must not wedge the sync in a requeue
+            # loop: mark Failed once (signer.go's terminal-failure path)
+            _mark_failed(self.client, csr.metadata.name,
+                         "unparseable spec.request")
+            return
         ttl = float(csr.spec.expiration_seconds or 0) or None
         cert = self.ca.issue(
             f"csr-{csr.metadata.name}",
@@ -119,7 +143,10 @@ class CSRApprovingController(Controller):
         """-> approval reason, or None when not auto-approvable."""
         if csr.spec.signer_name != certs.SIGNER_KUBE_APISERVER_CLIENT_KUBELET:
             return None
-        req = certs.decode_request(csr.spec.request)
+        try:
+            req = certs.decode_request(csr.spec.request)
+        except (ValueError, TypeError):
+            return None  # malformed: not approvable (cleaner reaps it)
         if not req.get("commonName", "").startswith("system:node:"):
             return None
         if "system:nodes" not in req.get("organizations", []):

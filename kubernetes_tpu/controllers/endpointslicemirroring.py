@@ -56,17 +56,18 @@ class EndpointSliceMirroringController(Controller):
         ]
 
     def _desired(self, ep: v1.Endpoints) -> List[discovery.EndpointSlice]:
-        endpoints: List[discovery.Endpoint] = []
-        ports: List[discovery.EndpointSlicePort] = []
-        seen_ports = set()
-        for subset in ep.subsets or []:
-            for p in subset.ports or []:
-                key = (p.name, p.protocol, p.port)
-                if key not in seen_ports:
-                    seen_ports.add(key)
-                    ports.append(discovery.EndpointSlicePort(
-                        name=p.name, protocol=p.protocol or "TCP",
-                        port=p.port))
+        # one slice group PER SUBSET: a subset's addresses serve exactly
+        # that subset's ports — merging ports across subsets would
+        # advertise addresses on ports they do not serve (the reference
+        # reconciler likewise keys slices by the subset's port set)
+        slices: List[discovery.EndpointSlice] = []
+        for si, subset in enumerate(ep.subsets or []):
+            ports = [
+                discovery.EndpointSlicePort(
+                    name=p.name, protocol=p.protocol or "TCP", port=p.port)
+                for p in subset.ports or []
+            ]
+            endpoints: List[discovery.Endpoint] = []
             for addr in subset.addresses or []:
                 endpoints.append(discovery.Endpoint(
                     addresses=[addr.ip],
@@ -78,21 +79,21 @@ class EndpointSliceMirroringController(Controller):
                     addresses=[addr.ip],
                     conditions=discovery.EndpointConditions(ready=False),
                 ))
-        slices = []
-        for i in range(0, max(len(endpoints), 1), self.max_per_slice):
-            chunk = endpoints[i:i + self.max_per_slice]
-            slices.append(discovery.EndpointSlice(
-                metadata=v1.ObjectMeta(
-                    name=f"{ep.metadata.name}-mirror-{i // self.max_per_slice}",
-                    namespace=ep.metadata.namespace,
-                    labels={
-                        discovery.LABEL_SERVICE_NAME: ep.metadata.name,
-                        MANAGED_BY_LABEL: MANAGED_BY,
-                    },
-                ),
-                endpoints=chunk,
-                ports=list(ports) or None,
-            ))
+            for i in range(0, max(len(endpoints), 1), self.max_per_slice):
+                chunk = endpoints[i:i + self.max_per_slice]
+                slices.append(discovery.EndpointSlice(
+                    metadata=v1.ObjectMeta(
+                        name=(f"{ep.metadata.name}-mirror-{si}"
+                              f"-{i // self.max_per_slice}"),
+                        namespace=ep.metadata.namespace,
+                        labels={
+                            discovery.LABEL_SERVICE_NAME: ep.metadata.name,
+                            MANAGED_BY_LABEL: MANAGED_BY,
+                        },
+                    ),
+                    endpoints=chunk,
+                    ports=list(ports) or None,
+                ))
         return slices
 
     def sync(self, key: str) -> None:

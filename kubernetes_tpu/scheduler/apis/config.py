@@ -75,6 +75,11 @@ class KubeSchedulerProfile:
     plugins: Optional[Plugins] = None
     plugin_config: Dict[str, dict] = field(default_factory=dict)
     backend: str = "tpu"  # tpu | oracle (the TPU build's selector)
+    # multi-chip: shard the node axis over the first N devices as a
+    # jax.sharding.Mesh (0 = single device). The analog of the
+    # reference's `parallelism` knob, pointed at chips instead of
+    # goroutines (parallel/sharded.py).
+    mesh_devices: int = 0
 
 
 @dataclass
@@ -164,6 +169,10 @@ def validate_configuration(cfg: KubeSchedulerConfiguration) -> None:
             raise ConfigError("schedulerName is required")
         if profile.backend not in ("tpu", "oracle"):
             raise ConfigError(f"unknown backend {profile.backend!r}")
+        if profile.mesh_devices < 0:
+            raise ConfigError("meshDevices must be >= 0")
+        if profile.mesh_devices and profile.backend != "tpu":
+            raise ConfigError("meshDevices requires the tpu backend")
         merged = merged_plugins_for_profile(profile)
         for name, weight in merged.get("score", []):
             if weight < 0:
@@ -213,6 +222,7 @@ def load_configuration(text: str) -> KubeSchedulerConfiguration:
         profile = KubeSchedulerProfile(
             scheduler_name=pd.get("schedulerName", "default-scheduler"),
             backend=pd.get("backend", "tpu"),
+            mesh_devices=pd.get("meshDevices", 0),
         )
         if "plugins" in pd and pd["plugins"]:
             plugins = Plugins()

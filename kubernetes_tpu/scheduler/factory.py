@@ -71,7 +71,22 @@ def create_scheduler(
                     f"use backend: oracle for this profile"
                 )
             weights[key] = weight
-        tpu_backend = TPUBackend(weights=weights)
+        mesh = None
+        if profile.mesh_devices:
+            import jax
+
+            from ..parallel.sharded import make_mesh
+
+            n_avail = len(jax.devices())
+            if n_avail < profile.mesh_devices:
+                # silently truncating to fewer chips would hide a
+                # topology misconfiguration behind halved throughput
+                raise ConfigError(
+                    f"meshDevices: {profile.mesh_devices} but only "
+                    f"{n_avail} devices are available"
+                )
+            mesh = make_mesh(n_devices=profile.mesh_devices)
+        tpu_backend = TPUBackend(weights=weights, mesh=mesh)
 
     sched = Scheduler(
         clientset,

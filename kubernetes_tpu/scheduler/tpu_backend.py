@@ -76,11 +76,18 @@ class TPUBackend(CacheListener):
         self,
         weights: Optional[Dict[str, int]] = None,
         rng: Optional[random.Random] = None,
+        mesh=None,
     ):
         self.enc = ClusterEncoding()
         self.pe = PodEncoder(self.enc)
         self.weights = weights or DEFAULT_WEIGHTS
         self.rng = rng or random.Random()
+        # multi-chip: a jax.sharding.Mesh shards the NODE axis of every
+        # dispatch (parallel/sharded.py) — session statics and carry
+        # inherit the sharding through GSPMD, reductions ride ICI
+        # collectives. Decisions are bit-identical to single-device
+        # (tests/test_sharded.py through the Scheduler loop).
+        self.mesh = mesh
         self._lock = threading.RLock()
         # cross-cycle hoisted session (ops/hoisted.py HoistedSession): the
         # device-resident carry survives between schedule_many calls as
@@ -95,10 +102,14 @@ class TPUBackend(CacheListener):
         self._pending: Optional[_BatchHandle] = None  # one in-flight batch
         self.MAX_SESSION_TEMPLATES = 8
         # pallas rides only on real TPUs: on CPU (tests, dryruns) the
-        # interpreter would be pathologically slow and compile-heavy
+        # interpreter would be pathologically slow and compile-heavy.
+        # A mesh also disables it: the Mosaic kernel is a single-device
+        # program; multi-chip rides the GSPMD-sharded hoisted session.
         import jax
 
-        self.use_pallas = jax.devices()[0].platform == "tpu"
+        self.use_pallas = (
+            jax.devices()[0].platform == "tpu" and mesh is None
+        )
 
     def _invalidate_session(self) -> None:
         # _session_assumed survives invalidation deliberately: an assume
@@ -160,6 +171,11 @@ class TPUBackend(CacheListener):
             self._invalidate_session()
             p = {k: v for k, v in self.pe.encode(pod).items() if not k.startswith("_")}
             c = self.enc.device_state()
+            if self.mesh is not None:
+                from ..parallel import sharded
+
+                c = sharded.shard_cluster(c, self.mesh)
+                p = sharded.replicate_pod(p, self.mesh)
             out = schedule_pod_jit(c, p, self.weights)
             total = np.asarray(out["total"])
             feasible = np.asarray(out["feasible"])
@@ -340,8 +356,13 @@ class TPUBackend(CacheListener):
             from ..ops.hoisted import schedule_batch_hoisted
 
             self._invalidate_session()
+            cluster = self.enc.device_state()
+            if self.mesh is not None:
+                from ..parallel import sharded
+
+                cluster = sharded.shard_cluster(cluster, self.mesh)
             decisions, _ = schedule_batch_hoisted(
-                self.enc.device_state(), arrays, self.weights
+                cluster, arrays, self.weights
             )
             return decisions
         # an encoding rebuild (vocab/table growth) changes array shapes;
@@ -386,6 +407,17 @@ class TPUBackend(CacheListener):
 
         templates = list(self._known_templates.values())
         cluster = self.enc.device_state()
+        if self.mesh is not None:
+            # node-sharded session over the mesh (parallel/sharded.py
+            # ShardedScheduler.session semantics, inlined so the product
+            # session cache/invalidation applies unchanged)
+            from ..parallel import sharded
+
+            session_builds.inc(kind="hoisted", reason="mesh")
+            return HoistedSession(
+                sharded.shard_cluster(cluster, self.mesh),
+                templates, self.weights,
+            )
         if self.use_pallas:
             from ..ops.pallas_scan import PallasSession, PallasUnsupported
 

@@ -143,6 +143,59 @@ class TestCSRControllers:
         assert created.spec.groups == ["devs"]
         assert CSRApprovingController._recognize(created) is None
 
+    def test_create_drops_caller_supplied_status(self):
+        """A CSR created WITH a forged Approved condition must reach the
+        store with an empty status — else the signer would mint
+        credentials no approver granted."""
+        api = APIServer()
+        csr = _bootstrap_csr(name="forged")
+        csr.status.conditions = [certsapi.CertificateSigningRequestCondition(
+            type=certsapi.APPROVED, reason="Forged")]
+        created = api.create("certificatesigningrequests", csr)
+        assert not (created.status.conditions or [])
+        assert not certsapi.has_condition(created, certsapi.APPROVED)
+
+    def test_authenticated_update_cannot_rewrite_spec(self):
+        """spec is immutable post-create for authenticated callers: a
+        user with update rights must not be able to swap in a bootstrap
+        username after the fact."""
+        from kubernetes_tpu.apiserver.requestcontext import request_user
+        from kubernetes_tpu.apiserver.auth import UserInfo
+
+        api = APIServer()
+        with request_user(UserInfo(name="mallory", groups=("devs",))):
+            created = api.create(
+                "certificatesigningrequests", _bootstrap_csr(name="mut"))
+            created.spec.username = "system:bootstrap:abcdef"
+            created.spec.groups = ["system:bootstrappers"]
+            updated = api.update("certificatesigningrequests", created)
+        assert updated.spec.username == "mallory"
+        assert updated.spec.groups == ["devs"]
+
+    def test_malformed_request_marks_failed_not_wedged(self, cluster):
+        """Non-JSON spec.request must not wedge the signer in a requeue
+        loop: it gets a Failed condition (approver simply ignores it)."""
+        api, cs, factory, start = cluster
+        ca = CertificateAuthority()
+        start(CSRApprovingController(cs, factory),
+              CSRSigningController(cs, factory, ca=ca))
+        bad = _bootstrap_csr(name="garbled")
+        bad.spec.request = "not-json"
+        created = cs.resource("certificatesigningrequests").create(bad)
+        # approve it manually so the signer actually looks at it
+        created.status.conditions = [
+            certsapi.CertificateSigningRequestCondition(
+                type=certsapi.APPROVED, reason="Manual")]
+        cs.resource("certificatesigningrequests").update_status(created)
+
+        def failed():
+            cur = cs.resource("certificatesigningrequests").get("garbled")
+            return certsapi.has_condition(cur, certsapi.FAILED)
+
+        assert wait_until(failed), "malformed CSR not marked Failed"
+        cur = cs.resource("certificatesigningrequests").get("garbled")
+        assert not cur.status.certificate
+
     def test_join_refuses_foreign_csr(self):
         """join(via_csr=True) must not adopt a pre-existing CSR for a
         different identity (credential-harvest guard)."""
