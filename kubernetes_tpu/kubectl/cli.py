@@ -205,9 +205,36 @@ class Kubectl:
         p.add_argument("-c", "--container", default="")
         p.add_argument("cmd", nargs="+")  # after `--` in real kubectl
 
+        p = sub.add_parser("patch")
+        p.add_argument("resource")
+        p.add_argument("name")
+        p.add_argument("-p", "--patch", required=True)
+        p.add_argument("--type", default="strategic",
+                       choices=["strategic", "merge", "json"])
+        p.add_argument("--subresource", default="", choices=["", "status"])
+
+        p = sub.add_parser("attach")
+        p.add_argument("pod")
+        p.add_argument("-c", "--container", default="")
+        p.add_argument("--read-timeout", type=float, default=2.0)
+
+        p = sub.add_parser("port-forward")
+        p.add_argument("pod")
+        p.add_argument("port", type=int)
+        p.add_argument("--send", default="",
+                       help="data to forward (stdin when omitted)")
+
+        p = sub.add_parser("wait")
+        p.add_argument("resource")
+        p.add_argument("name")
+        p.add_argument("--for", dest="condition", required=True,
+                       help="delete | condition=Type[=Value] | "
+                            "jsonpath-lite field=value")
+        p.add_argument("--timeout", type=float, default=30.0)
+
         args = parser.parse_args(argv)
         try:
-            getattr(self, f"cmd_{args.verb}")(args)
+            getattr(self, f"cmd_{args.verb.replace('-', '_')}")(args)
             return 0
         except APIError as e:
             self._print(f"Error: {e}")
@@ -601,6 +628,119 @@ class Kubectl:
         if code != 0:
             raise APIError(f"command terminated with exit code {code}")
 
+    def cmd_patch(self, args) -> None:
+        """kubectl patch (pkg/cmd/patch): merge-patch (RFC 7386 — maps
+        merge recursively, null deletes, lists replace) or JSON-patch
+        (RFC 6902 add/replace/remove). `strategic` is accepted and
+        applied with merge semantics: the strategic merge keys
+        (patchMergeKey tags) are a codegen artifact this build's types
+        don't carry; for list fields the merge-patch replace rule
+        applies."""
+        import copy as _copy
+
+        from ..apiserver.webhook import apply_json_patch
+
+        resource = self._resource(args.resource)
+        client = self._client(resource)
+        ns = args.namespace if self._namespaced(resource) else ""
+        obj = client.get(args.name, ns)
+        body = serde.to_dict(obj)
+        # malformed patches must surface as 'Error: ...' + exit 1 like
+        # every other bad input, not a traceback (run() catches APIError)
+        try:
+            patch = json.loads(args.patch)
+            if args.type == "json":
+                patched = apply_json_patch(_copy.deepcopy(body), patch)
+            else:
+                patched = _merge_patch(body, patch)
+            info = self.cs.api._info(resource)
+            new_obj = serde.from_dict(info.type, patched)
+        except APIError:
+            raise
+        except Exception as e:  # noqa: BLE001 — json/pointer/shape errors
+            raise APIError(f"invalid patch: {e}")
+        new_obj.metadata.resource_version = obj.metadata.resource_version
+        if args.subresource == "status":
+            client.update_status(new_obj)
+        else:
+            client.update(new_obj)
+        self._print(f"{resource}/{args.name} patched")
+
+    def cmd_attach(self, args) -> None:
+        """kubectl attach (pkg/cmd/attach): stream the running
+        container's output over the apiserver→kubelet attach session
+        (kubelet/streaming.py) until the stream closes or goes idle."""
+        try:
+            session = self.cs.api.pod_attach(
+                args.pod, args.namespace, args.container
+            )
+        except KeyError as e:
+            raise APIError(str(e))
+        try:
+            while True:
+                try:
+                    chunk = session.read_stdout(timeout=args.read_timeout)
+                except TimeoutError:
+                    break  # stream idle: detach (real kubectl stays; this
+                    # CLI is non-interactive)
+                if chunk is None:
+                    break
+                self.out.write(chunk.decode(errors="replace"))
+            self.out.flush()
+        finally:
+            session.close()
+
+    def cmd_port_forward(self, args) -> None:
+        """kubectl port-forward (pkg/cmd/portforward): forward one
+        round of data through the pod's port-forward stream. The real
+        kubectl binds a local socket; this terminal-less build forwards
+        --send (or stdin) and prints the response."""
+        data = args.send.encode() if args.send else sys.stdin.buffer.read()
+        try:
+            session = self.cs.api.pod_portforward(
+                args.pod, args.namespace, args.port
+            )
+        except KeyError as e:
+            raise APIError(str(e))
+        try:
+            if data:
+                session.write_stdin(data)
+            try:
+                reply = session.read_stdout(timeout=5.0)
+            except TimeoutError:
+                reply = None
+            if reply is not None:
+                self.out.write(reply.decode(errors="replace"))
+                self.out.flush()
+        finally:
+            session.close()
+
+    def cmd_wait(self, args) -> None:
+        """kubectl wait (pkg/cmd/wait): block until --for is met.
+        Supports `delete`, `condition=Type[=Value]` (status.conditions),
+        and a field=value form over dotted status paths
+        (e.g. status.phase=Running)."""
+        resource = self._resource(args.resource)
+        client = self._client(resource)
+        ns = args.namespace if self._namespaced(resource) else ""
+        want = args.condition
+        deadline = time.time() + args.timeout
+        while time.time() < deadline:
+            try:
+                obj = client.get(args.name, ns)
+            except NotFound:
+                if want == "delete":
+                    self._print(f"{resource}/{args.name} condition met")
+                    return
+                time.sleep(0.1)
+                continue
+            if want != "delete" and _wait_condition_met(obj, want):
+                self._print(f"{resource}/{args.name} condition met")
+                return
+            time.sleep(0.1)
+        raise APIError(f"timed out waiting for {want!r} on "
+                       f"{resource}/{args.name}")
+
     def cmd_top(self, args) -> None:
         """kubectl top nodes|pods from the metrics API (metrics.k8s.io;
         staging/src/k8s.io/kubectl/pkg/cmd/top)."""
@@ -640,6 +780,43 @@ class Kubectl:
         self._print("   ".join(h.ljust(w) for h, w in zip(hdr, widths)).rstrip())
         for r in rows:
             self._print("   ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+
+
+def _merge_patch(body: Dict, patch: Any) -> Any:
+    """RFC 7386 merge patch: maps merge recursively, null deletes keys,
+    everything else (lists, scalars) replaces. (RFC 6902 json patches
+    reuse apiserver/webhook.py apply_json_patch — one implementation.)"""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(body, dict):
+        body = {}
+    out = dict(body)
+    for k, pv in patch.items():
+        if pv is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge_patch(out.get(k), pv)
+    return out
+
+
+def _wait_condition_met(obj, want: str) -> bool:
+    """condition=Type[=Value] over status.conditions, or a dotted
+    field=value check (status.phase=Running)."""
+    if want.startswith("condition="):
+        spec = want[len("condition="):]
+        ctype, _, cval = spec.partition("=")
+        cval = cval or "True"
+        for cond in getattr(obj.status, "conditions", None) or []:
+            if cond.type == ctype and cond.status == cval:
+                return True
+        return False
+    field, _, val = want.partition("=")
+    cur: Any = obj
+    for part in field.split("."):
+        cur = getattr(cur, part, None)
+        if cur is None:
+            return False
+    return str(cur) == val
 
 
 def _fmt_mem(qty: str) -> str:

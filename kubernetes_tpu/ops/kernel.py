@@ -656,3 +656,20 @@ def _jitted(c, p, weights_key):
 def schedule_pod_jit(c: Dict, p: Dict, weights: Dict[str, int] = None) -> Dict:
     key = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     return _jitted(c, p, key)
+
+
+@functools.partial(jax.jit, static_argnames=("weights_key",))
+def _jitted_vmapped(c, P, weights_key):
+    return jax.vmap(lambda p: schedule_pod(c, p, dict(weights_key)))(P)
+
+
+def schedule_pods_jit(c: Dict, P: Dict, weights: Dict[str, int] = None) -> Dict:
+    """Batched independent evaluation: every pod in the stacked arrays P
+    ([B, ...] rows) against the SAME cluster state — per-pod masks,
+    scores and totals in one dispatch. This is the status-recovery path
+    for preemption dry-runs (default_preemption.go:320 dryRunPreemption
+    consumes per-node failure statuses): re-dispatching failed pods one
+    at a time was a session teardown + a full kernel launch each over
+    the tunnel; one vmapped launch amortizes all of it."""
+    key = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+    return _jitted_vmapped(c, P, key)

@@ -39,6 +39,7 @@ class PodTemplate:
     cpu: str = "100m"
     memory: str = "128Mi"
     labels: Dict[str, str] = field(default_factory=lambda: {"app": "perf"})
+    priority: Optional[int] = None  # spec.priority (preemption workloads)
     spread_zone: bool = False  # PodTopologySpread on zone, ScheduleAnyway
     spread_zone_hard: bool = False  # maxSkew=1 DoNotSchedule on zone
     spread_hostname_hard: bool = False  # maxSkew=1 DoNotSchedule on hostname
@@ -99,6 +100,7 @@ class PodTemplate:
             cpu=self.cpu,
             memory=self.memory,
             labels=dict(self.labels),
+            priority=self.priority,
             constraints=constraints or None,
             affinity=affinity,
             extended=self.extended,
@@ -115,6 +117,12 @@ class Workload:
     num_pods: int = 0  # measured
     init_template: PodTemplate = field(default_factory=PodTemplate)
     template: PodTemplate = field(default_factory=PodTemplate)
+    # churn mixing: every `second_every`-th measured pod is stamped from
+    # second_template instead (e.g. permanently-unschedulable pods
+    # churning between schedulable ones — the reference's Unschedulable
+    # workload variants); 0 disables
+    second_template: Optional[PodTemplate] = None
+    second_every: int = 0
     backend: str = "tpu"
     n_zones: int = 3
     max_batch: int = 128
@@ -294,7 +302,11 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         # batches; the reference's harness likewise measures scheduling,
         # not client-side creation
         def _create_measured(i):
-            pod = w.template.build(f"measure-{i}")
+            tmpl = w.template
+            if w.second_every and w.second_template is not None \
+                    and i % w.second_every == 0:
+                tmpl = w.second_template
+            pod = tmpl.build(f"measure-{i}")
             if w.gang_size > 1:
                 # annotations, not labels: gang identity must not enter
                 # the encoded self rows (see coscheduling.pod_group)
@@ -329,15 +341,22 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         last_bound, last_t = 0, t0
         stall_since = t0
         deadline = t0 + w.timeout
+        last_att = 0
         while time.perf_counter() < deadline:
             time.sleep(1.0)
             bound = bound_count() - bound0
+            att = total_attempts() - attempts0
             now = time.perf_counter()
             samples.append((bound - last_bound) / (now - last_t))
             sample_times.append(now)
-            if bound != last_bound:
+            # the stall clock runs only while the scheduler is live but
+            # not progressing: ATTEMPTS reset it too (a preemption wave
+            # records failures long before its first bind), and nothing
+            # counts as a stall before the first attempt (the first
+            # dispatch of a fresh shape can compile for >30s on the chip)
+            if bound != last_bound or att != last_att or (bound == 0 and att == 0):
                 stall_since = now
-            last_bound, last_t = bound, now
+            last_bound, last_t, last_att = bound, now, att
             if bound >= w.num_pods:
                 break
             if w.stall_stop and now - stall_since >= w.stall_stop:
@@ -353,7 +372,12 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
                 s for s, ts in zip(samples, sample_times) if ts <= stall_since
             ] or samples[:1]
         pods, _ = cs.pods.list(namespace="default")
-        bound_measured = sum(1 for p in pods if p.spec.node_name) - w.num_init_pods
+        # count bound MEASURED pods by name: preemption workloads evict
+        # init pods, so "total bound minus num_init" would undercount
+        bound_measured = sum(
+            1 for p in pods
+            if p.spec.node_name and p.metadata.name.startswith("measure-")
+        )
         # exact per-pod latency percentiles over the measured pods: the
         # scheduler's sample ring holds (e2e, attempt, attempts) tuples;
         # take the most recent num_pods entries (init pods scheduled

@@ -331,6 +331,7 @@ class Scheduler:
         )
         min_prio: Optional[int] = None
         bound: List[Tuple] = []  # (info, node)
+        redispatch: List = []  # failed infos needing statuses (preemption)
         for info in todo:
             node = by_key.get(v1.pod_key(info.pod))
             if node is None:
@@ -339,17 +340,40 @@ class Scheduler:
                 if not has_post_filter or (info.pod.spec.priority or 0) <= min_prio:
                     self._record_failure(info, cycle, {})
                     continue
-                # re-dispatch singly to recover per-node failure statuses
-                # for the preemption dry-run (FitError carries them)
-                try:
-                    r = self.tpu.schedule(info.pod)
-                    self._assume_and_bind(info.pod, r.suggested_host, info=info)
-                except FitError as fe:
-                    self._record_failure(info, cycle, fe.filtered_nodes_statuses)
+                redispatch.append(info)
             else:
                 bound.append((info, node))
         if bound:
             self._assume_and_bind_batch(bound)
+        if redispatch:
+            # ONE batched re-evaluation recovers per-node failure
+            # statuses for every failed pod (the preemption dry-run's
+            # input) — the per-pod schedule() this replaces was a session
+            # teardown + full kernel launch each (r2's preemption crawl).
+            # A pod that now FITS (state moved since its batch) binds;
+            # the batched evaluation is against one state, so only the
+            # first fit binds directly — later fits re-dispatch singly to
+            # keep sequential-assume semantics (rare: failure waves
+            # mostly stay failed).
+            bound_once = False
+            for info, (node, statuses) in zip(
+                redispatch, self.tpu.reevaluate([i.pod for i in redispatch])
+            ):
+                if node is None:
+                    self._record_failure(info, cycle, statuses)
+                elif not bound_once:
+                    bound_once = True
+                    self._assume_and_bind(info.pod, node, info=info)
+                else:
+                    try:
+                        r = self.tpu.schedule(info.pod)
+                        self._assume_and_bind(
+                            info.pod, r.suggested_host, info=info
+                        )
+                    except FitError as fe:
+                        self._record_failure(
+                            info, cycle, fe.filtered_nodes_statuses
+                        )
 
     def _assume_and_bind_batch(self, bound: List[Tuple]) -> None:
         """Batched assume + binding-cycle kickoff. Per-pod semantics match

@@ -186,6 +186,81 @@ class TPUBackend(CacheListener):
             best = self._select_host(total, feasible)
             return ScheduleResult(self.enc.node_names[best], n_nodes, n_feasible)
 
+    def reevaluate(self, pods: List[v1.Pod]) -> List[Tuple[Optional[str], Dict]]:
+        """Batched re-evaluation of FAILED pods against current state:
+        per pod, (best node | None, per-node failure statuses). One
+        vmapped kernel dispatch per shape group instead of a per-pod
+        schedule() (each of which was a session teardown + a full
+        launch over the tunnel — the r2 preemption-workload crawl).
+        Statuses feed the DefaultPreemption dry-run
+        (default_preemption.go:320); a pod that now fits (state moved
+        since its batch was dispatched) gets its node directly."""
+        from ..ops.kernel import schedule_pods_jit
+
+        results: List[Tuple[Optional[str], Dict]] = []
+        with self._lock:
+            self._flush_pending()
+            # device_state() with dirty rows donates buffers a live
+            # session still references — same discipline as schedule()
+            self._invalidate_session()
+            c = self.enc.device_state()
+            if self.mesh is not None:
+                from ..parallel import sharded
+
+                c = sharded.shard_cluster(c, self.mesh)
+            n_nodes = self.enc.n_nodes
+            encoded = [
+                {k: v for k, v in self.pe.encode(p).items()
+                 if not k.startswith("_")}
+                for p in pods
+            ]
+            # group by shape signature so each group stacks; chunk to a
+            # FIXED width — the kernel's per-pod PTS/IPA sweeps are
+            # [P]-sized, so an unbounded vmap width makes XLA chew on a
+            # [B, P, ...] program (a 500-wide vmap at 500 nodes compiled
+            # for minutes); 32-wide chunks bound the program and reuse
+            # one compile across waves (rows are padded by repeating row
+            # 0 — outputs for pads are discarded)
+            CHUNK = 32
+            out_rows: List[Tuple[Dict, int]] = [None] * len(pods)
+            # group by shape via a sort (results are written back by
+            # original index, so order is free): interleaved shapes must
+            # not produce one padded chunk per 1-2 pods
+            by_shape: Dict[Tuple, List[int]] = {}
+            for idx, e in enumerate(encoded):
+                by_shape.setdefault(shape_signature(e), []).append(idx)
+            for group in by_shape.values():
+                for lo in range(0, len(group), CHUNK):
+                    chunk = group[lo:lo + CHUNK]
+                    pad = CHUNK - len(chunk)
+                    stacked = {
+                        k: np.stack(
+                            [np.asarray(encoded[g][k]) for g in chunk]
+                            + [np.asarray(encoded[chunk[0]][k])] * pad
+                        )
+                        for k in encoded[chunk[0]]
+                    }
+                    if self.mesh is not None:
+                        from ..parallel import sharded
+
+                        stacked = sharded.replicate_pod(stacked, self.mesh)
+                    outs = schedule_pods_jit(c, stacked, self.weights)
+                    outs = {k: np.asarray(v) for k, v in outs.items()}
+                    for row, g in enumerate(chunk):
+                        out_rows[g] = (outs, row)
+            for g, pod in enumerate(pods):
+                outs, row = out_rows[g]
+                feasible = outs["feasible"][row][:n_nodes]
+                if feasible.any():
+                    total = outs["total"][row][:n_nodes]
+                    best = self._select_host(total, feasible)
+                    results.append((self.enc.node_names[best], {}))
+                else:
+                    results.append(
+                        (None, self._statuses(outs, n_nodes, row=row))
+                    )
+        return results
+
     # -- pipelined batch API -----------------------------------------------
     # The session dispatch is ASYNC (HoistedSession.schedule returns device
     # arrays without blocking; batch k+1's scan chains on k's carry as a
@@ -455,11 +530,19 @@ class TPUBackend(CacheListener):
         masked = np.where(feasible, total, np.iinfo(np.int64).min)
         return int(np.argmax(masked))
 
-    def _statuses(self, out: Dict, n_nodes: int) -> Dict[str, Status]:
+    def _statuses(
+        self, out: Dict, n_nodes: int, row: Optional[int] = None
+    ) -> Dict[str, Status]:
+        """row selects one pod of a batched (vmapped) output."""
         statuses: Dict[str, Status] = {}
-        masks = {k: np.asarray(out[k]) for k, _ in MASK_PLUGINS}
-        pts_unres = np.asarray(out["pts_unresolvable"])
-        ipa_unres = np.asarray(out["ipa_unresolvable"])
+
+        def arr(key):
+            a = np.asarray(out[key])
+            return a[row] if row is not None else a
+
+        masks = {k: arr(k) for k, _ in MASK_PLUGINS}
+        pts_unres = arr("pts_unresolvable")
+        ipa_unres = arr("ipa_unresolvable")
         for i in range(n_nodes):
             failed = [name for key, name in MASK_PLUGINS if not masks[key][i]]
             if not failed:

@@ -28,6 +28,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
 
+from kubernetes_tpu.utils.compilation_cache import (  # noqa: E402
+    enable_persistent_cache,
+)
+
+enable_persistent_cache()
+
 from kubernetes_tpu.perf.harness import (  # noqa: E402
     PodTemplate,
     Workload,
@@ -80,6 +86,30 @@ CONFIGS = {
         template=PodTemplate(extended={"example.com/gpu": "1"}),
         node_extended={"example.com/gpu": "8"},
         max_batch=2048, timeout=900.0,
+    ),
+    # Preemption (performance-config.yaml Preemption section shape):
+    # 500 nodes saturated by 2000 low-priority pods (4 x 900m fills a
+    # 4-CPU node); 500 high-priority pods must each evict a victim via
+    # the DefaultPreemption dry-run, then bind on the freed node
+    "preemption": Workload(
+        "Preemption-500n-500hi", num_nodes=500, num_init_pods=2000,
+        num_pods=500,
+        init_template=PodTemplate(cpu="900m", memory="64Mi", priority=1),
+        template=PodTemplate(cpu="900m", memory="64Mi", priority=100),
+        max_batch=512, timeout=900.0, stall_stop=30.0,
+    ),
+    # Unschedulable churn (the reference's Unschedulable workload
+    # variants): every 3rd measured pod requests 8 CPU (> any node) and
+    # churns permanently; the schedulable majority binds through the
+    # noise. stall_stop ends the run once only churners remain.
+    "unschedchurn": Workload(
+        "Unschedulable-churn-500n", num_nodes=500, num_init_pods=1000,
+        num_pods=3000,
+        init_template=PodTemplate(spread_zone=True),
+        template=PodTemplate(spread_zone=True),
+        second_template=PodTemplate(cpu="8", memory="64Gi"),
+        second_every=3,
+        max_batch=1024, timeout=900.0, stall_stop=15.0,
     ),
 }
 

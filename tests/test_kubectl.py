@@ -295,3 +295,111 @@ class TestLogsExec:
         out = io.StringIO()
         assert Kubectl(cs, out=out).run(["logs", "web"]) == 1
         assert "no kubelet connection" in out.getvalue()
+
+
+class TestPatch:
+    def test_merge_patch_labels(self, kubectl):
+        k, cs, out = kubectl
+        cs.pods.create(make_pod("p1", labels={"app": "a", "tier": "web"}))
+        assert k.run([
+            "patch", "pods", "p1",
+            "-p", '{"metadata":{"labels":{"app":"b","tier":null}}}',
+        ]) == 0
+        pod = cs.pods.get("p1", "default")
+        assert pod.metadata.labels == {"app": "b"}
+        assert "patched" in out.getvalue()
+
+    def test_json_patch_replace_and_remove(self, kubectl):
+        k, cs, out = kubectl
+        cs.pods.create(make_pod("p2", labels={"app": "a", "x": "1"}))
+        assert k.run([
+            "patch", "pods", "p2", "--type", "json",
+            "-p", json.dumps([
+                {"op": "replace", "path": "/metadata/labels/app",
+                 "value": "z"},
+                {"op": "remove", "path": "/metadata/labels/x"},
+            ]),
+        ]) == 0
+        pod = cs.pods.get("p2", "default")
+        assert pod.metadata.labels == {"app": "z"}
+
+    def test_patch_status_subresource(self, kubectl):
+        k, cs, out = kubectl
+        cs.pods.create(make_pod("p3"))
+        assert k.run([
+            "patch", "pods", "p3", "--subresource", "status",
+            "-p", '{"status":{"phase":"Running"}}',
+        ]) == 0
+        assert cs.pods.get("p3", "default").status.phase == "Running"
+
+
+class TestWait:
+    def test_wait_for_field_and_delete(self, kubectl):
+        import threading
+        import time as _time
+
+        k, cs, out = kubectl
+        cs.pods.create(make_pod("w1"))
+
+        def later():
+            _time.sleep(0.3)
+            p = cs.pods.get("w1", "default")
+            p.status.phase = "Running"
+            cs.pods.update_status(p)
+
+        threading.Thread(target=later, daemon=True).start()
+        assert k.run([
+            "wait", "pods", "w1", "--for", "status.phase=Running",
+            "--timeout", "5",
+        ]) == 0
+
+        def delete_later():
+            _time.sleep(0.3)
+            cs.pods.delete("w1", "default")
+
+        threading.Thread(target=delete_later, daemon=True).start()
+        assert k.run([
+            "wait", "pods", "w1", "--for", "delete", "--timeout", "5",
+        ]) == 0
+
+    def test_wait_timeout_fails(self, kubectl):
+        k, cs, out = kubectl
+        cs.pods.create(make_pod("w2"))
+        assert k.run([
+            "wait", "pods", "w2", "--for", "status.phase=Running",
+            "--timeout", "0.4",
+        ]) == 1
+        assert "timed out" in out.getvalue()
+
+
+class TestAttachPortForward:
+    """kubectl attach / port-forward over the streaming sessions
+    (kubelet/streaming.py; staging kubectl pkg/cmd/{attach,portforward})."""
+
+    def test_attach_streams_container_output(self):
+        t = TestLogsExec()
+        api, cs, kl = t._cluster()
+        try:
+            out = io.StringIO()
+            assert Kubectl(cs, out=out).run(
+                ["attach", "web", "--read-timeout", "0.5"]
+            ) == 0
+            assert "starting" in out.getvalue()
+        finally:
+            kl.stop()
+
+    def test_port_forward_roundtrip(self):
+        t = TestLogsExec()
+        api, cs, kl = t._cluster()
+        try:
+            for sb in kl.runtime.list_pod_sandboxes():
+                if sb.pod_name == "web":
+                    kl.runtime.register_port_server(
+                        sb.id, 8080, lambda b: b"echo:" + b)
+            out = io.StringIO()
+            assert Kubectl(cs, out=out).run(
+                ["port-forward", "web", "8080", "--send", "hello"]
+            ) == 0
+            assert out.getvalue() == "echo:hello"
+        finally:
+            kl.stop()
