@@ -1,0 +1,73 @@
+"""Measure the HTTP wire tax ONCE (VERDICT r2 missing #6): the same
+workload through the full scheduler loop, in-proc vs over the real HTTP
+apiserver (apiserver/http.py socket + RemoteAPIServer clients — the
+boundary the reference's scheduler_perf always crosses, util.go:61).
+
+Writes one JSON line per mode to BENCH_WIRE.json.
+
+Usage: python scripts/bench_wire.py [nodes] [pods]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+from kubernetes_tpu.utils.compilation_cache import (  # noqa: E402
+    enable_persistent_cache,
+)
+
+enable_persistent_cache()
+
+from kubernetes_tpu.perf.harness import (  # noqa: E402
+    PodTemplate,
+    Workload,
+    run_workload,
+)
+
+
+def main() -> None:
+    n_nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    n_pods = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+    out_path = os.path.join(os.path.dirname(__file__), "..", "BENCH_WIRE.json")
+    lines = []
+    for wire in (False, True):
+        w = Workload(
+            f"WireTax-{n_nodes}n-{'http' if wire else 'inproc'}",
+            num_nodes=n_nodes, num_init_pods=2048, num_pods=n_pods,
+            init_template=PodTemplate(spread_zone=True),
+            template=PodTemplate(spread_zone=True),
+            max_batch=1024, timeout=900.0, wire=wire,
+        )
+        r = run_workload(w)
+        line = r.to_dict()
+        line["wire"] = wire
+        print(json.dumps(line), flush=True)
+        lines.append(line)
+    inproc = next(ln for ln in lines if not ln["wire"])
+    http = next(ln for ln in lines if ln["wire"])
+    summary = {
+        "name": "WireTaxSummary",
+        "inproc_pods_per_sec": inproc["throughput_avg"],
+        "http_pods_per_sec": http["throughput_avg"],
+        "wire_tax_pct": round(
+            100.0 * (1 - http["throughput_avg"]
+                     / max(inproc["throughput_avg"], 1e-9)), 1),
+    }
+    print(json.dumps(summary), flush=True)
+    with open(out_path, "w") as f:
+        for ln in lines + [summary]:
+            f.write(json.dumps(ln) + "\n")
+
+
+if __name__ == "__main__":
+    main()

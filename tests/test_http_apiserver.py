@@ -299,3 +299,24 @@ class TestWireRoutingEdges:
         assert resp.status == 201
         assert created["metadata"]["namespace"] == "team-a"
         assert remote.get("pods", "bare", "team-a").metadata.name == "bare"
+
+
+class TestBulkBindings:
+    def test_bulk_bind_outcomes(self, wire):
+        srv, remote = wire
+        cs = Clientset(remote)
+        cs.nodes.create(make_node("n1"))
+        cs.pods.create(make_pod("a"))
+        cs.pods.create(make_pod("b"))
+        # b is pre-bound elsewhere: its bulk outcome must be a Conflict
+        remote.bind_pod("default", "b", "n-other")
+        outcomes = remote.bind_pods([
+            ("default", "a", "n1"),
+            ("default", "b", "n1"),       # already bound -> error
+            ("default", "missing", "n1"),  # no such pod -> error
+        ])
+        assert outcomes[0] is None
+        assert outcomes[1] is not None and "already assigned" in str(outcomes[1])
+        assert outcomes[2] is not None
+        assert cs.pods.get("a", "default").spec.node_name == "n1"
+        assert cs.pods.get("b", "default").spec.node_name == "n-other"
