@@ -283,6 +283,33 @@ class ClusterEncoding:
     def n_nodes(self) -> int:
         return len(self._node_order)
 
+    @staticmethod
+    def node_fingerprint(node: v1.Node) -> tuple:
+        """Identity of the scheduling-relevant node state — EXACTLY the
+        fields this encoding consumes (_intern_node_vocabs +
+        _encode_node_row below: labels, the prefer-avoid annotation,
+        taints, unschedulable, allocatable-or-capacity, images). The
+        TPU backend's heartbeat gate compares these so status-only
+        updates (conditions/timestamps, what kubelets patch every ~10s)
+        don't tear down the device session or force a rebuild. KEEP IN
+        LOCK-STEP with the consumers below: a field consumed but not
+        fingerprinted would make the gate serve stale state."""
+        st = node.status
+        return (
+            tuple(sorted((node.metadata.labels or {}).items())),
+            (node.metadata.annotations or {}).get(
+                PREFER_AVOID_PODS_ANNOTATION, ""),
+            tuple(
+                (t.key, t.value, t.effect) for t in node.spec.taints or []
+            ),
+            bool(node.spec.unschedulable),
+            tuple(sorted(((st.allocatable or st.capacity) or {}).items())),
+            tuple(sorted(
+                (tuple(sorted(img.names or [])), img.size_bytes)
+                for img in st.images or []
+            )),
+        )
+
     # -- encoding internals -------------------------------------------------
 
     def _intern_node_vocabs(self, node: v1.Node) -> None:

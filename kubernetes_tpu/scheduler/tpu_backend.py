@@ -98,6 +98,7 @@ class TPUBackend(CacheListener):
         # batch rebuilds it from the synced encoding.
         self._session = None  # HoistedSession or pallas PallasSession
         self._session_assumed: set = set()
+        self._node_fps: Dict[str, tuple] = {}  # heartbeat-change gate
         self._known_templates: Dict = {}  # fingerprint -> pod arrays
         self._pending: Optional[_BatchHandle] = None  # one in-flight batch
         self.MAX_SESSION_TEMPLATES = 8
@@ -138,16 +139,29 @@ class TPUBackend(CacheListener):
 
     def on_add_node(self, node: v1.Node) -> None:
         with self._lock:
+            self._node_fps[node.metadata.name] = ClusterEncoding.node_fingerprint(node)
             self._invalidate_session()
             self.enc.add_node(node)
 
     def on_update_node(self, node: v1.Node) -> None:
         with self._lock:
+            # heartbeat gate: kubelets PATCH node status every ~10s
+            # (conditions + heartbeat timestamps), none of which the
+            # encoding consumes — tearing down the session (and forcing
+            # a full encoding rebuild) per heartbeat would make the
+            # cross-batch session useless in a live cluster. Only
+            # scheduling-relevant changes (labels, annotations, taints,
+            # unschedulable, allocatable/capacity, images) invalidate.
+            fp = ClusterEncoding.node_fingerprint(node)
+            if self._node_fps.get(node.metadata.name) == fp:
+                return
+            self._node_fps[node.metadata.name] = fp
             self._invalidate_session()
             self.enc.update_node(node)
 
     def on_remove_node(self, node_name: str) -> None:
         with self._lock:
+            self._node_fps.pop(node_name, None)
             self._invalidate_session()
             self.enc.remove_node(node_name)
 

@@ -536,3 +536,47 @@ class TestPipelinedDispatch:
         except FitError:
             pass
         assert h.results is not None, "schedule() must flush the pipeline"
+
+
+class TestHeartbeatGate:
+    """Node STATUS heartbeats (conditions/timestamps — what kubelets
+    patch every ~10s) must NOT tear down the cross-batch session or
+    force an encoding rebuild; scheduling-relevant changes must."""
+
+    def _backend(self):
+        import random as _random
+
+        from kubernetes_tpu.scheduler.tpu_backend import TPUBackend
+        from kubernetes_tpu.testing.synth import synth_cluster as sc
+
+        nodes, init_pods = sc(6, pods_per_node=1)
+        b = TPUBackend(rng=_random.Random(0))
+        for n in nodes:
+            b.on_add_node(n)
+        for p in init_pods:
+            b.on_add_pod(p, p.spec.node_name)
+        return b, nodes
+
+    def test_heartbeat_keeps_session(self):
+        import copy
+
+        from kubernetes_tpu.testing.synth import synth_pending_pods
+
+        b, nodes = self._backend()
+        pending = synth_pending_pods(4, spread=True)
+        b.schedule_many(pending[:2])
+        assert b._session is not None
+        # heartbeat: same spec/labels/allocatable, new conditions
+        hb = copy.deepcopy(nodes[0])
+        hb.status.conditions = [
+            __import__("kubernetes_tpu.api.types", fromlist=["x"])
+            .NodeCondition(type="Ready", status="True",
+                           last_heartbeat_time=12345.0)
+        ]
+        b.on_update_node(hb)
+        assert b._session is not None, "heartbeat must not kill the session"
+        # real change: cordon the node
+        cordoned = copy.deepcopy(nodes[0])
+        cordoned.spec.unschedulable = True
+        b.on_update_node(cordoned)
+        assert b._session is None, "cordon must invalidate the session"
