@@ -559,6 +559,9 @@ class PersistentVolumeSpec:
     claim_ref_name: str = ""
     node_affinity: Optional[VolumeNodeAffinity] = None
     persistent_volume_reclaim_policy: str = ""
+    # volume source (PersistentVolumeSource, types.go): only the CSI
+    # member carries scheduling semantics here (driver -> attach limits)
+    csi: Optional[Dict[str, str]] = None  # {driver, volumeHandle}
 
 
 @dataclass

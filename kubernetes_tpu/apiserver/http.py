@@ -518,6 +518,7 @@ class RemoteAPIServer:
         import http.client
 
         conn = getattr(self._local, "conn", None)
+        fresh = False
         if conn is None or conn.sock is None:
             # conn.sock is None after the server closed the socket (every
             # error response sends Connection: close): http.client would
@@ -533,7 +534,8 @@ class RemoteAPIServer:
                 socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
             )
             self._local.conn = conn
-        return conn
+            fresh = True
+        return conn, fresh
 
     def _drop_conn(self) -> None:
         conn = getattr(self._local, "conn", None)
@@ -553,14 +555,17 @@ class RemoteAPIServer:
             headers["Authorization"] = f"Bearer {self.token}"
         url = path + (f"?{query}" if query else "")
         for attempt in (0, 1):
-            conn = self._conn()
+            conn, fresh = self._conn()
             try:
-                # send phase: a stale kept-alive socket fails HERE before
-                # the server saw the request — safe to retry any verb once
+                # send phase: a STALE kept-alive socket fails here before
+                # the server saw the request — safe to retry any verb
+                # once. On a freshly-connected socket the failure can be
+                # mid-send (headers+body partially flushed and possibly
+                # parsed server-side), so only idempotent GETs retry then
                 conn.request(method, url, body=payload, headers=headers)
             except (http.client.HTTPException, OSError):
                 self._drop_conn()
-                if attempt:
+                if attempt or (fresh and method != "GET"):
                     raise
                 continue
             try:

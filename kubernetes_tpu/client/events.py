@@ -9,8 +9,9 @@ Recording is ASYNCHRONOUS, like the reference's broadcaster (event.go
 StartRecordingToSink drains a buffered watch channel on its own
 goroutine; Event() never blocks the caller on the API write — a full
 buffer drops the event). Here: event() enqueues onto a bounded deque
-serviced by a daemon thread; overflow drops the oldest entry. flush()
-waits for the queue to drain (tests; Scheduler.stop).
+serviced by a daemon thread; overflow drops the INCOMING event (the
+broadcaster's DropIfChannelFull) and counts it in dropped_events.
+flush() waits for the queue to drain (tests; Scheduler.stop).
 
 Events aggregate by (involved object, reason, message): a repeat bumps
 count instead of creating a new object (event_aggregator semantics).
@@ -60,7 +61,12 @@ class EventRecorder:
         self._component = component
         self._lock = threading.Lock()
         self._known: Dict[tuple, str] = {}  # aggregation key -> event name
-        self._queue: deque = deque(maxlen=self.MAX_QUEUE)  # overflow drops oldest
+        # unbounded deque, bounded by hand in event(): the INCOMING event
+        # is dropped when full (watch.NewBroadcaster's DropIfChannelFull
+        # — a full channel never evicts already-queued events), counted
+        # in dropped_events
+        self._queue: deque = deque()
+        self.dropped_events = 0
         self._wake = threading.Event()
         self._idle = threading.Event()
         self._idle.set()
@@ -79,6 +85,9 @@ class EventRecorder:
             uid=obj.metadata.uid,
         )
         with self._lock:
+            if len(self._queue) >= self.MAX_QUEUE:
+                self.dropped_events += 1
+                return
             self._idle.clear()
             self._queue.append((ref, event_type, reason, message, time.time()))
             if self._thread is None:

@@ -217,6 +217,21 @@ class CSRCleanerController(Controller):
                 resolved = (certs.has_condition(csr, certs.APPROVED)
                             or certs.has_condition(csr, certs.DENIED)
                             or certs.has_condition(csr, certs.FAILED))
+                if resolved:
+                    # age from the resolving condition's LastUpdateTime
+                    # (cleaner.go isOlderThan(c.LastUpdateTime, ...)): a
+                    # CSR pending >TTL that then gets approved must get a
+                    # fresh TTL for the signer to issue the certificate,
+                    # not be deleted out from under it
+                    created = max(
+                        [created] + [
+                            c.last_update_time
+                            for c in csr.status.conditions or []
+                            if c.type in (certs.APPROVED, certs.DENIED,
+                                          certs.FAILED)
+                            and c.last_update_time is not None
+                        ]
+                    )
                 expired_cert = False
                 if csr.status.certificate:
                     try:

@@ -34,6 +34,7 @@ from .garbagecollector import GarbageCollector
 from .job import JobController
 from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
+from .nodeipam import NodeIpamController
 from .nodettl import TTLController
 from .persistentvolume import PersistentVolumeController
 from .podautoscaler import HorizontalController
@@ -110,6 +111,13 @@ def new_controller_initializers() -> Dict[str, Callable]:
         "pvc-protection": lambda cs, inf, opts: PVCProtectionController(cs, inf),
         "pv-protection": lambda cs, inf, opts: PVProtectionController(cs, inf),
         "ttl": lambda cs, inf, opts: TTLController(cs, inf),
+        # central podCIDR range allocator (controllermanager.go:412
+        # startNodeIpamController; ipam/range_allocator.go:47)
+        "nodeipam": lambda cs, inf, opts: NodeIpamController(
+            cs, inf,
+            cluster_cidr=opts.get("cluster_cidr", "10.244.0.0/16"),
+            node_cidr_mask_size=opts.get("node_cidr_mask_size", 24),
+        ),
         # round-3 long tail (controllermanager.go:391,406-428)
         "csrsigning": lambda cs, inf, opts: CSRSigningController(
             cs, inf, ca=opts.get("csr_ca") or _default_ca(opts)

@@ -629,13 +629,11 @@ class Kubectl:
             raise APIError(f"command terminated with exit code {code}")
 
     def cmd_patch(self, args) -> None:
-        """kubectl patch (pkg/cmd/patch): merge-patch (RFC 7386 — maps
-        merge recursively, null deletes, lists replace) or JSON-patch
-        (RFC 6902 add/replace/remove). `strategic` is accepted and
-        applied with merge semantics: the strategic merge keys
-        (patchMergeKey tags) are a codegen artifact this build's types
-        don't carry; for list fields the merge-patch replace rule
-        applies."""
+        """kubectl patch (pkg/cmd/patch): strategic (RFC 7386 + merge-
+        by-patchMergeKey for the known list fields — containers, env,
+        ports, volumes, volumeMounts...; tolerations stay atomic, as in
+        the reference), merge-patch (RFC 7386 — lists replace
+        wholesale), or JSON-patch (RFC 6902 add/replace/remove)."""
         import copy as _copy
 
         from ..apiserver.webhook import apply_json_patch
@@ -651,6 +649,8 @@ class Kubectl:
             patch = json.loads(args.patch)
             if args.type == "json":
                 patched = apply_json_patch(_copy.deepcopy(body), patch)
+            elif args.type == "strategic":
+                patched = _strategic_merge(body, patch)
             else:
                 patched = _merge_patch(body, patch)
             info = self.cs.api._info(resource)
@@ -796,6 +796,90 @@ def _merge_patch(body: Dict, patch: Any) -> Any:
             out.pop(k, None)
         else:
             out[k] = _merge_patch(out.get(k), pv)
+    return out
+
+
+# strategic-merge patchMergeKey tags for the well-known list fields
+# (reference: the `patchMergeKey` struct tags in staging/src/k8s.io/api/
+# core/v1/types.go — e.g. PodSpec.Containers `patchMergeKey:"name"`,
+# Container.Ports `patchMergeKey:"containerPort"`, ServiceSpec.Ports
+# `patchMergeKey:"port"`; PodSpec.Tolerations has NO tag — atomic).
+# The reference derives these from codegen'd struct tags; this build's
+# types don't carry tags, so the daily-driver set is pinned by hand.
+# Fields whose merge key depends on the parent type ("ports") list the
+# candidates in order; the first key present in EVERY item of both
+# sides wins (untyped JSON has no parent type to dispatch on).
+_STRATEGIC_MERGE_KEYS = {
+    "containers": ("name",),
+    "initContainers": ("name",),
+    "ephemeralContainers": ("name",),
+    "env": ("name",),
+    "ports": ("containerPort", "port"),
+    "volumes": ("name",),
+    "volumeMounts": ("mountPath",),
+    "imagePullSecrets": ("name",),
+    "hostAliases": ("ip",),
+}
+
+
+def _strategic_merge(body: Dict, patch: Any, field: str = "") -> Any:
+    """Strategic merge patch: RFC 7386 semantics PLUS merge-by-key for
+    the known patchMergeKey lists — a patch naming one container by
+    `name` updates that container instead of replacing the whole list
+    (strategicpatch.StrategicMergePatch list-of-maps behavior)."""
+    if isinstance(patch, list):
+        key = next(
+            (
+                k
+                for k in _STRATEGIC_MERGE_KEYS.get(field, ())
+                if isinstance(body, list)
+                and all(isinstance(x, dict) and k in x for x in patch)
+                and all(isinstance(x, dict) and k in x for x in body)
+            ),
+            None,
+        )
+        if key:
+            out = list(body)
+            index = {x[key]: i for i, x in enumerate(out)}
+            for item in patch:
+                if item.get("$patch") == "delete":
+                    idx = index.get(item[key])
+                    if idx is not None:
+                        out[idx] = None
+                    continue
+                idx = index.get(item[key])
+                if idx is not None:
+                    out[idx] = _strategic_merge(out[idx], item)
+                else:
+                    index[item[key]] = len(out)
+                    out.append(item)
+            return [x for x in out if x is not None]
+        # atomic list replace (no merge key) — but never store directive
+        # markers into the object as data
+        for x in patch:
+            if isinstance(x, dict) and "$patch" in x:
+                raise ValueError(
+                    f"$patch directive in list field {field!r} without a "
+                    "known merge key is not supported"
+                )
+        return patch
+    if not isinstance(patch, dict):
+        return patch
+    if "$patch" in patch:
+        # map-level directives (e.g. {"$patch": "delete"} to clear a
+        # whole map) — unimplemented; rejecting beats silently storing
+        # the marker as object data
+        raise ValueError(
+            f"map-level $patch directive {patch['$patch']!r} is not supported"
+        )
+    if not isinstance(body, dict):
+        body = {}
+    out = dict(body)
+    for k, pv in patch.items():
+        if pv is None:
+            out.pop(k, None)
+        else:
+            out[k] = _strategic_merge(out.get(k), pv, field=k)
     return out
 
 

@@ -102,6 +102,22 @@ class FakeRuntimeService:
                 return ip
         raise RuntimeError(f"pod IP range {self._ip_prefix} exhausted")
 
+    def set_pod_cidr(self, cidr: str) -> None:
+        """CNI range follows the node's centrally-allocated spec.podCIDR
+        (controllers/nodeipam.py): a /24 maps to a 3-octet prefix, a /16
+        to 2 octets. The kubelet calls this from its node-status sync;
+        no-op when unchanged, existing sandboxes keep their IPs."""
+        base, _, masklen = cidr.partition("/")
+        octets = base.split(".")
+        prefix = (
+            ".".join(octets[:3]) if int(masklen or 24) > 16
+            else ".".join(octets[:2])
+        )
+        with self._lock:
+            if prefix != self._ip_prefix:
+                self._ip_prefix = prefix
+                self._ip_counter = 0
+
     def run_pod_sandbox(self, pod_name: str, pod_namespace: str, pod_uid: str) -> str:
         self._latency()
         with self._lock:

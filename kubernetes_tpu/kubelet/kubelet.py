@@ -233,6 +233,11 @@ class Kubelet:
         except APIError:
             return
         node.status.conditions = self._conditions(memory_pressure=pressure)
+        # consume the centrally-allocated podCIDR (nodeipam controller,
+        # range_allocator.go updateCIDRsAllocation): the fake CNI's pod-IP
+        # range follows spec.podCIDR, replacing the node-side invention
+        if node.spec.pod_cidr:
+            self.runtime.set_pod_cidr(node.spec.pod_cidr)
         if self.device_manager is not None:
             # setNodeStatusAllocatable: plugin resources join capacity;
             # removed resources are zeroed, not dropped (kubelet_node_status.go)

@@ -199,6 +199,15 @@ class ClusterEncoding:
         self._pod_reserve = 0
         self._anti_reserve = 0
         self._score_reserve = 0
+        # volume hook (scheduler/volume_device.py VolumeDeviceResolver):
+        # contributes attach-limit scalars to pod requests and node
+        # allocatable, and tracks PVC reference counts. None = volumes
+        # invisible to the encoding (oracle handles PVC pods entirely).
+        self.volume_hook = None
+        # extras actually APPLIED per pod at add time — removal must
+        # subtract the same vector even if the resolver's view of the
+        # PVC/PV world changed in between
+        self._pod_extras: Dict[str, Dict[str, int]] = {}
 
     def reserve(self, pods: int = 0, anti_terms: int = 0,
                 score_terms: int = 0) -> None:
@@ -259,6 +268,9 @@ class ClusterEncoding:
         if key in self._pods:
             self.remove_pod(pod)
         self._pods[key] = (pod, node_name)
+        if self.volume_hook is not None:
+            self.volume_hook.pod_added(pod)
+            self._pod_extras[key] = self.volume_hook.pod_extra_scalars(pod)
         if self._rebuild_needed:
             return
         nidx = self.node_index.get(node_name)
@@ -271,13 +283,18 @@ class ClusterEncoding:
     def remove_pod(self, pod: v1.Pod) -> None:
         key = v1.pod_key(pod)
         entry = self._pods.pop(key, None)
-        if entry is None or self._rebuild_needed:
+        if entry is None:
+            return
+        extras = self._pod_extras.pop(key, None)
+        if self.volume_hook is not None:
+            self.volume_hook.pod_removed(entry[0])
+        if self._rebuild_needed:
             return
         pidx = self.pod_index.pop(key, None)
         if pidx is None:
             self._rebuild_needed = True
             return
-        self._remove_pod_arrays(entry[0], entry[1], pidx)
+        self._remove_pod_arrays(entry[0], entry[1], pidx, extras)
 
     @property
     def n_nodes(self) -> int:
@@ -357,6 +374,15 @@ class ClusterEncoding:
             for name in (c.resources.requests or {}):
                 if is_scalar_resource_name(name):
                     self.scalar_vocab.intern(name)
+        if self.volume_hook is not None:
+            key = v1.pod_key(pod)
+            extras = self._pod_extras.get(key)
+            if extras is None:
+                extras = self.volume_hook.pod_extra_scalars(pod)
+                if key in self._pods:
+                    self._pod_extras[key] = extras
+            for name in extras:
+                self.scalar_vocab.intern(name)
 
     def _pod_term_tables(self, pod_info: PodInfo) -> List[Tuple[str, object, List[int], int, int, int]]:
         """Compile an existing pod's affinity terms.
@@ -393,7 +419,7 @@ class ClusterEncoding:
     def _res_width(self) -> int:
         return 3 + self.scalar_vocab.capacity
 
-    def _res_vec(self, res) -> np.ndarray:
+    def _res_vec(self, res, extras: Optional[Dict[str, int]] = None) -> np.ndarray:
         vec = np.zeros(self._res_width(), np.int64)
         vec[0] = res.milli_cpu
         vec[1] = res.memory
@@ -402,6 +428,10 @@ class ClusterEncoding:
             s = self.scalar_vocab.get(name)
             if s:
                 vec[2 + s] = val
+        for name, val in (extras or {}).items():
+            s = self.scalar_vocab.get(name)
+            if s:
+                vec[2 + s] += val
         return vec
 
     def rebuild(self) -> None:
@@ -410,6 +440,13 @@ class ClusterEncoding:
             self._intern_node_vocabs(self._nodes[node_name])
         pod_infos: Dict[str, PodInfo] = {}
         for key, (pod, _) in self._pods.items():
+            if self.volume_hook is not None:
+                # refresh BEFORE interning: a rebuild is where resolver
+                # state changes (PVC rebind, CSINode update) converge
+                # into the rows, and _intern_pod_vocabs reads the stored
+                # extras (resolving twice per pod per rebuild doubles
+                # the resolver cost for nothing)
+                self._pod_extras[key] = self.volume_hook.pod_extra_scalars(pod)
             self._intern_pod_vocabs(pod)
             pod_infos[key] = PodInfo(pod)
 
@@ -544,7 +581,11 @@ class ClusterEncoding:
 
         alloc = Resource()
         alloc.add(node.status.allocatable or node.status.capacity)
-        A["alloc"][i] = self._res_vec(alloc)
+        extra_alloc = (
+            self.volume_hook.node_extra_alloc(node)
+            if self.volume_hook is not None else None
+        )
+        A["alloc"][i] = self._res_vec(alloc, extra_alloc)
         A["allowed_pods"][i] = alloc.allowed_pod_number
         A["requested"][i] = 0
         A["nz_requested"][i] = 0
@@ -616,7 +657,9 @@ class ClusterEncoding:
                 A["ppair"][pidx, pid] = True
         # node aggregates
         res, non0_cpu, non0_mem = calculate_resource(pod)
-        A["requested"][nidx] += self._res_vec(res)
+        A["requested"][nidx] += self._res_vec(
+            res, self._pod_extras.get(v1.pod_key(pod))
+        )
         A["nz_requested"][nidx, 0] += non0_cpu
         A["nz_requested"][nidx, 1] += non0_mem
         A["pod_count"][nidx] += 1
@@ -679,7 +722,9 @@ class ClusterEncoding:
         self._encode_pod_row(pidx, pod, nidx, pod_info)
         return True
 
-    def _remove_pod_arrays(self, pod: v1.Pod, node_name: str, pidx: int) -> None:
+    def _remove_pod_arrays(
+        self, pod: v1.Pod, node_name: str, pidx: int, extras=None
+    ) -> None:
         A = self._arrays
         nidx = self.node_index.get(node_name)
         A["pvalid"][pidx] = False
@@ -687,7 +732,7 @@ class ClusterEncoding:
         self._dirty_pods.add(pidx)
         if nidx is not None:
             res, non0_cpu, non0_mem = calculate_resource(pod)
-            A["requested"][nidx] -= self._res_vec(res)
+            A["requested"][nidx] -= self._res_vec(res, extras)
             A["nz_requested"][nidx, 0] -= non0_cpu
             A["nz_requested"][nidx, 1] -= non0_mem
             A["pod_count"][nidx] -= 1

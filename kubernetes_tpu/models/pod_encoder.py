@@ -71,11 +71,20 @@ class PodEncoder:
         self.ignored_resource_groups = ignored_resource_groups or set()
         self.default_constraints = default_constraints or []
         self._cache: Dict[str, dict] = {}
+        # volume device path (scheduler/volume_device.py): resolves a
+        # bound-PVC pod's volume constraints into extra node-affinity
+        # term groups + attach-count scalars. None = PVC pods never
+        # reach this encoder (the oracle diversion).
+        self.volume_resolver = None
 
     def encode(self, pod: v1.Pod) -> dict:
         fp = _fingerprint(pod)
         cached = self._cache.get(fp)
-        if cached is not None and cached["_caps"] == self._caps_signature():
+        if (
+            cached is not None
+            and cached["_caps"] == self._caps_signature()
+            and cached["_volver"] in (None, self._vol_version())
+        ):
             out = dict(cached)
             # node-name index depends on current node table, not the spec
             out["node_name_idx"], out["has_node_name"] = self._node_name(pod)
@@ -86,6 +95,12 @@ class PodEncoder:
         out = dict(arrays)
         out["node_name_idx"], out["has_node_name"] = self._node_name(pod)
         return out
+
+    def _vol_version(self):
+        return (
+            self.volume_resolver.version
+            if self.volume_resolver is not None else None
+        )
 
     def _caps_signature(self) -> tuple:
         e = self.enc
@@ -108,6 +123,22 @@ class PodEncoder:
         pod_info = PodInfo(pod)
         out: dict = {}
 
+        # volume device path: resolve bound-PVC constraints FIRST so the
+        # attach-limit scalar names intern before the resource width is
+        # captured (a new driver widens the resource rows; device_state's
+        # _caps_grew rebuild aligns the cluster side)
+        vol = None
+        out["_volver"] = None
+        if self.volume_resolver is not None and any(
+            (v.source or {}).get("persistentVolumeClaim")
+            for v in pod.spec.volumes or []
+        ):
+            out["_volver"] = self._vol_version()
+            vol = self.volume_resolver.resolve(pod)
+            if vol is not None:
+                for name in vol.extra_scalars:
+                    enc.scalar_vocab.intern(name)
+
         # -- NodeResourcesFit (fit.go:148 computePodResourceRequest) -------
         res, _, _ = calculate_resource(pod)
         rw = enc._res_width()
@@ -126,11 +157,19 @@ class PodEncoder:
                 "/" in name and name.split("/", 1)[0] in self.ignored_resource_groups
             )
             check[2 + s] = not ignored
+        if vol is not None:
+            # attach limits ride the resource-fit mask as scalar dims
+            # (nodevolumelimits/csi.go -> attachable-volumes-csi-<drv>)
+            for name, val in vol.extra_scalars.items():
+                s = enc.scalar_vocab.intern(name)
+                req[2 + s] += val
+                check[2 + s] = True
         out["req"] = req
         out["req_check"] = check
         out["req_has_any"] = np.array(
             res.milli_cpu != 0 or res.memory != 0 or res.ephemeral_storage != 0
             or bool(res.scalar_resources)
+            or bool(vol is not None and vol.extra_scalars)
         )
         out["nz_req"] = np.array(
             [
@@ -184,6 +223,23 @@ class PodEncoder:
         sel_table, aff_terms, has_aff = compile_pod_node_constraints(
             pod, enc.node_key_vocab, enc.node_pair_vocab
         )
+        if vol is not None and vol.term_groups:
+            # bound-PV constraints (PV nodeAffinity + VolumeZone) join
+            # the pod's required node affinity by term distribution —
+            # mask_node_affinity then enforces them on-device
+            from ..scheduler.volume_device import (
+                _own_affinity_terms,
+                distribute_term_groups,
+            )
+            from .selectors import compile_node_selector_terms
+
+            combined = distribute_term_groups(
+                _own_affinity_terms(pod), vol.term_groups
+            )
+            aff_terms = compile_node_selector_terms(
+                combined, enc.node_key_vocab, enc.node_pair_vocab
+            )
+            has_aff = True
         nr = bucket_capacity(max(sel_table.n_reqs, 1), minimum=2)
         nv = bucket_capacity(max(sel_table.n_vals, 1), minimum=2)
         sel = sel_table.padded(nr, nv)

@@ -30,6 +30,7 @@ from ..testing.synth import make_node, make_pod
 
 DENSITY_FAIL_THRESHOLD = 30.0  # scheduler_test.go:41 threshold3K
 DENSITY_WARN_THRESHOLD = 100.0  # scheduler_test.go:40 warning3K
+CSI_PERF_DRIVER = "csi.perf.example"  # the CSIPVs workloads' driver
 
 
 @dataclass
@@ -46,6 +47,21 @@ class PodTemplate:
     anti_affinity_zone: bool = False  # required anti-affinity on zone
     anti_affinity_hostname: bool = False  # required anti-affinity per node
     extended: Optional[Dict[str, str]] = None  # e.g. {"example.com/gpu": "1"}
+    # SchedulingSecrets: secret volumes (no scheduling constraint — pins
+    # that volume-bearing non-PVC pods stay on the kernel fast path)
+    secret_volumes: int = 0
+    # required pod AFFINITY on zone toward self-labels (SchedulingPodAffinity)
+    pod_affinity_zone: bool = False
+    # preferred (anti-)affinity on zone (SchedulingPreferredPodAffinity /
+    # SchedulingPreferredPodAntiAffinity)
+    preferred_affinity_zone: bool = False
+    preferred_anti_affinity_zone: bool = False
+    # required node affinity: zone In [zone-0, zone-1] (SchedulingNodeAffinity)
+    node_affinity_zones: Optional[List[str]] = None
+    # one pre-bound PVC+PV per measured pod (SchedulingInTreePVs /
+    # SchedulingCSIPVs): "zonal" labels the PV with the pod-index zone;
+    # "csi" additionally carries a CSI driver (attach-limit accounting)
+    with_pvc: str = ""  # "" | "zonal" | "csi"
 
     def build(self, name: str, namespace: str = "default") -> v1.Pod:
         constraints = []
@@ -77,24 +93,71 @@ class PodTemplate:
                 )
             )
         affinity = None
+        pod_affinity = None
+        pod_anti = None
+        node_aff = None
         if self.anti_affinity_zone or self.anti_affinity_hostname:
-            affinity = v1.Affinity(
-                pod_anti_affinity=v1.PodAntiAffinity(
-                    required_during_scheduling_ignored_during_execution=[
-                        v1.PodAffinityTerm(
-                            label_selector=v1.LabelSelector(
-                                match_labels=dict(self.labels)
-                            ),
-                            topology_key=(
-                                v1.LABEL_ZONE
-                                if self.anti_affinity_zone
-                                else v1.LABEL_HOSTNAME
-                            ),
-                        )
+            pod_anti = v1.PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    v1.PodAffinityTerm(
+                        label_selector=v1.LabelSelector(
+                            match_labels=dict(self.labels)
+                        ),
+                        topology_key=(
+                            v1.LABEL_ZONE
+                            if self.anti_affinity_zone
+                            else v1.LABEL_HOSTNAME
+                        ),
+                    )
+                ]
+            )
+        if self.pod_affinity_zone:
+            pod_affinity = v1.PodAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    v1.PodAffinityTerm(
+                        label_selector=v1.LabelSelector(
+                            match_labels=dict(self.labels)
+                        ),
+                        topology_key=v1.LABEL_ZONE,
+                    )
+                ]
+            )
+        if self.preferred_affinity_zone or self.preferred_anti_affinity_zone:
+            term = v1.WeightedPodAffinityTerm(
+                weight=100,
+                pod_affinity_term=v1.PodAffinityTerm(
+                    label_selector=v1.LabelSelector(
+                        match_labels=dict(self.labels)
+                    ),
+                    topology_key=v1.LABEL_ZONE,
+                ),
+            )
+            if self.preferred_affinity_zone:
+                pod_affinity = pod_affinity or v1.PodAffinity()
+                pod_affinity.preferred_during_scheduling_ignored_during_execution = [term]
+            else:
+                pod_anti = pod_anti or v1.PodAntiAffinity()
+                pod_anti.preferred_during_scheduling_ignored_during_execution = [term]
+        if self.node_affinity_zones:
+            node_aff = v1.NodeAffinity(
+                required_during_scheduling_ignored_during_execution=v1.NodeSelector(
+                    node_selector_terms=[
+                        v1.NodeSelectorTerm(match_expressions=[
+                            v1.NodeSelectorRequirement(
+                                key=v1.LABEL_ZONE, operator="In",
+                                values=list(self.node_affinity_zones),
+                            )
+                        ])
                     ]
                 )
             )
-        return make_pod(
+        if pod_affinity or pod_anti or node_aff:
+            affinity = v1.Affinity(
+                pod_affinity=pod_affinity,
+                pod_anti_affinity=pod_anti,
+                node_affinity=node_aff,
+            )
+        pod = make_pod(
             name,
             namespace=namespace,
             cpu=self.cpu,
@@ -105,6 +168,13 @@ class PodTemplate:
             affinity=affinity,
             extended=self.extended,
         )
+        if self.secret_volumes:
+            pod.spec.volumes = [
+                v1.Volume(name=f"sec{i}", source={"secret": {
+                    "secretName": f"perf-secret-{i}"}})
+                for i in range(self.secret_volumes)
+            ]
+        return pod
 
 
 @dataclass
@@ -211,6 +281,7 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         http_srv = HTTPAPIServer(api=api).start()
         api = RemoteAPIServer(http_srv.address)
     cs = Clientset(api)
+    csi_mode = "csi" in (w.template.with_pvc, w.init_template.with_pvc)
     for i in range(w.num_nodes):
         cs.nodes.create(
             make_node(
@@ -223,6 +294,15 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
                 extended=w.node_extended,
             )
         )
+        if csi_mode:
+            from ..api.storage import CSINode, CSINodeDriver, CSINodeSpec
+
+            cs.resource("csinodes").create(CSINode(
+                metadata=v1.ObjectMeta(name=f"node-{i}"),
+                spec=CSINodeSpec(drivers=[
+                    CSINodeDriver(name=CSI_PERF_DRIVER, count=64)
+                ]),
+            ))
     factory = SharedInformerFactory(cs)
     sched = Scheduler(cs, factory, backend=w.backend, max_batch=w.max_batch)
     if w.backend == "tpu":
@@ -293,12 +373,58 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             sched.resume()
 
         # init pods (scheduled but not measured — warms caches + compile)
+        def _attach_pvc(pod, i, tmpl, prefix):
+            """One pre-bound PVC+PV per pod (mustSetupScheduler's PV
+            fixtures): zonal PVs carry the pod-index zone label
+            (VolumeZone constraints), csi PVs a driver (attach limits)."""
+            pv = v1.PersistentVolume(
+                metadata=v1.ObjectMeta(
+                    name=f"{prefix}pv-{i}",
+                    labels=(
+                        {v1.LABEL_ZONE: f"zone-{i % w.n_zones}"}
+                        if tmpl.with_pvc == "zonal" else {}
+                    ),
+                ),
+                spec=v1.PersistentVolumeSpec(
+                    capacity={"storage": "1Gi"},
+                    access_modes=["ReadWriteOnce"],
+                    csi=(
+                        {"driver": CSI_PERF_DRIVER, "volumeHandle": f"h-{i}"}
+                        if tmpl.with_pvc == "csi" else None
+                    ),
+                ),
+                status=v1.PersistentVolumeStatus(phase="Bound"),
+            )
+            cs.resource("persistentvolumes").create(pv)
+            cs.resource("persistentvolumeclaims").create(
+                v1.PersistentVolumeClaim(
+                    metadata=v1.ObjectMeta(
+                        name=f"{prefix}claim-{i}", namespace="default"
+                    ),
+                    spec=v1.PersistentVolumeClaimSpec(
+                        access_modes=["ReadWriteOnce"],
+                        volume_name=f"{prefix}pv-{i}",
+                        resources=v1.ResourceRequirements(
+                            requests={"storage": "1Gi"}
+                        ),
+                    ),
+                )
+            )
+            pod.spec.volumes = [v1.Volume(
+                name="data",
+                source={"persistentVolumeClaim":
+                        {"claimName": f"{prefix}claim-{i}"}},
+            )]
+
+        def _create_init(i):
+            pod = w.init_template.build(f"init-{i}")
+            if w.init_template.with_pvc:
+                _attach_pvc(pod, i, w.init_template, "i-")
+            cs.pods.create(pod)
+
         if w.num_init_pods:
             sched.start()
-            _stage(
-                w.num_init_pods,
-                lambda i: cs.pods.create(w.init_template.build(f"init-{i}")),
-            )
+            _stage(w.num_init_pods, _create_init)
             if not _wait_all_bound(cs, w.num_init_pods, w.timeout):
                 raise RuntimeError("init pods did not all bind")
         else:
@@ -314,12 +440,15 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         # every measured pod): the measured phase drains full max_batch
         # batches; the reference's harness likewise measures scheduling,
         # not client-side creation
+
         def _create_measured(i):
             tmpl = w.template
             if w.second_every and w.second_template is not None \
                     and i % w.second_every == 0:
                 tmpl = w.second_template
             pod = tmpl.build(f"measure-{i}")
+            if tmpl.with_pvc:
+                _attach_pvc(pod, i, tmpl, "m-")
             if w.gang_size > 1:
                 # annotations, not labels: gang identity must not enter
                 # the encoded self rows (see coscheduling.pod_group)

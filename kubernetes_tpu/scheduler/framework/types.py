@@ -250,6 +250,7 @@ class QueuedPodInfo:
         "initial_attempt_timestamp",
         "last_failure_timestamp",
         "pop_timestamp",
+        "nominated_node",
     )
 
     def __init__(self, pod: v1.Pod, timestamp: Optional[float] = None):
@@ -262,6 +263,11 @@ class QueuedPodInfo:
         # the per-attempt latency (pod_scheduling_duration measures from
         # initial_attempt_timestamp, i.e. includes queue wait)
         self.pop_timestamp = 0.0
+        # set when this pod preempted victims on a node: the in-memory
+        # mirror of status.nominatedNodeName (the API echo can lag the
+        # victims' delete events; the queue's event-driven re-admission
+        # and the scheduler's nominated-node short-circuit read this)
+        self.nominated_node = ""
 
     @property
     def pod(self) -> v1.Pod:

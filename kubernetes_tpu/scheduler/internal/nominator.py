@@ -59,3 +59,9 @@ class PodNominator:
     def nominated_pods_for_node(self, node_name: str) -> List[v1.Pod]:
         with self._lock:
             return list(self._by_node.get(node_name, []))
+
+    def all_nominated_pods(self) -> List[v1.Pod]:
+        """Every currently-nominated pod (the fast preemption planner's
+        envelope check scans these for required anti-affinity terms)."""
+        with self._lock:
+            return [p for pods in self._by_node.values() for p in pods]

@@ -238,6 +238,32 @@ class PriorityQueue:
             self._move_request_cycle = self._scheduling_cycle
             self._lock.notify_all()
 
+    def activate(self, pod: v1.Pod) -> bool:
+        """scheduling_queue.go Activate: move THIS pod to activeQ now,
+        from wherever it is parked (unschedulableQ or backoffQ),
+        skipping any remaining backoff. The scheduler calls it when an
+        event provably resolves the pod's unschedulability — a nominated
+        preemptor whose last victim's delete just echoed (the reference's
+        queueing-hint immediate path; waiting out 2^attempts backoff
+        after the victim is already gone is pure idle time — the r3
+        preemption workload spent most of its 88.6s p50 pod latency
+        exactly there). Returns False when the pod is not parked here
+        (already active, or not yet re-added — callers handle that by
+        checking pending state at add time)."""
+        with self._lock:
+            key = v1.pod_key(pod)
+            info = self._unschedulable.pop(key, None)
+            if info is None:
+                info = self._backoff.get(pod)
+                if info is not None:
+                    self._backoff.delete(pod)
+            if info is None:
+                return False
+            self._active.push(info)
+            self._move_request_cycle = self._scheduling_cycle
+            self._lock.notify_all()
+            return True
+
     # -- consumer ----------------------------------------------------------
 
     @property

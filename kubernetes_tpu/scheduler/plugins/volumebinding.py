@@ -87,12 +87,20 @@ class VolumeBinding(fwk.PreFilterPlugin, fwk.FilterPlugin, fwk.ReservePlugin, fw
         try:
             data: _StateData = state.read(STATE_KEY)
         except KeyError:
-            # PreFilter never ran for this pod (e.g. a path that bypassed the
-            # oracle framework). Silently proceeding would bind the pod with
-            # its PVCs forever Pending — fail loudly instead
-            # (volume_binding.go:233 errors when state is missing).
+            # PreFilter never ran for this pod: the kernel path reaches
+            # Reserve directly for bound-PVC pods it scheduled
+            # (scheduler/volume_device.py gates that path to all-bound
+            # claims). All-bound means nothing to assume — the
+            # reference's AssumePodVolumes no-op. Anything still
+            # unbound here would bind the pod with its PVCs forever
+            # Pending — fail loudly (volume_binding.go:233).
             if _pod_has_pvcs(pod):
-                return Status.error("VolumeBinding state missing at Reserve")
+                bound, to_bind, immediate, missing = \
+                    self._binder.get_pod_volumes(pod)
+                if to_bind or immediate or missing:
+                    return Status.error(
+                        "VolumeBinding state missing at Reserve"
+                    )
             return None
         if data.skip:
             return None
@@ -118,8 +126,16 @@ class VolumeBinding(fwk.PreFilterPlugin, fwk.FilterPlugin, fwk.ReservePlugin, fw
         try:
             data: _StateData = state.read(STATE_KEY)
         except KeyError:
+            # same no-PreFilter contract as reserve() above: the kernel
+            # path's all-bound pods have no bindings to apply; anything
+            # unbound reaching PreBind without state is a real error
             if _pod_has_pvcs(pod):
-                return Status.error("VolumeBinding state missing at PreBind")
+                bound, to_bind, immediate, missing = \
+                    self._binder.get_pod_volumes(pod)
+                if to_bind or immediate or missing:
+                    return Status.error(
+                        "VolumeBinding state missing at PreBind"
+                    )
             return None
         if data.skip:
             return None

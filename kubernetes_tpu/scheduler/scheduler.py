@@ -17,6 +17,7 @@ preserving sequential assume semantics.
 from __future__ import annotations
 
 import copy
+import logging
 import random
 import threading
 import traceback
@@ -40,9 +41,21 @@ from .framework.snapshot import Snapshot
 from .internal.cache import SchedulerCache
 from .internal.nominator import PodNominator
 from .internal.queue import PriorityQueue
+from . import preemption as fast_preemption
 from .plugins.defaultpreemption import get_lower_priority_nominated_pods
 from .plugins.registry import default_plugins, new_in_tree_registry
 from .tpu_backend import TPUBackend
+
+logger = logging.getLogger(__name__)
+
+
+def _has_required_anti_affinity(pod: v1.Pod) -> bool:
+    a = pod.spec.affinity
+    return (
+        a is not None
+        and a.pod_anti_affinity is not None
+        and bool(a.pod_anti_affinity.required_during_scheduling_ignored_during_execution)
+    )
 
 
 class Scheduler:
@@ -76,11 +89,16 @@ class Scheduler:
         self.snapshot = Snapshot()
         self.nominator = PodNominator()
         # a Framework exists in BOTH modes: TPU mode uses it for the long
-        # tail (preemption dry-runs, extenders) — SURVEY.md §7 stage 4
+        # tail (preemption dry-runs, extenders) — SURVEY.md §7 stage 4.
+        # The default framework gets real volume listers: the kernel
+        # path's bound-PVC pods pass through VolumeBinding's Reserve and
+        # the oracle diversion needs a working binder (the factory wires
+        # richer extras for configured profiles, factory.py:126)
         self.framework = framework or Framework(
             new_in_tree_registry(),
             plugins=default_plugins(),
             snapshot_fn=lambda: self.snapshot,
+            handle_extras=self._volume_handle_extras(),
         )
         self.framework.nominator = self.nominator
         self.framework.pdb_lister = self._list_pdbs
@@ -95,6 +113,7 @@ class Scheduler:
         if backend == "tpu":
             self.tpu = tpu_backend or TPUBackend(rng=self.rng)
             self.cache.add_listener(self.tpu)
+            self._wire_volume_device()
         else:
             self.tpu = None
         self._stop = threading.Event()
@@ -113,6 +132,25 @@ class Scheduler:
         self._permit_released: List[Tuple] = []
         self._permit_wake = threading.Event()
         self._permit_thread: Optional[threading.Thread] = None
+        # in-flight preemptions, tracked per NOMINATED NODE: a node's
+        # preemptors are parked until the node's ENTIRE claimed victim
+        # set has delete-echoed, then queue.activate()d together —
+        # precise event-driven re-admission (scheduling_queue.go
+        # Activate / queueing-hints semantics) instead of flushing every
+        # parked pod on every delete. Waking each preemptor on its OWN
+        # victims alone thrashes when several preemptors share a node
+        # (the planner's pick-one legitimately piles them up): the early
+        # riser fails the nominated-node filter against its siblings'
+        # still-dying victims, falls into the kernel path, and replans —
+        # measured as a mid-window session teardown + 14s recompile.
+        # The pod-key set also backs the guard that stops a re-popped
+        # preemptor from planning a SECOND victim set while the first is
+        # dying (the oracle's PodEligibleToPreemptOthers
+        # terminating-victim check, default_preemption.go:539).
+        self._preempt_lock = threading.Lock()
+        self._node_waves: Dict[str, Tuple[set, List]] = {}  # node -> (victim keys, infos)
+        self._victim_waiters: Dict[str, str] = {}  # victim key -> node
+        self._inflight_preemptors: set = set()  # pod keys
         self._thread: Optional[threading.Thread] = None
         self._binders = ThreadPoolExecutor(max_workers=8, thread_name_prefix="binder")
         self._inflight = 0  # scheduling batches + binds not yet finished
@@ -136,6 +174,7 @@ class Scheduler:
             if assigned(pod):
                 self.cache.add_pod(pod)  # may confirm an assumed pod
                 self.nominator.delete_nominated_pod_if_exists(pod)
+                self._clear_preempt_tracking(pod)
             elif self._schedulable(pod):
                 if pod.status.nominated_node_name:
                     self.nominator.add_nominated_pod(pod)
@@ -156,9 +195,11 @@ class Scheduler:
             if assigned(pod):
                 self.cache.remove_pod(pod)
                 self.queue.move_all_to_active_or_backoff_queue("AssignedPodDelete")
+                self._on_victim_deleted(pod)
             else:
                 self.nominator.delete_nominated_pod_if_exists(pod)
                 self.queue.delete(pod)
+                self._clear_preempt_tracking(pod)
 
         pods.add_event_handler(
             EventHandler(on_add=on_pod_add, on_update=on_pod_update, on_delete=on_pod_delete)
@@ -182,6 +223,45 @@ class Scheduler:
     @staticmethod
     def _schedulable(pod: v1.Pod) -> bool:
         return pod.metadata.deletion_timestamp is None
+
+    def _volume_handle_extras(self) -> dict:
+        from ..volume.binder import SchedulerVolumeBinder
+
+        pvc_inf = self.informers.informer_for("persistentvolumeclaims")
+        pv_inf = self.informers.informer_for("persistentvolumes")
+        sc_inf = self.informers.informer_for("storageclasses")
+        csi_inf = self.informers.informer_for("csinodes")
+        return {
+            "volume_binder": SchedulerVolumeBinder(
+                list_pvcs=pvc_inf.list,
+                list_pvs=pv_inf.list,
+                list_storage_classes=sc_inf.list,
+                client=self.client,
+            ),
+            "volume_listers": (pvc_inf.list, pv_inf.list),
+            "csi_node_lister": csi_inf.list,
+        }
+
+    def _wire_volume_device(self) -> None:
+        """Volume device path (volume_device.py): PVC/PV/CSINode listers
+        feed the resolver; any volume-object event bumps its version and
+        queues an encoding rebuild. Informers are created HERE — before
+        factory.start() — because lazily-created informers never start."""
+        from .volume_device import VolumeDeviceResolver
+
+        pvc_inf = self.informers.informer_for("persistentvolumeclaims")
+        pv_inf = self.informers.informer_for("persistentvolumes")
+        csi_inf = self.informers.informer_for("csinodes")
+        resolver = VolumeDeviceResolver(pvc_inf.list, pv_inf.list, csi_inf.list)
+        self.tpu.set_volume_resolver(resolver)
+        bump = EventHandler(
+            on_add=lambda obj: self.tpu.on_volume_change(),
+            on_update=lambda old, new: self.tpu.on_volume_change(),
+            on_delete=lambda obj: self.tpu.on_volume_change(),
+        )
+        pvc_inf.add_event_handler(bump)
+        pv_inf.add_event_handler(bump)
+        csi_inf.add_event_handler(bump)
 
     # -- run loop ----------------------------------------------------------
 
@@ -217,7 +297,12 @@ class Scheduler:
             except Exception:  # noqa: BLE001 — teardown best-effort
                 traceback.print_exc()
         self._binders.shutdown(wait=True)
-        self.recorder.flush(timeout=5.0)  # events are async; land the tail
+        if not self.recorder.flush(timeout=5.0):  # events are async
+            logger.warning(
+                "event queue did not drain within 5s at scheduler stop "
+                "(%d events dropped during the run)",
+                self.recorder.dropped_events,
+            )
 
     def _run(self) -> None:
         import time
@@ -283,15 +368,18 @@ class Scheduler:
             return True
         return self.cache.is_assumed_pod(pod)
 
-    @staticmethod
-    def _needs_oracle(pod: v1.Pod) -> bool:
-        """Pods whose constraints live outside the TPU kernel (PVC volumes:
-        VolumeBinding/Zone/Restrictions are host-side plugins) take the
-        oracle path; the kernel would silently ignore those constraints."""
-        return any(
+    def _needs_oracle(self, pod: v1.Pod) -> bool:
+        """Pods whose constraints live outside the TPU kernel take the
+        oracle path. PVC-bearing pods ride the kernel when their volume
+        constraints are statically resolvable (all PVCs bound, claims
+        unshared — volume_device.py); unbound PVCs keep the oracle
+        (VolumeBinding's provisioning decisions are host-side)."""
+        if not any(
             (vol.source or {}).get("persistentVolumeClaim")
             for vol in pod.spec.volumes or []
-        )
+        ):
+            return False
+        return self.tpu is None or not self.tpu.volume_kernel_safe(pod)
 
     def _schedule_batch_tpu(self, infos: List) -> None:
         cycle = self.queue.scheduling_cycle
@@ -302,6 +390,20 @@ class Scheduler:
                 todo = [i for i in todo if not self._needs_oracle(i.pod)]
                 for info in oracle_infos:
                     self._schedule_one_oracle(info)
+            # nominated-node short-circuit (generic_scheduler.go:235
+            # evaluateNominatedNode): a preemptor whose victims were
+            # evicted re-arrives with a nominated node — feasibility is
+            # checked on THAT node only and the pod binds there without a
+            # kernel dispatch (and without racing other waves' pods for
+            # the freed capacity)
+            nominated = [
+                i for i in todo
+                if (i.nominated_node or i.pod.status.nominated_node_name)
+            ]
+            if nominated:
+                placed = self._place_nominated(nominated)
+                if placed:
+                    todo = [i for i in todo if id(i) not in placed]
         # 1-deep pipeline: dispatch this batch (async on the live session
         # — the device scan chains on the previous batch's carry), then
         # harvest/bind the PREVIOUS batch while the device works. The
@@ -319,42 +421,100 @@ class Scheduler:
     def _complete_batch(self, todo: List, handle, cycle: int) -> None:
         results = self.tpu.harvest(handle)
         by_key = {v1.pod_key(p): node for p, node in results}
-        # per-node failure statuses only matter when a PostFilter
-        # (preemption) will consume them, and preemption can only evict
-        # strictly-lower-priority victims. The re-dispatch that recovers
-        # statuses costs one full kernel dispatch + status
-        # materialization PER POD — on saturation workloads (every node
-        # full, uniform priorities) that's a crawl for provably-empty
-        # dry-runs, so gate it on both conditions.
-        has_post_filter = bool(
-            self.framework is not None and self.framework.post_filter_plugins
-        )
-        min_prio: Optional[int] = None
         bound: List[Tuple] = []  # (info, node)
-        redispatch: List = []  # failed infos needing statuses (preemption)
+        failed: List = []
         for info in todo:
             node = by_key.get(v1.pod_key(info.pod))
             if node is None:
-                if has_post_filter and min_prio is None:
-                    min_prio = self.cache.min_pod_priority()
-                if not has_post_filter or (info.pod.spec.priority or 0) <= min_prio:
-                    self._record_failure(info, cycle, {})
-                    continue
-                redispatch.append(info)
+                failed.append(info)
             else:
                 bound.append((info, node))
         if bound:
             self._assume_and_bind_batch(bound)
+        if failed:
+            self._handle_failure_wave(failed, cycle)
+
+    def _handle_failure_wave(self, failed: List, cycle: int) -> None:
+        """Failure handling for a whole batch at once. Preemption can
+        only evict strictly-lower-priority victims, so pods at or below
+        the cluster's priority floor park immediately (no dry-run can
+        help). The rest split between the batched fast planner
+        (preemption.py — one numpy pass over every node for the whole
+        wave) and the oracle path (a batched kernel re-evaluation
+        recovers per-node statuses, then DefaultPreemption runs per
+        pod). The per-pod schedule() the redispatch replaces was a
+        session teardown + full kernel launch each (r2's preemption
+        crawl); the fast planner removes even the redispatch."""
+        has_post_filter = bool(
+            self.framework is not None and self.framework.post_filter_plugins
+        )
+        min_prio = self.cache.min_pod_priority() if has_post_filter else 0
+        redispatch: List = []
+        preemptable: List = []
+        for info in failed:
+            if self._preemption_in_flight(info.pod):
+                # victims from a previous plan are still dying — park and
+                # wait for their delete echoes (the oracle's terminating-
+                # victim eligibility gate); planning a SECOND victim set
+                # now would double-evict. Re-check after parking: the
+                # last echo may have landed in between, with activate()
+                # a no-op because the pod wasn't parked yet
+                self._record_failure(info, cycle, {})
+                if not self._preemption_in_flight(info.pod):
+                    self.queue.activate(info.pod)
+            elif not has_post_filter or (info.pod.spec.priority or 0) <= min_prio:
+                self._record_failure(info, cycle, {})
+            else:
+                preemptable.append(info)
+        if preemptable:
+            self.snapshot = self.cache.update_snapshot(self.snapshot)
+            pdbs = self._list_pdbs()
+            nominated_simple = all(
+                not _has_required_anti_affinity(p)
+                for p in self.nominator.all_nominated_pods()
+            )
+            fast: List = []
+            for info in preemptable:
+                if nominated_simple and fast_preemption.fast_eligible(
+                    info.pod, self.snapshot, pdbs, self.extenders
+                ):
+                    fast.append(info)
+                else:
+                    redispatch.append(info)
+            if fast:
+                planner = fast_preemption.FastPreemptionPlanner(
+                    self.snapshot, self.nominator,
+                    args=self._preemption_args(),
+                )
+                cands = planner.plan([i.pod for i in fast])
+                preempted: List[Tuple] = []
+                for info, cand, fits in zip(fast, cands, planner.fits_now):
+                    if fits:
+                        # cluster state moved since the batch dispatched:
+                        # the pod fits without preemption — let the
+                        # kernel re-evaluate (scores + sequential assume)
+                        redispatch.append(info)
+                    elif cand is None:
+                        # preemption cannot help anymore: a stale
+                        # nomination would keep short-circuiting the
+                        # batch path for nothing — clear it and take
+                        # normal backoff
+                        if info.nominated_node or \
+                                info.pod.status.nominated_node_name:
+                            self._clear_nomination(info)
+                        self._record_failure(info, cycle, {})
+                    else:
+                        preempted.append((info, cand))
+                if preempted:
+                    self._apply_preemptions(preempted, cycle)
         if redispatch:
             # ONE batched re-evaluation recovers per-node failure
             # statuses for every failed pod (the preemption dry-run's
-            # input) — the per-pod schedule() this replaces was a session
-            # teardown + full kernel launch each (r2's preemption crawl).
-            # A pod that now FITS (state moved since its batch) binds;
-            # the batched evaluation is against one state, so only the
-            # first fit binds directly — later fits re-dispatch singly to
-            # keep sequential-assume semantics (rare: failure waves
-            # mostly stay failed).
+            # input). A pod that now FITS (state moved since its batch)
+            # binds; the batched evaluation is against one state, so only
+            # the first fit binds directly — later fits re-dispatch
+            # singly to keep sequential-assume semantics (rare: failure
+            # waves mostly stay failed).
             bound_once = False
             for info, (node, statuses) in zip(
                 redispatch, self.tpu.reevaluate([i.pod for i in redispatch])
@@ -374,6 +534,205 @@ class Scheduler:
                         self._record_failure(
                             info, cycle, fe.filtered_nodes_statuses
                         )
+
+    def _preemption_args(self) -> dict:
+        """The DefaultPreemption plugin's candidate-count args, so the
+        fast planner scans exactly as far as the oracle would."""
+        if self.framework is not None:
+            for pl in self.framework.post_filter_plugins:
+                if getattr(pl, "name", "") == "DefaultPreemption":
+                    return {
+                        "minCandidateNodesPercentage":
+                            pl.min_candidate_nodes_percentage,
+                        "minCandidateNodesAbsolute":
+                            pl.min_candidate_nodes_absolute,
+                    }
+        return {}
+
+    def _apply_preemptions(self, items: List[Tuple], cycle: int) -> None:
+        """PrepareCandidate (default_preemption.go:690) for a wave of
+        fast-planned candidates. Scheduler-thread work is the in-memory
+        bookkeeping only (nominations, metrics, queue parking); the API
+        effects — victim deletes, then nominatedNodeName status patches —
+        run on a worker so the scheduler is already parked on the queue
+        when the delete echoes flush the wave back (the r3 serial apply
+        held the scheduling thread for the whole wave)."""
+        for info, cand in items:
+            pod = info.pod
+            metrics.preemption_attempts.inc()
+            metrics.preemption_victims.observe(len(cand.victims))
+            self.recorder.event(
+                pod, "Normal", "Preempted",
+                f"preempted {len(cand.victims)} pod(s) on node "
+                f"{cand.node_name}",
+            )
+            self.nominator.add_nominated_pod(pod, cand.node_name)
+            info.nominated_node = cand.node_name
+            for lower in get_lower_priority_nominated_pods(
+                self.nominator, pod, cand.node_name
+            ):
+                self.nominator.delete_nominated_pod_if_exists(lower)
+            # register the victim set on the node's wave, THEN park: the
+            # node's preemptors re-activate together when its last
+            # claimed victim's delete echoes
+            pkey = v1.pod_key(pod)
+            vkeys = {v1.pod_key(v) for v in cand.victims}
+            with self._preempt_lock:
+                pending, infos = self._node_waves.setdefault(
+                    cand.node_name, (set(), [])
+                )
+                pending |= vkeys
+                infos.append(info)
+                self._inflight_preemptors.add(pkey)
+                for vk in vkeys:
+                    self._victim_waiters[vk] = cand.node_name
+            self._record_failure(info, cycle, {})
+            # the wave may have fully drained between registration and
+            # parking — activate now rather than never
+            if not self._preemption_in_flight(pod):
+                self.queue.activate(pod)
+
+        def _effects(items=items):
+            # victims first — their deletion unblocks the preemptors; the
+            # status patch is observability (the in-memory nominated_node
+            # already steers the queue and the placement short-circuit)
+            for info, cand in items:
+                for victim in cand.victims:
+                    try:
+                        self.client.pods.delete(
+                            victim.metadata.name, victim.metadata.namespace
+                        )
+                    except APIError:
+                        # already gone (external delete raced the plan):
+                        # no informer echo is coming for this key —
+                        # resolve the wave bookkeeping here or the
+                        # node's preemptors would wait for the 60s
+                        # leftover flush (idempotent if the echo DID
+                        # land before registration)
+                        self._on_victim_deleted(victim)
+            for info, cand in items:
+                try:
+                    fresh = self.client.pods.get(
+                        info.pod.metadata.name, info.pod.metadata.namespace
+                    )
+                    fresh.status.nominated_node_name = cand.node_name
+                    self.client.pods.update_status(fresh)
+                except APIError:
+                    pass
+
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            self._binders.submit(self._run_then_release, _effects)
+        except RuntimeError:  # pool shut down (stop() race)
+            with self._inflight_lock:
+                self._inflight -= 1
+            _effects()
+
+    def _clear_nomination(self, info) -> None:
+        """util.ClearNominatedNodeName equivalent: the nomination can no
+        longer lead anywhere (no candidate and no fit) — drop it from the
+        nominator, the queue bookkeeping, and the API status."""
+        pod = info.pod
+        info.nominated_node = ""
+        self.nominator.delete_nominated_pod_if_exists(pod)
+        if pod.status.nominated_node_name:
+            def _clear(pod=pod):
+                try:
+                    fresh = self.client.pods.get(
+                        pod.metadata.name, pod.metadata.namespace
+                    )
+                    fresh.status.nominated_node_name = ""
+                    self.client.pods.update_status(fresh)
+                except APIError:
+                    pass
+            with self._inflight_lock:
+                self._inflight += 1
+            try:
+                self._binders.submit(self._run_then_release, _clear)
+            except RuntimeError:  # pool shut down (stop() race)
+                with self._inflight_lock:
+                    self._inflight -= 1
+                _clear()
+
+    def _on_victim_deleted(self, pod: v1.Pod) -> None:
+        """A deleted assigned pod may be a claimed preemption victim:
+        when its node's LAST outstanding victim goes, activate every
+        preemptor nominated there (skip any remaining backoff — the
+        capacity they were promised just finished freeing)."""
+        key = v1.pod_key(pod)
+        ready: List = []
+        with self._preempt_lock:
+            node = self._victim_waiters.pop(key, None)
+            if node is None:
+                return
+            wave = self._node_waves.get(node)
+            if wave is None:
+                return
+            pending, infos = wave
+            pending.discard(key)
+            if not pending:
+                del self._node_waves[node]
+                for info in infos:
+                    self._inflight_preemptors.discard(v1.pod_key(info.pod))
+                ready = infos
+        for info in ready:
+            self.queue.activate(info.pod)
+
+    def _clear_preempt_tracking(self, pod: v1.Pod) -> None:
+        """The preemptor bound or was deleted: drop its in-flight state.
+        Its node wave keeps draining for any sibling preemptors."""
+        key = v1.pod_key(pod)
+        with self._preempt_lock:
+            if key not in self._inflight_preemptors:
+                return
+            self._inflight_preemptors.discard(key)
+            for node, (pending, infos) in list(self._node_waves.items()):
+                infos[:] = [i for i in infos if v1.pod_key(i.pod) != key]
+                if not infos and not pending:
+                    del self._node_waves[node]
+
+    def _preemption_in_flight(self, pod: v1.Pod) -> bool:
+        with self._preempt_lock:
+            return v1.pod_key(pod) in self._inflight_preemptors
+
+    def _run_then_release(self, fn) -> None:
+        try:
+            fn()
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    def _place_nominated(self, infos: List) -> set:
+        """Feasibility on the nominated node ONLY (the reference's
+        evaluateNominatedNode); feasible pods assume+bind directly.
+        Returns ids of placed infos."""
+        self.snapshot = self.cache.update_snapshot(self.snapshot)
+        bound: List[Tuple] = []
+        placed: set = set()
+        for info in infos:
+            node_name = (
+                info.nominated_node or info.pod.status.nominated_node_name
+            )
+            ni = self.snapshot.node_info_map.get(node_name)
+            if ni is None:
+                continue
+            state = CycleState()
+            st = self.framework.run_pre_filter_plugins(state, info.pod)
+            if st is not None and not st.is_success():
+                continue
+            st = self.framework.run_filter_plugins_with_nominated_pods(
+                state, info.pod, ni, self.nominator
+            )
+            if st is not None:
+                continue
+            bound.append((info, node_name))
+            placed.add(id(info))
+        if bound:
+            self._assume_and_bind_batch(bound)
+        return placed
 
     def _assume_and_bind_batch(self, bound: List[Tuple]) -> None:
         """Batched assume + binding-cycle kickoff. Per-pod semantics match
@@ -592,14 +951,20 @@ class Scheduler:
                 len(done), result=metrics.SCHEDULED, profile=self.profile_name
             )
             for assumed, node, state, info in done:
-                self._observe_bound(info, now)
-                self.recorder.event(
-                    assumed, "Normal", "Scheduled",
-                    f"Successfully assigned {assumed.metadata.namespace}/"
-                    f"{assumed.metadata.name} to {node}",
-                )
-                if fwk is not None:
-                    fwk.run_post_bind_plugins(state, assumed, node)
+                # one pod's PostBind/event failure must not skip the
+                # rest of the batch's hooks (all of `done` is already
+                # bound — there is nothing left to unwind)
+                try:
+                    self._observe_bound(info, now)
+                    self.recorder.event(
+                        assumed, "Normal", "Scheduled",
+                        f"Successfully assigned {assumed.metadata.namespace}/"
+                        f"{assumed.metadata.name} to {node}",
+                    )
+                    if fwk is not None:
+                        fwk.run_post_bind_plugins(state, assumed, node)
+                except Exception:  # noqa: BLE001
+                    traceback.print_exc()
         except Exception:
             traceback.print_exc()
             for assumed in unsettled.values():

@@ -102,6 +102,7 @@ class TPUBackend(CacheListener):
         self._known_templates: Dict = {}  # fingerprint -> pod arrays
         self._pending: Optional[_BatchHandle] = None  # one in-flight batch
         self.MAX_SESSION_TEMPLATES = 8
+        self.volume_resolver = None  # scheduler/volume_device.py
         # pallas rides only on real TPUs: on CPU (tests, dryruns) the
         # interpreter would be pathologically slow and compile-heavy.
         # A mesh also disables it: the Mosaic kernel is a single-device
@@ -111,6 +112,33 @@ class TPUBackend(CacheListener):
         self.use_pallas = (
             jax.devices()[0].platform == "tpu" and mesh is None
         )
+
+    def set_volume_resolver(self, resolver) -> None:
+        """Enable the volume device path: bound-PVC pods encode their PV
+        constraints + attach counts into kernel inputs (volume_device.py)
+        instead of diverting to the oracle."""
+        with self._lock:
+            self.volume_resolver = resolver
+            self.pe.volume_resolver = resolver
+            self.enc.volume_hook = resolver
+
+    def volume_kernel_safe(self, pod: v1.Pod) -> bool:
+        """True when this PVC-bearing pod's volume constraints resolve
+        into the kernel envelope RIGHT NOW (gates the oracle diversion)."""
+        if self.volume_resolver is None:
+            return False
+        return self.volume_resolver.resolve(pod) is not None
+
+    def on_volume_change(self) -> None:
+        """A PVC/PV/CSINode event: resolved constraints may have moved —
+        cached encodings key off resolver.version; the cluster rows
+        rebuild so node attach-limit columns and pod attach counts
+        converge (rare outside provisioning bursts)."""
+        with self._lock:
+            if self.volume_resolver is not None:
+                self.volume_resolver.bump()
+            self._invalidate_session()
+            self.enc._rebuild_needed = True
 
     def _invalidate_session(self) -> None:
         # _session_assumed survives invalidation deliberately: an assume

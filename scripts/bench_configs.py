@@ -111,6 +111,67 @@ CONFIGS = {
         second_every=3,
         max_batch=1024, timeout=900.0, stall_stop=15.0,
     ),
+    # -- the volume/affinity tail of the reference's matrix
+    #    (performance-config.yaml:51-272), round-4 additions ------------
+    # SchedulingSecrets: secret-volume pods (no scheduling constraint;
+    # pins that volume-bearing non-PVC pods keep the kernel fast path)
+    "secrets": Workload(
+        "SchedulingSecrets-500n", num_nodes=500, num_init_pods=1000,
+        num_pods=1000, template=PodTemplate(secret_volumes=2),
+        max_batch=1024,
+    ),
+    # SchedulingInTreePVs: one pre-bound zonal PV+PVC per pod — VolumeZone
+    # constraints ride the kernel's node-affinity mask (volume_device.py)
+    "intreepvs": Workload(
+        "SchedulingInTreePVs-500n", num_nodes=500, num_init_pods=1000,
+        num_pods=1000, template=PodTemplate(with_pvc="zonal"),
+        max_batch=1024, timeout=900.0,
+    ),
+    # SchedulingCSIPVs: pre-bound CSI PVs — attach limits ride the
+    # resource-fit mask via attachable-volumes-csi-* scalars
+    "csipvs": Workload(
+        "SchedulingCSIPVs-500n", num_nodes=500, num_init_pods=1000,
+        num_pods=1000, template=PodTemplate(with_pvc="csi"),
+        max_batch=1024, timeout=900.0,
+    ),
+    # SchedulingPodAffinity: required zone affinity toward self-labels
+    "podaffinity": Workload(
+        "SchedulingPodAffinity-500n", num_nodes=500, num_init_pods=1000,
+        num_pods=1000,
+        init_template=PodTemplate(labels={"app": "aff"}),
+        template=PodTemplate(pod_affinity_zone=True, labels={"app": "aff"}),
+        max_batch=1024, timeout=900.0,
+    ),
+    # SchedulingPreferredPodAffinity / ...AntiAffinity: soft zone terms
+    "prefaffinity": Workload(
+        "SchedulingPreferredPodAffinity-500n", num_nodes=500,
+        num_init_pods=1000, num_pods=1000,
+        init_template=PodTemplate(labels={"app": "aff"}),
+        template=PodTemplate(preferred_affinity_zone=True,
+                             labels={"app": "aff"}),
+        max_batch=1024, timeout=900.0,
+    ),
+    "prefantiaffinity": Workload(
+        "SchedulingPreferredPodAntiAffinity-500n", num_nodes=500,
+        num_init_pods=1000, num_pods=1000,
+        init_template=PodTemplate(labels={"app": "aff"}),
+        template=PodTemplate(preferred_anti_affinity_zone=True,
+                             labels={"app": "aff"}),
+        max_batch=1024, timeout=900.0,
+    ),
+    # SchedulingNodeAffinity: required node affinity zone In [0, 1]
+    "nodeaffinity": Workload(
+        "SchedulingNodeAffinity-500n", num_nodes=500, num_init_pods=1000,
+        num_pods=1000,
+        template=PodTemplate(node_affinity_zones=["zone-0", "zone-1"]),
+        max_batch=1024,
+    ),
+    # 5000-node PV variant: the volume class at headline scale
+    "intreepvs5000": Workload(
+        "SchedulingInTreePVs-5000n", num_nodes=5000, num_init_pods=2048,
+        num_pods=5000, template=PodTemplate(with_pvc="zonal"),
+        max_batch=2048, timeout=900.0,
+    ),
 }
 
 

@@ -323,6 +323,90 @@ class TestPatch:
         pod = cs.pods.get("p2", "default")
         assert pod.metadata.labels == {"app": "z"}
 
+    def test_strategic_patch_merges_containers_by_name(self, kubectl):
+        """The default --type strategic merges list fields by their
+        patchMergeKey (containers by name): patching one container's
+        image must keep the other container."""
+        k, cs, out = kubectl
+        pod = make_pod("p4")
+        from kubernetes_tpu.api import types as v1
+
+        pod.spec.containers.append(
+            v1.Container(name="sidecar", image="registry.example/side:v1")
+        )
+        cs.pods.create(pod)
+        assert k.run([
+            "patch", "pods", "p4",
+            "-p", '{"spec":{"containers":[{"name":"c0","image":"new:v2"}]}}',
+        ]) == 0
+        got = cs.pods.get("p4", "default")
+        by_name = {c.name: c for c in got.spec.containers}
+        assert set(by_name) == {"c0", "sidecar"}
+        assert by_name["c0"].image == "new:v2"
+        assert by_name["sidecar"].image == "registry.example/side:v1"
+
+    def test_merge_patch_replaces_containers_wholesale(self, kubectl):
+        """--type merge keeps RFC 7386 list semantics: replace."""
+        k, cs, out = kubectl
+        pod = make_pod("p5")
+        from kubernetes_tpu.api import types as v1
+
+        pod.spec.containers.append(
+            v1.Container(name="sidecar", image="registry.example/side:v1")
+        )
+        cs.pods.create(pod)
+        assert k.run([
+            "patch", "pods", "p5", "--type", "merge",
+            "-p", '{"spec":{"containers":[{"name":"c0","image":"new:v2"}]}}',
+        ]) == 0
+        got = cs.pods.get("p5", "default")
+        assert [c.name for c in got.spec.containers] == ["c0"]
+
+    def test_strategic_patch_delete_directive(self, kubectl):
+        k, cs, out = kubectl
+        pod = make_pod("p6")
+        from kubernetes_tpu.api import types as v1
+
+        pod.spec.containers.append(
+            v1.Container(name="sidecar", image="registry.example/side:v1")
+        )
+        cs.pods.create(pod)
+        assert k.run([
+            "patch", "pods", "p6",
+            "-p",
+            '{"spec":{"containers":[{"name":"sidecar","$patch":"delete"}]}}',
+        ]) == 0
+        got = cs.pods.get("p6", "default")
+        assert [c.name for c in got.spec.containers] == ["c0"]
+
+    def test_strategic_patch_service_ports_merge_by_port(self, kubectl):
+        """ServiceSpec.Ports merges by `port` (not containerPort): adding
+        a nodePort to one port must keep the other ports."""
+        k, cs, out = kubectl
+        from kubernetes_tpu.api import types as v1
+
+        cs.resource("services").create(
+            v1.Service(
+                metadata=v1.ObjectMeta(name="svc", namespace="default"),
+                spec=v1.ServiceSpec(
+                    selector={"app": "a"},
+                    ports=[
+                        v1.ServicePort(name="http", port=80, target_port=8080),
+                        v1.ServicePort(name="https", port=443, target_port=8443),
+                    ],
+                ),
+            )
+        )
+        assert k.run([
+            "patch", "services", "svc",
+            "-p", '{"spec":{"ports":[{"port":80,"nodePort":30080}]}}',
+        ]) == 0
+        got = cs.resource("services").get("svc", "default")
+        by_port = {p.port: p for p in got.spec.ports}
+        assert set(by_port) == {80, 443}
+        assert by_port[80].node_port == 30080
+        assert by_port[80].target_port == 8080
+
     def test_patch_status_subresource(self, kubectl):
         k, cs, out = kubectl
         cs.pods.create(make_pod("p3"))

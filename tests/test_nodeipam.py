@@ -1,0 +1,166 @@
+"""NodeIpamController: central podCIDR allocation.
+
+Reference behaviors pinned (pkg/controller/nodeipam/ipam/
+range_allocator.go + cidr_set.go): lowest-free-subnet allocation,
+occupation of pre-recorded CIDRs at startup, release + reuse on node
+delete, exhaustion handling, and the kubelet consuming spec.podCIDR
+into its CNI range.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Clientset, SharedInformerFactory
+from kubernetes_tpu.controllers.manager import new_controller_initializers
+from kubernetes_tpu.controllers.nodeipam import CIDRSet, NodeIpamController
+
+from .util import wait_until
+from kubernetes_tpu.testing.synth import make_node
+
+
+@pytest.fixture()
+def cluster():
+    api = APIServer()
+    cs = Clientset(api)
+    factory = SharedInformerFactory(cs)
+    started = []
+
+    def start(*ctrls):
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        for c in ctrls:
+            c.run()
+            started.append(c)
+        return ctrls
+
+    yield api, cs, factory, start
+    for c in started:
+        c.stop()
+    factory.stop()
+
+
+class TestCIDRSet:
+    def test_lowest_free_and_reuse(self):
+        s = CIDRSet("10.244.0.0/16", 24)
+        assert s.max_cidrs == 256
+        assert s.allocate_next() == "10.244.0.0/24"
+        assert s.allocate_next() == "10.244.1.0/24"
+        s.release("10.244.0.0/24")
+        assert s.allocate_next() == "10.244.0.0/24"
+
+    def test_occupy_blocks_allocation(self):
+        s = CIDRSet("10.244.0.0/16", 24)
+        s.occupy("10.244.0.0/24")
+        assert s.allocate_next() == "10.244.1.0/24"
+
+    def test_exhaustion_returns_none(self):
+        s = CIDRSet("10.244.0.0/24", 26)
+        got = [s.allocate_next() for _ in range(4)]
+        assert got == ["10.244.0.0/26", "10.244.0.64/26",
+                       "10.244.0.128/26", "10.244.0.192/26"]
+        assert s.allocate_next() is None
+
+    def test_foreign_cidr_rejected(self):
+        s = CIDRSet("10.244.0.0/16", 24)
+        with pytest.raises(ValueError):
+            s.occupy("192.168.0.0/24")
+
+
+class TestController:
+    def test_allocates_to_new_nodes(self, cluster):
+        api, cs, factory, start = cluster
+        ctrl = NodeIpamController(cs, factory)
+        start(ctrl)
+        for i in range(3):
+            cs.nodes.create(make_node(f"n{i}"))
+        assert wait_until(
+            lambda: all(
+                cs.nodes.get(f"n{i}").spec.pod_cidr for i in range(3)
+            )
+        )
+        cidrs = {cs.nodes.get(f"n{i}").spec.pod_cidr for i in range(3)}
+        assert len(cidrs) == 3
+        assert all(c.startswith("10.244.") and c.endswith("/24") for c in cidrs)
+
+    def test_occupies_existing_and_releases_on_delete(self, cluster):
+        api, cs, factory, start = cluster
+        pre = make_node("pre")
+        pre.spec.pod_cidr = "10.244.0.0/24"
+        cs.nodes.create(pre)
+        ctrl = NodeIpamController(cs, factory)
+        start(ctrl)
+        cs.nodes.create(make_node("fresh"))
+        assert wait_until(lambda: cs.nodes.get("fresh").spec.pod_cidr)
+        # pre-recorded subnet was occupied, not re-handed out
+        assert cs.nodes.get("fresh").spec.pod_cidr != "10.244.0.0/24"
+        cs.nodes.delete("pre")
+        assert wait_until(lambda: ctrl.cidrs.used_count() == 1)
+        cs.nodes.create(make_node("next"))
+        assert wait_until(
+            lambda: cs.nodes.get("next").spec.pod_cidr == "10.244.0.0/24"
+        )
+
+    def test_exhaustion_then_release_recovers(self, cluster):
+        api, cs, factory, start = cluster
+        ctrl = NodeIpamController(cs, factory,
+                                  cluster_cidr="10.9.0.0/24",
+                                  node_cidr_mask_size=26)
+        start(ctrl)
+        for i in range(5):  # only 4 subnets exist
+            cs.nodes.create(make_node(f"n{i}"))
+        assert wait_until(
+            lambda: sum(
+                1 for i in range(5) if cs.nodes.get(f"n{i}").spec.pod_cidr
+            ) == 4
+        )
+        starved = next(
+            f"n{i}" for i in range(5) if not cs.nodes.get(f"n{i}").spec.pod_cidr
+        )
+        victim = next(
+            f"n{i}" for i in range(5) if cs.nodes.get(f"n{i}").spec.pod_cidr
+        )
+        cs.nodes.delete(victim)
+        # the release may be claimed by the starved node's still-queued
+        # sync immediately; otherwise a poke re-enqueues it
+        n = cs.nodes.get(starved)
+        n.metadata.labels["poke"] = "1"
+        cs.nodes.update(n)
+        assert wait_until(lambda: cs.nodes.get(starved).spec.pod_cidr)
+        assert ctrl.cidrs.used_count() == 4  # 4 nodes, 4 subnets
+
+    def test_registered_as_initializer(self):
+        assert "nodeipam" in new_controller_initializers()
+
+
+class TestKubeletConsumption:
+    def test_kubelet_applies_pod_cidr_to_cni(self):
+        from kubernetes_tpu.kubelet.cri import FakeRuntimeService
+
+        rt = FakeRuntimeService()
+        rt.set_pod_cidr("10.244.7.0/24")
+        sid = rt.run_pod_sandbox("p", "default", "uid-1")
+        ip = next(
+            sb.ip for sb in rt.list_pod_sandboxes() if sb.id == sid
+        )
+        assert ip.startswith("10.244.7.")
+
+    def test_kubelet_status_sync_consumes_spec(self, cluster):
+        from kubernetes_tpu.kubelet.kubelet import Kubelet, KubeletConfig
+
+        api, cs, factory, start = cluster
+        ctrl = NodeIpamController(cs, factory)
+        start(ctrl)
+        kl = Kubelet(
+            cs, factory,
+            config=KubeletConfig(node_name="kn0", node_status_period=0.1),
+        )
+        kl.run()
+        try:
+            assert wait_until(lambda: cs.nodes.get("kn0").spec.pod_cidr)
+            cidr = cs.nodes.get("kn0").spec.pod_cidr
+            prefix = ".".join(cidr.split("/")[0].split(".")[:3])
+            assert wait_until(lambda: kl.runtime._ip_prefix == prefix)
+        finally:
+            kl.stop()

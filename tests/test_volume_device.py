@@ -1,0 +1,297 @@
+"""Volume device path: bound-PVC pods on the kernel, parity vs the oracle.
+
+Pins (scheduler/volume_device.py):
+  * envelope gating — unbound PVCs, shared claims, oversized term
+    products stay on the oracle path;
+  * PV nodeAffinity + VolumeZone constraints ride the kernel's
+    node-affinity mask with decisions identical to the oracle plugins
+    (volume_binding.go bound-check, volume_zone.go);
+  * CSI attach limits ride the resource-fit mask
+    (nodevolumelimits/csi.go semantics via attachable-volumes-csi-*);
+  * the live scheduler loop binds PVC pods through the kernel path
+    (no oracle diversion) with correct placement.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.scheduler.volume_device import (
+    VolumeDeviceResolver,
+    attach_resource_name,
+    distribute_term_groups,
+)
+
+from .test_volumes import mk_pv, mk_pvc, pod_with_pvc
+from .util import make_node, wait_until
+
+
+def mk_resolver(pvcs=(), pvs=(), csinodes=()):
+    return VolumeDeviceResolver(
+        lambda: list(pvcs), lambda: list(pvs), lambda: list(csinodes)
+    )
+
+
+class TestEnvelope:
+    def test_unbound_pvc_is_oracle(self):
+        pvc = mk_pvc("c1")  # no volume_name
+        r = mk_resolver(pvcs=[pvc])
+        assert r.resolve(pod_with_pvc("p", "c1")) is None
+
+    def test_missing_pvc_is_oracle(self):
+        r = mk_resolver()
+        assert r.resolve(pod_with_pvc("p", "ghost")) is None
+
+    def test_shared_claim_is_oracle(self):
+        pvc = mk_pvc("c1", volume_name="pv1")
+        pv = mk_pv("pv1")
+        r = mk_resolver(pvcs=[pvc], pvs=[pv])
+        assert r.resolve(pod_with_pvc("a", "c1")) is not None
+        r.pod_added(pod_with_pvc("a", "c1"))  # a is now assumed/assigned
+        assert r.resolve(pod_with_pvc("b", "c1")) is None
+        r.pod_removed(pod_with_pvc("a", "c1"))
+        assert r.resolve(pod_with_pvc("b", "c1")) is not None
+
+    def test_bound_resolves_with_affinity_and_scalars(self):
+        pvc = mk_pvc("c1", volume_name="pv1")
+        pv = mk_pv("pv1", node="node-3")
+        pv.spec.csi = {"driver": "ebs.csi.aws.com", "volumeHandle": "h1"}
+        r = mk_resolver(pvcs=[pvc], pvs=[pv])
+        res = r.resolve(pod_with_pvc("p", "c1"))
+        assert res is not None
+        assert len(res.term_groups) == 1  # the PV's required terms
+        assert res.extra_scalars == {
+            attach_resource_name("ebs.csi.aws.com"): 1
+        }
+
+
+class TestDistribution:
+    def test_two_groups_distribute(self):
+        t = lambda k, vals: v1.NodeSelectorTerm(match_expressions=[
+            v1.NodeSelectorRequirement(key=k, operator="In", values=vals)
+        ])
+        out = distribute_term_groups(
+            None, [[t("a", ["1"]), t("a", ["2"])], [t("b", ["x"])]]
+        )
+        assert len(out) == 2
+        for term in out:
+            keys = [r.key for r in term.match_expressions]
+            assert keys.count("b") == 1
+
+    def test_empty_group_is_never(self):
+        out = distribute_term_groups(
+            None, [[v1.NodeSelectorTerm()]]  # empty term matches nothing
+        )
+        assert len(out) == 1
+        assert out[0].match_expressions[0].values == []
+
+
+def _live_cluster(n_nodes=6):
+    from kubernetes_tpu.apiserver import APIServer
+    from kubernetes_tpu.client import Clientset, SharedInformerFactory
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+    api = APIServer()
+    cs = Clientset(api)
+    for i in range(n_nodes):
+        cs.nodes.create(make_node(
+            f"node-{i}",
+            labels={
+                v1.LABEL_HOSTNAME: f"node-{i}",
+                v1.LABEL_ZONE: f"zone-{i % 3}",
+            },
+        ))
+    factory = SharedInformerFactory(cs)
+    sched = Scheduler(cs, factory, backend="tpu")
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    return api, cs, factory, sched
+
+
+class TestLiveLoop:
+    def test_pv_node_affinity_steers_placement(self):
+        api, cs, factory, sched = _live_cluster()
+        try:
+            for i in range(3):
+                cs.resource("persistentvolumes").create(
+                    mk_pv(f"pv{i}", node=f"node-{2 * i}", phase="Bound")
+                )
+                cs.resource("persistentvolumeclaims").create(
+                    mk_pvc(f"c{i}", volume_name=f"pv{i}")
+                )
+            sched.start()
+            for i in range(3):
+                cs.pods.create(pod_with_pvc(f"p{i}", f"c{i}"))
+            assert wait_until(
+                lambda: all(
+                    cs.pods.get(f"p{i}", "default").spec.node_name
+                    for i in range(3)
+                ),
+                timeout=60,
+            )
+            for i in range(3):
+                assert cs.pods.get(f"p{i}", "default").spec.node_name \
+                    == f"node-{2 * i}", i
+            # a fresh bound claim rides the kernel (no oracle diversion);
+            # a claim already in use by a bound pod correctly does NOT
+            cs.resource("persistentvolumes").create(
+                mk_pv("pv9", node="node-1", phase="Bound")
+            )
+            cs.resource("persistentvolumeclaims").create(
+                mk_pvc("c9", volume_name="pv9")
+            )
+            assert wait_until(
+                lambda: not sched._needs_oracle(pod_with_pvc("probe", "c9"))
+            )
+            assert sched._needs_oracle(pod_with_pvc("probe2", "c0"))
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_zone_labelled_pv_constrains_to_zone(self):
+        api, cs, factory, sched = _live_cluster()
+        try:
+            cs.resource("persistentvolumes").create(
+                mk_pv("pvz", labels={v1.LABEL_ZONE: "zone-1"}, phase="Bound")
+            )
+            cs.resource("persistentvolumeclaims").create(
+                mk_pvc("cz", volume_name="pvz")
+            )
+            sched.start()
+            cs.pods.create(pod_with_pvc("pz", "cz"))
+            assert wait_until(
+                lambda: cs.pods.get("pz", "default").spec.node_name,
+                timeout=60,
+            )
+            node = cs.pods.get("pz", "default").spec.node_name
+            got = cs.nodes.get(node)
+            assert got.metadata.labels[v1.LABEL_ZONE] == "zone-1"
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_csi_attach_limits_enforced(self):
+        """2 nodes x limit 1: three 1-volume pods -> exactly two bind;
+        the third parks unschedulable (csi.go CSILimits)."""
+        from kubernetes_tpu.api.storage import (
+            CSINode,
+            CSINodeDriver,
+            CSINodeSpec,
+        )
+
+        api, cs, factory, sched = _live_cluster(n_nodes=2)
+        try:
+            for i in range(2):
+                cs.resource("csinodes").create(CSINode(
+                    metadata=v1.ObjectMeta(name=f"node-{i}"),
+                    spec=CSINodeSpec(drivers=[
+                        CSINodeDriver(name="x.csi.example", count=1)
+                    ]),
+                ))
+            for i in range(3):
+                pv = mk_pv(f"pv{i}", phase="Bound")
+                pv.spec.csi = {"driver": "x.csi.example",
+                               "volumeHandle": f"h{i}"}
+                cs.resource("persistentvolumes").create(pv)
+                cs.resource("persistentvolumeclaims").create(
+                    mk_pvc(f"c{i}", volume_name=f"pv{i}")
+                )
+            sched.start()
+            for i in range(3):
+                cs.pods.create(pod_with_pvc(f"p{i}", f"c{i}"))
+
+            def bound():
+                pods, _ = cs.pods.list(namespace="default")
+                return sum(1 for p in pods if p.spec.node_name)
+
+            assert wait_until(lambda: bound() == 2, timeout=60)
+            import time
+
+            time.sleep(2.0)  # the third must STAY unschedulable
+            assert bound() == 2
+            nodes_used = {
+                p.spec.node_name
+                for p, in [(p,) for p in cs.pods.list(namespace="default")[0]]
+                if p.spec.node_name
+            }
+            assert nodes_used == {"node-0", "node-1"}
+        finally:
+            sched.stop()
+            factory.stop()
+
+
+class TestOracleParity:
+    def test_fuzz_kernel_vs_oracle_decision(self):
+        """Randomized clusters with per-node PVs: the kernel's feasible
+        set must equal the oracle filter chain's on every trial."""
+        from kubernetes_tpu.scheduler.framework.interface import CycleState
+        from kubernetes_tpu.scheduler.framework.runtime import Framework
+        from kubernetes_tpu.scheduler.framework.snapshot import Snapshot
+        from kubernetes_tpu.scheduler.plugins.registry import (
+            default_plugins,
+            new_in_tree_registry,
+        )
+        from kubernetes_tpu.scheduler.tpu_backend import TPUBackend
+        from kubernetes_tpu.scheduler.framework.interface import FitError
+
+        rng = random.Random(7)
+        for trial in range(10):
+            n = rng.randint(2, 6)
+            nodes = [
+                make_node(
+                    f"n{i}",
+                    labels={
+                        v1.LABEL_HOSTNAME: f"n{i}",
+                        v1.LABEL_ZONE: f"z{i % 2}",
+                    },
+                )
+                for i in range(n)
+            ]
+            # one PV, randomly zone-labelled or host-pinned
+            if rng.random() < 0.5:
+                pv = mk_pv("pv0", labels={v1.LABEL_ZONE: f"z{rng.randint(0, 1)}"},
+                           phase="Bound")
+            else:
+                pv = mk_pv("pv0", node=f"n{rng.randrange(n)}", phase="Bound")
+            pvc = mk_pvc("c0", volume_name="pv0")
+            pod = pod_with_pvc("pend", "c0")
+            resolver = mk_resolver(pvcs=[pvc], pvs=[pv])
+
+            # oracle: full filter chain over the snapshot
+            from kubernetes_tpu.volume.binder import SchedulerVolumeBinder
+
+            snapshot = Snapshot.from_objects([], nodes)
+            fwk = Framework(
+                new_in_tree_registry(), plugins=default_plugins(),
+                snapshot_fn=lambda: snapshot,
+                handle_extras={
+                    "volume_binder": SchedulerVolumeBinder(
+                        lambda: [pvc], lambda: [pv], lambda: []
+                    ),
+                    "volume_listers": (lambda: [pvc], lambda: [pv]),
+                    "csi_node_lister": lambda: [],
+                },
+            )
+            state = CycleState()
+            assert fwk.run_pre_filter_plugins(state, pod) is None
+            oracle_ok = {
+                ni.node.metadata.name
+                for ni in snapshot.list()
+                if not fwk.run_filter_plugins(state, pod, ni)
+            }
+
+            # kernel: backend with the resolver, same cluster
+            backend = TPUBackend()
+            backend.set_volume_resolver(resolver)
+            for node in nodes:
+                backend.on_add_node(node)
+            try:
+                r = backend.schedule(pod)
+                assert r.suggested_host in oracle_ok, trial
+                assert len(oracle_ok) >= 1, trial
+                assert r.feasible_nodes == len(oracle_ok), trial
+            except FitError:
+                assert not oracle_ok, trial
