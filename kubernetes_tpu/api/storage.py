@@ -7,7 +7,7 @@ CSINode) and scheduling/v1/types.go (PriorityClass).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from .types import ObjectMeta
 
@@ -61,3 +61,27 @@ class PriorityClass:
     preemption_policy: Optional[str] = None
     kind: str = "PriorityClass"
     api_version: str = "scheduling.k8s.io/v1"
+
+
+# -- node.k8s.io/v1 RuntimeClass (staging/src/k8s.io/api/node/v1/types.go)
+
+
+@dataclass
+class RuntimeClassOverhead:
+    pod_fixed: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class RuntimeClassScheduling:
+    node_selector: Optional[Dict[str, str]] = None
+    tolerations: Optional[List] = None  # List[v1.Toleration]
+
+
+@dataclass
+class RuntimeClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    handler: str = ""
+    overhead: Optional[RuntimeClassOverhead] = None
+    scheduling: Optional[RuntimeClassScheduling] = None
+    kind: str = "RuntimeClass"
+    api_version: str = "node.k8s.io/v1"

@@ -281,6 +281,7 @@ class PodSpec:
     preemption_policy: Optional[str] = None  # PreemptLowerPriority | Never
     scheduler_name: str = ""
     overhead: Optional[Dict[str, str]] = None
+    runtime_class_name: Optional[str] = None  # node.k8s.io RuntimeClass
     host_network: bool = False
     host_pid: bool = False
     host_ipc: bool = False

@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..api import types as v1
 from ..api.quantity import Quantity, parse_quantity
+from ..utils import serde
 from .server import APIServer, Invalid, NotFound
 
 DEFAULT_TOLERATION_SECONDS = 300  # defaulttolerationseconds/admission.go:38
@@ -560,22 +561,268 @@ def pod_security(api: APIServer):
     return admit
 
 
+def persistent_volume_claim_resize(api: APIServer):
+    """PersistentVolumeClaimResize (plugin/pkg/admission/storage/
+    persistentvolume/resize/admission.go): a PVC storage request may only
+    GROW, and only when its StorageClass allows volume expansion."""
+    from ..api.quantity import Quantity
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "persistentvolumeclaims" or op != "UPDATE":
+            return
+        try:
+            old = api.get(
+                "persistentvolumeclaims", obj.metadata.name,
+                obj.metadata.namespace,
+            )
+        except NotFound:
+            return
+        new_req = (obj.spec.resources.requests or {}).get("storage") \
+            if obj.spec.resources else None
+        old_req = (old.spec.resources.requests or {}).get("storage") \
+            if old.spec.resources else None
+        if new_req is None or old_req is None:
+            return
+        new_q, old_q = Quantity(new_req).value(), Quantity(old_req).value()
+        if new_q == old_q:
+            return
+        if new_q < old_q:
+            raise Invalid(
+                "persistent volume claims cannot be shrunk "
+                f"({old_req} -> {new_req})"
+            )
+        # growth: the class must allow expansion (admission.go:119)
+        cls_name = obj.spec.storage_class_name or old.spec.storage_class_name
+        allow = False
+        if cls_name:
+            try:
+                sc = api.get("storageclasses", cls_name)
+                allow = bool(getattr(sc, "allow_volume_expansion", False))
+            except NotFound:
+                allow = False
+        if not allow:
+            raise Invalid(
+                "only dynamically provisioned pvc can be resized and "
+                "the storageclass that provisions the pvc must support resize"
+            )
+
+    return admit
+
+
+def taint_nodes_by_condition(api: APIServer):
+    """TaintNodesByCondition (plugin/pkg/admission/nodetaint/
+    admission.go): every NEW node starts tainted
+    node.kubernetes.io/not-ready:NoSchedule until its lifecycle
+    controller observes a Ready condition and lifts it."""
+    NOT_READY = "node.kubernetes.io/not-ready"
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "nodes" or op != "CREATE":
+            return
+        taints = list(obj.spec.taints or [])
+        if any(t.key == NOT_READY and t.effect == "NoSchedule"
+               for t in taints):
+            return
+        taints.append(v1.Taint(key=NOT_READY, effect="NoSchedule"))
+        obj.spec.taints = taints
+
+    return admit
+
+
+def runtime_class_admission(api: APIServer):
+    """RuntimeClass (plugin/pkg/admission/runtimeclass/admission.go):
+    resolve spec.runtimeClassName at pod CREATE — the class must exist,
+    its overhead is stamped onto the pod (conflicting user-set overhead
+    rejected), and its scheduling constraints merge into the pod."""
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "pods" or op != "CREATE":
+            return
+        name = obj.spec.runtime_class_name
+        if not name:
+            return
+        try:
+            rc = api.get("runtimeclasses", name)
+        except NotFound:
+            raise Invalid(f"pod rejected: RuntimeClass {name!r} not found")
+        if rc.overhead is not None and rc.overhead.pod_fixed:
+            if obj.spec.overhead and obj.spec.overhead != rc.overhead.pod_fixed:
+                raise Invalid(
+                    "pod rejected: Pod's Overhead doesn't match "
+                    f"RuntimeClass's defined Overhead ({rc.overhead.pod_fixed})"
+                )
+            obj.spec.overhead = dict(rc.overhead.pod_fixed)
+        if rc.scheduling is not None:
+            if rc.scheduling.node_selector:
+                merged = dict(obj.spec.node_selector or {})
+                for k, val in rc.scheduling.node_selector.items():
+                    if k in merged and merged[k] != val:
+                        raise Invalid(
+                            "pod rejected: conflict with RuntimeClass "
+                            f"nodeSelector key {k!r}"
+                        )
+                    merged[k] = val
+                obj.spec.node_selector = merged
+            if rc.scheduling.tolerations:
+                obj.spec.tolerations = list(obj.spec.tolerations or []) + [
+                    t if isinstance(t, v1.Toleration)
+                    else serde.from_dict(v1.Toleration, t)
+                    for t in rc.scheduling.tolerations
+                ]
+
+    return admit
+
+
+def certificate_approval(api: APIServer):
+    """CertificateApproval (plugin/pkg/admission/certificates/approval/
+    admission.go:44): adding an Approved/Denied condition requires the
+    requester to hold the `approve` verb on `signers` for the CSR's
+    signerName (exact name or the <domain>/* wildcard)."""
+    from ..api import certificates as certs
+    from .requestcontext import current_user
+
+    return _certificate_verb_gate(
+        api, verb="approve",
+        changed=lambda old, new: (
+            _csr_condition_types(new) - _csr_condition_types(old)
+        ) & {certs.APPROVED, certs.DENIED},
+        current_user=current_user,
+    )
+
+
+def certificate_signing(api: APIServer):
+    """CertificateSigning (plugin/pkg/admission/certificates/signing/
+    admission.go): populating status.certificate requires the `sign`
+    verb on the CSR's signer."""
+    from .requestcontext import current_user
+
+    def changed(old, new) -> bool:
+        return bool(new.status.certificate) and (
+            old is None or new.status.certificate != old.status.certificate
+        )
+
+    return _certificate_verb_gate(
+        api, verb="sign", changed=changed, current_user=current_user,
+    )
+
+
+def _csr_condition_types(csr) -> set:
+    if csr is None:
+        return set()
+    return {c.type for c in csr.status.conditions or []}
+
+
+def _certificate_verb_gate(api: APIServer, verb: str, changed, current_user):
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "certificatesigningrequests" or op != "UPDATE":
+            return
+        authorizer = getattr(api, "authorizer", None)
+        user = current_user()
+        if authorizer is None or user is None:
+            # no RBAC surface on this server (plain APIServer) — the
+            # reference plugin equally requires an authorizer to act
+            return
+        try:
+            old = api.get("certificatesigningrequests", obj.metadata.name)
+        except NotFound:
+            old = None
+        if not changed(old, obj):
+            return
+        signer = obj.spec.signer_name
+        domain = signer.split("/", 1)[0] + "/*" if "/" in signer else signer
+        if authorizer.authorize(user, verb, "signers", "", signer) or \
+                authorizer.authorize(user, verb, "signers", "", domain):
+            return
+        from .auth import Forbidden
+        raise Forbidden(
+            f"user not permitted to {verb} requests with signerName "
+            f"{signer!r}"
+        )
+
+    return admit
+
+
+def certificate_subject_restriction(api: APIServer):
+    """CertificateSubjectRestriction (plugin/pkg/admission/certificates/
+    subjectrestriction/admission.go): the kube-apiserver-client signer
+    must never issue a certificate claiming system:masters."""
+    import json as _json
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "certificatesigningrequests" or op != "CREATE":
+            return
+        if obj.spec.signer_name != "kubernetes.io/kube-apiserver-client":
+            return
+        try:
+            req = _json.loads(obj.spec.request or "{}")
+        except ValueError:
+            return
+        groups = req.get("groups") or req.get("organizations") or []
+        if "system:masters" in groups:
+            raise Invalid(
+                "use of kubernetes.io/kube-apiserver-client signer with "
+                "system:masters group is not allowed"
+            )
+
+    return admit
+
+
+def default_ingress_class(api: APIServer):
+    """DefaultIngressClass (plugin/pkg/admission/network/
+    defaultingressclass/admission.go): an Ingress created without
+    ingressClassName gets the cluster default; two defaults is a
+    configuration error."""
+    from ..api.networking import DEFAULT_INGRESS_CLASS_ANNOTATION
+
+    def admit(resource: str, op: str, obj) -> None:
+        if resource != "ingresses" or op != "CREATE":
+            return
+        if obj.spec.ingress_class_name is not None:
+            return
+        try:
+            classes, _ = api.list("ingressclasses")
+        except NotFound:
+            return
+        defaults = [
+            c for c in classes
+            if (c.metadata.annotations or {}).get(
+                DEFAULT_INGRESS_CLASS_ANNOTATION) == "true"
+        ]
+        if not defaults:
+            return
+        if len(defaults) > 1:
+            raise Invalid(
+                f"{len(defaults)} default IngressClasses were found, "
+                "only 1 allowed"
+            )
+        obj.spec.ingress_class_name = defaults[0].metadata.name
+
+    return admit
+
+
 def default_admission_chain(api: APIServer) -> Tuple[List, List]:
     """(mutating, validating) — reference default-enabled order
     (kubeapiserver/options/plugins.go:108-140, minus cloud/deprecated)."""
     mutating = [
         namespace_lifecycle(api),
         service_account_admission(api),
+        taint_nodes_by_condition(api),
         priority_admission(api),
+        runtime_class_admission(api),
         default_toleration_seconds(api),
         limit_ranger(api),
         default_storage_class(api),
         storage_object_in_use_protection(api),
+        default_ingress_class(api),
     ]
     validating = [
         node_restriction(api),
         pod_security(api),
         event_rate_limit(api),
+        persistent_volume_claim_resize(api),
+        certificate_approval(api),
+        certificate_signing(api),
+        certificate_subject_restriction(api),
         resource_quota(api),
     ]
     return mutating, validating

@@ -230,18 +230,31 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
             self.wfile.flush()
 
+        def encode(ev) -> bytes:
+            return json.dumps({
+                "type": ev.type,
+                "revision": ev.revision,
+                "object": serde.to_dict(ev.object),
+            }).encode() + b"\n"
+
         try:
             while self.hub.running:
                 ev = w.poll(timeout=0.5)
                 if ev is None:
                     chunk(b" \n")  # heartbeat keeps dead peers detectable
                     continue
-                line = json.dumps({
-                    "type": ev.type,
-                    "revision": ev.revision,
-                    "object": serde.to_dict(ev.object),
-                }).encode() + b"\n"
-                chunk(line)
+                # drain everything already queued into ONE chunk: a
+                # 2048-pod bind wave is 2048 MODIFIED events, and one
+                # frame+flush per event made the watch stream the wire
+                # path's throughput ceiling (the client's readline loop
+                # splits lines, so framing is free to batch)
+                buf = [encode(ev)]
+                while len(buf) < 512:
+                    ev = w.poll(timeout=0)
+                    if ev is None:
+                        break
+                    buf.append(encode(ev))
+                chunk(b"".join(buf))
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
@@ -277,6 +290,21 @@ class _Handler(BaseHTTPRequestHandler):
                         {"code": getattr(e, "code", 500), "message": str(e)}
                     )
             return self._send_json(200, {"outcomes": outcomes})
+        if resource == "bulkcreate":
+            # TPU-build extension beside bulkbindings: N creates of one
+            # resource in one request (the event firehose), best-effort
+            # per-item outcomes
+            body = self._body()
+            target = body.get("resource", "")
+            info = api._info(target)
+            n_ok = 0
+            for item in body.get("items") or []:
+                try:
+                    api.create(target, serde.from_dict(info.type, item))
+                    n_ok += 1
+                except APIError:
+                    pass
+            return self._send_json(200, {"created": n_ok})
         if resource == "pods" and sub == "exec":
             body = self._body()
             out, code = api.pod_exec(
@@ -618,6 +646,24 @@ class RemoteAPIServer:
             serde.to_dict(obj),
         )
         return serde.from_dict(info.type, data)
+
+    def create_bulk(self, resource: str, objs) -> None:
+        """N creates in ONE request (bulkcreate extension route),
+        best-effort; falls back to per-object POSTs on older servers."""
+        try:
+            self._request(
+                "POST", "/api/v1/bulkcreate",
+                {"resource": resource,
+                 "items": [serde.to_dict(o) for o in objs]},
+            )
+            return
+        except NotFound:
+            pass
+        for obj in objs:
+            try:
+                self.create(resource, obj)
+            except APIError:
+                pass
 
     def get(self, resource: str, name: str, namespace: str = "") -> Any:
         info = self._info(resource)

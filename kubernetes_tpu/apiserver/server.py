@@ -70,6 +70,7 @@ def _default_resources() -> Tuple["ResourceInfo", ...]:
         certificates,
         discovery,
         metrics,
+        networking,
         rbac,
         storage,
     )
@@ -119,6 +120,10 @@ def _default_resources() -> Tuple["ResourceInfo", ...]:
         ResourceInfo("storageclasses", storage.StorageClass, False),
         ResourceInfo("csinodes", storage.CSINode, False),
         ResourceInfo("priorityclasses", storage.PriorityClass, False),
+        ResourceInfo("runtimeclasses", storage.RuntimeClass, False),
+        ResourceInfo("networkpolicies", networking.NetworkPolicy, True),
+        ResourceInfo("ingresses", networking.Ingress, True),
+        ResourceInfo("ingressclasses", networking.IngressClass, False),
     )
 
 

@@ -24,6 +24,20 @@ class _ResourceClient:
     def create(self, obj: Any) -> Any:
         return self._api.create(self._resource, obj)
 
+    def create_many(self, objs) -> None:
+        """Best-effort bulk create (event firehose): ONE request on
+        wire-backed servers (create_bulk), a loop in-proc; individual
+        failures are swallowed (callers are fire-and-forget paths)."""
+        bulk = getattr(self._api, "create_bulk", None)
+        if bulk is not None:
+            bulk(self._resource, list(objs))
+            return
+        for obj in objs:
+            try:
+                self._api.create(self._resource, obj)
+            except Exception:  # noqa: BLE001 — best-effort semantics
+                pass
+
     def get(self, name: str, namespace: str = "") -> Any:
         return self._api.get(self._resource, name, namespace)
 

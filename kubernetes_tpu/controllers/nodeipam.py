@@ -108,7 +108,10 @@ class NodeIpamController(Controller):
         return self._events
 
     def _on_delete(self, node) -> None:
-        """ReleaseCIDR (:240): the subnet returns to the pool."""
+        """ReleaseCIDR (:240): the subnet returns to the pool, and any
+        node still waiting (a previous exhaustion) gets re-enqueued —
+        without this the freed subnet sits idle until an unrelated
+        event happens to touch the starved node."""
         cidr = node.spec.pod_cidr or self._allocated.get(node.metadata.name)
         with self._alloc_lock:
             self._allocated.pop(node.metadata.name, None)
@@ -117,6 +120,9 @@ class NodeIpamController(Controller):
                 self.cidrs.release(cidr)
             except ValueError:
                 pass  # foreign CIDR recorded on the node; nothing to release
+            for other in self.informer.list():
+                if not other.spec.pod_cidr:
+                    self.enqueue(other.metadata.name)
 
     def sync(self, key: str) -> None:
         """AllocateOrOccupyCIDR (:214): occupy a pre-recorded podCIDR,
@@ -136,21 +142,26 @@ class NodeIpamController(Controller):
             return
         cidr = self.cidrs.allocate_next()
         if cidr is None:
-            # exhausted: the reference records a CIDRNotAvailable event
-            # and retries; the informer's next node event re-enqueues
+            # exhausted: record CIDRNotAvailable and RAISE so the
+            # rate-limited workqueue retries with backoff (the reference
+            # range_allocator returns the error for the same reason —
+            # returning success would strand the node until an
+            # unrelated event; releases also re-enqueue, _on_delete)
             self._recorder().event(
                 node, "Warning", "CIDRNotAvailable",
                 "no CIDRs remaining in cluster CIDR",
             )
-            return
+            raise RuntimeError(f"cluster CIDR exhausted; node {key} waits")
         with self._alloc_lock:
             self._allocated[key] = cidr
         try:
             fresh = self.client.nodes.get(key)
             fresh.spec.pod_cidr = cidr
             self.client.nodes.update(fresh)
-        except Exception:  # noqa: BLE001 — conflict/deleted: return the
-            # subnet; the re-enqueue (update echo / next sync) retries
+        except Exception:
+            # conflict/deleted: return the subnet and retry via the
+            # workqueue backoff
             with self._alloc_lock:
                 self._allocated.pop(key, None)
             self.cidrs.release(cidr)
+            raise

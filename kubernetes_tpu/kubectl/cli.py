@@ -232,6 +232,24 @@ class Kubectl:
                             "jsonpath-lite field=value")
         p.add_argument("--timeout", type=float, default=30.0)
 
+        p = sub.add_parser("edit")
+        p.add_argument("resource")
+        p.add_argument("name")
+
+        p = sub.add_parser("explain")
+        p.add_argument("field_path")  # resource[.field[.subfield...]]
+        p.add_argument("--recursive", action="store_true")
+
+        sub.add_parser("api-resources")
+
+        p = sub.add_parser("auth")
+        p.add_argument("subverb", choices=["can-i"])
+        p.add_argument("verb_arg")
+        p.add_argument("resource")
+        p.add_argument("--as", dest="as_user", default="")
+        p.add_argument("--as-group", dest="as_groups", action="append",
+                       default=[])
+
         args = parser.parse_args(argv)
         try:
             getattr(self, f"cmd_{args.verb.replace('-', '_')}")(args)
@@ -741,6 +759,153 @@ class Kubectl:
         raise APIError(f"timed out waiting for {want!r} on "
                        f"{resource}/{args.name}")
 
+    def cmd_edit(self, args) -> None:
+        """kubectl edit (pkg/cmd/edit): dump the live object as YAML,
+        hand it to $KUBE_EDITOR/$EDITOR, apply the edited result as an
+        update (resourceVersion preserved for optimistic concurrency)."""
+        import os
+        import subprocess
+        import tempfile
+
+        resource = self._resource(args.resource)
+        client = self._client(resource)
+        ns = args.namespace if self._namespaced(resource) else ""
+        obj = client.get(args.name, ns)
+        doc = serde.to_dict(obj)
+        editor = os.environ.get("KUBE_EDITOR") or os.environ.get("EDITOR")
+        if not editor:
+            raise APIError("KUBE_EDITOR or EDITOR must be set for edit")
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".yaml", delete=False
+        ) as f:
+            yaml.safe_dump(doc, f, sort_keys=False)
+            path = f.name
+        try:
+            proc = subprocess.run([*editor.split(), path])
+            if proc.returncode != 0:
+                raise APIError(f"editor exited with code {proc.returncode}")
+            with open(path) as f:
+                edited = yaml.safe_load(f.read())
+            if edited == doc:
+                self._print("Edit cancelled, no changes made.")
+                return
+            info = self.cs.api._info(resource)
+            new_obj = serde.from_dict(info.type, edited)
+            new_obj.metadata.resource_version = obj.metadata.resource_version
+            client.update(new_obj)
+            self._print(f"{resource}/{args.name} edited")
+        finally:
+            os.unlink(path)
+
+    def cmd_explain(self, args) -> None:
+        """kubectl explain (pkg/cmd/explain): field documentation from
+        the live type schemas — this build derives the schema from the
+        dataclass field tree the serde layer already walks, the runtime
+        analog of the reference's published OpenAPI."""
+        import dataclasses
+        import typing
+
+        parts = args.field_path.split(".")
+        resource = self._resource(parts[0])
+        info = self.cs.api._info(resource)
+        typ = info.type
+        for seg in parts[1:]:
+            hints = typing.get_type_hints(typ)
+            fields = {f.name: f for f in dataclasses.fields(typ)} \
+                if dataclasses.is_dataclass(typ) else {}
+            json_names = {
+                serde._json_key(f): f.name for f in fields.values()
+            }
+            name = json_names.get(seg, seg)
+            if name not in fields:
+                raise APIError(
+                    f"field {seg!r} does not exist in {typ.__name__}"
+                )
+            typ = _unwrap_type(hints[name])
+        self._print(f"KIND:     {info.type.__name__}")
+        self._print(f"RESOURCE: {resource}")
+        self._print(f"PATH:     {args.field_path}")
+        self._print("")
+        self._print(f"FIELD TYPE: {_type_name(typ)}")
+        if dataclasses.is_dataclass(typ):
+            self._print("FIELDS:")
+            self._explain_fields(typ, indent=2,
+                                 recursive=args.recursive, seen=set())
+
+    def _explain_fields(self, typ, indent: int, recursive: bool, seen) -> None:
+        import dataclasses
+        import typing
+
+        if typ in seen:
+            return  # recursive types (e.g. ObjectMeta loops)
+        seen = seen | {typ}
+        hints = typing.get_type_hints(typ)
+        for f in dataclasses.fields(typ):
+            ft = _unwrap_type(hints[f.name])
+            self._print(
+                " " * indent + f"{serde._json_key(f)}\t<{_type_name(ft)}>"
+            )
+            if recursive and dataclasses.is_dataclass(ft):
+                self._explain_fields(ft, indent + 2, recursive, seen)
+
+    def cmd_api_resources(self, args) -> None:
+        """kubectl api-resources: the server's resource table."""
+        rows = []
+        for name, info in sorted(self.cs.api._resources.items()):
+            t = info.type()
+            group = (
+                t.api_version.split("/", 1)[0]
+                if "/" in t.api_version else ""
+            )
+            rows.append((
+                name, group or "v1",
+                "true" if info.namespaced else "false",
+                getattr(t, "kind", info.type.__name__),
+            ))
+        hdr = ("NAME", "APIVERSION", "NAMESPACED", "KIND")
+        widths = [
+            max(len(h), *(len(r[i]) for r in rows))
+            for i, h in enumerate(hdr)
+        ]
+        self._print("   ".join(h.ljust(w) for h, w in zip(hdr, widths)).rstrip())
+        for r in rows:
+            self._print(
+                "   ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+            )
+
+    def cmd_auth(self, args) -> None:
+        """kubectl auth can-i (pkg/cmd/auth/cani.go): evaluate RBAC for
+        the current (or impersonated) identity against the server's
+        authorizer; plain servers without RBAC always allow."""
+        authorizer = getattr(self.cs.api, "authorizer", None)
+        if authorizer is None:
+            self._print("yes")  # no RBAC surface: everything allowed
+            return
+        from ..apiserver.auth import UserInfo
+        from ..apiserver.requestcontext import current_user
+
+        user = current_user()
+        if args.as_user or args.as_groups:
+            # impersonation carries ONLY the passed identity: inheriting
+            # the caller's groups (e.g. system:masters) would make every
+            # --as query answer "yes" (kubectl drops to exactly
+            # --as/--as-group)
+            user = UserInfo(
+                name=args.as_user or (user.name if user else ""),
+                groups=tuple(args.as_groups),
+            )
+        if user is None:
+            raise APIError("no identity: pass --as or authenticate")
+        resource = self._resource(args.resource)
+        ok = authorizer.authorize(
+            user, args.verb_arg, resource, args.namespace or "",
+        )
+        self._print("yes" if ok else "no")
+        if not ok:
+            raise APIError(
+                f"user {user.name!r} cannot {args.verb_arg} {resource}"
+            )
+
     def cmd_top(self, args) -> None:
         """kubectl top nodes|pods from the metrics API (metrics.k8s.io;
         staging/src/k8s.io/kubectl/pkg/cmd/top)."""
@@ -935,3 +1100,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     from ..client.clientset import Clientset
 
     return Kubectl(Clientset(APIServer())).run(argv or sys.argv[1:])
+
+
+def _unwrap_type(tp):
+    """Optional[X] -> X; List[X] -> X; Dict stays Dict (explain shows
+    the container kind via _type_name)."""
+    import typing
+
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        return _unwrap_type(args[0]) if args else tp
+    if origin in (list, tuple):
+        args = typing.get_args(tp)
+        return _unwrap_type(args[0]) if args else tp
+    return tp
+
+
+def _type_name(tp) -> str:
+    import dataclasses
+    import typing
+
+    origin = typing.get_origin(tp)
+    if origin is dict:
+        return "map[string]string"
+    if dataclasses.is_dataclass(tp):
+        return f"Object({tp.__name__})"
+    return getattr(tp, "__name__", str(tp))

@@ -270,7 +270,12 @@ class ClusterEncoding:
         self._pods[key] = (pod, node_name)
         if self.volume_hook is not None:
             self.volume_hook.pod_added(pod)
-            self._pod_extras[key] = self.volume_hook.pod_extra_scalars(pod)
+            # refcounted per-handle delta: the second sharer of a volume
+            # on a node contributes 0 (unique-handle semantics, matching
+            # NodeVolumeLimits)
+            self._pod_extras[key] = self.volume_hook.attach_delta(
+                pod, node_name, +1
+            )
         if self._rebuild_needed:
             return
         nidx = self.node_index.get(node_name)
@@ -285,9 +290,13 @@ class ClusterEncoding:
         entry = self._pods.pop(key, None)
         if entry is None:
             return
-        extras = self._pod_extras.pop(key, None)
+        self._pod_extras.pop(key, None)
+        extras = None
         if self.volume_hook is not None:
             self.volume_hook.pod_removed(entry[0])
+            # live refcount math, NOT the stored add-time delta: with a
+            # surviving sharer the handle stays attached (delta 0)
+            extras = self.volume_hook.attach_delta(entry[0], entry[1], -1)
         if self._rebuild_needed:
             return
         pidx = self.pod_index.pop(key, None)
@@ -439,14 +448,16 @@ class ClusterEncoding:
         for node_name in self._node_order:
             self._intern_node_vocabs(self._nodes[node_name])
         pod_infos: Dict[str, PodInfo] = {}
-        for key, (pod, _) in self._pods.items():
+        if self.volume_hook is not None:
+            # re-derive every attach refcount from scratch: a rebuild is
+            # where resolver-state changes (PVC rebind, CSINode update)
+            # converge into the rows
+            self.volume_hook.reset_attach()
+        for key, (pod, node_name) in self._pods.items():
             if self.volume_hook is not None:
-                # refresh BEFORE interning: a rebuild is where resolver
-                # state changes (PVC rebind, CSINode update) converge
-                # into the rows, and _intern_pod_vocabs reads the stored
-                # extras (resolving twice per pod per rebuild doubles
-                # the resolver cost for nothing)
-                self._pod_extras[key] = self.volume_hook.pod_extra_scalars(pod)
+                self._pod_extras[key] = self.volume_hook.attach_delta(
+                    pod, node_name, +1
+                )
             self._intern_pod_vocabs(pod)
             pod_infos[key] = PodInfo(pod)
 

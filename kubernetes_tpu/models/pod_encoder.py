@@ -135,6 +135,22 @@ class PodEncoder:
         ):
             out["_volver"] = self._vol_version()
             vol = self.volume_resolver.resolve(pod)
+            if vol is None and not pod.spec.node_name:
+                # the scheduler gated this pod kernel-safe, but the
+                # resolution changed before encode (a PVC/assume event
+                # raced the cycle). Encoding WITHOUT the volume
+                # constraints would let the kernel violate the PV's node
+                # affinity — fail the attempt instead; the retry
+                # re-gates. (Bound pods are pinned by NodeName; encoding
+                # them without volume constraints is safe.)
+                from ..scheduler.volume_device import (
+                    VolumeResolutionChanged,
+                )
+
+                raise VolumeResolutionChanged(
+                    f"volume resolution changed for "
+                    f"{pod.metadata.namespace}/{pod.metadata.name}"
+                )
             if vol is not None:
                 for name in vol.extra_scalars:
                     enc.scalar_vocab.intern(name)

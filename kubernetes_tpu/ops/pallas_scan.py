@@ -110,6 +110,32 @@ def _gcd_all(*arrays) -> int:
     return max(g, 1)
 
 
+@functools.partial(jax.jit, static_argnames=("n",))
+def _pack_group(n: int, *arrs):
+    return jnp.concatenate([a.ravel() for a in arrs])
+
+
+def _fetch_packed(tree: Dict) -> Dict:
+    """Device->host fetch of a dict of device arrays in ONE transfer per
+    dtype group. Fetching the ~80 prologue outputs one np.asarray at a
+    time cost a 56ms tunnel round-trip EACH — 4.6s of every session
+    rebuild was pure transfer latency."""
+    items = [(k, v) for k, v in tree.items()]
+    by_dtype: Dict = {}
+    for k, v in items:
+        by_dtype.setdefault(jnp.asarray(v).dtype, []).append(k)
+    out: Dict = {}
+    for dtype, keys in by_dtype.items():
+        arrs = [jnp.asarray(tree[k]) for k in keys]
+        packed = np.asarray(_pack_group(len(arrs), *arrs))
+        off = 0
+        for k, a in zip(keys, arrs):
+            size = int(np.prod(a.shape)) if a.shape else 1
+            out[k] = packed[off:off + size].reshape(a.shape)
+            off += size
+    return out
+
+
 class _Cfg(NamedTuple):
     """Value-hashable kernel configuration — the ONLY static jit input.
     Sessions with equal shapes/weights share one compiled program; the
@@ -120,6 +146,7 @@ class _Cfg(NamedTuple):
     ur: int
     carry_keys: tuple
     interpret: bool
+    mode: str = "full"  # full | eval | apply (see _build_kernel)
 
 
 class PallasSession:
@@ -181,13 +208,10 @@ class PallasSession:
             for k in ("ptsf_op", "ptsf_rkey", "ptsf_pairs",
                       "ptss_op", "ptss_rkey", "ptss_pairs", "self_ns")
         }
-        S = {
-            k: np.asarray(v)
-            for k, v in _session_prologue(
-                cluster, tp, dyn_ipa=self.dyn_ipa
-            ).items()
-        }
-        c = {k: np.asarray(v) for k, v in cluster.items()}
+        S = _fetch_packed(
+            _session_prologue(cluster, tp, dyn_ipa=self.dyn_ipa)
+        )
+        c = _fetch_packed(cluster)
         self._build(c, S)
         self._ipa = self._build_ipa(c, S, tp) if self.dyn_ipa else None
         if self._ipa is not None:
@@ -715,12 +739,77 @@ class PallasSession:
     def decisions(ys) -> List[int]:
         return [int(v) for v in np.asarray(ys["rows"])[0, :ys["n"]]]
 
+    # -- split eval/apply (the sharded session's building blocks) ----------
+    # A multi-chip session cannot let each shard apply its own local
+    # best: the winner is a cross-shard argmax. These run the SAME
+    # kernel in mode="eval" (masks/scores/local best, carries untouched)
+    # and mode="apply" (commit externally-decided placements; off-shard
+    # lanes no-op), so eval -> global argmax -> apply replays the full
+    # kernel exactly (pinned by tests/test_pallas_scan.py
+    # TestEvalApplySplit).
+
+    def _dispatch_mode(self, pod_arrays_list, mode, forced=None):
+        B = len(pod_arrays_list)
+        from .hoisted import batch_bucket
+
+        Bp = batch_bucket(B, minimum=LANE)
+        tmpl = np.zeros(Bp, np.int32)
+        for i, pa in enumerate(pod_arrays_list):
+            tmpl[i] = self._fps[template_fingerprint(pa)]
+        mfa, msa = match_matrices_np(self._tp_np, pod_arrays_list)
+        T, C, CP = self.T, self.C, self.CP
+        mfT = np.zeros((Bp, LANE), np.int8)
+        msT = np.zeros((Bp, LANE), np.int8)
+        for t in range(T):
+            mfT[:B, t * CP:t * CP + C] = mfa[t].reshape(B, C)
+            msT[:B, t * CP:t * CP + C] = msa[t].reshape(B, C)
+        if self._carry is None:
+            self._carry = self._initial_carry()
+        cfg, statics, ipa = self._get_bundle()
+        cfg = cfg._replace(mode=mode)
+        fvec = None
+        if mode == "apply":
+            fvec = np.zeros(2 * Bp, np.int32)
+            for i, (lane, ok) in enumerate(forced):
+                fvec[2 * i] = lane
+                fvec[2 * i + 1] = ok
+            fvec = jnp.asarray(fvec)
+        out, self._carry = _dispatch(
+            cfg, statics, ipa, jnp.asarray([B], jnp.int32), self._carry,
+            jnp.asarray(tmpl), jnp.asarray(mfT), jnp.asarray(msT),
+            forced=fvec)
+        return {"rows": out, "n": B}
+
+    def evaluate(self, pod_arrays_list: List[Dict]):
+        """Local (best, score) per pod WITHOUT carry updates — every pod
+        evaluated against the same carry state."""
+        ys = self._dispatch_mode(pod_arrays_list, "eval")
+        rows = np.asarray(ys["rows"])
+        return [
+            (int(rows[0, i]), int(rows[1, i])) for i in range(ys["n"])
+        ]
+
+    def apply_decisions(
+        self, pod_arrays_list: List[Dict], decisions: List[int]
+    ) -> None:
+        """Commit placements (node lane or -1 = unplaced / off-shard)
+        to the session carry."""
+        forced = [(d if d >= 0 else -1, 1 if d >= 0 else 0)
+                  for d in decisions]
+        self._dispatch_mode(pod_arrays_list, "apply", forced=forced)
+
 
 # ---------------------------------------------------------------------------
 # kernel
 
 
-def _build_kernel(shapes, weights, Bp: int, ur: int = 0):
+def _build_kernel(shapes, weights, Bp: int, ur: int = 0,
+                  mode: str = "full"):
+    """mode: "full" = eval + select + apply own decision (single-device
+    session); "eval" = masks/scores/local-best only, carries untouched;
+    "apply" = apply an externally-decided (cross-shard) placement to the
+    carries. The sharded session alternates eval/apply around an ICI
+    argmax (ShardedPallasSession)."""
     import os as _os
 
     skip = frozenset(
@@ -739,6 +828,10 @@ def _build_kernel(shapes, weights, Bp: int, ur: int = 0):
      W_F_KEY, W_S_KEY, W_F_PERNO, W_S_PERNO) = range(10)
 
     def kernel(*refs):
+        forced_ref = None
+        if mode == "apply":
+            forced_ref = refs[0]  # SMEM [2*Bp]: (local lane | -1, ok)
+            refs = refs[1:]
         (breal_ref, tmpl_ref, sc_ref, mf_ref, ms_ref,
          alloc_ref, stat_ref, onehot_ref, regrowf_ref, zvnode_ref,
          zvalid_ref, konnf_ref, konns_ref, shasall_ref, validn_ref,
@@ -812,8 +905,93 @@ def _build_kernel(shapes, weights, Bp: int, ur: int = 0):
                 out = out + sm_av(which, t, tau).astype(f32) * e
             return out
 
+        def _apply_updates(b, t, lane_n, best, oki, okf):
+            """Carry updates for pod b landing on node lane `best` (all
+            no-ops when best is off this kernel's node range — `hot` is
+            then all-zero, which is exactly how the sharded session's
+            non-owning shards stay consistent)."""
+            hot = (lane_n == best).astype(jnp.int32) * oki   # (1, Np)
+            hotf = hot.astype(f32)
+            for r in range(R):
+                requested_ref[r:r + 1, :] = (
+                    requested_ref[r:r + 1, :] + hot * sm_t(t, r))
+            nzpc_ref[0:1, :] = nzpc_ref[0:1, :] + hot * sm_t(t, 2 * R + 1)
+            nzpc_ref[1:2, :] = nzpc_ref[1:2, :] + hot * sm_t(t, 2 * R + 2)
+            nzpc_ref[2:3, :] = nzpc_ref[2:3, :] + hot
+
+            # per-row match weights: column b of mf/ms via identity-dot
+            mf_vec = mf_ref[pl.ds(b, 1), :].astype(f32)      # (1, LANE)
+            ms_vec = ms_ref[pl.ds(b, 1), :].astype(f32)
+            mf_col = jax.lax.dot_general(
+                eye_ref[:], mf_vec, (((1,), (1,)), ((), ())),
+                preferred_element_type=f32)                  # (TCp, 1)
+            ms_col = jax.lax.dot_general(
+                eye_ref[:], ms_vec, (((1,), (1,)), ((), ())),
+                preferred_element_type=f32)
+
+            # pair id at best, per row (one matvec each side); same-pair
+            # lanes get the count delta — hostname rows degenerate to
+            # same-NODE exactly like the pair-space update they mirror
+            pf = prowf_ref[:].astype(f32)
+            zb_f = jax.lax.dot_general(
+                pf, hotf, (((1,), (1,)), ((), ())),
+                preferred_element_type=f32,
+                precision=jax.lax.Precision.HIGHEST)         # (TCp, 1)
+            m_f = ((pf == zb_f) & (prowf_ref[:] >= 0)).astype(f32) * okf
+            ps_ = prows_ref[:].astype(f32)
+            zb_s = jax.lax.dot_general(
+                ps_, hotf, (((1,), (1,)), ((), ())),
+                preferred_element_type=f32,
+                precision=jax.lax.Precision.HIGHEST)
+            m_s = ((ps_ == zb_s) & (prows_ref[:] >= 0)).astype(f32) * okf
+
+            # s_src factor at best per row's template (zone rows only; the
+            # per-node/hostname update has no src gate, mirroring _step)
+            srcrow = jnp.zeros((TCp, 1), f32)
+            for tt in range(T):
+                srow = stat_ref[pl.ds(tt * SR + 7, 1), :]
+                v = jnp.sum(
+                    jnp.where(lane_n == best, srow, jnp.int32(0)).astype(f32))
+                srcrow = srcrow + rowt_ref[tt][:, 0:1].astype(f32) * v
+            pernosel = _stack_tc(sm_tc, W_S_PERNO, T, C, TCp)             # (TCp, 1)
+            factor = pernosel + (f32(1.0) - pernosel) * srcrow
+
+            cntfn_ref[:] = (cntfn_ref[:].astype(f32)
+                            + mf_col * m_f).astype(jnp.int32)
+            cntsn_ref[:] = (cntsn_ref[:].astype(f32)
+                            + ms_col * factor * m_s).astype(jnp.int32)
+
+            if dyn_ipa:
+                # the assumed pod joins its node's topology groups for
+                # every IPA key the node carries: same-pair mask from
+                # prow_ipa (-1 rows = node lacks key -> no-op), written
+                # into template t's own 8-row ucnt block
+                pi = prowipa_ref[:].astype(f32)                # (SUB, Np)
+                zb_i = doth(pi, hotf, (((1,), (1,)), ((), ())))  # (SUB, 1)
+                m_i = ((pi == zb_i)
+                       & (prowipa_ref[:] >= 0)).astype(f32) * okf
+                base_u = pl.multiple_of(t * SUB, SUB)
+                ucnt_ref[pl.ds(base_u, SUB), :] = (
+                    ucnt_ref[pl.ds(base_u, SUB), :].astype(f32) + m_i
+                ).astype(jnp.int32)
+                hask = doth((pi >= 0).astype(f32), hotf,
+                            (((1,), (1,)), ((), ())))          # (SUB, 1)
+                kcnt_ref[pl.ds(base_u, SUB), :] = (
+                    kcnt_ref[pl.ds(base_u, SUB), :].astype(f32)
+                    + hask * okf
+                ).astype(jnp.int32)
+
         def one_pod(b):
             t = tmpl_ref[b]
+            if mode == "apply":
+                # forced decision (the cross-shard winner, mapped to this
+                # shard's local lanes or -1): updates only, no eval
+                lane_n = jax.lax.broadcasted_iota(jnp.int32, (1, Np), 1)
+                best = forced_ref[2 * b]
+                oki = forced_ref[2 * b + 1]
+                okf = oki.astype(f32)
+                _apply_updates(b, t, lane_n, best, oki, okf)
+                return jnp.int32(0)
             # NOTHING big is hoisted out of the loop: values live across
             # iterations spill out of vector registers and the
             # spill/restore swamps the step (measured; see PERF_NOTES)
@@ -1079,85 +1257,23 @@ def _build_kernel(shapes, weights, Bp: int, ur: int = 0):
             oki = ok.astype(jnp.int32)
             okf = oki.astype(f32)
 
-            if "updates" in skip:
+            if "updates" in skip or mode == "eval":
+                # eval-only: best/score/feasible out, carries untouched
+                # (the sharded session applies the GLOBAL decision in a
+                # separate "apply" launch after the cross-shard argmax)
+                subi0 = jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 0)
+                lanei0 = jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 1)
+                at_b0 = lanei0 == b
                 o = out_ref[:]
-                o = jnp.where(
-                    (jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 1) == b)
-                    & (jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 0) == 0),
-                    jnp.where(ok, best, jnp.int32(-1)), o)
+                o = jnp.where(at_b0 & (subi0 == 0),
+                              jnp.where(ok, best, jnp.int32(-1)), o)
+                o = jnp.where(at_b0 & (subi0 == 1),
+                              jnp.where(ok, m.astype(jnp.int32),
+                                        jnp.int32(-1)), o)
+                o = jnp.where(at_b0 & (subi0 == 2), n_feasible, o)
                 out_ref[:] = o
                 return jnp.int32(0)
-            # ---- carry updates (refs) ----
-            hot = (lane_n == best).astype(jnp.int32) * oki   # (1, Np)
-            hotf = hot.astype(f32)
-            for r in range(R):
-                requested_ref[r:r + 1, :] = (
-                    requested_ref[r:r + 1, :] + hot * sm_t(t, r))
-            nzpc_ref[0:1, :] = nzpc_ref[0:1, :] + hot * sm_t(t, 2 * R + 1)
-            nzpc_ref[1:2, :] = nzpc_ref[1:2, :] + hot * sm_t(t, 2 * R + 2)
-            nzpc_ref[2:3, :] = nzpc_ref[2:3, :] + hot
-
-            # per-row match weights: column b of mf/ms via identity-dot
-            mf_vec = mf_ref[pl.ds(b, 1), :].astype(f32)      # (1, LANE)
-            ms_vec = ms_ref[pl.ds(b, 1), :].astype(f32)
-            mf_col = jax.lax.dot_general(
-                eye_ref[:], mf_vec, (((1,), (1,)), ((), ())),
-                preferred_element_type=f32)                  # (TCp, 1)
-            ms_col = jax.lax.dot_general(
-                eye_ref[:], ms_vec, (((1,), (1,)), ((), ())),
-                preferred_element_type=f32)
-
-            # pair id at best, per row (one matvec each side); same-pair
-            # lanes get the count delta — hostname rows degenerate to
-            # same-NODE exactly like the pair-space update they mirror
-            pf = prowf_ref[:].astype(f32)
-            zb_f = jax.lax.dot_general(
-                pf, hotf, (((1,), (1,)), ((), ())),
-                preferred_element_type=f32,
-                precision=jax.lax.Precision.HIGHEST)         # (TCp, 1)
-            m_f = ((pf == zb_f) & (prowf_ref[:] >= 0)).astype(f32) * okf
-            ps_ = prows_ref[:].astype(f32)
-            zb_s = jax.lax.dot_general(
-                ps_, hotf, (((1,), (1,)), ((), ())),
-                preferred_element_type=f32,
-                precision=jax.lax.Precision.HIGHEST)
-            m_s = ((ps_ == zb_s) & (prows_ref[:] >= 0)).astype(f32) * okf
-
-            # s_src factor at best per row's template (zone rows only; the
-            # per-node/hostname update has no src gate, mirroring _step)
-            srcrow = jnp.zeros((TCp, 1), f32)
-            for tt in range(T):
-                srow = stat_ref[pl.ds(tt * SR + 7, 1), :]
-                v = jnp.sum(
-                    jnp.where(lane_n == best, srow, jnp.int32(0)).astype(f32))
-                srcrow = srcrow + rowt_ref[tt][:, 0:1].astype(f32) * v
-            pernosel = _stack_tc(sm_tc, W_S_PERNO, T, C, TCp)             # (TCp, 1)
-            factor = pernosel + (f32(1.0) - pernosel) * srcrow
-
-            cntfn_ref[:] = (cntfn_ref[:].astype(f32)
-                            + mf_col * m_f).astype(jnp.int32)
-            cntsn_ref[:] = (cntsn_ref[:].astype(f32)
-                            + ms_col * factor * m_s).astype(jnp.int32)
-
-            if dyn_ipa:
-                # the assumed pod joins its node's topology groups for
-                # every IPA key the node carries: same-pair mask from
-                # prow_ipa (-1 rows = node lacks key -> no-op), written
-                # into template t's own 8-row ucnt block
-                pi = prowipa_ref[:].astype(f32)                # (SUB, Np)
-                zb_i = doth(pi, hotf, (((1,), (1,)), ((), ())))  # (SUB, 1)
-                m_i = ((pi == zb_i)
-                       & (prowipa_ref[:] >= 0)).astype(f32) * okf
-                base_u = pl.multiple_of(t * SUB, SUB)
-                ucnt_ref[pl.ds(base_u, SUB), :] = (
-                    ucnt_ref[pl.ds(base_u, SUB), :].astype(f32) + m_i
-                ).astype(jnp.int32)
-                hask = doth((pi >= 0).astype(f32), hotf,
-                            (((1,), (1,)), ((), ())))          # (SUB, 1)
-                kcnt_ref[pl.ds(base_u, SUB), :] = (
-                    kcnt_ref[pl.ds(base_u, SUB), :].astype(f32)
-                    + hask * okf
-                ).astype(jnp.int32)
+            _apply_updates(b, t, lane_n, best, oki, okf)
 
             subi = jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 0)
             lanei = jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 1)
@@ -1230,7 +1346,7 @@ def _stack_tc(sm_tc, which, T, C, TCp):
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnames=("carry",))
 def _dispatch(cfg: "_Cfg", statics: Dict, ipa: Optional[Dict],
-              B_real, carry: Dict, tmpl, mfT, msT):
+              B_real, carry: Dict, tmpl, mfT, msT, forced=None):
     # B_real is a DYNAMIC (SMEM) scalar: variable batch lengths must not
     # recompile the kernel (only the padded width Bp is static).
     # The cluster statics arrive as DYNAMIC pytree args, NOT via the
@@ -1240,7 +1356,8 @@ def _dispatch(cfg: "_Cfg", statics: Dict, ipa: Optional[Dict],
     # workload paid mid-window. cfg hashes by VALUE, so two sessions
     # with the same shapes share one compiled program.
     Bp = int(tmpl.shape[0])
-    kernel = _build_kernel(cfg.shapes, cfg.weights, Bp, cfg.ur)
+    kernel = _build_kernel(cfg.shapes, cfg.weights, Bp, cfg.ur,
+                           mode=cfg.mode)
     # widen the int8 wire format on-device (i8 VMEM rows would need
     # 32-sublane alignment in the kernel; one cheap convert avoids that)
     mfT = mfT.astype(jnp.int32)
@@ -1259,7 +1376,12 @@ def _dispatch(cfg: "_Cfg", statics: Dict, ipa: Optional[Dict],
     )
     vm = pl.BlockSpec(memory_space=pltpu.VMEM)
     sm = pl.BlockSpec(memory_space=pltpu.SMEM)
-    n_pre = 19 + len(ipa_in)  # inputs before the carries
+    pre_args: tuple = ()
+    pre_specs: list = []
+    if cfg.mode == "apply":
+        pre_args = (forced.astype(jnp.int32),)
+        pre_specs = [sm]
+    n_pre = len(pre_specs) + 19 + len(ipa_in)  # inputs before the carries
     # trace the kernel with x64 OFF: every input is explicitly 32-bit,
     # and weak python literals must not widen ops to i64/f64 (Mosaic has
     # no 64-bit types)
@@ -1269,13 +1391,13 @@ def _dispatch(cfg: "_Cfg", statics: Dict, ipa: Optional[Dict],
         results = pl.pallas_call(
             kernel,
             out_shape=out_shape,
-            in_specs=([sm, sm, sm, vm, vm] + [vm] * 14
+            in_specs=(pre_specs + [sm, sm, sm, vm, vm] + [vm] * 14
                       + [vm] * len(ipa_in) + [vm] * len(carry_in)),
             out_specs=tuple([vm] * (1 + len(carry_in))),
             input_output_aliases={n_pre + i: 1 + i
                                   for i in range(len(carry_in))},
             interpret=cfg.interpret,
-        )(B_real, tmpl, statics["scalars"], mfT, msT,
+        )(*pre_args, B_real, tmpl, statics["scalars"], mfT, msT,
           statics["alloc"], statics["stat"], statics["onehot"],
           statics["regrow_f"], statics["zvalid_node_s"],
           statics["zvalid_s"], statics["konn_f"], statics["konn_s"],

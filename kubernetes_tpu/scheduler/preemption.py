@@ -101,10 +101,17 @@ class FastPreemptionPlanner:
     dims. All arrays are [D, N] int64.
     """
 
-    def __init__(self, snapshot, nominator, framework=None, args: Optional[dict] = None):
+    def __init__(self, snapshot, nominator, framework=None,
+                 args: Optional[dict] = None,
+                 claimed_victims: Optional[Set[str]] = None):
         self.snapshot = snapshot
         self.nominator = nominator
         self.framework = framework
+        # victims claimed by earlier waves still dying in the cache:
+        # treated as already-removed (their resources left the books the
+        # moment they were claimed; the claimer's nominated load covers
+        # the replacement)
+        self.claimed_victims = claimed_victims or set()
         args = args or {}
         self.min_pct = args.get(
             "minCandidateNodesPercentage", MIN_CANDIDATE_NODES_PERCENTAGE
@@ -184,6 +191,13 @@ class FastPreemptionPlanner:
             self._max_pods[i] = ni.allocatable.allowed_pod_number
             victims = []
             for pi in ni.pods:
+                if v1.pod_key(pi.pod) in self.claimed_victims:
+                    # an in-flight wave already evicted it: neither
+                    # present (its resources are spoken for) nor
+                    # evictable again
+                    self._used[:, i] -= self._req_vec(pi.pod)
+                    self._npods[i] -= 1
+                    continue
                 vp = _prio(pi.pod)
                 if vp >= wave_prios[-1]:
                     continue

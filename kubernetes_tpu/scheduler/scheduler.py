@@ -254,14 +254,17 @@ class Scheduler:
         csi_inf = self.informers.informer_for("csinodes")
         resolver = VolumeDeviceResolver(pvc_inf.list, pv_inf.list, csi_inf.list)
         self.tpu.set_volume_resolver(resolver)
-        bump = EventHandler(
-            on_add=lambda obj: self.tpu.on_volume_change(),
-            on_update=lambda old, new: self.tpu.on_volume_change(),
-            on_delete=lambda obj: self.tpu.on_volume_change(),
-        )
-        pvc_inf.add_event_handler(bump)
-        pv_inf.add_event_handler(bump)
-        csi_inf.add_event_handler(bump)
+
+        def bump_for(kind):
+            return EventHandler(
+                on_add=lambda obj: self.tpu.on_volume_change(kind, obj),
+                on_update=lambda old, new: self.tpu.on_volume_change(kind, new),
+                on_delete=lambda obj: self.tpu.on_volume_change(kind, obj),
+            )
+
+        pvc_inf.add_event_handler(bump_for("pvc"))
+        pv_inf.add_event_handler(bump_for("pv"))
+        csi_inf.add_event_handler(bump_for("csinode"))
 
     # -- run loop ----------------------------------------------------------
 
@@ -385,11 +388,27 @@ class Scheduler:
         cycle = self.queue.scheduling_cycle
         todo = [i for i in infos if not self._skip(i.pod)]
         if self.framework is not None:
-            oracle_infos = [i for i in todo if self._needs_oracle(i.pod)]
-            if oracle_infos:
-                todo = [i for i in todo if not self._needs_oracle(i.pod)]
-                for info in oracle_infos:
-                    self._schedule_one_oracle(info)
+            # one partition pass: _needs_oracle runs a resolver pass for
+            # PVC pods, and pending pods SHARING a claim within this
+            # batch must not both ride the kernel (attach counting is
+            # unique-handle; the refcount gate only sees assumed pods)
+            from .volume_device import pod_pvc_names
+
+            oracle_infos, kernel_infos = [], []
+            batch_claims: set = set()
+            for i in todo:
+                claims = {
+                    (i.pod.metadata.namespace, c)
+                    for c in pod_pvc_names(i.pod)
+                } if i.pod.spec.volumes else set()
+                if self._needs_oracle(i.pod) or (claims & batch_claims):
+                    oracle_infos.append(i)
+                else:
+                    kernel_infos.append(i)
+                    batch_claims |= claims
+            todo = kernel_infos
+            for info in oracle_infos:
+                self._schedule_one_oracle(info)
             # nominated-node short-circuit (generic_scheduler.go:235
             # evaluateNominatedNode): a preemptor whose victims were
             # evicted re-arrives with a nominated node — feasibility is
@@ -421,11 +440,17 @@ class Scheduler:
     def _complete_batch(self, todo: List, handle, cycle: int) -> None:
         results = self.tpu.harvest(handle)
         by_key = {v1.pod_key(p): node for p, node in results}
+        from .tpu_backend import RETRY_NODE
+
         bound: List[Tuple] = []  # (info, node)
         failed: List = []
         for info in todo:
             node = by_key.get(v1.pod_key(info.pod))
-            if node is None:
+            if node == RETRY_NODE:
+                # volume gate/encode race: not unschedulable — re-gate
+                # on the next pop instead of parking for the flusher
+                self.queue.add(info.pod)
+            elif node is None:
                 failed.append(info)
             else:
                 bound.append((info, node))
@@ -482,9 +507,16 @@ class Scheduler:
                 else:
                     redispatch.append(info)
             if fast:
+                # victims claimed by in-flight waves whose delete echoes
+                # have not landed in the cache yet must not be claimed
+                # again (their capacity is already spoken for by the
+                # claiming preemptor's nominator entry)
+                with self._preempt_lock:
+                    claimed = set(self._victim_waiters)
                 planner = fast_preemption.FastPreemptionPlanner(
                     self.snapshot, self.nominator,
                     args=self._preemption_args(),
+                    claimed_victims=claimed,
                 )
                 cands = planner.plan([i.pod for i in fast])
                 preempted: List[Tuple] = []
@@ -515,11 +547,15 @@ class Scheduler:
             # the first fit binds directly — later fits re-dispatch
             # singly to keep sequential-assume semantics (rare: failure
             # waves mostly stay failed).
+            from .tpu_backend import RETRY_NODE
+
             bound_once = False
             for info, (node, statuses) in zip(
                 redispatch, self.tpu.reevaluate([i.pod for i in redispatch])
             ):
-                if node is None:
+                if node == RETRY_NODE:
+                    self.queue.add(info.pod)
+                elif node is None:
                     self._record_failure(info, cycle, statuses)
                 elif not bound_once:
                     bound_once = True
@@ -596,20 +632,34 @@ class Scheduler:
             # victims first — their deletion unblocks the preemptors; the
             # status patch is observability (the in-memory nominated_node
             # already steers the queue and the placement short-circuit)
+            from ..apiserver.server import NotFound
+
             for info, cand in items:
                 for victim in cand.victims:
                     try:
                         self.client.pods.delete(
                             victim.metadata.name, victim.metadata.namespace
                         )
+                    except NotFound:
+                        # already gone — but ONLY resolve the wave here
+                        # if the delete echo has also been processed
+                        # (victim absent from the informer cache);
+                        # otherwise the in-flight echo fires
+                        # _on_victim_deleted itself, and resolving
+                        # early would activate preemptors against a
+                        # cache that still shows the victim
+                        if self.informers.pods().get(
+                            meta_namespace_key(victim)
+                        ) is None:
+                            self._on_victim_deleted(victim)
                     except APIError:
-                        # already gone (external delete raced the plan):
-                        # no informer echo is coming for this key —
-                        # resolve the wave bookkeeping here or the
-                        # node's preemptors would wait for the 60s
-                        # leftover flush (idempotent if the echo DID
-                        # land before registration)
-                        self._on_victim_deleted(victim)
+                        # transient server error: the victim may still
+                        # be alive — leave the wave pending (the 60s
+                        # leftover flush is the honest fallback)
+                        logger.warning(
+                            "victim delete failed for %s",
+                            v1.pod_key(victim), exc_info=True,
+                        )
             for info, cand in items:
                 try:
                     fresh = self.client.pods.get(

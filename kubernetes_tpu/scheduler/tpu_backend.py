@@ -31,10 +31,16 @@ from ..ops.batch import shape_signature
 from ..ops.hoisted import HoistedSession, template_fingerprint
 
 logger = logging.getLogger(__name__)
+
+# sentinel "node" for a gate/encode volume-resolution race: the pod is
+# not unschedulable — it must RE-GATE promptly (the scheduler re-adds it
+# to the active queue instead of parking it for the leftover flusher)
+RETRY_NODE = "\x00volume-retry"
 from ..ops.kernel import DEFAULT_WEIGHTS, schedule_pod_jit
 from .core import ScheduleResult
 from .framework.interface import FitError, Status
 from .internal.cache import CacheListener
+from .volume_device import VolumeResolutionChanged
 
 # kernel mask key -> plugin name (for FitError statuses)
 MASK_PLUGINS = (
@@ -121,6 +127,15 @@ class TPUBackend(CacheListener):
             self.volume_resolver = resolver
             self.pe.volume_resolver = resolver
             self.enc.volume_hook = resolver
+            resolver.on_new_driver = self._on_new_volume_driver
+
+    def _on_new_volume_driver(self) -> None:
+        """A driver just entered use: node rows built before it carry no
+        limit column (reads 0 = limit 0) — rebuild before the next
+        dispatch treats every node as attach-full."""
+        with self._lock:
+            self._invalidate_session()
+            self.enc._rebuild_needed = True
 
     def volume_kernel_safe(self, pod: v1.Pod) -> bool:
         """True when this PVC-bearing pod's volume constraints resolve
@@ -129,16 +144,44 @@ class TPUBackend(CacheListener):
             return False
         return self.volume_resolver.resolve(pod) is not None
 
-    def on_volume_change(self) -> None:
-        """A PVC/PV/CSINode event: resolved constraints may have moved —
-        cached encodings key off resolver.version; the cluster rows
-        rebuild so node attach-limit columns and pod attach counts
-        converge (rare outside provisioning bursts)."""
+    def on_volume_change(self, kind: str = "", obj=None) -> None:
+        """A PVC/PV/CSINode event: resolver.version bumps always (cached
+        pod encodings key off it), but the EXPENSIVE part — session
+        teardown + full encoding rebuild — only runs when the object can
+        actually touch encoded state: a claim some encoded pod
+        references, a PV bound to such a claim, or a CSINode for a
+        driver in use. A steady provisioning drip for not-yet-scheduled
+        pods must not cost a multi-second rebuild per event."""
+        resolver = self.volume_resolver
+        if resolver is None:
+            return
         with self._lock:
-            if self.volume_resolver is not None:
-                self.volume_resolver.bump()
+            resolver.bump()
+            if not self._volume_obj_encoded(kind, obj, resolver):
+                return
             self._invalidate_session()
             self.enc._rebuild_needed = True
+
+    @staticmethod
+    def _volume_obj_encoded(kind: str, obj, resolver) -> bool:
+        if obj is None or not kind:
+            return True  # unknown shape: stay conservative
+        try:
+            if kind == "pvc":
+                key = (obj.metadata.namespace, obj.metadata.name)
+                return resolver.claim_referenced(key)
+            if kind == "pv":
+                ns = obj.spec.claim_ref_namespace
+                name = obj.spec.claim_ref_name
+                if not name:
+                    return False  # unbound PV: no encoded pod can see it
+                return resolver.claim_referenced((ns or "default", name))
+            if kind == "csinode":
+                drivers = {d.name for d in obj.spec.drivers or []}
+                return resolver.drivers_referenced(drivers)
+        except Exception:  # noqa: BLE001 — malformed object: conservative
+            return True
+        return True
 
     def _invalidate_session(self) -> None:
         # _session_assumed survives invalidation deliberately: an assume
@@ -211,7 +254,12 @@ class TPUBackend(CacheListener):
             # _schedule_batch_tpu), whose enc.add_pod()s would otherwise
             # leave a surviving session's carry missing those pods.
             self._invalidate_session()
-            p = {k: v for k, v in self.pe.encode(pod).items() if not k.startswith("_")}
+            try:
+                p = {k: v for k, v in self.pe.encode(pod).items()
+                     if not k.startswith("_")}
+            except VolumeResolutionChanged:
+                # gate/encode race: fail this attempt; the retry re-gates
+                raise FitError(pod, self.enc.n_nodes, {})
             c = self.enc.device_state()
             if self.mesh is not None:
                 from ..parallel import sharded
@@ -251,11 +299,17 @@ class TPUBackend(CacheListener):
 
                 c = sharded.shard_cluster(c, self.mesh)
             n_nodes = self.enc.n_nodes
-            encoded = [
-                {k: v for k, v in self.pe.encode(p).items()
-                 if not k.startswith("_")}
-                for p in pods
-            ]
+            encoded = []
+            skipped = set()
+            for idx, p in enumerate(pods):
+                try:
+                    encoded.append({
+                        k: v for k, v in self.pe.encode(p).items()
+                        if not k.startswith("_")
+                    })
+                except VolumeResolutionChanged:
+                    encoded.append(None)
+                    skipped.add(idx)
             # group by shape signature so each group stacks; chunk to a
             # FIXED width — the kernel's per-pod PTS/IPA sweeps are
             # [P]-sized, so an unbounded vmap width makes XLA chew on a
@@ -270,6 +324,8 @@ class TPUBackend(CacheListener):
             # not produce one padded chunk per 1-2 pods
             by_shape: Dict[Tuple, List[int]] = {}
             for idx, e in enumerate(encoded):
+                if idx in skipped:
+                    continue
                 by_shape.setdefault(shape_signature(e), []).append(idx)
             for group in by_shape.values():
                 for lo in range(0, len(group), CHUNK):
@@ -291,6 +347,9 @@ class TPUBackend(CacheListener):
                     for row, g in enumerate(chunk):
                         out_rows[g] = (outs, row)
             for g, pod in enumerate(pods):
+                if g in skipped:
+                    results.append((RETRY_NODE, {}))  # prompt re-gate
+                    continue
                 outs, row = out_rows[g]
                 feasible = outs["feasible"][row][:n_nodes]
                 if feasible.any():
@@ -325,11 +384,17 @@ class TPUBackend(CacheListener):
             if pods and self._session is not None and all(
                 not p.spec.node_name for p in pods
             ):
-                clean = [
-                    {k: v for k, v in self.pe.encode(p).items()
-                     if not k.startswith("_")}
-                    for p in pods
-                ]
+                try:
+                    clean = [
+                        {k: v for k, v in self.pe.encode(p).items()
+                         if not k.startswith("_")}
+                        for p in pods
+                    ]
+                except VolumeResolutionChanged:
+                    clean = None  # schedule_many handles it per pod
+                if clean is None:
+                    h.results = self.schedule_many(pods)
+                    return h
                 sig0 = shape_signature(clean[0])
                 if (
                     all(shape_signature(a) == sig0 for a in clean[1:])
@@ -392,7 +457,12 @@ class TPUBackend(CacheListener):
             i = 0
             while i < len(pods):
                 pod = pods[i]
-                p = self.pe.encode(pod)
+                try:
+                    p = self.pe.encode(pod)
+                except VolumeResolutionChanged:
+                    results.append((pod, RETRY_NODE))  # prompt re-gate
+                    i += 1
+                    continue
                 # bound pods (spec.nodeName already set) go one-at-a-time;
                 # everything else — including affinity/host-port pods,
                 # whose assume effects the session carries dynamically
@@ -421,7 +491,10 @@ class TPUBackend(CacheListener):
                 while j < len(pods):
                     if pods[j].spec.node_name:
                         break
-                    q = self.pe.encode(pods[j])
+                    try:
+                        q = self.pe.encode(pods[j])
+                    except VolumeResolutionChanged:
+                        break  # handled when the outer loop reaches j
                     qa = {k: v for k, v in q.items() if not k.startswith("_")}
                     if shape_signature(qa) != sig:
                         break

@@ -47,11 +47,10 @@ def attach_resource_name(driver: str) -> str:
     return f"attachable-volumes-csi-{driver}"
 
 
-_INTREE_TO_CSI = {
-    "awsElasticBlockStore": "ebs.csi.aws.com",
-    "gcePersistentDisk": "pd.csi.storage.gke.io",
-    "azureDisk": "disk.csi.azure.com",
-}
+class VolumeResolutionChanged(Exception):
+    """A pod gated kernel-safe resolved differently at encode time (a
+    PVC/assume event raced the scheduling cycle). The backend fails the
+    pod's attempt; the retry re-gates against fresh state."""
 
 
 class VolumeResolution:
@@ -94,12 +93,29 @@ class VolumeDeviceResolver:
         self._drivers_in_use: Set[str] = set()
         self._index_cache = None  # (version, pvc index, pv index)
         self._csinode_cache = None  # (version, node -> {driver: count})
+        # (node, driver, handle) -> refcount of encoded pods using it
+        self._node_handles: Dict[Tuple[str, str, str], int] = {}
+        # fired (outside the lock) when a driver enters _drivers_in_use:
+        # node rows built before it have no limit column for it (column
+        # reads 0 = limit 0 = everything infeasible) — the backend hooks
+        # this to queue an encoding rebuild
+        self.on_new_driver = None
 
     # -- event hooks -------------------------------------------------------
 
     def bump(self, *_args) -> None:
         with self._lock:
             self.version += 1
+
+    def claim_referenced(self, key: Tuple[str, str]) -> bool:
+        """True when an ASSIGNED/ASSUMED (encoded) pod uses this claim.
+        Callers may hold the backend lock — this lock nests inside it."""
+        with self._lock:
+            return self._pvc_refs.get(key, 0) > 0
+
+    def drivers_referenced(self, drivers) -> bool:
+        with self._lock:
+            return bool(self._drivers_in_use & set(drivers))
 
     def pod_added(self, pod: v1.Pod) -> None:
         ns = pod.metadata.namespace
@@ -124,18 +140,22 @@ class VolumeDeviceResolver:
     def _indexes(self):
         """(pvc-by-key, pv-by-name) maps, rebuilt lazily per version —
         per-pod lister scans would be O(n^2) over a benchmark's PVC
-        population."""
+        population. The version is captured BEFORE listing: a bump()
+        racing the build must leave the cache stamped stale (a stale
+        index stamped with the NEW version would serve wrong
+        resolutions until an unrelated event)."""
         with self._lock:
             idx = self._index_cache
             if idx is not None and idx[0] == self.version:
                 return idx[1], idx[2]
+            version = self.version
         pvcs = {
             (c.metadata.namespace, c.metadata.name): c
             for c in self._list_pvcs()
         }
         pvs = {p.metadata.name: p for p in self._list_pvs()}
         with self._lock:
-            self._index_cache = (self.version, pvcs, pvs)
+            self._index_cache = (version, pvcs, pvs)
         return pvcs, pvs
 
     def _pv_of(self, namespace: str, claim: str):
@@ -202,13 +222,18 @@ class VolumeDeviceResolver:
             return None
         # attach limits -> scalar requests per driver
         scalars: Dict[str, int] = {}
+        new_drivers = []
         for pv in pvs:
             drv = _pv_driver(pv)
             if drv:
                 name = attach_resource_name(drv)
                 scalars[name] = scalars.get(name, 0) + 1
                 with self._lock:
-                    self._drivers_in_use.add(drv)
+                    if drv not in self._drivers_in_use:
+                        self._drivers_in_use.add(drv)
+                        new_drivers.append(drv)
+        if new_drivers and self.on_new_driver is not None:
+            self.on_new_driver()
         return VolumeResolution(term_groups, scalars)
 
     # -- node side ---------------------------------------------------------
@@ -221,6 +246,7 @@ class VolumeDeviceResolver:
             idx = self._csinode_cache
             if idx is not None and idx[0] == self.version:
                 return idx[1]
+            version = self.version
         by_node: Dict[str, Dict[str, int]] = {}
         for cn in self._list_csinodes():
             limits = {
@@ -231,7 +257,7 @@ class VolumeDeviceResolver:
             if limits:
                 by_node[cn.metadata.name] = limits
         with self._lock:
-            self._csinode_cache = (self.version, by_node)
+            self._csinode_cache = (version, by_node)
         return by_node
 
     def node_extra_alloc(self, node: v1.Node) -> Dict[str, int]:
@@ -252,59 +278,84 @@ class VolumeDeviceResolver:
             out[attach_resource_name(drv)] = limit
         return out
 
+    def _pod_volumes_by_driver(self, pod: v1.Pod):
+        """driver -> volume handles, via the oracle plugin's own walk
+        (_csi_volumes_of) so the fast path's accounting and
+        NodeVolumeLimits can never diverge."""
+        from .plugins.volumes import _csi_volumes_of
+
+        def lookup(namespace: str, name: str):
+            pv = self._pv_of(namespace, name)
+            if pv is None:
+                return None
+            drv = _pv_driver(pv)
+            return (drv, pv.metadata.name) if drv else None
+
+        return _csi_volumes_of(pod, lookup)
+
     def pod_extra_scalars(self, pod: v1.Pod) -> Dict[str, int]:
-        """Attach-count scalars an ASSIGNED/ASSUMED pod consumes on its
-        node row. Must mirror resolve()'s accounting; pods outside the
-        envelope contribute too (their volumes occupy attach slots that
-        kernel pods compete for)."""
-        scalars: Dict[str, int] = {}
-        seen: Set[Tuple[str, str]] = set()
-        for vol in pod.spec.volumes or []:
-            src = vol.source or {}
-            drv = ident = None
-            if "csi" in src:
-                drv = src["csi"].get("driver", "")
-                ident = src["csi"].get("volumeHandle", vol.name)
-            else:
-                for key, mapped in _INTREE_TO_CSI.items():
-                    if key in src:
-                        drv = mapped
-                        d = src[key]
-                        ident = (d.get("pdName") or d.get("volumeID")
-                                 or d.get("diskName") or vol.name)
-                        break
-            pvc_src = src.get("persistentVolumeClaim")
-            if drv is None and pvc_src:
-                pv = self._pv_of(
-                    pod.metadata.namespace, pvc_src.get("claimName", "")
-                )
-                if pv is not None:
-                    drv = _pv_driver(pv)
-                    ident = pv.metadata.name
-            if drv and (drv, ident) not in seen:
-                seen.add((drv, ident))
-                name = attach_resource_name(drv)
-                scalars[name] = scalars.get(name, 0) + 1
-        if scalars:
-            with self._lock:
-                for name in scalars:
-                    self._drivers_in_use.add(
-                        name[len("attachable-volumes-csi-"):]
-                    )
-        return scalars
+        """The pod's OWN attach requirement (vocab interning + pending
+        encode). Node-row accounting goes through attach_delta, which is
+        refcounted by handle."""
+        return {
+            attach_resource_name(drv): len(idents)
+            for drv, idents in self._pod_volumes_by_driver(pod).items()
+        }
+
+    def attach_delta(self, pod: v1.Pod, node_name: str, sign: int) -> Dict[str, int]:
+        """Node-row attach-count delta for adding (sign=+1) or removing
+        (sign=-1) this pod on node_name, REFCOUNTED per volume handle:
+        the oracle counts unique handles per node
+        (plugins/volumes.py NodeVolumeLimits.filter unions idents), so
+        the second pod sharing a handle on a node contributes 0 — a
+        per-pod count would overcount and reject nodes the oracle
+        accepts. Returned values are always positive (the caller applies
+        the sign). Handle drift between add and remove (a PV rebinding
+        while the pod runs) leaves a stale refcount until the next full
+        rebuild (reset_attach) realigns."""
+        by_driver = self._pod_volumes_by_driver(pod)
+        delta: Dict[str, int] = {}
+        new_drivers = []
+        with self._lock:
+            for drv, idents in by_driver.items():
+                d = 0
+                for h in idents:
+                    key = (node_name, drv, h)
+                    n = self._node_handles.get(key, 0)
+                    if sign > 0:
+                        if n == 0:
+                            d += 1
+                        self._node_handles[key] = n + 1
+                    else:
+                        if n <= 1:
+                            self._node_handles.pop(key, None)
+                            if n == 1:
+                                d += 1
+                        else:
+                            self._node_handles[key] = n - 1
+                if d:
+                    delta[attach_resource_name(drv)] = d
+                if sign > 0 and drv not in self._drivers_in_use:
+                    self._drivers_in_use.add(drv)
+                    new_drivers.append(drv)
+        if new_drivers and self.on_new_driver is not None:
+            self.on_new_driver()
+        return delta
+
+    def reset_attach(self) -> None:
+        """A full encoding rebuild re-applies every pod's attach_delta
+        from scratch."""
+        with self._lock:
+            self._node_handles.clear()
 
 
 def _pv_driver(pv) -> Optional[str]:
+    """PV -> CSI driver (PersistentVolumeSpec models only the CSI
+    source; in-tree pod-level sources map through the oracle plugin's
+    _INTREE_TO_CSI inside _csi_volumes_of)."""
     csi = getattr(pv.spec, "csi", None)
     if isinstance(csi, dict) and csi.get("driver"):
         return csi["driver"]
-    src = getattr(pv.spec, "source", None) or {}
-    if isinstance(src, dict):
-        if "csi" in src and src["csi"].get("driver"):
-            return src["csi"]["driver"]
-        for key, mapped in _INTREE_TO_CSI.items():
-            if key in src:
-                return mapped
     return None
 
 

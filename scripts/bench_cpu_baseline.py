@@ -1,0 +1,98 @@
+"""Single-core CPU baseline of the SAME dense scheduling math.
+
+Run by bench.py in a subprocess pinned to one CPU core (taskset -c 0)
+with JAX_PLATFORMS=cpu: the identical hoisted-session program (same
+cluster arrays, same sequential-assume scan, same decisions) compiled by
+XLA for one CPU thread. This is the honest same-algorithm CPU
+denominator BASELINE.md's north star asks for ("single-goroutine CPU
+baseline with identical decisions") — stronger than a hand-written
+numpy twin, because it is literally the same program, and conservative,
+because XLA-CPU is faster than numpy.
+
+Prints one JSON line: {"pods_per_sec": ..., "n_pods": ..., "n_nodes": ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+# one intra-op thread: the baseline must stay single-core even if the
+# taskset pin is unavailable
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_cpu_multi_thread_eigen=false intra_op_parallelism_threads=1"
+).strip()
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+from kubernetes_tpu.utils.compilation_cache import (  # noqa: E402
+    enable_persistent_cache,
+)
+
+enable_persistent_cache()
+
+
+def main() -> None:
+    n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
+    n_meas = int(os.environ.get("BENCH_CPU_PODS", "256"))
+    batch = int(os.environ.get("BENCH_CPU_BATCH", "256"))
+
+    from kubernetes_tpu.models.encoding import ClusterEncoding
+    from kubernetes_tpu.ops.hoisted import HoistedSession
+    from kubernetes_tpu.models.pod_encoder import PodEncoder
+    from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+    nodes, init_pods = synth_cluster(n_nodes, pods_per_node=2)
+    pending = synth_pending_pods(batch + n_meas, spread=True)
+
+    enc = ClusterEncoding()
+    for node in nodes:
+        enc.add_node(node)
+    for pod in init_pods:
+        enc.add_pod(pod, pod.spec.node_name)
+    pe = PodEncoder(enc)
+    arrays = [
+        {k: v for k, v in pe.encode(p).items() if not k.startswith("_")}
+        for p in pending
+    ]
+    from kubernetes_tpu.ops.hoisted import template_fingerprint
+
+    cluster = enc.device_state()
+    templates: dict = {}
+    for a in arrays:
+        templates.setdefault(template_fingerprint(a), a)
+    session = HoistedSession(cluster, list(templates.values()), weights=None)
+    # warmup batch: compile + prologue outside the measured window
+    ys = session.schedule(arrays[:batch])
+    HoistedSession.decisions(ys)
+    t0 = time.perf_counter()
+    done = 0
+    while done < n_meas:
+        chunk = arrays[batch + done: batch + done + batch]
+        ys = session.schedule(chunk)
+        HoistedSession.decisions(ys)  # blocks: decisions on host
+        done += len(chunk)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "pods_per_sec": round(n_meas / dt, 3),
+        "n_pods": n_meas,
+        "n_nodes": n_nodes,
+        "note": (
+            "identical hoisted-session program on ONE CPU core "
+            "(taskset + single-thread XLA): same arrays, same "
+            "sequential-assume scan, same decisions"
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()

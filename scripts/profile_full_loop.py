@@ -95,10 +95,12 @@ Scheduler.resume = patched_resume
 t = threading.Thread(target=sampler, daemon=True)
 t.start()
 
+WIRE = os.environ.get("PROFILE_WIRE", "0") == "1"
 w = Workload(
     f"profile-{N}n-{P}p", num_nodes=N, num_init_pods=min(2048, P),
     num_pods=P, init_template=PodTemplate(spread_zone=True),
     template=PodTemplate(spread_zone=True), max_batch=B, timeout=600.0,
+    wire=WIRE,
 )
 t0 = time.perf_counter()
 r = harness.run_workload(w)

@@ -6,6 +6,7 @@ Reference shape: staging/src/k8s.io/kubectl command tests (cmd/*_test.go)
 
 import io
 import json
+import sys
 
 import pytest
 import yaml
@@ -487,3 +488,89 @@ class TestAttachPortForward:
             assert out.getvalue() == "echo:hello"
         finally:
             kl.stop()
+
+
+class TestRound4Verbs:
+    def test_api_resources_lists_table(self, kubectl):
+        k, cs, out = kubectl
+        assert k.run(["api-resources"]) == 0
+        lines = _lines(out)
+        assert lines[0].split()[:3] == ["NAME", "APIVERSION", "NAMESPACED"]
+        names = {ln.split()[0] for ln in lines[1:]}
+        assert {"pods", "nodes", "ingresses", "networkpolicies"} <= names
+
+    def test_explain_walks_fields(self, kubectl):
+        k, cs, out = kubectl
+        assert k.run(["explain", "pods.spec.nodeName"]) == 0
+        text = out.getvalue()
+        assert "KIND:     Pod" in text
+        assert "FIELD TYPE: str" in text
+
+    def test_explain_lists_subfields(self, kubectl):
+        k, cs, out = kubectl
+        assert k.run(["explain", "pods.spec"]) == 0
+        text = out.getvalue()
+        assert "containers" in text
+        assert "nodeName" in text
+
+    def test_explain_bad_field(self, kubectl):
+        k, cs, out = kubectl
+        assert k.run(["explain", "pods.spec.bogus"]) == 1
+        assert "does not exist" in out.getvalue()
+
+    def test_edit_applies_editor_changes(self, kubectl, tmp_path, monkeypatch):
+        k, cs, out = kubectl
+        cs.pods.create(make_pod("p-edit", labels={"app": "old"}))
+        # a scripted "editor": rewrites the label value in place
+        script = tmp_path / "ed.py"
+        script.write_text(
+            "import sys\n"
+            "p = sys.argv[1]\n"
+            "s = open(p).read().replace('old', 'new')\n"
+            "open(p, 'w').write(s)\n"
+        )
+        monkeypatch.setenv("KUBE_EDITOR", f"{sys.executable} {script}")
+        assert k.run(["edit", "pods", "p-edit"]) == 0
+        assert cs.pods.get("p-edit", "default").metadata.labels["app"] == "new"
+        assert "edited" in out.getvalue()
+
+    def test_edit_no_changes(self, kubectl, tmp_path, monkeypatch):
+        k, cs, out = kubectl
+        cs.pods.create(make_pod("p-same"))
+        script = tmp_path / "noop.py"
+        script.write_text("pass\n")
+        monkeypatch.setenv("KUBE_EDITOR", f"{sys.executable} {script}")
+        assert k.run(["edit", "pods", "p-same"]) == 0
+        assert "no changes" in out.getvalue()
+
+    def test_auth_can_i_without_rbac(self, kubectl):
+        k, cs, out = kubectl
+        assert k.run(["auth", "can-i", "create", "pods"]) == 0
+        assert out.getvalue().strip() == "yes"
+
+    def test_auth_can_i_with_rbac(self):
+        from kubernetes_tpu.api import rbac
+        from kubernetes_tpu.apiserver.auth import SecureAPIServer
+
+        secure = SecureAPIServer()
+        api = secure.api
+        api.create("clusterroles", rbac.ClusterRole(
+            metadata=v1.ObjectMeta(name="pod-reader"),
+            rules=[rbac.PolicyRule(verbs=["get", "list"],
+                                   resources=["pods"])],
+        ))
+        api.create("clusterrolebindings", rbac.ClusterRoleBinding(
+            metadata=v1.ObjectMeta(name="rb"),
+            role_ref=rbac.RoleRef(kind="ClusterRole", name="pod-reader"),
+            subjects=[rbac.Subject(kind="User", name="alice")],
+        ))
+        api.authorizer = secure.authorizer  # the CLI reads api.authorizer
+        cs = Clientset(api)
+        out = io.StringIO()
+        k = Kubectl(cs, out=out)
+        assert k.run(["auth", "can-i", "list", "pods", "--as", "alice"]) == 0
+        assert out.getvalue().strip() == "yes"
+        out2 = io.StringIO()
+        k2 = Kubectl(cs, out=out2)
+        assert k2.run(["auth", "can-i", "delete", "pods", "--as", "alice"]) == 1
+        assert "no" in out2.getvalue()

@@ -1,0 +1,343 @@
+"""networking.k8s.io group: types + REST, NetworkPolicy evaluation, and
+the round-4 admission long tail (PVC resize, node taint, RuntimeClass,
+certificate gates, DefaultIngressClass).
+
+Reference: staging/src/k8s.io/api/networking/v1/types.go;
+plugin/pkg/admission/{storage/persistentvolume/resize,nodetaint,
+runtimeclass,certificates,network/defaultingressclass}.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kubernetes_tpu.api import networking, types as v1
+from kubernetes_tpu.api.storage import (
+    RuntimeClass,
+    RuntimeClassOverhead,
+    RuntimeClassScheduling,
+    StorageClass,
+)
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver.admission import install_default_admission
+from kubernetes_tpu.apiserver.server import Invalid
+from kubernetes_tpu.proxy.netpol import Endpoint, NetworkPolicyEvaluator
+
+from .util import make_pod
+
+
+def _api() -> APIServer:
+    return install_default_admission(APIServer())
+
+
+class TestNetworkingREST:
+    def test_crud_roundtrip(self):
+        api = _api()
+        api.create("networkpolicies", networking.NetworkPolicy(
+            metadata=v1.ObjectMeta(name="deny", namespace="default"),
+            spec=networking.NetworkPolicySpec(
+                pod_selector=v1.LabelSelector(match_labels={"app": "db"}),
+            ),
+        ))
+        got = api.get("networkpolicies", "deny", "default")
+        assert got.spec.pod_selector.match_labels == {"app": "db"}
+        api.create("ingressclasses", networking.IngressClass(
+            metadata=v1.ObjectMeta(name="nginx"),
+            spec=networking.IngressClassSpec(controller="example.com/nginx"),
+        ))
+        api.create("ingresses", networking.Ingress(
+            metadata=v1.ObjectMeta(name="web", namespace="default"),
+            spec=networking.IngressSpec(
+                ingress_class_name="nginx",
+                rules=[networking.IngressRule(
+                    host="x.example",
+                    http=networking.HTTPIngressRuleValue(paths=[
+                        networking.HTTPIngressPath(
+                            path="/", backend=networking.IngressBackend(
+                                service=networking.IngressServiceBackend(
+                                    name="web",
+                                    port=networking.ServiceBackendPort(
+                                        number=80),
+                                )
+                            )
+                        )
+                    ]),
+                )],
+            ),
+        ))
+        ing = api.get("ingresses", "web", "default")
+        assert ing.spec.rules[0].http.paths[0].backend.service.port.number == 80
+
+    def test_except_serde_roundtrip(self):
+        from kubernetes_tpu.utils import serde
+
+        blk = networking.IPBlock(cidr="10.0.0.0/8",
+                                 except_=["10.1.0.0/16"])
+        d = serde.to_dict(blk)
+        assert d["except"] == ["10.1.0.0/16"]
+        back = serde.from_dict(networking.IPBlock, d)
+        assert back.except_ == ["10.1.0.0/16"]
+
+
+def _pol(name, ns, pod_sel, ingress=None, egress=None, types=None):
+    return networking.NetworkPolicy(
+        metadata=v1.ObjectMeta(name=name, namespace=ns),
+        spec=networking.NetworkPolicySpec(
+            pod_selector=v1.LabelSelector(match_labels=pod_sel),
+            ingress=ingress, egress=egress, policy_types=types,
+        ),
+    )
+
+
+class TestNetworkPolicyEvaluator:
+    def _eps(self):
+        web = Endpoint("default", {"app": "web"}, "10.0.0.1")
+        db = Endpoint("default", {"app": "db"}, "10.0.0.2")
+        other = Endpoint("other", {"app": "web"}, "10.0.1.1")
+        return web, db, other
+
+    def test_default_allow_when_unselected(self):
+        web, db, _ = self._eps()
+        ev = NetworkPolicyEvaluator([])
+        assert ev.allowed(web, db, 5432)
+
+    def test_selected_denies_unmatched(self):
+        web, db, other = self._eps()
+        pol = _pol("db-in", "default", {"app": "db"}, ingress=[
+            networking.NetworkPolicyIngressRule(from_=[
+                networking.NetworkPolicyPeer(
+                    pod_selector=v1.LabelSelector(match_labels={"app": "web"})
+                )
+            ]),
+        ])
+        ev = NetworkPolicyEvaluator([pol])
+        assert ev.allowed(web, db, 5432)  # same-ns web matches
+        stranger = Endpoint("default", {"app": "job"}, "10.0.0.9")
+        assert not ev.allowed(stranger, db, 5432)
+        # peer without namespaceSelector never crosses namespaces
+        assert not ev.allowed(other, db, 5432)
+
+    def test_port_ranges(self):
+        web, db, _ = self._eps()
+        pol = _pol("db-in", "default", {"app": "db"}, ingress=[
+            networking.NetworkPolicyIngressRule(
+                from_=[networking.NetworkPolicyPeer(
+                    pod_selector=v1.LabelSelector(match_labels={"app": "web"})
+                )],
+                ports=[networking.NetworkPolicyPort(
+                    protocol="TCP", port=5000, end_port=5999)],
+            ),
+        ])
+        ev = NetworkPolicyEvaluator([pol])
+        assert ev.allowed(web, db, 5432)
+        assert not ev.allowed(web, db, 6000)
+        assert not ev.allowed(web, db, 5432, protocol="UDP")
+
+    def test_namespace_selector_and_ipblock(self):
+        web, db, other = self._eps()
+        pol = _pol("db-in", "default", {"app": "db"}, ingress=[
+            networking.NetworkPolicyIngressRule(from_=[
+                networking.NetworkPolicyPeer(
+                    namespace_selector=v1.LabelSelector(
+                        match_labels={"team": "a"})
+                ),
+                networking.NetworkPolicyPeer(ip_block=networking.IPBlock(
+                    cidr="192.168.0.0/16", except_=["192.168.9.0/24"],
+                )),
+            ]),
+        ])
+        ev = NetworkPolicyEvaluator([pol], namespaces={"other": {"team": "a"}})
+        assert ev.allowed(other, db, 80)  # namespace labels match
+        assert not ev.allowed(web, db, 80)  # own ns has no team=a label
+        assert ev.allowed(Endpoint.external("192.168.1.5"), db, 80)
+        assert not ev.allowed(Endpoint.external("192.168.9.5"), db, 80)
+
+    def test_egress_direction(self):
+        web, db, _ = self._eps()
+        pol = _pol("web-out", "default", {"app": "web"}, egress=[
+            networking.NetworkPolicyEgressRule(to=[
+                networking.NetworkPolicyPeer(
+                    pod_selector=v1.LabelSelector(match_labels={"app": "db"})
+                )
+            ]),
+        ])
+        ev = NetworkPolicyEvaluator([pol])
+        assert ev.allowed(web, db, 5432)
+        stranger = Endpoint("default", {"app": "cache"}, "10.0.0.8")
+        assert not ev.allowed(web, stranger, 6379)
+        # ingress to web is unconstrained (policy only types Egress via
+        # defaulting? no — defaulting adds Ingress ONLY when unset...)
+        # explicit: policy_types defaulted to [Ingress, Egress] because
+        # egress rules exist; web has no ingress RULES -> ingress denied
+        assert not ev.allowed(db, web, 80)
+
+    def test_empty_peers_allow_all_on_port(self):
+        web, db, _ = self._eps()
+        pol = _pol("db-in", "default", {"app": "db"}, ingress=[
+            networking.NetworkPolicyIngressRule(
+                ports=[networking.NetworkPolicyPort(protocol="TCP", port=5432)]
+            ),
+        ])
+        ev = NetworkPolicyEvaluator([pol])
+        assert ev.allowed(Endpoint.external("8.8.8.8"), db, 5432)
+        assert not ev.allowed(Endpoint.external("8.8.8.8"), db, 80)
+
+
+class TestResizeAdmission:
+    def _api_with_pvc(self, expand: bool):
+        api = _api()
+        api.create("storageclasses", StorageClass(
+            metadata=v1.ObjectMeta(name="fast"),
+            allow_volume_expansion=expand,
+        ))
+        api.create("persistentvolumeclaims", v1.PersistentVolumeClaim(
+            metadata=v1.ObjectMeta(name="c", namespace="default"),
+            spec=v1.PersistentVolumeClaimSpec(
+                storage_class_name="fast",
+                resources=v1.ResourceRequirements(
+                    requests={"storage": "5Gi"}),
+            ),
+        ))
+        return api
+
+    def test_growth_requires_expandable_class(self):
+        api = self._api_with_pvc(expand=False)
+        pvc = api.get("persistentvolumeclaims", "c", "default")
+        pvc.spec.resources.requests["storage"] = "10Gi"
+        with pytest.raises(Invalid):
+            api.update("persistentvolumeclaims", pvc)
+
+    def test_growth_allowed_when_class_expands(self):
+        api = self._api_with_pvc(expand=True)
+        pvc = api.get("persistentvolumeclaims", "c", "default")
+        pvc.spec.resources.requests["storage"] = "10Gi"
+        api.update("persistentvolumeclaims", pvc)
+
+    def test_shrink_rejected(self):
+        api = self._api_with_pvc(expand=True)
+        pvc = api.get("persistentvolumeclaims", "c", "default")
+        pvc.spec.resources.requests["storage"] = "1Gi"
+        with pytest.raises(Invalid):
+            api.update("persistentvolumeclaims", pvc)
+
+
+class TestNodeTaintAdmission:
+    def test_new_node_gets_not_ready_taint(self):
+        from kubernetes_tpu.testing.synth import make_node
+
+        api = _api()
+        api.create("nodes", make_node("n0"))
+        got = api.get("nodes", "n0")
+        assert any(
+            t.key == "node.kubernetes.io/not-ready" and t.effect == "NoSchedule"
+            for t in got.spec.taints or []
+        )
+
+
+class TestRuntimeClassAdmission:
+    def test_overhead_and_scheduling_merge(self):
+        api = _api()
+        api.create("runtimeclasses", RuntimeClass(
+            metadata=v1.ObjectMeta(name="gvisor"),
+            handler="runsc",
+            overhead=RuntimeClassOverhead(
+                pod_fixed={"cpu": "250m", "memory": "64Mi"}),
+            scheduling=RuntimeClassScheduling(
+                node_selector={"sandbox": "gvisor"}),
+        ))
+        pod = make_pod("p")
+        pod.spec.runtime_class_name = "gvisor"
+        api.create("pods", pod)
+        got = api.get("pods", "p", "default")
+        assert got.spec.overhead == {"cpu": "250m", "memory": "64Mi"}
+        assert got.spec.node_selector == {"sandbox": "gvisor"}
+
+    def test_missing_class_rejected(self):
+        api = _api()
+        pod = make_pod("p")
+        pod.spec.runtime_class_name = "ghost"
+        with pytest.raises(Invalid):
+            api.create("pods", pod)
+
+    def test_conflicting_overhead_rejected(self):
+        api = _api()
+        api.create("runtimeclasses", RuntimeClass(
+            metadata=v1.ObjectMeta(name="kata"),
+            overhead=RuntimeClassOverhead(pod_fixed={"cpu": "1"}),
+        ))
+        pod = make_pod("p")
+        pod.spec.runtime_class_name = "kata"
+        pod.spec.overhead = {"cpu": "2"}
+        with pytest.raises(Invalid):
+            api.create("pods", pod)
+
+
+class TestCertificateAdmission:
+    def test_subject_restriction_blocks_masters(self):
+        from kubernetes_tpu.api.certificates import CertificateSigningRequest
+
+        api = _api()
+        csr = CertificateSigningRequest(
+            metadata=v1.ObjectMeta(name="bad"),
+        )
+        csr.spec.signer_name = "kubernetes.io/kube-apiserver-client"
+        csr.spec.request = json.dumps(
+            {"commonName": "eve", "groups": ["system:masters"]}
+        )
+        with pytest.raises(Invalid):
+            api.create("certificatesigningrequests", csr)
+
+    def test_other_signer_unrestricted(self):
+        from kubernetes_tpu.api.certificates import CertificateSigningRequest
+
+        api = _api()
+        csr = CertificateSigningRequest(metadata=v1.ObjectMeta(name="ok"))
+        csr.spec.signer_name = "kubernetes.io/kubelet-serving"
+        csr.spec.request = json.dumps(
+            {"commonName": "n", "groups": ["system:masters"]}
+        )
+        api.create("certificatesigningrequests", csr)
+
+
+class TestDefaultIngressClass:
+    def test_default_applied(self):
+        api = _api()
+        api.create("ingressclasses", networking.IngressClass(
+            metadata=v1.ObjectMeta(
+                name="nginx",
+                annotations={
+                    networking.DEFAULT_INGRESS_CLASS_ANNOTATION: "true"},
+            ),
+            spec=networking.IngressClassSpec(controller="x"),
+        ))
+        api.create("ingresses", networking.Ingress(
+            metadata=v1.ObjectMeta(name="web", namespace="default"),
+        ))
+        assert api.get("ingresses", "web", "default") \
+            .spec.ingress_class_name == "nginx"
+
+    def test_two_defaults_rejected(self):
+        api = _api()
+        for n in ("a", "b"):
+            api.create("ingressclasses", networking.IngressClass(
+                metadata=v1.ObjectMeta(
+                    name=n,
+                    annotations={
+                        networking.DEFAULT_INGRESS_CLASS_ANNOTATION: "true"},
+                ),
+            ))
+        with pytest.raises(Invalid):
+            api.create("ingresses", networking.Ingress(
+                metadata=v1.ObjectMeta(name="web", namespace="default"),
+            ))
+
+    def test_explicit_class_untouched(self):
+        api = _api()
+        api.create("ingresses", networking.Ingress(
+            metadata=v1.ObjectMeta(name="web", namespace="default"),
+            spec=networking.IngressSpec(ingress_class_name="custom"),
+        ))
+        assert api.get("ingresses", "web", "default") \
+            .spec.ingress_class_name == "custom"

@@ -301,3 +301,52 @@ class TestPallasTerms:
             {"app": "m"}, _affinity(zone=False, anti=True, labels={"app": "m"}),
             n_nodes=10, n_existing=0, n_pending=20, batch=4)
         assert got == ref
+
+
+class TestEvalApplySplit:
+    """The sharded session's building blocks: mode="eval" (no carry
+    writes) + mode="apply" (externally-forced placement) replayed
+    per-pod must reproduce the full kernel's decisions and carry
+    exactly — including -1 (off-shard, in the sharded case) forcing a
+    no-op."""
+
+    def test_eval_apply_replays_full(self):
+        nodes, init_pods = synth_cluster(12, pods_per_node=1)
+        pending = synth_pending_pods(16, spread=True)
+        enc, pe = _presized_encoding(
+            copy.deepcopy(nodes), copy.deepcopy(init_pods),
+            copy.deepcopy(pending))
+        arrays = _encode_all(enc, pe, pending)
+        full = PallasSession(enc.device_state(), _templates_of(arrays),
+                             interpret=True)
+        ref = PallasSession.decisions(full.schedule(arrays))[:len(arrays)]
+
+        enc2, pe2 = _presized_encoding(nodes, init_pods, pending)
+        arrays2 = _encode_all(enc2, pe2, pending)
+        split = PallasSession(enc2.device_state(), _templates_of(arrays2),
+                              interpret=True)
+        got = []
+        for a in arrays2:
+            ((best, _score),) = split.evaluate([a])
+            got.append(best)
+            split.apply_decisions([a], [best])
+        assert got == ref
+
+    def test_off_shard_apply_is_noop(self):
+        """Forcing -1 (the pod landed on ANOTHER shard's nodes) must not
+        move this session's carry: a subsequent eval sees unchanged
+        state."""
+        nodes, init_pods = synth_cluster(8, pods_per_node=1)
+        pending = synth_pending_pods(4, spread=True)
+        enc, pe = _presized_encoding(nodes, init_pods, pending)
+        arrays = _encode_all(enc, pe, pending)
+        s = PallasSession(enc.device_state(), _templates_of(arrays),
+                          interpret=True)
+        before = s.evaluate([arrays[0]])
+        s.apply_decisions([arrays[0]], [-1])  # off-shard: no-op
+        after = s.evaluate([arrays[0]])
+        assert before == after
+        # a real apply then DOES move the carry
+        s.apply_decisions([arrays[0]], [before[0][0]])
+        moved = s.evaluate([arrays[1]])
+        assert isinstance(moved[0][0], int)

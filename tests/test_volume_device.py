@@ -295,3 +295,57 @@ class TestOracleParity:
                 assert r.feasible_nodes == len(oracle_ok), trial
             except FitError:
                 assert not oracle_ok, trial
+
+
+class TestUniqueHandleAccounting:
+    def test_shared_handle_counts_once_per_node(self):
+        """attach_delta refcounts handles per node (NodeVolumeLimits
+        unions idents): the second sharer contributes 0, and removal
+        only frees the slot when the LAST sharer leaves."""
+        pvc = mk_pvc("c1", volume_name="pv1")
+        pv = mk_pv("pv1", phase="Bound")
+        pv.spec.csi = {"driver": "x.csi", "volumeHandle": "h1"}
+        r = mk_resolver(pvcs=[pvc], pvs=[pv])
+        name = attach_resource_name("x.csi")
+        a, b = pod_with_pvc("a", "c1"), pod_with_pvc("b", "c1")
+        assert r.attach_delta(a, "n0", +1) == {name: 1}
+        assert r.attach_delta(b, "n0", +1) == {}  # shared: no new attach
+        assert r.attach_delta(a, "n0", -1) == {}  # b still holds it
+        assert r.attach_delta(b, "n0", -1) == {name: 1}  # last one frees
+
+    def test_distinct_nodes_count_independently(self):
+        pvc = mk_pvc("c1", volume_name="pv1")
+        pv = mk_pv("pv1", phase="Bound")
+        pv.spec.csi = {"driver": "x.csi", "volumeHandle": "h1"}
+        r = mk_resolver(pvcs=[pvc], pvs=[pv])
+        name = attach_resource_name("x.csi")
+        assert r.attach_delta(pod_with_pvc("a", "c1"), "n0", +1) == {name: 1}
+        assert r.attach_delta(pod_with_pvc("b", "c1"), "n1", +1) == {name: 1}
+
+    def test_batch_sharers_split_kernel_oracle(self):
+        """Two pods sharing a claim arriving in ONE batch: the first
+        rides the kernel, the second is diverted to the oracle (both
+        still bind)."""
+        import time
+
+        api, cs, factory, sched = _live_cluster(n_nodes=2)
+        try:
+            cs.resource("persistentvolumes").create(
+                mk_pv("pvs", phase="Bound", access=("ReadWriteMany",))
+            )
+            cs.resource("persistentvolumeclaims").create(
+                mk_pvc("cs1", volume_name="pvs", access=("ReadWriteMany",))
+            )
+            sched.start()
+            cs.pods.create(pod_with_pvc("sh-a", "cs1"))
+            cs.pods.create(pod_with_pvc("sh-b", "cs1"))
+            assert wait_until(
+                lambda: all(
+                    cs.pods.get(n, "default").spec.node_name
+                    for n in ("sh-a", "sh-b")
+                ),
+                timeout=60,
+            )
+        finally:
+            sched.stop()
+            factory.stop()
