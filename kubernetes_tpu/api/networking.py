@@ -11,7 +11,7 @@ consumes.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Union
 
 from .types import LabelSelector, ObjectMeta
 
@@ -45,7 +45,10 @@ class NetworkPolicyPeer:
 @dataclass
 class NetworkPolicyPort:
     protocol: str = "TCP"
-    port: Optional[int] = None  # None = every port
+    # None = every port; int = numeric; str = a NAMED container port,
+    # resolved against the destination pod's container specs
+    # (types.go IntOrString — networking/v1/types.go NetworkPolicyPort)
+    port: Optional[Union[int, str]] = None
     end_port: Optional[int] = None  # inclusive range [port, endPort]
 
 

@@ -32,6 +32,18 @@ from .server import APIServer, Invalid, NotFound
 DEFAULT_TOLERATION_SECONDS = 300  # defaulttolerationseconds/admission.go:38
 
 
+def _quantities_equal(a: dict, b: dict) -> bool:
+    """Semantic quantity equality: {"cpu": "1"} == {"cpu": "1000m"}."""
+    if set(a) != set(b):
+        return False
+    try:
+        return all(parse_quantity(a[k]) == parse_quantity(b[k]) for k in a)
+    except (ValueError, ArithmeticError, TypeError, AttributeError):
+        # unparseable values (None, lists, ...) fall back to the strict
+        # comparison the reference's conflict check would fail anyway
+        return a == b
+
+
 def namespace_lifecycle(api: APIServer):
     """Reject writes into nonexistent or terminating namespaces."""
 
@@ -646,7 +658,8 @@ def runtime_class_admission(api: APIServer):
         except NotFound:
             raise Invalid(f"pod rejected: RuntimeClass {name!r} not found")
         if rc.overhead is not None and rc.overhead.pod_fixed:
-            if obj.spec.overhead and obj.spec.overhead != rc.overhead.pod_fixed:
+            if obj.spec.overhead and not _quantities_equal(
+                    obj.spec.overhead, rc.overhead.pod_fixed):
                 raise Invalid(
                     "pod rejected: Pod's Overhead doesn't match "
                     f"RuntimeClass's defined Overhead ({rc.overhead.pod_fixed})"
@@ -756,7 +769,15 @@ def certificate_subject_restriction(api: APIServer):
         try:
             req = _json.loads(obj.spec.request or "{}")
         except ValueError:
-            return
+            req = None
+        if not isinstance(req, dict):
+            # fail CLOSED: an unparseable (or non-object) request must
+            # not bypass the system:masters gate
+            # (subjectrestriction/admission.go denies on parse failure)
+            raise Invalid(
+                "unable to parse CSR spec.request for signer "
+                "kubernetes.io/kube-apiserver-client"
+            )
         groups = req.get("groups") or req.get("organizations") or []
         if "system:masters" in groups:
             raise Invalid(

@@ -886,6 +886,31 @@ class Kubectl:
 
         user = current_user()
         if args.as_user or args.as_groups:
+            # the server only honors --as/--as-group if the CALLER holds
+            # the impersonate verb (apiserver filters/impersonation.go);
+            # without this gate any identity could probe any other's
+            # RBAC. No request context = the in-proc loopback client,
+            # which (like the reference's loopback credential) is
+            # system:masters and may always impersonate.
+            caller = user
+            def _can_impersonate(resource: str, name: str) -> bool:
+                if caller is None:
+                    return True
+                return (
+                    authorizer.authorize(
+                        caller, "impersonate", resource, "", name)
+                    or authorizer.authorize(
+                        caller, "impersonate", resource, "")
+                )
+            if args.as_user and not _can_impersonate("users", args.as_user):
+                raise APIError(
+                    f"user {caller.name!r} cannot impersonate users"
+                )
+            for g in args.as_groups or []:
+                if not _can_impersonate("groups", g):
+                    raise APIError(
+                        f"user {caller.name!r} cannot impersonate groups"
+                    )
             # impersonation carries ONLY the passed identity: inheriting
             # the caller's groups (e.g. system:masters) would make every
             # --as query answer "yes" (kubectl drops to exactly

@@ -70,6 +70,7 @@ class FakeRuntimeService:
         self._port_servers: Dict[Tuple[str, int], Callable[[bytes], bytes]] = {}
         self._op_latency = op_latency
         self._ip_prefix = ip_prefix
+        self._ip_masklen = 0  # 0 = derive from prefix octet count
         self._ip_counter = 0
         # test hooks: container name -> exit code to fail with on start
         self.fail_starts: Dict[str, int] = {}
@@ -86,36 +87,50 @@ class FakeRuntimeService:
         """Lowest free address in the range (real CNI IPAM reuses released
         IPs; a monotonic counter would wrap and hand a live pod's IP to a
         new sandbox under churn). Suffix 0 is skipped (network address)."""
-        slash24 = self._ip_prefix.count(".") == 2
+        base, size = self._ip_range()
         in_use = {sb.ip for sb in self._sandboxes.values()}
-        limit = 256 if slash24 else 65536
         start = self._ip_counter + 1  # first-fit from last allocation
-        for off in range(limit - 1):
-            n = (start + off - 1) % (limit - 1) + 1  # cycle [1, limit-1]
-            ip = (
-                f"{self._ip_prefix}.{n}"
-                if slash24
-                else f"{self._ip_prefix}.{n // 256}.{n % 256}"
+        for off in range(size - 1):
+            n = (start + off - 1) % (size - 1) + 1  # cycle [1, size-1]
+            addr = base + n
+            ip = ".".join(
+                str((addr >> s) & 0xFF) for s in (24, 16, 8, 0)
             )
             if ip not in in_use:
                 self._ip_counter = n
                 return ip
         raise RuntimeError(f"pod IP range {self._ip_prefix} exhausted")
 
+    def _ip_range(self) -> Tuple[int, int]:
+        """(base address as int, range size) from the current CIDR. The
+        legacy 2-/3-octet ip_prefix constructor form means /16 and /24."""
+        octets = [int(o) for o in self._ip_prefix.split(".")]
+        mask = self._ip_masklen if self._ip_masklen else (
+            24 if len(octets) == 3 else 16
+        )
+        octets += [0] * (4 - len(octets))
+        base = (octets[0] << 24) | (octets[1] << 16) | (octets[2] << 8) | octets[3]
+        base &= (0xFFFFFFFF << (32 - mask)) & 0xFFFFFFFF
+        return base, 1 << (32 - mask)
+
     def set_pod_cidr(self, cidr: str) -> None:
         """CNI range follows the node's centrally-allocated spec.podCIDR
-        (controllers/nodeipam.py): a /24 maps to a 3-octet prefix, a /16
-        to 2 octets. The kubelet calls this from its node-status sync;
-        no-op when unchanged, existing sandboxes keep their IPs."""
+        (controllers/nodeipam.py); the usable range is derived from the
+        actual mask length (a /23 hands out 510 addresses, not its first
+        /24). The kubelet calls this from its node-status sync; no-op
+        when unchanged, existing sandboxes keep their IPs."""
         base, _, masklen = cidr.partition("/")
+        mask = int(masklen or 24)
         octets = base.split(".")
-        prefix = (
-            ".".join(octets[:3]) if int(masklen or 24) > 16
-            else ".".join(octets[:2])
+        # keep _ip_prefix as the human-readable aligned prefix (tests and
+        # exhaustion messages); allocation uses the exact (base, mask)
+        prefix = base if mask % 8 else (
+            ".".join(octets[:3]) if mask > 16 else ".".join(octets[:2])
         )
         with self._lock:
-            if prefix != self._ip_prefix:
+            if (prefix, mask) != (self._ip_prefix, self._ip_masklen):
                 self._ip_prefix = prefix
+                self._ip_masklen = mask
                 self._ip_counter = 0
 
     def run_pod_sandbox(self, pod_name: str, pod_namespace: str, pod_uid: str) -> str:

@@ -40,20 +40,30 @@ class Endpoint:
     """One traffic endpoint: a pod (labels + namespace + ip) or a bare
     IP (external traffic)."""
 
-    __slots__ = ("namespace", "labels", "ip")
+    __slots__ = ("namespace", "labels", "ip", "named_ports")
 
     def __init__(self, namespace: str = "", labels: Optional[Dict] = None,
-                 ip: str = ""):
+                 ip: str = "", named_ports: Optional[Dict[str, int]] = None):
         self.namespace = namespace
         self.labels = labels or {}
         self.ip = ip
+        # container port name -> containerPort (named NetworkPolicyPort
+        # targets resolve against the DESTINATION pod's container specs)
+        self.named_ports = named_ports or {}
 
     @classmethod
     def from_pod(cls, pod: v1.Pod) -> "Endpoint":
+        named = {
+            p.name: p.container_port
+            for c in pod.spec.containers or []
+            for p in c.ports or []
+            if getattr(p, "name", None)
+        }
         return cls(
             namespace=pod.metadata.namespace,
             labels=dict(pod.metadata.labels or {}),
             ip=pod.status.pod_ip,
+            named_ports=named,
         )
 
     @classmethod
@@ -124,7 +134,7 @@ class NetworkPolicyEvaluator:
 
     @staticmethod
     def _port_matches(ports: Optional[List[NetworkPolicyPort]],
-                      port: int, protocol: str) -> bool:
+                      port: int, protocol: str, dst: Endpoint) -> bool:
         if not ports:
             return True  # no ports = every port
         for p in ports:
@@ -132,15 +142,27 @@ class NetworkPolicyEvaluator:
                 continue
             if p.port is None:
                 return True
-            hi = p.end_port if p.end_port is not None else p.port
-            if p.port <= port <= hi:
+            lo = p.port
+            if isinstance(lo, str):
+                # named port: resolves against the destination pod's
+                # container specs; unresolvable names match nothing
+                # (endPort is invalid with a named port, types.go)
+                lo = dst.named_ports.get(lo)
+                if lo is None:
+                    continue
+                if port == lo:
+                    return True
+                continue
+            hi = p.end_port if p.end_port is not None else lo
+            if lo <= port <= hi:
                 return True
         return False
 
     def allowed(self, src: Endpoint, dst: Endpoint, port: int,
                 protocol: str = "TCP") -> bool:
         """Both directions must pass: dst's ingress policies AND src's
-        egress policies (conformance: a connection needs both sides)."""
+        egress policies (conformance: a connection needs both sides).
+        `port` is a port on dst; named policy ports resolve against dst."""
         return self._direction_allowed(
             dst, src, port, protocol, POLICY_TYPE_INGRESS
         ) and self._direction_allowed(
@@ -151,6 +173,9 @@ class NetworkPolicyEvaluator:
                            port: int, protocol: str, direction: str) -> bool:
         if not subject.is_pod:
             return True  # external endpoints are not policy subjects
+        # traffic destination: the subject for ingress, the remote for
+        # egress — named policy ports always resolve against it
+        dst = subject if direction == POLICY_TYPE_INGRESS else other
         selecting = self._selecting(subject, direction)
         if not selecting:
             return True  # default-allow when unselected
@@ -164,7 +189,7 @@ class NetworkPolicyEvaluator:
                     rule.from_ if direction == POLICY_TYPE_INGRESS
                     else rule.to
                 )
-                if not self._port_matches(rule.ports, port, protocol):
+                if not self._port_matches(rule.ports, port, protocol, dst):
                     continue
                 if not peers:
                     return True  # no peers = every counterpart

@@ -574,3 +574,72 @@ class TestRound4Verbs:
         k2 = Kubectl(cs, out=out2)
         assert k2.run(["auth", "can-i", "delete", "pods", "--as", "alice"]) == 1
         assert "no" in out2.getvalue()
+
+
+class TestAuthCanIImpersonationGate:
+    """Advisor r4: --as/--as-group requires the caller to hold the
+    impersonate verb (filters/impersonation.go); the loopback (no
+    request context) client is system:masters and always may."""
+
+    def _secure(self):
+        from kubernetes_tpu.api import rbac
+        from kubernetes_tpu.apiserver.auth import SecureAPIServer
+
+        secure = SecureAPIServer()
+        api = secure.api
+        api.create("clusterroles", rbac.ClusterRole(
+            metadata=v1.ObjectMeta(name="pod-reader"),
+            rules=[rbac.PolicyRule(verbs=["get", "list"],
+                                   resources=["pods"])],
+        ))
+        api.create("clusterrolebindings", rbac.ClusterRoleBinding(
+            metadata=v1.ObjectMeta(name="rb"),
+            role_ref=rbac.RoleRef(kind="ClusterRole", name="pod-reader"),
+            subjects=[rbac.Subject(kind="User", name="alice")],
+        ))
+        api.authorizer = secure.authorizer
+        return secure, api
+
+    def test_plain_caller_cannot_impersonate(self):
+        from kubernetes_tpu.apiserver.auth import UserInfo
+        from kubernetes_tpu.apiserver.requestcontext import request_user
+
+        _, api = self._secure()
+        cs = Clientset(api)
+        out = io.StringIO()
+        k = Kubectl(cs, out=out)
+        with request_user(UserInfo(name="bob", groups=())):
+            assert k.run(
+                ["auth", "can-i", "list", "pods", "--as", "alice"]) == 1
+        assert "impersonate" in out.getvalue()
+
+    def test_impersonate_verb_grants_access(self):
+        from kubernetes_tpu.api import rbac
+        from kubernetes_tpu.apiserver.auth import UserInfo
+        from kubernetes_tpu.apiserver.requestcontext import request_user
+
+        _, api = self._secure()
+        api.create("clusterroles", rbac.ClusterRole(
+            metadata=v1.ObjectMeta(name="impersonator"),
+            rules=[rbac.PolicyRule(verbs=["impersonate"],
+                                   resources=["users"])],
+        ))
+        api.create("clusterrolebindings", rbac.ClusterRoleBinding(
+            metadata=v1.ObjectMeta(name="rb-imp"),
+            role_ref=rbac.RoleRef(kind="ClusterRole", name="impersonator"),
+            subjects=[rbac.Subject(kind="User", name="bob")],
+        ))
+        cs = Clientset(api)
+        out = io.StringIO()
+        k = Kubectl(cs, out=out)
+        with request_user(UserInfo(name="bob", groups=())):
+            assert k.run(
+                ["auth", "can-i", "list", "pods", "--as", "alice"]) == 0
+        assert "yes" in out.getvalue()
+
+    def test_loopback_still_allowed(self):
+        _, api = self._secure()
+        cs = Clientset(api)
+        out = io.StringIO()
+        k = Kubectl(cs, out=out)
+        assert k.run(["auth", "can-i", "list", "pods", "--as", "alice"]) == 0

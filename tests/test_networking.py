@@ -341,3 +341,92 @@ class TestDefaultIngressClass:
         ))
         assert api.get("ingresses", "web", "default") \
             .spec.ingress_class_name == "custom"
+
+
+class TestAdviceR4Fixes:
+    """Round-4 advisor findings: fail-closed CSR parse, semantic overhead
+    quantities, named policy ports."""
+
+    def test_unparseable_csr_request_fails_closed(self):
+        from kubernetes_tpu.api.certificates import CertificateSigningRequest
+
+        api = _api()
+        csr = CertificateSigningRequest(metadata=v1.ObjectMeta(name="junk"))
+        csr.spec.signer_name = "kubernetes.io/kube-apiserver-client"
+        csr.spec.request = "{not json"
+        with pytest.raises(Invalid):
+            api.create("certificatesigningrequests", csr)
+
+    def test_overhead_semantic_quantity_equality(self):
+        api = _api()
+        api.create("runtimeclasses", RuntimeClass(
+            metadata=v1.ObjectMeta(name="kata"),
+            overhead=RuntimeClassOverhead(pod_fixed={"cpu": "1000m"}),
+        ))
+        pod = make_pod("p")
+        pod.spec.runtime_class_name = "kata"
+        pod.spec.overhead = {"cpu": "1"}  # == 1000m semantically
+        api.create("pods", pod)  # must NOT be rejected as a conflict
+        assert api.get("pods", "p", "default").spec.overhead == {
+            "cpu": "1000m"}
+
+    def test_named_policy_port(self):
+        db = Endpoint("default", {"app": "db"}, "10.0.0.2",
+                      named_ports={"postgres": 5432})
+        web = Endpoint("default", {"app": "web"}, "10.0.0.1")
+        pol = _pol("db-in", "default", {"app": "db"}, ingress=[
+            networking.NetworkPolicyIngressRule(
+                from_=[networking.NetworkPolicyPeer(
+                    pod_selector=v1.LabelSelector(match_labels={"app": "web"})
+                )],
+                ports=[networking.NetworkPolicyPort(
+                    protocol="TCP", port="postgres")],
+            ),
+        ])
+        ev = NetworkPolicyEvaluator([pol])
+        assert ev.allowed(web, db, 5432)
+        assert not ev.allowed(web, db, 80)
+        # a destination without the named port matches nothing
+        anon = Endpoint("default", {"app": "db"}, "10.0.0.3")
+        assert not ev.allowed(web, anon, 5432)
+
+    def test_named_port_from_pod_and_serde_roundtrip(self):
+        from kubernetes_tpu.utils import serde
+
+        pod = make_pod("p")
+        pod.spec.containers[0].ports = [
+            v1.ContainerPort(name="metrics", container_port=9090)]
+        pod.status.pod_ip = "10.0.0.7"
+        ep = Endpoint.from_pod(pod)
+        assert ep.named_ports == {"metrics": 9090}
+        npp = networking.NetworkPolicyPort(port="metrics")
+        back = serde.from_dict(
+            networking.NetworkPolicyPort, serde.to_dict(npp))
+        assert back.port == "metrics"
+        npp2 = networking.NetworkPolicyPort(port=443)
+        assert serde.from_dict(
+            networking.NetworkPolicyPort, serde.to_dict(npp2)).port == 443
+
+    def test_non_dict_csr_request_fails_closed(self):
+        from kubernetes_tpu.api.certificates import CertificateSigningRequest
+
+        api = _api()
+        for payload in ('["system:masters"]', 'null', '"x"'):
+            csr = CertificateSigningRequest(
+                metadata=v1.ObjectMeta(name=f"j{hash(payload) % 100}"))
+            csr.spec.signer_name = "kubernetes.io/kube-apiserver-client"
+            csr.spec.request = payload
+            with pytest.raises(Invalid):
+                api.create("certificatesigningrequests", csr)
+
+    def test_unparseable_overhead_value_rejected_not_crashed(self):
+        api = _api()
+        api.create("runtimeclasses", RuntimeClass(
+            metadata=v1.ObjectMeta(name="kata2"),
+            overhead=RuntimeClassOverhead(pod_fixed={"cpu": "100m"}),
+        ))
+        pod = make_pod("p2")
+        pod.spec.runtime_class_name = "kata2"
+        pod.spec.overhead = {"cpu": None}
+        with pytest.raises(Invalid):
+            api.create("pods", pod)

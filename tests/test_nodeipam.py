@@ -164,3 +164,50 @@ class TestKubeletConsumption:
             assert wait_until(lambda: kl.runtime._ip_prefix == prefix)
         finally:
             kl.stop()
+
+
+class TestCidrMaskLengths:
+    """Advisor r4: the CNI range must follow the actual mask length, not
+    a two-bucket octet heuristic."""
+
+    def test_slash23_uses_both_24s(self):
+        from kubernetes_tpu.kubelet.cri import FakeRuntimeService
+
+        rt = FakeRuntimeService()
+        rt.set_pod_cidr("10.244.6.0/23")
+        ips = set()
+        for i in range(300):  # > 254, must spill into 10.244.7.x
+            sid = rt.run_pod_sandbox(f"p{i}", "default", f"uid-{i}")
+            ips.add(next(
+                sb.ip for sb in rt.list_pod_sandboxes() if sb.id == sid))
+        assert len(ips) == 300
+        assert any(ip.startswith("10.244.7.") for ip in ips)
+        assert all(
+            ip.startswith("10.244.6.") or ip.startswith("10.244.7.")
+            for ip in ips
+        )
+
+    def test_slash25_exhausts_at_126(self):
+        from kubernetes_tpu.kubelet.cri import FakeRuntimeService
+
+        rt = FakeRuntimeService()
+        rt.set_pod_cidr("10.1.2.128/25")
+        got = []
+        for i in range(127):
+            sid = rt.run_pod_sandbox(f"p{i}", "default", f"uid-{i}")
+            got.append(next(
+                sb.ip for sb in rt.list_pod_sandboxes() if sb.id == sid))
+        # 127 usable host slots (skip network addr .128): .129-.255
+        assert len(set(got)) == 127
+        assert all(129 <= int(ip.rsplit(".", 1)[1]) <= 255 for ip in got)
+        with pytest.raises(RuntimeError):
+            rt.run_pod_sandbox("overflow", "default", "uid-x")
+
+    def test_slash24_unchanged(self):
+        from kubernetes_tpu.kubelet.cri import FakeRuntimeService
+
+        rt = FakeRuntimeService()
+        rt.set_pod_cidr("10.244.7.0/24")
+        sid = rt.run_pod_sandbox("p", "default", "u")
+        ip = next(sb.ip for sb in rt.list_pod_sandboxes() if sb.id == sid)
+        assert ip.startswith("10.244.7.")
