@@ -238,6 +238,12 @@ def main() -> None:
             [r["throughput_avg"] for r in runs]
         )
         line["wire"] = wire
+        # artifact provenance (VERDICT r4 weak #2: append-mode rows with
+        # mixed schemas made "the number" whichever row was last); only
+        # stamped when the round is actually known — a wrong assertion
+        # is worse than an absent field
+        if os.environ.get("BENCH_ROUND"):
+            line["round"] = int(os.environ["BENCH_ROUND"])
         print(json.dumps(line), flush=True)
         # append per config: a crash or timeout must not lose finished runs
         with open(out_path, mode) as f:
