@@ -136,6 +136,28 @@ def _fetch_packed(tree: Dict) -> Dict:
     return out
 
 
+def batch_prologue(fps: Dict, tp_np: Dict, pod_arrays_list: List[Dict],
+                   minimum: int, require_unbound: bool = True):
+    """Shared host-side batch prep for the session schedule paths
+    (PallasSession.schedule, _dispatch_mode, ShardedPallasSession):
+    pow2 length bucket (each distinct Bp is a fresh compile; production
+    batches are ragged), template ids, and the match matrices — computed
+    on HOST (match_matrices_np): an on-device compute + readback here
+    would wait out the previous batch's scan and kill the
+    dispatch/harvest overlap. Returns (Bp, tmpl[Bp], mfa, msa)."""
+    from .hoisted import batch_bucket
+
+    B = len(pod_arrays_list)
+    Bp = batch_bucket(B, minimum=minimum)
+    tmpl = np.zeros(Bp, np.int32)
+    for i, pa in enumerate(pod_arrays_list):
+        if require_unbound and bool(np.asarray(pa["has_node_name"])):
+            raise ValueError("session pods must be unbound")
+        tmpl[i] = fps[template_fingerprint(pa)]
+    mfa, msa = match_matrices_np(tp_np, pod_arrays_list)
+    return Bp, tmpl, mfa, msa
+
+
 class _Cfg(NamedTuple):
     """Value-hashable kernel configuration — the ONLY static jit input.
     Sessions with equal shapes/weights share one compiled program; the
@@ -631,6 +653,14 @@ class PallasSession:
 
     def _pack_scalars(self, S) -> np.ndarray:
         T, C, R = self.T, self.C, self.R
+        # the sharded two-phase session (ops/sharded_scan.py) reads these
+        # as structured tables instead of SMEM offsets
+        self._sc_tables = {
+            k: np.asarray(S[k]).copy()
+            for k in ("f_valid", "s_valid", "f_skew", "s_skew",
+                      "f_self_match", "s_first", "f_same_key", "s_same_key",
+                      "ipa_present")
+        }
         per_t = np.concatenate([
             self._req_s, self._req_check_s,
             self._req_has_any_s[:, None], self._nz_req_s,
@@ -704,20 +734,8 @@ class PallasSession:
         """Enqueue one batch; returns the (8, Bp) device result rows —
         row 0 best / row 1 score / row 2 n_feasible. decisions() blocks."""
         B = len(pod_arrays_list)
-        # pow2 length buckets (not just LANE multiples): each distinct Bp
-        # is a fresh Mosaic compile, and production batches are ragged
-        from .hoisted import batch_bucket
-
-        Bp = batch_bucket(B, minimum=LANE)
-        tmpl = np.zeros(Bp, np.int32)
-        for i, pa in enumerate(pod_arrays_list):
-            if bool(np.asarray(pa["has_node_name"])):
-                raise ValueError("session pods must be unbound")
-            tmpl[i] = self._fps[template_fingerprint(pa)]
-        # match matrices on HOST (match_matrices_np): an on-device
-        # compute + readback here would wait out the previous batch's
-        # scan and kill the dispatch/harvest overlap
-        mfa, msa = match_matrices_np(self._tp_np, pod_arrays_list)
+        Bp, tmpl, mfa, msa = batch_prologue(
+            self._fps, self._tp_np, pod_arrays_list, minimum=LANE)
         T, C, CP = self.T, self.C, self.CP
         # [Bp, LANE]: lane (t*CP+c) = that constraint row, per pod.
         # int8 on the wire: match weights are 0/1 and the per-batch
@@ -750,13 +768,9 @@ class PallasSession:
 
     def _dispatch_mode(self, pod_arrays_list, mode, forced=None):
         B = len(pod_arrays_list)
-        from .hoisted import batch_bucket
-
-        Bp = batch_bucket(B, minimum=LANE)
-        tmpl = np.zeros(Bp, np.int32)
-        for i, pa in enumerate(pod_arrays_list):
-            tmpl[i] = self._fps[template_fingerprint(pa)]
-        mfa, msa = match_matrices_np(self._tp_np, pod_arrays_list)
+        Bp, tmpl, mfa, msa = batch_prologue(
+            self._fps, self._tp_np, pod_arrays_list, minimum=LANE,
+            require_unbound=False)
         T, C, CP = self.T, self.C, self.CP
         mfT = np.zeros((Bp, LANE), np.int8)
         msT = np.zeros((Bp, LANE), np.int8)

@@ -1,0 +1,534 @@
+"""Sharded two-phase scheduling session: the pallas session's math over a
+jax.sharding.Mesh, exact.
+
+The single-launch pallas kernel (ops/pallas_scan.py) cannot span chips: a
+Mosaic program owns one core's VMEM, and the per-pod loop needs GLOBAL
+reductions each step (score normalization min/max over ALL nodes —
+reference helper/normalize_score.go:24, framework/runtime/framework.go:757
+— the PTS min-match, the cross-node argmax). Sharding those away silently
+changes decisions. So the mesh path restructures each per-pod step into
+the two-phase form (VERDICT r4 #2 / PERF_NOTES "Sharded pallas"):
+
+  raw partials   — every shard computes masks/counts/scores over ITS node
+                   slice only, from node-sharded carries (the pallas
+                   session's node-space carry layout: requested/nzpc/
+                   cnt_fn/cnt_sn, all [rows, N] — nothing pair-global);
+  collectives    — the handful of cross-shard scalars ride named-axis
+                   collectives over ICI (psum/pmax/pmin): the PTS filter's
+                   per-constraint min-match, zone-presence (<=128-lane
+                   vocab rows), n_scored/n_feasible, the four normalize
+                   min/max pairs, the argmax (max score, then min global
+                   lane among maxima = the first-max convention), and the
+                   winner's pair-ids for the count updates;
+  finish + apply — normalization and totals are shard-local elementwise;
+                   the winning shard alone takes the carry updates (the
+                   same off-shard no-op trick as the kernel's apply mode:
+                   `hot` is all-zero off the winner).
+
+The step body runs under shard_map inside ONE jit-compiled lax.scan per
+batch — one device dispatch per batch, carries device-resident across
+batches, exactly the session discipline of HoistedSession/PallasSession.
+Decisions are BIT-IDENTICAL to the single-device PallasSession (same
+int32 rescaled resources, f32 score math, first-max tie-break); parity is
+pinned by tests/test_sharded_scan.py over fuzzed clusters on a virtual
+8-device CPU mesh.
+
+Statics and envelope come from PallasSession's own prologue (the GCD
+int32 rescale, per-template static rows, compact topology vocab): a shape
+the pallas kernel rejects is rejected here with the same PallasUnsupported
+reasons. Templates with affinity TERMS currently ride the GSPMD hoisted
+mesh session instead (reason="ipa-terms-mesh") — the D1-D5 ucnt/kcnt
+machinery is node-sharded too but its collectives are not yet wired.
+
+Reference frame: pkg/scheduler/internal/parallelize/parallelism.go:27,56
+(the 16-goroutine node chunking this replaces) and
+framework/plugins/helper/normalize_score.go:24 (the global normalize that
+must not be sharded away).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.sharded import NODE_AXIS
+from .hoisted import template_fingerprint
+from .kernel import MAX_NODE_SCORE
+from .pallas_scan import (
+    LANE,
+    POS_BIG,
+    PallasSession,
+    PallasUnsupported,
+    _ceil,
+    batch_prologue,
+)
+
+# node-sharded statics: key -> node axis position
+_NODE_DIM = {
+    "alloc": 1, "stat": 2, "regrow_f": 1, "zvalid_node_s": 1,
+    "konn_f": 1, "konn_s": 1, "shasall": 1, "valid_n": 1,
+    "prow_f": 1, "prow_s": 1, "onehot": 1,
+}
+_CARRY_KEYS = ("requested", "nzpc", "cnt_fn", "cnt_sn")
+
+
+def _step_fn(cfg, statics, tables, carry, x):
+    """One pod through the two-phase step (runs per shard, inside
+    shard_map): local partials -> collectives -> finish -> winner-shard
+    carry updates. Mirrors ops/pallas_scan.py _build_kernel one_pod
+    (mode="full") line for line; divergences are bugs."""
+    (T, C, CP, R, SR, K, Npl, TCp) = cfg[0]
+    W = dict(cfg[1])
+    f32 = jnp.float32
+    t = x["tmpl"]
+    shard = jax.lax.axis_index(NODE_AXIS)
+    glane = shard * Npl + jnp.arange(Npl, dtype=jnp.int32)[None, :]  # (1,Npl)
+
+    def psum(v):
+        return jax.lax.psum(v, NODE_AXIS)
+
+    def pmax(v):
+        return jax.lax.pmax(v, NODE_AXIS)
+
+    def pmin(v):
+        return jax.lax.pmin(v, NODE_AXIS)
+
+    requested, nzpc = carry["requested"], carry["nzpc"]
+    cnt_fn, cnt_sn = carry["cnt_fn"], carry["cnt_sn"]
+    alloc = statics["alloc"]
+    valid_n = statics["valid_n"][0:1, :]
+    stat3 = statics["stat"]                      # (T, SR, Npl)
+
+    def trow(i):
+        return jax.lax.dynamic_index_in_dim(stat3, t, 0,
+                                            keepdims=False)[i:i + 1, :]
+
+    static_mask = trow(0)
+    raw_ipa = trow(1)
+    cnt_taint = trow(2)
+    cnt_nodeaff = trow(3)
+    sc_image = trow(4)
+    sc_avoid = trow(5)
+
+    def tc8(a):
+        """[T, C] table -> (CP, 1) column for template t."""
+        row = jax.lax.dynamic_index_in_dim(a, t, 0, keepdims=False)  # (C,)
+        return jnp.pad(row, (0, CP - C))[:, None]
+
+    def block(a):
+        """[TCp, Npl] -> this template's (CP, Npl) rows."""
+        return jax.lax.dynamic_slice_in_dim(a, t * CP, CP, axis=0)
+
+    # ---- NodeResourcesFit (exact int32 after the session's GCD rescale)
+    req_t = jax.lax.dynamic_index_in_dim(tables["req"], t, 0,
+                                         keepdims=False)          # (R,)
+    req_check = jax.lax.dynamic_index_in_dim(tables["req_check"], t, 0,
+                                             keepdims=False)
+    over = jnp.zeros((1, Npl), jnp.bool_)
+    for r in range(R):
+        free = alloc[r:r + 1, :] - requested[r:r + 1, :]
+        over = over | ((req_t[r] > free) & (req_check[r] != 0))
+    nz_req = jax.lax.dynamic_index_in_dim(tables["nz_req"], t, 0,
+                                          keepdims=False)         # (2,)
+    fail_dims = (tables["req_has_any"][t] != 0) & over
+    fail_count = (nzpc[2:3, :] + jnp.int32(1)) > nzpc[3:4, :]
+    mask_fit = jnp.logical_not(fail_count | fail_dims)
+
+    # ---- PTS filter: local shifted counts, GLOBAL per-constraint min
+    cntf = block(cnt_fn).astype(f32)                              # (CP,Npl)
+    sameM = jax.lax.dynamic_index_in_dim(
+        tables["f_same"], t, 0, keepdims=False)                   # (CP,CP)
+    sh = jax.lax.dot_general(
+        sameM, cntf, (((1,), (0,)), ((), ())),
+        preferred_element_type=f32,
+        precision=jax.lax.Precision.HIGHEST)
+    reg = block(statics["regrow_f"])
+    big = f32(POS_BIG)
+    min_c_l = jnp.min(jnp.where(reg != 0, sh, big), axis=1, keepdims=True)
+    min_c = pmin(min_c_l)                      # -- collective 1 (CP,1)
+    min_c = jnp.where(min_c == big, f32(0.0), min_c)
+    cnt_n = jnp.where(reg != 0, sh, f32(0.0))
+    konn = block(statics["konn_f"])
+    vld = tc8(tables["f_valid"])
+    selfm = tc8(tables["f_self_match"]).astype(f32)
+    maxskew = tc8(tables["f_skew"]).astype(f32)
+    fail_missing = (vld != 0) & (konn == 0)
+    skew = cnt_n + selfm - min_c
+    fail_skew = (vld != 0) & (konn != 0) & (skew > maxskew)
+    fail_pts = jnp.any(fail_missing | fail_skew, axis=0, keepdims=True)
+
+    feasible = ((static_mask != 0) & mask_fit
+                & jnp.logical_not(fail_pts) & (valid_n != 0))
+    n_feasible = psum(jnp.sum(feasible.astype(jnp.int32)))
+
+    # ---- resource scores (local) ----
+    nz_cpu = (nzpc[0:1, :] + nz_req[0]).astype(f32)
+    nz_mem = (nzpc[1:2, :] + nz_req[1]).astype(f32)
+    cap_cpu = alloc[0:1, :].astype(f32)
+    cap_mem = alloc[1:2, :].astype(f32)
+    frac_c = jnp.where(cap_cpu == 0, f32(1.0), nz_cpu / cap_cpu)
+    frac_m = jnp.where(cap_mem == 0, f32(1.0), nz_mem / cap_mem)
+    balanced = ((f32(1.0) - jnp.abs(frac_c - frac_m))
+                * MAX_NODE_SCORE).astype(jnp.int32)
+    balanced = jnp.where((frac_c >= 1) | (frac_m >= 1),
+                         jnp.int32(0), balanced)
+
+    def least_dim(cap, reqq):
+        d = ((cap - reqq) * MAX_NODE_SCORE
+             // jnp.where(cap == 0, jnp.int32(1), cap))
+        return jnp.where((cap == 0) | (reqq > cap), jnp.int32(0), d)
+
+    least = (least_dim(alloc[0:1, :], nzpc[0:1, :] + nz_req[0])
+             + least_dim(alloc[1:2, :], nzpc[1:2, :] + nz_req[1])
+             ) // jnp.int32(2)
+
+    # ---- PTS score: zone presence is a cross-shard OR ----
+    shasall = jax.lax.dynamic_index_in_dim(
+        statics["shasall"], t, 0, keepdims=True)                  # (1,Npl)
+    scored = feasible & (shasall != 0)
+    ignored = feasible & (shasall == 0)
+    scored_f32 = scored.astype(f32)
+    n_scored = psum(jnp.sum(scored_f32))       # -- collective 2 (scalars)
+    zp = []
+    zpn = []
+    for k in range(K):
+        cnt_z = jax.lax.dot_general(
+            scored_f32, statics["onehot"][k], (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)                           # (1,VZ)
+        p = (psum(cnt_z) > 0).astype(f32)      # -- collective 2 (VZ rows)
+        zp.append(p)
+        zpn.append(jax.lax.dot_general(
+            p, statics["onehot"][k], (((1,), (1,)), ((), ())),
+            preferred_element_type=f32))                          # (1,Npl)
+    cnts = block(cnt_sn).astype(f32)
+    sameS = jax.lax.dynamic_index_in_dim(
+        tables["s_same"], t, 0, keepdims=False)
+    sh_s = jax.lax.dot_general(
+        sameS, cnts, (((1,), (0,)), ((), ())),
+        preferred_element_type=f32,
+        precision=jax.lax.Precision.HIGHEST)                      # (CP,Npl)
+    vld_s = tc8(tables["s_valid"])
+    perno = tc8(tables["s_perno"])
+    key_s = tc8(tables["s_keyid"])
+    first = tc8(tables["s_first"])
+    sskew = tc8(tables["s_skew"]).astype(f32)
+    have_s = (jnp.sum(vld_s) > 0).astype(jnp.int32)
+    zval_l = block(statics["zvalid_s_rows"]).astype(f32)          # (CP,VZ)
+    zval_n = block(statics["zvalid_node_s"])
+    topo = jnp.zeros((CP, 1), f32)
+    regn = jnp.zeros((CP, Npl), f32)
+    for k in range(K):
+        use = (jnp.logical_not(perno != 0) & (key_s == k)).astype(f32)
+        topo = topo + use * jnp.sum(zp[k] * zval_l, axis=1, keepdims=True)
+        regn = regn + use * zpn[k]
+    regn = regn * (zval_n != 0)
+    topo_size = jnp.where(first != 0, topo, f32(0.0))
+    weight = jnp.log(jnp.where(perno != 0, n_scored, topo_size) + f32(2.0))
+    cnt_n_s = jnp.where(perno != 0, sh_s,
+                        jnp.where(regn > 0, sh_s, f32(0.0)))
+    konn_s = block(statics["konn_s"])
+    term = jnp.where((vld_s != 0) & (konn_s != 0),
+                     cnt_n_s * weight + (sskew - f32(1.0)), f32(0.0))
+    # same HIGHEST ones-dot reduction as the kernel (pallas_scan.py
+    # raw): f32 accumulation order must match for bit-parity on TPU
+    raw = jax.lax.dot_general(
+        jnp.ones((1, CP), f32), term, (((1,), (0,)), ((), ())),
+        preferred_element_type=f32,
+        precision=jax.lax.Precision.HIGHEST)                      # (1,Npl)
+    raw_i = raw.astype(jnp.int32)
+    min_r = pmin(jnp.min(jnp.where(scored, raw_i, jnp.int32(POS_BIG))))
+    max_r = pmax(jnp.max(jnp.where(scored, raw_i, jnp.int32(0))))
+    min_r = jnp.where(min_r == POS_BIG, jnp.int32(0), min_r)
+    norm = (MAX_NODE_SCORE * (max_r + min_r - raw_i)
+            // jnp.where(max_r == 0, jnp.int32(1), max_r))
+    norm = jnp.where(max_r == 0, jnp.int32(MAX_NODE_SCORE), norm)
+    norm = jnp.where(ignored, jnp.int32(0), norm)
+    sc_pts = jnp.where(have_s != 0, norm, jnp.int32(0))
+
+    # ---- IPA (static raw; term-free envelope) + normalize ----
+    present = tables["ipa_present"][t] != 0
+    min_i = pmin(jnp.min(jnp.where(feasible, raw_ipa, jnp.int32(POS_BIG))))
+    max_i = pmax(jnp.max(jnp.where(feasible, raw_ipa,
+                                   jnp.int32(-POS_BIG))))
+    diff = (max_i - min_i).astype(f32)
+    ipa = jnp.where(
+        diff > 0,
+        (MAX_NODE_SCORE * ((raw_ipa - min_i).astype(f32)
+                           / jnp.where(diff > 0, diff, f32(1.0))))
+        .astype(jnp.int32),
+        jnp.zeros((1, Npl), jnp.int32))
+    ipa = jnp.where(present, ipa, jnp.zeros((1, Npl), jnp.int32))
+
+    # ---- default-normalized taint / node-affinity ----
+    def norm_default(counts, reverse):
+        mx = pmax(jnp.max(jnp.where(feasible, counts, jnp.int32(0))))
+        scaled = (MAX_NODE_SCORE * counts
+                  // jnp.where(mx == 0, jnp.int32(1), mx))
+        if reverse:
+            return jnp.where(mx == 0, jnp.int32(MAX_NODE_SCORE),
+                             jnp.int32(MAX_NODE_SCORE) - scaled)
+        return jnp.where(mx == 0, counts, scaled)
+
+    sc_taint = norm_default(cnt_taint, True)
+    sc_nodeaff = norm_default(cnt_nodeaff, False)
+
+    total = (balanced * W["balanced"] + sc_image * W["image"]
+             + ipa * W["ipa"] + least * W["least"]
+             + sc_nodeaff * W["node_affinity"]
+             + sc_avoid * W["prefer_avoid"]
+             + sc_pts * W["pts"] + sc_taint * W["taint"])
+    total = jnp.where(feasible, total, jnp.int32(-1))
+
+    # ---- cross-shard first-max argmax -- collectives 3+4 ----
+    tf = total.astype(f32)
+    m = pmax(jnp.max(tf))
+    cand = jnp.min(jnp.where(tf >= m, glane, jnp.int32(POS_BIG)))
+    best = pmin(cand).astype(jnp.int32)
+    ok = (m >= 0) & x["valid"]
+    oki = ok.astype(jnp.int32)
+    okf = oki.astype(f32)
+
+    # ---- apply: winner shard only (hot == 0 everywhere else) ----
+    hot = (glane == best).astype(jnp.int32) * oki                 # (1,Npl)
+    hotf = hot.astype(f32)
+    new_requested = requested
+    for r in range(R):
+        new_requested = new_requested.at[r:r + 1, :].add(hot * req_t[r])
+    new_nzpc = nzpc.at[0:1, :].add(hot * nz_req[0])
+    new_nzpc = new_nzpc.at[1:2, :].add(hot * nz_req[1])
+    new_nzpc = new_nzpc.at[2:3, :].add(hot)
+
+    mf_col = x["mf"][:, None].astype(f32)                         # (TCp,1)
+    ms_col = x["ms"][:, None].astype(f32)
+    pf = statics["prow_f"].astype(f32)                            # (TCp,Npl)
+    # pair id at the winning node, shared across shards -- collective 5
+    zb_f = psum(jax.lax.dot_general(
+        pf, hotf, (((1,), (1,)), ((), ())),
+        preferred_element_type=f32,
+        precision=jax.lax.Precision.HIGHEST))                     # (TCp,1)
+    m_f = ((pf == zb_f) & (statics["prow_f"] >= 0)).astype(f32) * okf
+    ps_ = statics["prow_s"].astype(f32)
+    zb_s = psum(jax.lax.dot_general(
+        ps_, hotf, (((1,), (1,)), ((), ())),
+        preferred_element_type=f32,
+        precision=jax.lax.Precision.HIGHEST))
+    m_s = ((ps_ == zb_s) & (statics["prow_s"] >= 0)).astype(f32) * okf
+
+    # s_src factor at the winning node per template (stat row 7)
+    src_all = stat3[:, 7, :].astype(f32)                          # (T,Npl)
+    v_t = psum(jax.lax.dot_general(
+        src_all, hotf, (((1,), (1,)), ((), ())),
+        preferred_element_type=f32))                              # (T,1)
+    # expand to (TCp,1): row t*CP+c gets v_t[t]
+    v_rows = jnp.repeat(v_t, CP, axis=0)                          # (TCp,1)
+    pernosel = tables["s_perno_rows"][:, None].astype(f32)        # (TCp,1)
+    factor = pernosel + (f32(1.0) - pernosel) * v_rows
+
+    new_cnt_fn = (cnt_fn.astype(f32) + mf_col * m_f).astype(jnp.int32)
+    new_cnt_sn = (cnt_sn.astype(f32)
+                  + ms_col * factor * m_s).astype(jnp.int32)
+
+    new_carry = {
+        "requested": new_requested, "nzpc": new_nzpc,
+        "cnt_fn": new_cnt_fn, "cnt_sn": new_cnt_sn,
+    }
+    y = {
+        "best": jnp.where(ok, best, jnp.int32(-1)),
+        "score": jnp.where(ok, m.astype(jnp.int32), jnp.int32(-1)),
+        "n_feasible": n_feasible,
+    }
+    return new_carry, y
+
+
+def _node_spec(k, ndim):
+    nd = _NODE_DIM[k]
+    return P(*[NODE_AXIS if i == nd else None for i in range(ndim)])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "mesh"),
+    donate_argnames=("carry",),
+)
+def _sharded_scan(cfg, mesh, statics, tables, carry, xs):
+    statics_spec = {
+        k: _node_spec(k, np.ndim(v)) if k in _NODE_DIM else P()
+        for k, v in statics.items()
+    }
+    carry_spec = {k: P(None, NODE_AXIS) for k in carry}
+    tables_spec = {k: P() for k in tables}
+    xs_spec = {k: P() for k in xs}
+    ys_spec = {"best": P(), "score": P(), "n_feasible": P()}
+
+    def body(statics, tables, carry, xs):
+        step = functools.partial(_step_fn, cfg, statics, tables)
+        return jax.lax.scan(step, carry, xs)
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(statics_spec, tables_spec, carry_spec, xs_spec),
+        out_specs=(carry_spec, ys_spec),
+        check_vma=False,
+    )(statics, tables, carry, xs)
+
+
+class ShardedPallasSession:
+    """Session API (schedule/decisions) over the two-phase sharded scan.
+
+    Construction derives every static from PallasSession's prologue (the
+    envelope gates — GCD int32 rescale bounds, <=8 constraints, <=128
+    topology values, f32-exact weights — apply identically), then splits
+    the node axis over the mesh. Raises PallasUnsupported exactly where
+    the pallas kernel would, plus reason="ipa-terms-mesh" for term
+    templates (those ride the GSPMD hoisted mesh session for now)."""
+
+    def __init__(self, cluster: Dict, template_arrays_list: List[Dict],
+                 weights: Optional[Dict[str, int]] = None,
+                 mesh: Optional[Mesh] = None):
+        assert mesh is not None, "ShardedPallasSession needs a mesh"
+        if len(mesh.devices.ravel()) < 1:
+            raise PallasUnsupported("empty mesh", reason="other")
+        inner = PallasSession(cluster, template_arrays_list, weights)
+        if inner.dyn_ipa:
+            raise PallasUnsupported(
+                "term templates ride the hoisted mesh session",
+                reason="ipa-terms")
+        self.mesh = mesh
+        self.weights = inner.weights
+        self._fps = inner._fps
+        self._tp_np = inner._tp_np
+        self.T, self.C, self.CP = inner.T, inner.C, inner.CP
+        self.R, self.SR, self.K = inner.R, inner.SR, inner.K
+        self.TCp = inner.TCp
+        nsh = len(mesh.devices.ravel())
+        Npl = _ceil(max(inner.Np // nsh, 1), LANE)
+        while Npl * nsh < inner.Np:
+            Npl += LANE
+        self.Npl, self.Nps = Npl, Npl * nsh
+        self._cfg = (
+            (self.T, self.C, self.CP, self.R, self.SR, self.K,
+             Npl, self.TCp),
+            tuple(sorted(self.weights.items())),
+        )
+
+        def padn(a, axis, fill=0):
+            a = np.asarray(a)
+            pad = self.Nps - a.shape[axis]
+            if pad == 0:
+                return a
+            widths = [(0, 0)] * a.ndim
+            widths[axis] = (0, pad)
+            return np.pad(a, widths, constant_values=fill)
+
+        T, SR, TCp = self.T, self.SR, self.TCp
+        statics = {
+            "alloc": padn(inner._alloc, 1),
+            # (T, SR, Nps): template-indexed static rows
+            "stat": padn(inner._stat[:T * SR], 1).reshape(T, SR, self.Nps),
+            "regrow_f": padn(inner._regrow_f, 1),
+            "zvalid_node_s": padn(inner._zvalid_node_s, 1),
+            "konn_f": padn(inner._konn_f, 1),
+            "konn_s": padn(inner._konn_s, 1),
+            "shasall": padn(inner._shasall[:T], 1),
+            "valid_n": padn(inner._valid_n[0:1], 1),
+            "prow_f": padn(inner._prow_f, 1, fill=-1),
+            "prow_s": padn(inner._prow_s, 1, fill=-1),
+            "onehot": padn(inner._onehot, 1),
+            # replicated but grouped here for the step's block() reads
+            "zvalid_s_rows": inner._zvalid_s,
+        }
+        tb = inner._sc_tables
+        CP = self.CP
+
+        def same_pad(a):  # [T, C, C] -> [T, CP, CP]
+            out = np.zeros((T, CP, CP), np.float32)
+            out[:, :self.C, :self.C] = a
+            return out
+
+        tables = {
+            "req": inner._req_s,
+            "req_check": inner._req_check_s,
+            "req_has_any": inner._req_has_any_s,
+            "nz_req": inner._nz_req_s,
+            "f_valid": tb["f_valid"].astype(np.int32),
+            "s_valid": tb["s_valid"].astype(np.int32),
+            "f_skew": tb["f_skew"].astype(np.int32),
+            "s_skew": tb["s_skew"].astype(np.int32),
+            "f_self_match": tb["f_self_match"].astype(np.int32),
+            "s_first": tb["s_first"].astype(np.int32),
+            "s_perno": inner._s_perno.astype(np.int32),
+            "s_keyid": inner._s_keyid,
+            "f_same": same_pad(tb["f_same_key"]),
+            "s_same": same_pad(tb["s_same_key"]),
+            "ipa_present": tb["ipa_present"].astype(np.int32),
+            "s_perno_rows": _perno_rows(inner._s_perno, T, self.C, CP),
+        }
+        # device placement: node-sharded statics split over the mesh,
+        # tables replicated — collectives then ride ICI, not DCN
+        self._statics = {}
+        for k, v in statics.items():
+            if k in _NODE_DIM:
+                nd = _NODE_DIM[k]
+                ndim = np.ndim(v)
+                spec = P(*([None] * nd + [NODE_AXIS]
+                           + [None] * (ndim - nd - 1)))
+            else:
+                spec = P()
+            self._statics[k] = jax.device_put(
+                jnp.asarray(v), NamedSharding(mesh, spec))
+        repl = NamedSharding(mesh, P())
+        self._tables = {k: jax.device_put(jnp.asarray(v), repl)
+                        for k, v in tables.items()}
+        shard = NamedSharding(mesh, P(None, NODE_AXIS))
+        self._carry = {
+            "requested": jax.device_put(
+                jnp.asarray(padn(inner._requested0, 1)), shard),
+            "nzpc": jax.device_put(
+                jnp.asarray(padn(inner._nzpc0, 1)), shard),
+            "cnt_fn": jax.device_put(
+                jnp.asarray(padn(inner._cnt_fn0, 1)), shard),
+            "cnt_sn": jax.device_put(
+                jnp.asarray(padn(inner._cnt_sn0, 1)), shard),
+        }
+
+    def schedule(self, pod_arrays_list: List[Dict]) -> Dict:
+        """Enqueue one batch (async); decisions(ys) blocks. KeyError on
+        an unregistered template — the backend rebuilds, same contract as
+        the other sessions."""
+        B = len(pod_arrays_list)
+        Bp, tmpl, mfa, msa = batch_prologue(
+            self._fps, self._tp_np, pod_arrays_list, minimum=64)
+        T, C, CP, TCp = self.T, self.C, self.CP, self.TCp
+        mfx = np.zeros((Bp, TCp), np.float32)
+        msx = np.zeros((Bp, TCp), np.float32)
+        for t in range(T):
+            mfx[:B, t * CP:t * CP + C] = mfa[t].reshape(B, C)
+            msx[:B, t * CP:t * CP + C] = msa[t].reshape(B, C)
+        xs = {
+            "tmpl": jnp.asarray(tmpl),
+            "valid": jnp.asarray(np.arange(Bp) < B),
+            "mf": jnp.asarray(mfx),
+            "ms": jnp.asarray(msx),
+        }
+        self._carry, ys = _sharded_scan(
+            self._cfg, self.mesh, self._statics, self._tables,
+            self._carry, xs)
+        return {"best": ys["best"], "score": ys["score"],
+                "n_feasible": ys["n_feasible"], "_b_real": B}
+
+    @staticmethod
+    def decisions(ys: Dict) -> List[int]:
+        best = np.asarray(ys["best"])
+        return [int(v) for v in best[: ys["_b_real"]]]
+
+
+def _perno_rows(s_perno: np.ndarray, T: int, C: int, CP: int) -> np.ndarray:
+    out = np.zeros(T * CP, np.float32)
+    for t in range(T):
+        out[t * CP:t * CP + C] = s_perno[t].astype(np.float32)
+    return out

@@ -598,12 +598,31 @@ class TPUBackend(CacheListener):
         templates = list(self._known_templates.values())
         cluster = self.enc.device_state()
         if self.mesh is not None:
-            # node-sharded session over the mesh (parallel/sharded.py
-            # ShardedScheduler.session semantics, inlined so the product
-            # session cache/invalidation applies unchanged)
+            # two-phase sharded session (ops/sharded_scan.py): the pallas
+            # session's exact math with node-sharded carries and ICI
+            # scalar collectives — the mesh path no longer pays the
+            # hoisted tax for term-free workloads (VERDICT r4 #2)
+            from ..ops.pallas_scan import PallasUnsupported
+            from ..ops.sharded_scan import ShardedPallasSession
+
+            try:
+                s = ShardedPallasSession(
+                    cluster, templates, self.weights, mesh=self.mesh)
+                session_builds.inc(kind="pallas", reason="mesh-sharded")
+                return s
+            except PallasUnsupported as e:
+                logger.warning(
+                    "sharded two-phase session unsupported for this "
+                    "workload shape (%s); mesh rides the GSPMD hoisted "
+                    "session", e,
+                )
+                # mesh- prefix: a mesh downgrade is a different (bigger)
+                # throughput cliff than a single-chip one — alerting must
+                # tell them apart; slugs stay bounded
+                session_builds.inc(kind="hoisted",
+                                   reason=f"mesh-{e.reason}")
             from ..parallel import sharded
 
-            session_builds.inc(kind="hoisted", reason="mesh")
             return HoistedSession(
                 sharded.shard_cluster(cluster, self.mesh),
                 templates, self.weights,

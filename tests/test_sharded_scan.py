@@ -1,0 +1,158 @@
+"""ShardedPallasSession (two-phase mesh scan) decision parity with the
+single-device PallasSession, on a virtual 8-device CPU mesh.
+
+The invariant: sharding the node axis must not change ONE decision —
+the global normalize min/max, the PTS min-match, zone presence, and the
+first-max argmax all reduce across shards exactly (VERDICT r4 #2;
+reference helper/normalize_score.go:24 is the global normalize a naive
+shard-local kernel would silently break).
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.ops.hoisted import HoistedSession, template_fingerprint
+from kubernetes_tpu.ops.pallas_scan import PallasSession, PallasUnsupported
+from kubernetes_tpu.ops.sharded_scan import ShardedPallasSession
+from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
+
+from .test_hoisted import _encode_all, _presized_encoding
+from .util import make_pod
+
+
+def _templates_of(arrays):
+    out, seen = [], set()
+    for a in arrays:
+        fp = template_fingerprint(a)
+        if fp not in seen:
+            seen.add(fp)
+            out.append(a)
+    return out
+
+
+def _mesh(n=8):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} virtual devices")
+    return Mesh(np.asarray(devs[:n]), ("nodes",))
+
+
+def _run_pair(nodes, init_pods, pending, batch, n_shards=8):
+    """(single-device pallas decisions, sharded decisions)."""
+    enc, pe = _presized_encoding(
+        copy.deepcopy(nodes), copy.deepcopy(init_pods),
+        copy.deepcopy(pending))
+    arrays = _encode_all(enc, pe, pending)
+    psess = PallasSession(enc.device_state(), _templates_of(arrays),
+                          interpret=True)
+    ref = []
+    for i in range(0, len(pending), batch):
+        b = arrays[i:i + batch]
+        ref.extend(PallasSession.decisions(psess.schedule(b))[:len(b)])
+
+    enc2, pe2 = _presized_encoding(nodes, init_pods, pending)
+    arrays2 = _encode_all(enc2, pe2, pending)
+    ssess = ShardedPallasSession(
+        enc2.device_state(), _templates_of(arrays2), mesh=_mesh(n_shards))
+    got = []
+    for i in range(0, len(pending), batch):
+        b = arrays2[i:i + batch]
+        got.extend(ShardedPallasSession.decisions(ssess.schedule(b))[:len(b)])
+    return ref, got
+
+
+class TestShardedParity:
+    def test_spread_multi_batch(self):
+        nodes, init_pods = synth_cluster(16, pods_per_node=2)
+        pending = synth_pending_pods(36, spread=True)
+        ref, got = _run_pair(nodes, init_pods, pending, batch=12)
+        assert got == ref
+        assert all(d >= 0 for d in got)
+
+    def test_no_constraints(self):
+        nodes, init_pods = synth_cluster(10, pods_per_node=1)
+        pending = synth_pending_pods(16, spread=False)
+        ref, got = _run_pair(nodes, init_pods, pending, batch=8)
+        assert got == ref
+
+    def test_capacity_exhaustion(self):
+        nodes, init_pods = synth_cluster(3, pods_per_node=0)
+        for node in nodes:
+            node.status.allocatable["cpu"] = "350m"
+            node.status.capacity["cpu"] = "350m"
+        pending = synth_pending_pods(15, spread=True)
+        ref, got = _run_pair(nodes, init_pods, pending, batch=5)
+        assert got == ref
+        assert -1 in got
+
+    def test_hostname_hard_spread(self):
+        nodes, init_pods = synth_cluster(6, pods_per_node=1)
+        pending = []
+        for i in range(10):
+            pending.append(make_pod(
+                f"hard-{i}", cpu="50m", labels={"app": "hard"},
+                constraints=[v1.TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=v1.LABEL_HOSTNAME,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=v1.LabelSelector(
+                        match_labels={"app": "hard"}),
+                )]))
+        ref, got = _run_pair(nodes, init_pods, pending, batch=5)
+        assert got == ref
+
+    def test_odd_shard_counts(self):
+        # node counts that do NOT divide the shard count: padding rows
+        # must stay infeasible on every shard
+        for n_nodes, shards in ((7, 4), (17, 8), (5, 2)):
+            nodes, init_pods = synth_cluster(n_nodes, pods_per_node=1)
+            pending = synth_pending_pods(12, spread=True)
+            ref, got = _run_pair(nodes, init_pods, pending,
+                                 batch=6, n_shards=shards)
+            assert got == ref, (n_nodes, shards)
+
+    def test_term_templates_fall_back(self):
+        nodes, init_pods = synth_cluster(6, pods_per_node=1)
+        pending = [
+            make_pod(
+                f"aff-{i}", cpu="50m", labels={"app": "aff"},
+                affinity=v1.Affinity(pod_anti_affinity=v1.PodAntiAffinity(
+                    required_during_scheduling_ignored_during_execution=[
+                        v1.PodAffinityTerm(
+                            label_selector=v1.LabelSelector(
+                                match_labels={"app": "aff"}),
+                            topology_key=v1.LABEL_HOSTNAME,
+                        )])))
+            for i in range(4)
+        ]
+        enc, pe = _presized_encoding(nodes, init_pods, pending)
+        arrays = _encode_all(enc, pe, pending)
+        with pytest.raises(PallasUnsupported) as ei:
+            ShardedPallasSession(
+                enc.device_state(), _templates_of(arrays), mesh=_mesh(2))
+        assert ei.value.reason == "ipa-terms"
+
+    def test_parity_vs_hoisted_session_too(self):
+        # transitively pinned already (pallas == hoisted), but one direct
+        # check keeps the chain visible
+        nodes, init_pods = synth_cluster(12, pods_per_node=2)
+        pending = synth_pending_pods(18, spread=True)
+        enc, pe = _presized_encoding(
+            copy.deepcopy(nodes), copy.deepcopy(init_pods),
+            copy.deepcopy(pending))
+        arrays = _encode_all(enc, pe, pending)
+        jsess = HoistedSession(enc.device_state(), _templates_of(arrays))
+        ref = HoistedSession.decisions(jsess.schedule(arrays))[:len(arrays)]
+        enc2, pe2 = _presized_encoding(nodes, init_pods, pending)
+        arrays2 = _encode_all(enc2, pe2, pending)
+        ssess = ShardedPallasSession(
+            enc2.device_state(), _templates_of(arrays2), mesh=_mesh(8))
+        got = ShardedPallasSession.decisions(
+            ssess.schedule(arrays2))[:len(arrays2)]
+        assert got == ref
