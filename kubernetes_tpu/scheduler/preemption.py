@@ -60,13 +60,20 @@ def _prio(pod: v1.Pod) -> int:
 def fast_eligible(pod: v1.Pod, snapshot, pdbs: Sequence, extenders: Sequence) -> bool:
     """True when the planner's envelope provably matches the oracle
     dry-run for this pod: every filter that victims could influence is
-    the resource-fit filter."""
-    if pdbs or extenders:
+    the resource-fit filter. PDBs are INSIDE the envelope (the planner
+    vectorizes filterPodsWithPDBViolation + the violating-first reprieve);
+    required anti-affinity bails per POD, not per cluster — an existing
+    pod's anti term can only block this preemptor (or change under victim
+    removal) if the term MATCHES the preemptor's labels+namespace
+    (filtering.go existing-anti check); unmatched anti pods elsewhere in
+    the cluster are irrelevant to this pod's dry-run."""
+    if extenders:
         return False
-    if snapshot.have_pods_with_required_anti_affinity_list:
-        # an existing pod's required anti-affinity term can block the
-        # preemptor; removing such a victim changes non-resource filters
-        return False
+    for ni in snapshot.have_pods_with_required_anti_affinity_list:
+        for existing in ni.pods_with_required_anti_affinity:
+            for term in existing.required_anti_affinity_terms:
+                if term.matches(pod):
+                    return False
     if pod.spec.preemption_policy == "Never":
         return False
     spec = pod.spec
@@ -103,10 +110,12 @@ class FastPreemptionPlanner:
 
     def __init__(self, snapshot, nominator, framework=None,
                  args: Optional[dict] = None,
-                 claimed_victims: Optional[Set[str]] = None):
+                 claimed_victims: Optional[Set[str]] = None,
+                 pdbs: Optional[Sequence[v1.PodDisruptionBudget]] = None):
         self.snapshot = snapshot
         self.nominator = nominator
         self.framework = framework
+        self.pdbs = list(pdbs or [])
         # victims claimed by earlier waves still dying in the cache:
         # treated as already-removed (their resources left the books the
         # moment they were claimed; the claimer's nominated load covers
@@ -209,9 +218,10 @@ class FastPreemptionPlanner:
                     if vp < p:
                         lo_sum[p][:, i] += vec
                         lo_cnt[p][i] += 1
-            # oracle reprieve order (:633): highest priority first, then
-            # earliest start_time
-            victims.sort(key=lambda t: (-t[0], t[1]))
+            # victims kept in ni.pods ORDER (filterPodsWithPDBViolation
+            # consumes PDB allowances in list order, :660); the reprieve
+            # order (highest priority, earliest start, :633) rides the
+            # _vsort permutation instead
             per_node.append(victims)
         self._lower_sum = lo_sum
         self._lower_cnt = lo_cnt
@@ -227,6 +237,21 @@ class FastPreemptionPlanner:
         self._vstart = np.zeros((N, max(Vmax, 1)), dtype=np.float64)
         self._valive = np.zeros((N, max(Vmax, 1)), dtype=bool)
         self._vpods: List[List[Optional[v1.Pod]]] = []
+        # PDB match tensor [N, Vmax, P]: does evicting victim (i, j)
+        # consume pdb p's budget (same namespace + selector match)?
+        P = len(self.pdbs)
+        self._pdb_match = np.zeros((N, max(Vmax, 1), max(P, 1)), dtype=bool)
+        self._pdb_allowed = np.zeros(max(P, 1), dtype=np.int64)
+        sels = []
+        if P:
+            from ..api.labels import Selector
+
+            for p_i, pdb in enumerate(self.pdbs):
+                self._pdb_allowed[p_i] = pdb.status.disruptions_allowed
+                sels.append(
+                    Selector.from_label_selector(pdb.spec.selector)
+                    if pdb.spec.selector else None
+                )
         for i, victims in enumerate(per_node):
             pods_row: List[Optional[v1.Pod]] = []
             for j, (vp, start, vec, vpod) in enumerate(victims):
@@ -235,7 +260,22 @@ class FastPreemptionPlanner:
                 self._vstart[i, j] = start
                 self._valive[i, j] = True
                 pods_row.append(vpod)
+                for p_i, pdb in enumerate(self.pdbs):
+                    if pdb.metadata.namespace != vpod.metadata.namespace:
+                        continue
+                    sel = sels[p_i]
+                    if sel is not None and sel.matches(
+                            vpod.metadata.labels):
+                        self._pdb_match[i, j, p_i] = True
             self._vpods.append(pods_row)
+        # reprieve permutation: order victims (highest priority, earliest
+        # start); padding rows sort last
+        skey = np.where(
+            self._valive, self._vprio, np.int64(-(2 ** 62))
+        )
+        self._vsort = np.lexsort(
+            (self._vstart, -skey), axis=1
+        )
         # seed nominated load (RunFilterPluginsWithNominatedPods adds
         # nominated pods with priority >= preemptor's, framework.go:610).
         # Running totals make the uniform-priority wave O(1) per pod —
@@ -372,44 +412,71 @@ class FastPreemptionPlanner:
         # the pod fits with nobody removed — excluded by fits_now above),
         # so the oracle's first-`limit`-candidates cut is just a slice
         C = idxs[:limit]
-        # -- vectorized reprieve (:633) over all candidates at once:
-        # victims sorted (highest priority, earliest start) are added
-        # back column-by-column while the preemptor still fits; nodes
-        # are independent, so per-node sequential semantics hold exactly
+        Csz = C.size
+        rows = np.arange(Csz)
+        # -- filterPodsWithPDBViolation (:660), vectorized per candidate:
+        # victims consume PDB allowances in ni.pods ORDER; a victim whose
+        # matched budget is already exhausted at its turn is "violating"
+        violating = np.zeros((Csz, self._vmax), dtype=bool)
+        if self.pdbs:
+            allowed_rem = np.repeat(
+                self._pdb_allowed[:, None], Csz, axis=1
+            )  # [P, C]
+            for o in range(self._vmax):
+                valid_o = self._valive[C, o] & (self._vprio[C, o] < prio)
+                m = self._pdb_match[C, o, :].T & valid_o[None, :]  # [P, C]
+                violating[:, o] = np.any(m & (allowed_rem <= 0), axis=0)
+                allowed_rem -= m & (allowed_rem > 0)
+        # -- vectorized reprieve (:633) over all candidates at once, in
+        # the oracle's order: the VIOLATING group first, then the rest,
+        # each (highest priority, earliest start) via the _vsort
+        # permutation; nodes are independent, so per-node sequential
+        # semantics hold exactly
         free = free_all[:, C] - nom_vec[:, C] - req[:, None]  # [D, C]
         slots = (
             self._max_pods[C] - cnt_all[C] - nom_cnt[C] - 1
         )  # remaining re-add slots [C]
-        n_vict = np.zeros(C.size, dtype=np.int64)
-        sum_prio = np.zeros(C.size, dtype=np.int64)
-        max_prio = np.full(C.size, np.iinfo(np.int64).min, dtype=np.int64)
-        victim_mask = np.zeros((C.size, self._vmax), dtype=bool)
-        for v in range(self._vmax):
-            valid = self._valive[C, v] & (self._vprio[C, v] < prio)
-            vec = self._vvec[C, v].T  # [D, C]
-            can = valid & (slots >= 1) & np.all(vec <= free, axis=0)
-            free = free - np.where(can, vec, 0)
-            slots = slots - can
-            vic = valid & ~can
-            victim_mask[:, v] = vic
-            n_vict += vic
-            vp = self._vprio[C, v]
-            sum_prio += np.where(vic, vp, 0)
-            max_prio = np.maximum(max_prio, np.where(vic, vp, np.iinfo(np.int64).min))
+        n_vict = np.zeros(Csz, dtype=np.int64)
+        n_pdbv = np.zeros(Csz, dtype=np.int64)
+        sum_prio = np.zeros(Csz, dtype=np.int64)
+        max_prio = np.full(Csz, np.iinfo(np.int64).min, dtype=np.int64)
+        victim_mask = np.zeros((Csz, self._vmax), dtype=bool)
+        for in_violating_group in (True, False):
+            for v in range(self._vmax):
+                j = self._vsort[C, v]  # per-candidate column [C]
+                valid = (
+                    self._valive[C, j]
+                    & (self._vprio[C, j] < prio)
+                    & (violating[rows, j] == in_violating_group)
+                )
+                vec = self._vvec[C, j].T  # [D, C]
+                can = valid & (slots >= 1) & np.all(vec <= free, axis=0)
+                free = free - np.where(can, vec, 0)
+                slots = slots - can
+                vic = valid & ~can
+                victim_mask[rows, j] |= vic
+                n_vict += vic
+                if in_violating_group:
+                    n_pdbv += vic
+                vp = self._vprio[C, j]
+                sum_prio += np.where(vic, vp, 0)
+                max_prio = np.maximum(
+                    max_prio, np.where(vic, vp, np.iinfo(np.int64).min))
         # latest start among each candidate's HIGHEST-priority victims
         hi_mask = victim_mask & (self._vprio[C] == max_prio[:, None])
         latest = np.max(
             np.where(hi_mask, self._vstart[C], -np.inf), axis=1
         )
         # -- pickOneNodeForPreemption (:457), vectorized with the same
-        # tie-break ladder as DefaultPreemption._pick_one (PDB violations
-        # are uniformly 0 inside the fast envelope); final tie -> first
-        # candidate in snapshot order
+        # tie-break ladder as DefaultPreemption._pick_one (fewest PDB
+        # violations first); final tie -> first candidate in snapshot
+        # order
         alive = n_vict > 0
         if not alive.any():
             return None
         best_mask = alive
         for crit, reverse in (
+            (n_pdbv, False),
             (max_prio, False), (sum_prio, False),
             (n_vict, False), (latest, True),
         ):
@@ -420,13 +487,13 @@ class FastPreemptionPlanner:
                 break
         ci = int(np.flatnonzero(best_mask)[0])
         i = int(C[ci])
-        victims = [
-            self._vpods[i][j]
-            for j in range(self._vmax)
-            if victim_mask[ci, j]
-        ]
+        victims = _ordered_victims(
+            self._vpods[i], victim_mask[ci], violating[ci],
+            self._vsort[i], self._vmax,
+        )
         best = Candidate(
-            self.nodes[i].node.metadata.name, victims, num_pdb_violations=0
+            self.nodes[i].node.metadata.name, victims,
+            num_pdb_violations=int(n_pdbv[ci]),
         )
         self._claim(best, pod, prio, req)
         return best
@@ -460,6 +527,19 @@ class FastPreemptionPlanner:
                 if vp < p:
                     self._lower_sum[p][:, i] -= vec
                     self._lower_cnt[p][i] -= 1
+
+
+def _ordered_victims(pods_row, victim_mask, violating_row, vsort, vmax):
+    """Victims in the oracle's append order: the violating group first,
+    then the rest, each in reprieve (priority desc, start asc) order —
+    Candidate.victims ordering is observable (eviction order)."""
+    out = []
+    for in_violating_group in (True, False):
+        for v in range(vmax):
+            j = int(vsort[v])
+            if victim_mask[j] and bool(violating_row[j]) == in_violating_group:
+                out.append(pods_row[j])
+    return out
 
 
 def _affinity_fingerprint(pod: v1.Pod):

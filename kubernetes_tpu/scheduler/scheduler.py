@@ -494,13 +494,23 @@ class Scheduler:
         if preemptable:
             self.snapshot = self.cache.update_snapshot(self.snapshot)
             pdbs = self._list_pdbs()
-            nominated_simple = all(
-                not _has_required_anti_affinity(p)
+            # a nominated pod's required anti-affinity only matters to a
+            # preemptor its terms MATCH (the nominated pod is ADDed in
+            # RunFilterPluginsWithNominatedPods) — collect the terms once,
+            # gate per pod
+            from .framework.types import PodInfo as _PI
+
+            nominated_anti_terms = [
+                t
                 for p in self.nominator.all_nominated_pods()
-            )
+                if _has_required_anti_affinity(p)
+                for t in _PI(p).required_anti_affinity_terms
+            ]
             fast: List = []
             for info in preemptable:
-                if nominated_simple and fast_preemption.fast_eligible(
+                if not any(
+                    t.matches(info.pod) for t in nominated_anti_terms
+                ) and fast_preemption.fast_eligible(
                     info.pod, self.snapshot, pdbs, self.extenders
                 ):
                     fast.append(info)
@@ -517,6 +527,7 @@ class Scheduler:
                     self.snapshot, self.nominator,
                     args=self._preemption_args(),
                     claimed_victims=claimed,
+                    pdbs=pdbs,
                 )
                 cands = planner.plan([i.pod for i in fast])
                 preempted: List[Tuple] = []
