@@ -560,9 +560,15 @@ class PersistentVolumeSpec:
     claim_ref_name: str = ""
     node_affinity: Optional[VolumeNodeAffinity] = None
     persistent_volume_reclaim_policy: str = ""
-    # volume source (PersistentVolumeSource, types.go): only the CSI
-    # member carries scheduling semantics here (driver -> attach limits)
+    # volume source (PersistentVolumeSource, types.go): the CSI member
+    # carries scheduling semantics (driver -> attach limits); the three
+    # in-tree cloud-disk members exist for CSI MIGRATION
+    # (csi-translation-lib) — the scheduler sees them only through
+    # volume/csi_translation.py's translated copies
     csi: Optional[Dict[str, str]] = None  # {driver, volumeHandle}
+    gce_persistent_disk: Optional[Dict[str, str]] = None  # {pdName, fsType}
+    aws_elastic_block_store: Optional[Dict[str, str]] = None  # {volumeID}
+    azure_disk: Optional[Dict[str, str]] = None  # {diskName}
 
 
 @dataclass

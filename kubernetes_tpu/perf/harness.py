@@ -61,7 +61,7 @@ class PodTemplate:
     # one pre-bound PVC+PV per measured pod (SchedulingInTreePVs /
     # SchedulingCSIPVs): "zonal" labels the PV with the pod-index zone;
     # "csi" additionally carries a CSI driver (attach-limit accounting)
-    with_pvc: str = ""  # "" | "zonal" | "csi"
+    with_pvc: str = ""  # "" | "zonal" | "csi" | "migrated"
 
     def build(self, name: str, namespace: str = "default") -> v1.Pod:
         constraints = []
@@ -219,6 +219,11 @@ class Workload:
     # then bound/window arithmetic, not machine speed; the honest
     # headline for such rows is attempts_per_sec
     saturating: bool = False
+    # PodDisruptionBudget over the init template's labels (the
+    # Preemption-with-PDBs workload: victims are PDB-covered, the
+    # planner's vectorized PDB partitioning is on the measured path);
+    # None disables, an int is status.disruptionsAllowed
+    pdb_disruptions_allowed: Optional[int] = None
 
 
 @dataclass
@@ -293,6 +298,8 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         api = RemoteAPIServer(http_srv.address)
     cs = Clientset(api)
     csi_mode = "csi" in (w.template.with_pvc, w.init_template.with_pvc)
+    migrated_mode = "migrated" in (
+        w.template.with_pvc, w.init_template.with_pvc)
     for i in range(w.num_nodes):
         cs.nodes.create(
             make_node(
@@ -305,15 +312,31 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
                 extended=w.node_extended,
             )
         )
-        if csi_mode:
+        if csi_mode or migrated_mode:
             from ..api.storage import CSINode, CSINodeDriver, CSINodeSpec
 
+            drivers = []
+            if csi_mode:
+                drivers.append(CSINodeDriver(name=CSI_PERF_DRIVER, count=64))
+            if migrated_mode:
+                # performance-config.yaml:107-114 csiNodeAllocatable for
+                # the migrated ebs driver
+                drivers.append(
+                    CSINodeDriver(name="ebs.csi.aws.com", count=39))
             cs.resource("csinodes").create(CSINode(
                 metadata=v1.ObjectMeta(name=f"node-{i}"),
-                spec=CSINodeSpec(drivers=[
-                    CSINodeDriver(name=CSI_PERF_DRIVER, count=64)
-                ]),
+                spec=CSINodeSpec(drivers=drivers),
             ))
+    if w.pdb_disruptions_allowed is not None:
+        cs.resource("poddisruptionbudgets").create(v1.PodDisruptionBudget(
+            metadata=v1.ObjectMeta(name="bench-pdb", namespace="default"),
+            spec=v1.PodDisruptionBudgetSpec(
+                selector=v1.LabelSelector(
+                    match_labels=dict(w.init_template.labels or {})),
+            ),
+            status=v1.PodDisruptionBudgetStatus(
+                disruptions_allowed=w.pdb_disruptions_allowed),
+        ))
     factory = SharedInformerFactory(cs)
     sched = Scheduler(cs, factory, backend=w.backend, max_batch=w.max_batch)
     if w.backend == "tpu":
@@ -393,7 +416,7 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
                     name=f"{prefix}pv-{i}",
                     labels=(
                         {v1.LABEL_ZONE: f"zone-{i % w.n_zones}"}
-                        if tmpl.with_pvc == "zonal" else {}
+                        if tmpl.with_pvc in ("zonal", "migrated") else {}
                     ),
                 ),
                 spec=v1.PersistentVolumeSpec(
@@ -402,6 +425,14 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
                     csi=(
                         {"driver": CSI_PERF_DRIVER, "volumeHandle": f"h-{i}"}
                         if tmpl.with_pvc == "csi" else None
+                    ),
+                    # SchedulingMigratedInTreePVs (performance-config.
+                    # yaml:99-135, pv-aws.yaml): an IN-TREE cloud-disk
+                    # source the csi-translation layer rewrites to its
+                    # CSI twin (ebs.csi.aws.com)
+                    aws_elastic_block_store=(
+                        {"volumeID": f"vol-{prefix}{i}"}
+                        if tmpl.with_pvc == "migrated" else None
                     ),
                 ),
                 status=v1.PersistentVolumeStatus(phase="Bound"),

@@ -255,7 +255,11 @@ class NodeVolumeLimits(fwk.PreFilterPlugin, fwk.FilterPlugin):
             pv = pvs.get(claim.spec.volume_name)
             if pv is None:
                 return None
-            csi = getattr(pv.spec, "csi", None)
+            # translation-aware (csi-translation-lib): a migrated
+            # in-tree PV counts against its CSI driver's limit
+            from ...volume.csi_translation import pv_csi_source
+
+            csi = pv_csi_source(pv)
             if isinstance(csi, dict):
                 return csi.get("driver", ""), csi.get("volumeHandle", pv.metadata.name)
             return None

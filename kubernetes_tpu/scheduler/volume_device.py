@@ -153,7 +153,12 @@ class VolumeDeviceResolver:
             (c.metadata.namespace, c.metadata.name): c
             for c in self._list_pvcs()
         }
-        pvs = {p.metadata.name: p for p in self._list_pvs()}
+        # CSI migration at index time (volume/csi_translation.py): an
+        # in-tree cloud-disk PV reaches everything downstream — driver
+        # attach scalars, zone terms, node affinity — as its CSI twin
+        from ..volume.csi_translation import translate_pv
+
+        pvs = {p.metadata.name: translate_pv(p) for p in self._list_pvs()}
         with self._lock:
             self._index_cache = (version, pvcs, pvs)
         return pvcs, pvs

@@ -1,0 +1,160 @@
+"""CSI migration (volume/csi_translation.py) — translation parity and
+the migrated-PV ride on the kernel volume path.
+
+Reference: staging/src/k8s.io/csi-translation-lib/translate.go:30 with
+plugins/{gce_pd,aws_ebs,azure_disk}.go; consumed like the scheduler's
+CSIMigration feature — the kernel resolver and the oracle
+NodeVolumeLimits plugin must see the SAME driver for a migrated PV.
+"""
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.volume.csi_translation import (
+    migratable_plugin,
+    pv_csi_source,
+    translate_pv,
+)
+
+
+def _pv(name="pv0", zone=None, **spec_kw):
+    labels = {v1.LABEL_ZONE: zone} if zone else {}
+    return v1.PersistentVolume(
+        metadata=v1.ObjectMeta(name=name, labels=labels),
+        spec=v1.PersistentVolumeSpec(
+            capacity={"storage": "1Gi"},
+            access_modes=["ReadWriteOnce"],
+            **spec_kw,
+        ),
+        status=v1.PersistentVolumeStatus(phase="Bound"),
+    )
+
+
+class TestTranslate:
+    def test_gce_pd_zonal_handle(self):
+        pv = _pv(zone="us-central1-a",
+                 gce_persistent_disk={"pdName": "disk-1"})
+        assert migratable_plugin(pv) == "gce_persistent_disk"
+        out = translate_pv(pv)
+        assert out.spec.gce_persistent_disk is None
+        assert out.spec.csi["driver"] == "pd.csi.storage.gke.io"
+        # gce_pd.go volIDZonalFmt
+        assert out.spec.csi["volumeHandle"] == \
+            "projects/UNSPECIFIED/zones/us-central1-a/disks/disk-1"
+        # zone label lifted into node affinity (translateTopology)
+        terms = out.spec.node_affinity.required.node_selector_terms
+        assert terms[0].match_expressions[0].key == v1.LABEL_ZONE
+        assert terms[0].match_expressions[0].values == ["us-central1-a"]
+        # the original is untouched (translation returns a copy)
+        assert pv.spec.gce_persistent_disk is not None
+        assert pv.spec.csi is None
+
+    def test_gce_pd_regional(self):
+        pv = _pv(zone="us-east1-b__us-east1-c",
+                 gce_persistent_disk={"pdName": "r-disk"})
+        out = translate_pv(pv)
+        assert out.spec.csi["volumeHandle"] == \
+            "projects/UNSPECIFIED/zones/us-east1/disks/r-disk"
+        vals = out.spec.node_affinity.required \
+            .node_selector_terms[0].match_expressions[0].values
+        assert vals == ["us-east1-b", "us-east1-c"]
+
+    def test_aws_and_azure(self):
+        ebs = _pv(aws_elastic_block_store={"volumeID": "vol-123"})
+        assert pv_csi_source(ebs) == {
+            "driver": "ebs.csi.aws.com", "volumeHandle": "vol-123"}
+        az = _pv(azure_disk={"diskName": "d1"})
+        assert pv_csi_source(az)["driver"] == "disk.csi.azure.com"
+
+    def test_native_csi_passthrough(self):
+        pv = _pv(csi={"driver": "x.example", "volumeHandle": "h"})
+        assert migratable_plugin(pv) is None
+        assert translate_pv(pv) is pv
+        assert pv_csi_source(pv) == {"driver": "x.example",
+                                     "volumeHandle": "h"}
+
+    def test_untranslatable_pv(self):
+        pv = _pv()
+        assert migratable_plugin(pv) is None
+        assert pv_csi_source(pv) is None
+
+    def test_existing_node_affinity_preserved(self):
+        na = v1.VolumeNodeAffinity(required=v1.NodeSelector(
+            node_selector_terms=[v1.NodeSelectorTerm(match_expressions=[
+                v1.NodeSelectorRequirement(
+                    key="disk", operator="In", values=["ssd"])
+            ])]
+        ))
+        pv = _pv(zone="z-a", gce_persistent_disk={"pdName": "d"})
+        pv.spec.node_affinity = na
+        out = translate_pv(pv)
+        # translateTopology must not clobber an explicit affinity
+        assert out.spec.node_affinity.required \
+            .node_selector_terms[0].match_expressions[0].key == "disk"
+
+    def test_serde_roundtrip(self):
+        from kubernetes_tpu.utils import serde
+
+        pv = _pv(zone="z-a", gce_persistent_disk={"pdName": "d"})
+        back = serde.from_dict(v1.PersistentVolume, serde.to_dict(pv))
+        assert back.spec.gce_persistent_disk == {"pdName": "d"}
+
+
+class TestMigratedOnKernelPath:
+    """A bound migrated PV resolves into the kernel envelope with the
+    translated driver's attach scalar + zone terms — exactly like a
+    native CSI PV."""
+
+    def _resolver(self, pvs, pvcs):
+        from kubernetes_tpu.scheduler.volume_device import (
+            VolumeDeviceResolver,
+        )
+
+        return VolumeDeviceResolver(
+            list_pvcs=lambda: pvcs, list_pvs=lambda: pvs,
+            list_csinodes=lambda: [],
+        )
+
+    def test_resolve_migrated(self):
+        pv = _pv(zone="zone-0",
+                 aws_elastic_block_store={"volumeID": "vol-9"})
+        pvc = v1.PersistentVolumeClaim(
+            metadata=v1.ObjectMeta(name="c0", namespace="default"),
+            spec=v1.PersistentVolumeClaimSpec(volume_name="pv0"),
+        )
+        pod = v1.Pod(
+            metadata=v1.ObjectMeta(name="p", namespace="default"),
+            spec=v1.PodSpec(
+                containers=[v1.Container(name="c")],
+                volumes=[v1.Volume(name="d", source={
+                    "persistentVolumeClaim": {"claimName": "c0"}})],
+            ),
+        )
+        res = self._resolver([pv], [pvc]).resolve(pod)
+        assert res is not None
+        assert res.extra_scalars == {
+            "attachable-volumes-csi-ebs.csi.aws.com": 1}
+        # zone label -> zone term group
+        assert any(
+            any(r.key == v1.LABEL_ZONE for r in (t.match_expressions or []))
+            for g in res.term_groups for t in g
+        )
+
+    def test_oracle_limits_see_migrated_driver(self):
+        from kubernetes_tpu.scheduler.plugins.volumes import (
+            NodeVolumeLimits,
+        )
+
+        pv = _pv(aws_elastic_block_store={"volumeID": "vol-9"})
+        pvc = v1.PersistentVolumeClaim(
+            metadata=v1.ObjectMeta(name="c0", namespace="default"),
+            spec=v1.PersistentVolumeClaimSpec(volume_name="pv0"),
+        )
+
+        class H:
+            volume_listers = (lambda: [pvc], lambda: [pv])
+            csi_node_lister = None
+
+        plug = NodeVolumeLimits(handle=H())
+        lookup = plug._pvc_to_driver()
+        assert lookup("default", "c0") == ("ebs.csi.aws.com", "vol-9")

@@ -48,3 +48,28 @@ def test_gang_workload_small(backend):
     r = run_workload(w)
     assert r.throughput_avg > 0
     assert r.num_bound == 16  # every gang bound, none parked at Permit
+
+
+def test_migrated_pvs_small():
+    """SchedulingMigratedInTreePVs at CI size: in-tree EBS PVs translate
+    to CSI and every pod binds through the harness."""
+    w = Workload(
+        "migrated-ci", num_nodes=8, num_pods=16,
+        template=PodTemplate(with_pvc="migrated"), timeout=180,
+    )
+    r = run_workload(w)
+    assert r.num_bound == 16
+
+
+def test_preemption_pdb_small():
+    """Preemption with PDB-covered victims at CI size: the planner's
+    PDB partitioning rides the live loop."""
+    w = Workload(
+        "preempt-pdb-ci", num_nodes=4, num_init_pods=16, num_pods=4,
+        init_template=PodTemplate(cpu="900m", memory="64Mi", priority=1,
+                                  labels={"app": "victim"}),
+        template=PodTemplate(cpu="900m", memory="64Mi", priority=100),
+        timeout=180, stall_stop=30.0, pdb_disruptions_allowed=16,
+    )
+    r = run_workload(w)
+    assert r.num_bound == 4
