@@ -144,9 +144,23 @@ class Kubectl:
         p.add_argument("resource")
         p.add_argument("name")
 
-        for verb in ("create", "apply"):
-            p = sub.add_parser(verb)
-            p.add_argument("-f", "--filename", required=True)
+        p = sub.add_parser("create")
+        # generator form (`create deployment NAME --image=X ...`,
+        # pkg/cmd/create/*) or manifest form (`create -f FILE`)
+        p.add_argument("kind", nargs="?")
+        p.add_argument("name", nargs="?")
+        p.add_argument("extra", nargs="*")  # secret's `generic` etc.
+        p.add_argument("-f", "--filename")
+        p.add_argument("--image", default="")
+        p.add_argument("--replicas", type=int, default=1)
+        p.add_argument("--from-literal", dest="from_literal",
+                       action="append", default=[])
+
+        p = sub.add_parser("apply")
+        p.add_argument("-f", "--filename", required=True)
+
+        p = sub.add_parser("diff")
+        p.add_argument("-f", "--filename", required=True)
 
         p = sub.add_parser("delete")
         p.add_argument("resource", nargs="?")
@@ -242,6 +256,24 @@ class Kubectl:
 
         sub.add_parser("api-resources")
 
+        p = sub.add_parser("expose")
+        p.add_argument("target")  # resource/name
+        p.add_argument("--port", type=int, required=True)
+        p.add_argument("--target-port", dest="target_port", type=int,
+                       default=0)
+        p.add_argument("--name", default="")
+        p.add_argument("--type", default="ClusterIP")
+        p.add_argument("--protocol", default="TCP")
+
+        p = sub.add_parser("autoscale")
+        p.add_argument("target")  # resource/name
+        p.add_argument("--min", dest="min_replicas", type=int, default=1)
+        p.add_argument("--max", dest="max_replicas", type=int,
+                       required=True)
+        p.add_argument("--cpu-percent", dest="cpu_percent", type=int,
+                       default=-1)
+        p.add_argument("--name", default="")
+
         p = sub.add_parser("auth")
         p.add_argument("subverb", choices=["can-i"])
         p.add_argument("verb_arg")
@@ -251,9 +283,10 @@ class Kubectl:
                        default=[])
 
         args = parser.parse_args(argv)
+        self._exit_code = 0  # diff sets 1 on found-differences
         try:
             getattr(self, f"cmd_{args.verb.replace('-', '_')}")(args)
-            return 0
+            return self._exit_code
         except APIError as e:
             self._print(f"Error: {e}")
             return 1
@@ -374,12 +407,120 @@ class Kubectl:
                     self._print(f"  {line}")
 
     def cmd_create(self, args) -> None:
+        if args.kind and not args.filename:
+            return self._create_generator(args)
+        if not args.filename:
+            raise APIError("create requires -f FILE or a generator "
+                           "(deployment|namespace|configmap|secret|"
+                           "serviceaccount)")
         for doc in self._load_manifests(args.filename):
             resource, obj = self._obj_from_dict(doc)
             if self._namespaced(resource) and not obj.metadata.namespace:
                 obj.metadata.namespace = args.namespace
             created = self.cs.resource(resource).create(obj)
             self._print(f"{resource}/{created.metadata.name} created")
+
+    def _create_generator(self, args) -> None:
+        """kubectl create SUBCOMMAND (pkg/cmd/create/create_{deployment,
+        namespace,configmap,secret,serviceaccount}.go): object generators
+        for the daily-driver kinds."""
+        kind = args.kind
+        # `create secret generic NAME`: the type rides in front of name
+        if kind == "secret":
+            if args.name != "generic" or not args.extra:
+                raise APIError("usage: create secret generic NAME "
+                               "[--from-literal k=v ...]")
+            name = args.extra[0]
+        else:
+            name = args.name
+        if not name:
+            raise APIError(f"create {kind} requires NAME")
+        literals = {}
+        for pair in args.from_literal:
+            k, sep, val = pair.partition("=")
+            if not sep:
+                raise APIError(f"--from-literal {pair!r} is not k=v")
+            literals[k] = val
+        ns = args.namespace
+        if kind in ("namespace", "ns"):
+            self.cs.resource("namespaces").create(
+                v1.Namespace(metadata=v1.ObjectMeta(name=name)))
+            self._print(f"namespace/{name} created")
+        elif kind in ("deployment", "deploy"):
+            if not args.image:
+                raise APIError("create deployment requires --image")
+            from ..api import apps
+
+            labels = {"app": name}
+            dep = apps.Deployment(
+                metadata=v1.ObjectMeta(name=name, namespace=ns,
+                                       labels=dict(labels)),
+                spec=apps.DeploymentSpec(
+                    replicas=args.replicas,
+                    selector=v1.LabelSelector(match_labels=dict(labels)),
+                    template=v1.PodTemplateSpec(
+                        metadata=v1.ObjectMeta(labels=dict(labels)),
+                        spec=v1.PodSpec(containers=[
+                            v1.Container(name=name, image=args.image)
+                        ]),
+                    ),
+                ),
+            )
+            self.cs.resource("deployments").create(dep)
+            self._print(f"deployment.apps/{name} created")
+        elif kind in ("configmap", "cm"):
+            self.cs.resource("configmaps").create(v1.ConfigMap(
+                metadata=v1.ObjectMeta(name=name, namespace=ns),
+                data=dict(literals) or None,
+            ))
+            self._print(f"configmap/{name} created")
+        elif kind == "secret":
+            import base64
+
+            self.cs.resource("secrets").create(v1.Secret(
+                metadata=v1.ObjectMeta(name=name, namespace=ns),
+                data={
+                    k: base64.b64encode(val.encode()).decode()
+                    for k, val in literals.items()
+                } or None,
+            ))
+            self._print(f"secret/{name} created")
+        elif kind in ("serviceaccount", "sa"):
+            from ..api.rbac import ServiceAccount
+
+            self.cs.resource("serviceaccounts").create(ServiceAccount(
+                metadata=v1.ObjectMeta(name=name, namespace=ns)))
+            self._print(f"serviceaccount/{name} created")
+        else:
+            raise APIError(f"unknown create generator {kind!r}")
+
+    def _apply_merged(self, resource: str, obj, namespace: str):
+        """(live_doc | None, merged_doc) for one manifest object — the
+        3-way apply computation, shared by apply and diff so what diff
+        shows is exactly what apply would write."""
+        if self._namespaced(resource) and not obj.metadata.namespace:
+            obj.metadata.namespace = namespace
+        client = self.cs.resource(resource)
+        ns = obj.metadata.namespace if self._namespaced(resource) else ""
+        new_doc = serde.to_dict(obj)
+        try:
+            live = client.get(obj.metadata.name, ns)
+        except NotFound:
+            return None, new_doc
+        live_doc = serde.to_dict(live)
+        prev = json.loads(
+            (live.metadata.annotations or {}).get(LAST_APPLIED, "{}")
+        )
+        merged = _three_way_merge(prev, live_doc, new_doc)
+        merged.setdefault("metadata", {}).setdefault("annotations", {})[
+            LAST_APPLIED
+        ] = json.dumps(new_doc)
+        # preserve server-populated identity/concurrency fields
+        merged["metadata"]["resourceVersion"] = live_doc["metadata"].get(
+            "resourceVersion"
+        )
+        merged["metadata"]["uid"] = live_doc["metadata"].get("uid")
+        return live_doc, merged
 
     def cmd_apply(self, args) -> None:
         """3-way merge apply (reference: kubectl apply,
@@ -388,35 +529,57 @@ class Kubectl:
         semantics: lists replace wholesale)."""
         for doc in self._load_manifests(args.filename):
             resource, obj = self._obj_from_dict(doc)
-            if self._namespaced(resource) and not obj.metadata.namespace:
-                obj.metadata.namespace = args.namespace
+            live_doc, merged = self._apply_merged(
+                resource, obj, args.namespace)
             client = self.cs.resource(resource)
-            ns = obj.metadata.namespace if self._namespaced(resource) else ""
-            new_doc = serde.to_dict(obj)
-            try:
-                live = client.get(obj.metadata.name, ns)
-            except NotFound:
+            if live_doc is None:
                 obj.metadata.annotations = dict(obj.metadata.annotations or {})
-                obj.metadata.annotations[LAST_APPLIED] = json.dumps(new_doc)
+                obj.metadata.annotations[LAST_APPLIED] = json.dumps(merged)
                 client.create(obj)
                 self._print(f"{resource}/{obj.metadata.name} created")
                 continue
-            live_doc = serde.to_dict(live)
-            prev = json.loads(
-                (live.metadata.annotations or {}).get(LAST_APPLIED, "{}")
-            )
-            merged = _three_way_merge(prev, live_doc, new_doc)
-            merged.setdefault("metadata", {}).setdefault("annotations", {})[
-                LAST_APPLIED
-            ] = json.dumps(new_doc)
-            # preserve server-populated identity/concurrency fields
-            merged["metadata"]["resourceVersion"] = live_doc["metadata"].get(
-                "resourceVersion"
-            )
-            merged["metadata"]["uid"] = live_doc["metadata"].get("uid")
             info = self.cs.api._info(resource)
             client.update(serde.from_dict(info.type, merged))
             self._print(f"{resource}/{obj.metadata.name} configured")
+
+    def cmd_diff(self, args) -> None:
+        """kubectl diff (pkg/cmd/diff/diff.go:39): unified diff between
+        the live objects and what apply would produce; exit code 1 when
+        any difference is found (the reference's convention)."""
+        import difflib
+
+        for doc in self._load_manifests(args.filename):
+            resource, obj = self._obj_from_dict(doc)
+            live_doc, merged = self._apply_merged(
+                resource, obj, args.namespace)
+            name = f"{resource}/{obj.metadata.name}"
+
+            def clean(d):
+                if d is None:
+                    return []
+                d = dict(d)
+                meta = dict(d.get("metadata") or {})
+                # volatile server fields are not semantic differences
+                for k in ("resourceVersion", "uid", "creationTimestamp",
+                          "generation"):
+                    meta.pop(k, None)
+                ann = dict(meta.get("annotations") or {})
+                ann.pop(LAST_APPLIED, None)
+                if ann:
+                    meta["annotations"] = ann
+                else:
+                    meta.pop("annotations", None)
+                d["metadata"] = meta
+                return json.dumps(d, indent=2, sort_keys=True) \
+                    .splitlines(keepends=True)
+            lines = list(difflib.unified_diff(
+                clean(live_doc), clean(merged),
+                fromfile=f"LIVE/{name}", tofile=f"MERGED/{name}",
+            ))
+            if lines:
+                self._exit_code = 1
+                for ln in lines:
+                    self._print(ln.rstrip("\n"))
 
     def cmd_delete(self, args) -> None:
         if args.filename:
@@ -872,6 +1035,73 @@ class Kubectl:
             self._print(
                 "   ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
             )
+
+    def cmd_expose(self, args) -> None:
+        """kubectl expose (pkg/cmd/expose/exposer... generate.go): create
+        a Service whose selector is the target's pod labels."""
+        resource, name = args.target.split("/", 1)
+        resource = self._resource(resource)
+        ns = args.namespace
+        obj = self._client(resource).get(
+            name, ns if self._namespaced(resource) else "")
+        if resource == "services":
+            raise APIError("cannot expose a service")
+        if resource == "pods":
+            selector = dict(obj.metadata.labels or {})
+        else:  # deployments / replicasets / replicationcontrollers
+            sel = getattr(obj.spec, "selector", None)
+            if sel is not None and getattr(sel, "match_labels", None):
+                selector = dict(sel.match_labels)
+            elif isinstance(sel, dict):
+                selector = dict(sel)
+            else:
+                tmpl = getattr(obj.spec, "template", None)
+                selector = dict(
+                    (tmpl.metadata.labels or {}) if tmpl else {})
+        if not selector:
+            raise APIError(
+                f"couldn't find a selector to expose {args.target}")
+        svc = v1.Service(
+            metadata=v1.ObjectMeta(name=args.name or name, namespace=ns),
+            spec=v1.ServiceSpec(
+                selector=selector,
+                type=args.type,
+                ports=[v1.ServicePort(
+                    protocol=args.protocol, port=args.port,
+                    target_port=args.target_port or args.port,
+                )],
+            ),
+        )
+        self.cs.resource("services").create(svc)
+        self._print(f"service/{svc.metadata.name} exposed")
+
+    def cmd_autoscale(self, args) -> None:
+        """kubectl autoscale (pkg/cmd/autoscale/autoscale.go): create a
+        HorizontalPodAutoscaler targeting the workload."""
+        from ..api import autoscaling
+
+        resource, name = args.target.split("/", 1)
+        resource = self._resource(resource)
+        obj = self._client(resource).get(name, args.namespace)
+        hpa = autoscaling.HorizontalPodAutoscaler(
+            metadata=v1.ObjectMeta(
+                name=args.name or name, namespace=args.namespace),
+            spec=autoscaling.HorizontalPodAutoscalerSpec(
+                scale_target_ref=autoscaling.CrossVersionObjectReference(
+                    kind=getattr(obj, "kind", "") or "Deployment",
+                    name=name,
+                    api_version=getattr(obj, "api_version", ""),
+                ),
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                target_cpu_utilization_percentage=(
+                    args.cpu_percent if args.cpu_percent >= 0 else None),
+            ),
+        )
+        self.cs.resource("horizontalpodautoscalers").create(hpa)
+        self._print(
+            f"horizontalpodautoscaler.autoscaling/{hpa.metadata.name} "
+            "autoscaled")
 
     def cmd_auth(self, args) -> None:
         """kubectl auth can-i (pkg/cmd/auth/cani.go): evaluate RBAC for

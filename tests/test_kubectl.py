@@ -643,3 +643,118 @@ class TestAuthCanIImpersonationGate:
         out = io.StringIO()
         k = Kubectl(cs, out=out)
         assert k.run(["auth", "can-i", "list", "pods", "--as", "alice"]) == 0
+
+
+class TestDiffExposeAutoscaleCreate:
+    """Round-5 daily-driver tail: diff, expose, autoscale, create
+    generators (pkg/cmd/{diff,expose,autoscale,create})."""
+
+    def test_diff_reports_changes_and_exit_code(self, kubectl, tmp_path):
+        k, cs, out = kubectl
+        manifest = tmp_path / "cm.yaml"
+        manifest.write_text(yaml.safe_dump({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "cfg", "namespace": "default"},
+            "data": {"k": "v1"},
+        }))
+        # new object: everything is a difference, exit 1
+        assert k.run(["diff", "-f", str(manifest)]) == 1
+        assert "MERGED/configmaps/cfg" in out.getvalue()
+        # apply it, then diff again: no differences, exit 0
+        assert k.run(["apply", "-f", str(manifest)]) == 0
+        out2 = io.StringIO()
+        k2 = Kubectl(cs, out=out2)
+        assert k2.run(["diff", "-f", str(manifest)]) == 0
+        assert out2.getvalue() == ""
+        # change a value: diff shows it without writing
+        manifest.write_text(yaml.safe_dump({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "cfg", "namespace": "default"},
+            "data": {"k": "v2"},
+        }))
+        out3 = io.StringIO()
+        k3 = Kubectl(cs, out=out3)
+        assert k3.run(["diff", "-f", str(manifest)]) == 1
+        assert '+    "k": "v2"' in out3.getvalue()
+        assert cs.resource("configmaps").get("cfg", "default") \
+            .data == {"k": "v1"}  # diff never writes
+
+    def test_expose_deployment(self, kubectl):
+        k, cs, out = kubectl
+        dep = apps.Deployment(
+            metadata=v1.ObjectMeta(name="web", namespace="default"),
+            spec=apps.DeploymentSpec(
+                replicas=2,
+                selector=v1.LabelSelector(match_labels={"app": "web"}),
+                template=v1.PodTemplateSpec(
+                    metadata=v1.ObjectMeta(labels={"app": "web"}),
+                    spec=v1.PodSpec(containers=[
+                        v1.Container(name="c", image="img")]),
+                ),
+            ),
+        )
+        cs.resource("deployments").create(dep)
+        assert k.run(["expose", "deployment/web", "--port", "80",
+                      "--target-port", "8080"]) == 0
+        svc = cs.resource("services").get("web", "default")
+        assert svc.spec.selector == {"app": "web"}
+        assert svc.spec.ports[0].port == 80
+        assert svc.spec.ports[0].target_port == 8080
+
+    def test_expose_pod_by_labels(self, kubectl):
+        k, cs, out = kubectl
+        cs.pods.create(make_pod("p1", labels={"run": "p1"}))
+        assert k.run(["expose", "pod/p1", "--port", "9090",
+                      "--name", "p1-svc"]) == 0
+        svc = cs.resource("services").get("p1-svc", "default")
+        assert svc.spec.selector == {"run": "p1"}
+        assert svc.spec.ports[0].target_port == 9090
+
+    def test_autoscale(self, kubectl):
+        k, cs, out = kubectl
+        dep = apps.Deployment(
+            metadata=v1.ObjectMeta(name="web", namespace="default"),
+            spec=apps.DeploymentSpec(replicas=1),
+        )
+        cs.resource("deployments").create(dep)
+        assert k.run(["autoscale", "deployment/web", "--min", "2",
+                      "--max", "5", "--cpu-percent", "70"]) == 0
+        hpa = cs.resource("horizontalpodautoscalers").get("web", "default")
+        assert hpa.spec.min_replicas == 2
+        assert hpa.spec.max_replicas == 5
+        assert hpa.spec.target_cpu_utilization_percentage == 70
+        assert hpa.spec.scale_target_ref.name == "web"
+
+    def test_create_generators(self, kubectl):
+        k, cs, out = kubectl
+        assert k.run(["create", "namespace", "prod"]) == 0
+        assert cs.resource("namespaces").get("prod")
+        assert k.run(["create", "deployment", "api",
+                      "--image", "reg/app:v2", "--replicas", "3"]) == 0
+        dep = cs.resource("deployments").get("api", "default")
+        assert dep.spec.replicas == 3
+        assert dep.spec.template.spec.containers[0].image == "reg/app:v2"
+        assert dep.spec.selector.match_labels == {"app": "api"}
+        assert k.run(["create", "configmap", "cfg",
+                      "--from-literal", "a=1",
+                      "--from-literal", "b=2"]) == 0
+        assert cs.resource("configmaps").get("cfg", "default").data == {
+            "a": "1", "b": "2"}
+        assert k.run(["create", "secret", "generic", "tok",
+                      "--from-literal", "t=s3cr3t"]) == 0
+        import base64
+
+        sec = cs.resource("secrets").get("tok", "default")
+        assert base64.b64decode(sec.data["t"]).decode() == "s3cr3t"
+        assert k.run(["create", "serviceaccount", "robot"]) == 0
+        assert cs.resource("serviceaccounts").get("robot", "default")
+
+    def test_create_manifest_still_works(self, kubectl, tmp_path):
+        k, cs, out = kubectl
+        manifest = tmp_path / "ns.yaml"
+        manifest.write_text(yaml.safe_dump({
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "x"},
+        }))
+        assert k.run(["create", "-f", str(manifest)]) == 0
+        assert cs.resource("namespaces").get("x")
