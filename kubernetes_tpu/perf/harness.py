@@ -234,7 +234,14 @@ class Result:
     num_pods: int
     duration_s: float
     throughput_avg: float  # pods/s over the measured phase
-    throughput_p50: float  # of 1s samples
+    # percentiles of the 1s bind-rate samples, over the BINDING PHASE
+    # (first bind .. last bind): workloads with non-binding phases by
+    # design — preemption's plan/evict lead-in, churn's unschedulable
+    # retry tail — would otherwise report the phase mix (p50 = 0 from
+    # zero-bind seconds outside the binding phase), which says nothing
+    # about binding cadence. throughput_avg stays over the FULL window
+    # (conservative: it charges those phases).
+    throughput_p50: float
     throughput_p90: float
     throughput_p99: float
     attempts: int = 0
@@ -526,6 +533,7 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         stall_since = t0
         deadline = t0 + w.timeout
         last_att = 0
+        bind_seconds: List[bool] = []  # sample had >=1 bind
         while time.perf_counter() < deadline:
             time.sleep(1.0)
             bound = bound_count() - bound0
@@ -533,6 +541,7 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             now = time.perf_counter()
             samples.append((bound - last_bound) / (now - last_t))
             sample_times.append(now)
+            bind_seconds.append(bound != last_bound)
             # the stall clock runs only while the scheduler is live but
             # not progressing: ATTEMPTS reset it too (a preemption wave
             # records failures long before its first bind), and nothing
@@ -552,9 +561,16 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             # duration and the all-zero samples it contributed (filter by
             # sample timestamp: loop iterations drift past 1s under load)
             dt = stall_since - t0
-            samples = [
-                s for s, ts in zip(samples, sample_times) if ts <= stall_since
-            ] or samples[:1]
+            keep = [ts <= stall_since for ts in sample_times]
+            samples = [s for s, k in zip(samples, keep) if k] or samples[:1]
+            bind_seconds = [b for b, k in zip(bind_seconds, keep) if k] \
+                or bind_seconds[:1]
+        # percentile series scoped to the binding phase (see the Result
+        # field comment): first-bind .. last-bind sample, inclusive
+        if any(bind_seconds):
+            lo = bind_seconds.index(True)
+            hi = len(bind_seconds) - 1 - bind_seconds[::-1].index(True)
+            samples = samples[lo:hi + 1]
         pods, _ = cs.pods.list(namespace="default")
         # count bound MEASURED pods by name: preemption workloads evict
         # init pods, so "total bound minus num_init" would undercount

@@ -160,6 +160,12 @@ class SchedulerCache:
         with self._lock:
             return bool(self._assumed_pods.get(v1.pod_key(pod)))
 
+    def has_pod(self, key: str) -> bool:
+        """Membership test by key — O(1), for callers (the Coscheduling
+        prune) that would otherwise list_pods() + set-build per check."""
+        with self._lock:
+            return key in self._pod_states
+
     def min_pod_priority(self) -> int:
         """Lowest spec.priority among cached pods (0 when empty). A
         preemption dry-run can only evict strictly-lower-priority victims

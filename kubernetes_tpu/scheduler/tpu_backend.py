@@ -188,6 +188,14 @@ class TPUBackend(CacheListener):
         # echo (cache confirming a pod the torn-down session scheduled)
         # is host-bookkeeping either way and must not tear down the NEXT
         # session too
+        import os as _os
+
+        if self._session is not None and _os.environ.get(
+                "KTPU_DEBUG_INVALIDATE"):
+            import traceback as _tb
+
+            print("SESSION INVALIDATED BY:", file=__import__("sys").stderr)
+            _tb.print_stack(limit=8)
         self._session = None
 
     # -- CacheListener (called under the cache lock) -----------------------

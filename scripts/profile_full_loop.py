@@ -96,12 +96,35 @@ t = threading.Thread(target=sampler, daemon=True)
 t.start()
 
 WIRE = os.environ.get("PROFILE_WIRE", "0") == "1"
-w = Workload(
-    f"profile-{N}n-{P}p", num_nodes=N, num_init_pods=min(2048, P),
-    num_pods=P, init_template=PodTemplate(spread_zone=True),
-    template=PodTemplate(spread_zone=True), max_batch=B, timeout=600.0,
-    wire=WIRE,
-)
+GANG = int(os.environ.get("PROFILE_GANG", "0"))  # gang size; 0 = spread
+CHURN = os.environ.get("PROFILE_CHURN", "0") == "1"
+if CHURN:
+    w = Workload(
+        f"profile-churn-{N}n-{P}p", num_nodes=N, num_init_pods=1000,
+        num_pods=P,
+        init_template=PodTemplate(spread_zone=True),
+        template=PodTemplate(spread_zone=True),
+        second_template=PodTemplate(cpu="8", memory="64Gi"),
+        second_every=3,
+        max_batch=B, timeout=600.0, stall_stop=15.0, saturating=True,
+        wire=WIRE,
+    )
+elif GANG:
+    w = Workload(
+        f"profile-gang-{N}n-{P}p", num_nodes=N, num_init_pods=min(2048, P),
+        num_pods=P, gang_size=GANG,
+        init_template=PodTemplate(extended={"example.com/gpu": "1"}),
+        template=PodTemplate(extended={"example.com/gpu": "1"}),
+        node_extended={"example.com/gpu": "8"},
+        max_batch=B, timeout=600.0, wire=WIRE,
+    )
+else:
+    w = Workload(
+        f"profile-{N}n-{P}p", num_nodes=N, num_init_pods=min(2048, P),
+        num_pods=P, init_template=PodTemplate(spread_zone=True),
+        template=PodTemplate(spread_zone=True), max_batch=B, timeout=600.0,
+        wire=WIRE,
+    )
 t0 = time.perf_counter()
 r = harness.run_workload(w)
 sampling.clear()
