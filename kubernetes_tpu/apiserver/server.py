@@ -139,6 +139,13 @@ class TypedWatch:
         self._raw = raw
         self._typ = typ
 
+    def raw_events(self) -> kv.Watch:
+        """The underlying store watch (raw dict values). The HTTP wire
+        streams these directly: hydrating to typed objects and
+        re-serializing per watcher was pure per-event overhead on the
+        watch fan-out path."""
+        return self._raw
+
     def stop(self) -> None:
         self._raw.stop()
 
