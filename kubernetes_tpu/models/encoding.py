@@ -847,17 +847,23 @@ class ClusterEncoding:
         dev.update(updated)
 
 
-def _fingerprint(pod: v1.Pod) -> str:
-    """Spec-equivalence cache key: everything the kernel inputs depend on."""
+def _fingerprint(pod: v1.Pod, strip_volumes: bool = False) -> str:
+    """Spec-equivalence cache key: everything the kernel inputs depend
+    on. strip_volumes: the caller replaces the volumes section with a
+    resolved-constraint signature (PodEncoder.encode) — kernel inputs
+    depend on volumes only through that resolution."""
     ctrl = None
     for ref in pod.metadata.owner_references or []:
         if ref.controller:
             ctrl = (ref.kind, ref.uid)
             break
+    spec = serde.to_dict(pod.spec)
+    if strip_volumes:
+        spec.pop("volumes", None)
     body = {
         "ns": pod.metadata.namespace,
         "labels": pod.metadata.labels,
         "ctrl": ctrl,
-        "spec": serde.to_dict(pod.spec),
+        "spec": spec,
     }
     return json.dumps(body, sort_keys=True, default=str)

@@ -14,11 +14,14 @@ a dict lookup.
 
 from __future__ import annotations
 
+import json
+
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..api import types as v1
+from ..utils import serde
 from ..api.labels import Selector
 from ..api.taints import (
     TAINT_EFFECT_NO_EXECUTE,
@@ -78,7 +81,28 @@ class PodEncoder:
         self.volume_resolver = None
 
     def encode(self, pod: v1.Pod) -> dict:
-        fp = _fingerprint(pod)
+        # PVC-bearing pods: the kernel inputs depend on volumes ONLY
+        # through the RESOLUTION (term groups + attach scalars), so the
+        # cache key embeds that and drops the volumes section — 5000
+        # PV pods with 5000 distinct claim names in the same zone share
+        # ONE encode instead of missing per pod (the per-pod ~2ms
+        # re-encode was SchedulingInTreePVs-5000n's dominant host cost)
+        vol = None
+        vol_sig = None
+        if self.volume_resolver is not None and any(
+            (v.source or {}).get("persistentVolumeClaim")
+            for v in pod.spec.volumes or []
+        ):
+            vol = self.volume_resolver.resolve(pod)
+            if vol is not None:
+                vol_sig = json.dumps(
+                    [[serde.to_dict(t) for t in g] for g in vol.term_groups],
+                    sort_keys=True, default=str,
+                ) + "|" + json.dumps(sorted(vol.extra_scalars.items()))
+        fp = (
+            _fingerprint(pod, strip_volumes=True) + "#V" + vol_sig
+            if vol_sig is not None else _fingerprint(pod)
+        )
         cached = self._cache.get(fp)
         if (
             cached is not None
@@ -89,7 +113,7 @@ class PodEncoder:
             # node-name index depends on current node table, not the spec
             out["node_name_idx"], out["has_node_name"] = self._node_name(pod)
             return out
-        arrays = self._encode(pod)
+        arrays = self._encode(pod, vol=vol, have_vol=vol_sig is not None)
         arrays["_caps"] = self._caps_signature()
         self._cache[fp] = arrays
         out = dict(arrays)
@@ -117,7 +141,7 @@ class PodEncoder:
 
     # ------------------------------------------------------------------
 
-    def _encode(self, pod: v1.Pod) -> dict:
+    def _encode(self, pod: v1.Pod, vol=None, have_vol: bool = False) -> dict:
         enc = self.enc
         enc._intern_pod_vocabs(pod)
         pod_info = PodInfo(pod)
@@ -126,15 +150,17 @@ class PodEncoder:
         # volume device path: resolve bound-PVC constraints FIRST so the
         # attach-limit scalar names intern before the resource width is
         # captured (a new driver widens the resource rows; device_state's
-        # _caps_grew rebuild aligns the cluster side)
-        vol = None
+        # _caps_grew rebuild aligns the cluster side). encode() may have
+        # resolved already (have_vol) — the resolution is part of its
+        # cache key.
         out["_volver"] = None
         if self.volume_resolver is not None and any(
             (v.source or {}).get("persistentVolumeClaim")
             for v in pod.spec.volumes or []
         ):
             out["_volver"] = self._vol_version()
-            vol = self.volume_resolver.resolve(pod)
+            if not have_vol:
+                vol = self.volume_resolver.resolve(pod)
             if vol is None and not pod.spec.node_name:
                 # the scheduler gated this pod kernel-safe, but the
                 # resolution changed before encode (a PVC/assume event

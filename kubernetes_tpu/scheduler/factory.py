@@ -127,6 +127,7 @@ def create_scheduler(
         list_pvs=pv_inf.list,
         list_storage_classes=sc_inf.list,
         client=clientset,
+        get_pvc=pvc_inf.get,
     )
     framework = Framework(
         registry or new_in_tree_registry(),

@@ -237,6 +237,7 @@ class Scheduler:
                 list_pvs=pv_inf.list,
                 list_storage_classes=sc_inf.list,
                 client=self.client,
+                get_pvc=pvc_inf.get,
             ),
             "volume_listers": (pvc_inf.list, pv_inf.list),
             "csi_node_lister": csi_inf.list,

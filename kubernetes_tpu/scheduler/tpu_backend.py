@@ -641,6 +641,14 @@ class TPUBackend(CacheListener):
             try:
                 s = PallasSession(cluster, templates, self.weights)
                 session_builds.inc(kind="pallas", reason="")
+                # AOT-warm the ragged-tail batch buckets OFF the serving
+                # path: a daemon thread populates the (persistent)
+                # compile caches so a mid-window first-tail batch never
+                # pays a fresh Mosaic compile
+                threading.Thread(
+                    target=s.warm_buckets, name="pallas-bucket-warm",
+                    daemon=True,
+                ).start()
                 return s
             except PallasUnsupported as e:
                 logger.warning(

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..api import types as v1
 from ..api.labels import match_node_selector_terms, node_fields
@@ -139,12 +139,17 @@ class SchedulerVolumeBinder:
         list_storage_classes: Callable[[], List[StorageClass]],
         client=None,
         bind_timeout: float = 10.0,
+        get_pvc: Optional[Callable[[str], Any]] = None,
     ):
         self._list_pvcs = list_pvcs
         self._list_pvs = list_pvs
         self._list_classes = list_storage_classes
         self._client = client
         self._bind_timeout = bind_timeout
+        # keyed 'namespace/name' lookup (the informer store's own get):
+        # a full list scan per lookup ran at Reserve AND PreBind per pod
+        # — O(pods x PVCs) made the 5000-node PV workload binder-bound
+        self._keyed_get_pvc = get_pvc
         self._lock = threading.Lock()
         # pv name -> (claim namespace, claim name) optimistic reservations
         self._assumed: Dict[str, Tuple[str, str]] = {}
@@ -152,6 +157,10 @@ class SchedulerVolumeBinder:
     # -- lookups -----------------------------------------------------------
 
     def _get_pvc(self, namespace: str, name: str) -> Optional[v1.PersistentVolumeClaim]:
+        if self._keyed_get_pvc is not None:
+            return self._keyed_get_pvc(
+                f"{namespace}/{name}" if namespace else name
+            )
         for c in self._list_pvcs():
             if c.metadata.namespace == namespace and c.metadata.name == name:
                 return c

@@ -237,8 +237,10 @@ def main() -> None:
             import dataclasses
 
             w = dataclasses.replace(w, wire=True)
-        reps = max(1, reps_default // 2) if w.num_nodes >= 5000 \
-            else reps_default
+        # heavy (>=5000-node) configs used to halve the reps; VERDICT r4
+        # weak #2: never below 3 — a single sample is not a measurement
+        reps = max(min(3, reps_default), reps_default // 2) \
+            if w.num_nodes >= 5000 else reps_default
         print(f"=== {w.name}: {w.num_nodes} nodes, {w.num_pods} pods "
               f"(batch {w.max_batch}, reps {reps}, wire {wire}) on "
               f"{jax.devices()[0].platform}",

