@@ -36,9 +36,10 @@ pinned by tests/test_sharded_scan.py over fuzzed clusters on a virtual
 Statics and envelope come from PallasSession's own prologue (the GCD
 int32 rescale, per-template static rows, compact topology vocab): a shape
 the pallas kernel rejects is rejected here with the same PallasUnsupported
-reasons. Templates with affinity TERMS currently ride the GSPMD hoisted
-mesh session instead (reason="ipa-terms-mesh") — the D1-D5 ucnt/kcnt
-machinery is node-sharded too but its collectives are not yet wired.
+reasons. Templates with affinity TERMS ride the sharded session too: the
+D1-D5 ucnt carry is per-node (shards like everything else), kcnt holds
+per-shard partial key totals psum'd at read, and the presence flags
+(rowany) are a pmax.
 
 Reference frame: pkg/scheduler/internal/parallelize/parallelism.go:27,56
 (the 16-goroutine node chunking this replaces) and
@@ -62,6 +63,7 @@ from .kernel import MAX_NODE_SCORE
 from .pallas_scan import (
     LANE,
     POS_BIG,
+    SUB as SUB_IPA,
     PallasSession,
     PallasUnsupported,
     _ceil,
@@ -73,8 +75,19 @@ _NODE_DIM = {
     "alloc": 1, "stat": 2, "regrow_f": 1, "zvalid_node_s": 1,
     "konn_f": 1, "konn_s": 1, "shasall": 1, "valid_n": 1,
     "prow_f": 1, "prow_s": 1, "onehot": 1,
+    # IPA term machinery (dyn_ipa sessions only)
+    "ipa_stat": 2, "anti_static": 2, "anti_konn": 2, "aff_static": 2,
+    "prow_ipa": 1,
 }
 _CARRY_KEYS = ("requested", "nzpc", "cnt_fn", "cnt_sn")
+
+
+def _doth(a, b, dims):
+    """Exact-f32 dot (counts/pair-ids above 2^8 need HIGHEST) — the same
+    convention as the pallas kernel's doth."""
+    return jax.lax.dot_general(
+        a, b, dims, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)
 
 
 def _step_fn(cfg, statics, tables, carry, x):
@@ -82,7 +95,7 @@ def _step_fn(cfg, statics, tables, carry, x):
     shard_map): local partials -> collectives -> finish -> winner-shard
     carry updates. Mirrors ops/pallas_scan.py _build_kernel one_pod
     (mode="full") line for line; divergences are bugs."""
-    (T, C, CP, R, SR, K, Npl, TCp) = cfg[0]
+    (T, C, CP, R, SR, K, Npl, TCp, UR) = cfg[0]
     W = dict(cfg[1])
     f32 = jnp.float32
     t = x["tmpl"]
@@ -162,8 +175,63 @@ def _step_fn(cfg, statics, tables, carry, x):
     fail_skew = (vld != 0) & (konn != 0) & (skew > maxskew)
     fail_pts = jnp.any(fail_missing | fail_skew, axis=0, keepdims=True)
 
+    # ---- InterPodAffinity: static parts + assumed-pod term carries
+    # (the pallas kernel's D1-D5 machinery; ucnt is node-sharded, kcnt
+    # holds PER-SHARD partial totals psum'd at read) ----
+    if UR > 0:
+        ucnt, kcnt = carry["ucnt"], carry["kcnt"]
+        ucf = ucnt.astype(f32)                            # (UR, Npl)
+        pos = (ucnt > 0).astype(f32)
+
+        def t_row(a):                                     # [T?, UR] row t
+            return jax.lax.dynamic_index_in_dim(a, t, 0, keepdims=True)
+
+        def t_block(a):                                   # [T, SUB, *]
+            return jax.lax.dynamic_index_in_dim(a, t, 0, keepdims=False)
+
+        # D1: assumed pods' required anti terms repel this pod
+        fail1 = _doth(t_row(tables["g1"]), pos,
+                      (((1,), (0,)), ((), ()))) > 0       # (1, Npl)
+        ipa2 = jax.lax.dynamic_index_in_dim(
+            statics["ipa_stat"], t, 0, keepdims=False)    # (2, Npl)
+        fe_static = ipa2[0:1, :]
+        aff_allk = ipa2[1:2, :]
+        # D2: assumed pods vs this pod's own anti terms
+        anti_dyn = _doth(t_block(tables["wanti"]), ucf,
+                         (((1,), (0,)), ((), ())))        # (SUB, Npl)
+        a_stat = t_block(statics["anti_static"]).astype(f32)
+        akonn = t_block(statics["anti_konn"])
+        avld = jax.lax.dynamic_index_in_dim(
+            tables["anti_valid"], t, 0, keepdims=False)[:, None]
+        fail_anti = jnp.any(
+            (avld != 0) & (akonn != 0) & ((a_stat + anti_dyn) > 0),
+            axis=0, keepdims=True)                        # (1, Npl)
+        # D3: assumed pods matching ALL of this pod's affinity terms
+        aff_dyn = _doth(t_block(tables["waff"]), ucf,
+                        (((1,), (0,)), ((), ())))
+        f_stat = t_block(statics["aff_static"]).astype(f32)
+        fvld = jax.lax.dynamic_index_in_dim(
+            tables["aff_valid"], t, 0, keepdims=False)[:, None]
+        pods_missing = jnp.any(
+            (fvld != 0) & ((f_stat + aff_dyn) <= 0),
+            axis=0, keepdims=True)
+        kc0_g = psum(kcnt).astype(f32)   # -- collective: global totals
+        at_dyn = jnp.sum(_doth(t_row(tables["w3tot"]), kc0_g,
+                               (((1,), (0,)), ((), ()))))
+        counts_empty = (tables["aff_total"][t].astype(f32) + at_dyn) == 0
+        has_aff_t = tables["has_aff"][t]
+        smatch = tables["self_match_all"][t]
+        aff_ok = ((has_aff_t == 0)
+                  | ((aff_allk != 0)
+                     & (jnp.logical_not(pods_missing)
+                        | (counts_empty & (smatch != 0)))))
+        mask_ipa = (jnp.logical_not((fe_static != 0) | fail1)
+                    & jnp.logical_not(fail_anti) & aff_ok)
+    else:
+        mask_ipa = jnp.ones((1, Npl), jnp.bool_)
+
     feasible = ((static_mask != 0) & mask_fit
-                & jnp.logical_not(fail_pts) & (valid_n != 0))
+                & jnp.logical_not(fail_pts) & mask_ipa & (valid_n != 0))
     n_feasible = psum(jnp.sum(feasible.astype(jnp.int32)))
 
     # ---- resource scores (local) ----
@@ -250,8 +318,15 @@ def _step_fn(cfg, statics, tables, carry, x):
     norm = jnp.where(ignored, jnp.int32(0), norm)
     sc_pts = jnp.where(have_s != 0, norm, jnp.int32(0))
 
-    # ---- IPA (static raw; term-free envelope) + normalize ----
+    # ---- IPA score: static raw + assumed-pod terms (D4+D5) ----
     present = tables["ipa_present"][t] != 0
+    if UR > 0:
+        dyn45 = _doth(t_row(tables["w45"]), ucf, (((1,), (0,)), ((), ())))
+        raw_ipa = raw_ipa + dyn45.astype(jnp.int32)
+        rowany = pmax(jnp.max(pos, axis=1, keepdims=True))  # (UR,1)
+        pres_dyn = jnp.sum(_doth(t_row(tables["gpres"]), rowany,
+                                 (((1,), (0,)), ((), ())))) > 0
+        present = present | pres_dyn
     min_i = pmin(jnp.min(jnp.where(feasible, raw_ipa, jnp.int32(POS_BIG))))
     max_i = pmax(jnp.max(jnp.where(feasible, raw_ipa,
                                    jnp.int32(-POS_BIG))))
@@ -337,6 +412,30 @@ def _step_fn(cfg, statics, tables, carry, x):
         "requested": new_requested, "nzpc": new_nzpc,
         "cnt_fn": new_cnt_fn, "cnt_sn": new_cnt_sn,
     }
+    if UR > 0:
+        # the assumed pod joins its node's topology groups for every IPA
+        # key the node carries: same-pair mask from prow_ipa (-1 rows =
+        # node lacks key -> no-op), written into template t's 8-row ucnt
+        # block; kcnt accumulates the PER-SHARD key-presence totals
+        # (nonzero only on the winner's shard — global totals psum at
+        # read), mirroring the kernel's _apply_updates
+        pi = statics["prow_ipa"].astype(f32)              # (SUB, Npl)
+        zb_i = psum(_doth(pi, hotf, (((1,), (1,)), ((), ()))))  # (SUB,1)
+        m_i = ((pi == zb_i)
+               & (statics["prow_ipa"] >= 0)).astype(f32) * okf
+        base_u = t * SUB_IPA
+        ublock = jax.lax.dynamic_slice_in_dim(ucnt, base_u, SUB_IPA, 0)
+        new_ucnt = jax.lax.dynamic_update_slice_in_dim(
+            ucnt, (ublock.astype(f32) + m_i).astype(jnp.int32),
+            base_u, 0)
+        hask_l = _doth((pi >= 0).astype(f32), hotf,
+                       (((1,), (1,)), ((), ())))          # (SUB, 1) local
+        kblock = jax.lax.dynamic_slice_in_dim(kcnt, base_u, SUB_IPA, 0)
+        new_kcnt = jax.lax.dynamic_update_slice_in_dim(
+            kcnt, (kblock.astype(f32) + hask_l * okf).astype(jnp.int32),
+            base_u, 0)
+        new_carry["ucnt"] = new_ucnt
+        new_carry["kcnt"] = new_kcnt
     y = {
         "best": jnp.where(ok, best, jnp.int32(-1)),
         "score": jnp.where(ok, m.astype(jnp.int32), jnp.int32(-1)),
@@ -382,10 +481,13 @@ class ShardedPallasSession:
 
     Construction derives every static from PallasSession's prologue (the
     envelope gates — GCD int32 rescale bounds, <=8 constraints, <=128
-    topology values, f32-exact weights — apply identically), then splits
-    the node axis over the mesh. Raises PallasUnsupported exactly where
-    the pallas kernel would, plus reason="ipa-terms-mesh" for term
-    templates (those ride the GSPMD hoisted mesh session for now)."""
+    topology values, f32-exact weights, the IPA term/key budgets — apply
+    identically), then splits the node axis over the mesh. Affinity-TERM
+    templates are supported: the D1-D5 ucnt carry is node-sharded like
+    every other per-node count, and the two scalars that are genuinely
+    global (the kcnt key-presence totals and the rowany presence flags)
+    ride psum/pmax. Raises PallasUnsupported exactly where the pallas
+    kernel would."""
 
     def __init__(self, cluster: Dict, template_arrays_list: List[Dict],
                  weights: Optional[Dict[str, int]] = None,
@@ -394,10 +496,6 @@ class ShardedPallasSession:
         if len(mesh.devices.ravel()) < 1:
             raise PallasUnsupported("empty mesh", reason="other")
         inner = PallasSession(cluster, template_arrays_list, weights)
-        if inner.dyn_ipa:
-            raise PallasUnsupported(
-                "term templates ride the hoisted mesh session",
-                reason="ipa-terms")
         self.mesh = mesh
         self.weights = inner.weights
         self._fps = inner._fps
@@ -410,9 +508,10 @@ class ShardedPallasSession:
         while Npl * nsh < inner.Np:
             Npl += LANE
         self.Npl, self.Nps = Npl, Npl * nsh
+        self.UR = inner._ipa["UR"] if inner._ipa is not None else 0
         self._cfg = (
             (self.T, self.C, self.CP, self.R, self.SR, self.K,
-             Npl, self.TCp),
+             Npl, self.TCp, self.UR),
             tuple(sorted(self.weights.items())),
         )
 
@@ -468,6 +567,32 @@ class ShardedPallasSession:
             "ipa_present": tb["ipa_present"].astype(np.int32),
             "s_perno_rows": _perno_rows(inner._s_perno, T, self.C, CP),
         }
+        if self.UR:
+            # IPA term machinery (pallas _build_ipa products): node-axis
+            # blocks reshaped template-major for the step's
+            # dynamic_index reads; gate/weight matrices replicated
+            ipa = inner._ipa
+            S8, UR = SUB_IPA, self.UR
+            statics["ipa_stat"] = padn(
+                ipa["ipa_stat"][:2 * T], 1).reshape(T, 2, self.Nps)
+            statics["anti_static"] = padn(
+                ipa["anti_static"], 1).reshape(T, S8, self.Nps)
+            statics["anti_konn"] = padn(
+                ipa["anti_konn"], 1).reshape(T, S8, self.Nps)
+            statics["aff_static"] = padn(
+                ipa["aff_static"], 1).reshape(T, S8, self.Nps)
+            statics["prow_ipa"] = padn(ipa["prow_ipa"], 1, fill=-1)
+            tables["g1"] = ipa["g1"][:T]
+            tables["wanti"] = ipa["wanti"].reshape(T, S8, UR)
+            tables["waff"] = ipa["waff"].reshape(T, S8, UR)
+            tables["w3tot"] = ipa["w3tot"][:T]
+            tables["w45"] = ipa["w45"][:T]
+            tables["gpres"] = ipa["gpres"][:T]
+            tables["has_aff"] = ipa["has_aff"].astype(np.int32)
+            tables["self_match_all"] = ipa["self_match_all"].astype(np.int32)
+            tables["aff_total"] = ipa["aff_total"].astype(np.int32)
+            tables["anti_valid"] = ipa["anti_valid"].astype(np.int32)
+            tables["aff_valid"] = ipa["aff_valid"].astype(np.int32)
         # device placement: node-sharded statics split over the mesh,
         # tables replicated — collectives then ride ICI, not DCN
         self._statics = {}
@@ -495,6 +620,14 @@ class ShardedPallasSession:
             "cnt_sn": jax.device_put(
                 jnp.asarray(padn(inner._cnt_sn0, 1)), shard),
         }
+        if self.UR:
+            # session starts with zero ASSUMED pods (existing pods live
+            # in the static tables); kcnt is PER-SHARD partial totals —
+            # one column per shard, psum'd at read
+            self._carry["ucnt"] = jax.device_put(
+                jnp.zeros((self.UR, self.Nps), jnp.int32), shard)
+            self._carry["kcnt"] = jax.device_put(
+                jnp.zeros((self.UR, nsh), jnp.int32), shard)
 
     def schedule(self, pod_arrays_list: List[Dict]) -> Dict:
         """Enqueue one batch (async); decisions(ys) blocks. KeyError on

@@ -18,7 +18,7 @@ from jax.sharding import Mesh
 
 from kubernetes_tpu.api import types as v1
 from kubernetes_tpu.ops.hoisted import HoistedSession, template_fingerprint
-from kubernetes_tpu.ops.pallas_scan import PallasSession, PallasUnsupported
+from kubernetes_tpu.ops.pallas_scan import PallasSession
 from kubernetes_tpu.ops.sharded_scan import ShardedPallasSession
 from kubernetes_tpu.testing.synth import synth_cluster, synth_pending_pods
 
@@ -117,8 +117,11 @@ class TestShardedParity:
                                  batch=6, n_shards=shards)
             assert got == ref, (n_nodes, shards)
 
-    def test_term_templates_fall_back(self):
-        nodes, init_pods = synth_cluster(6, pods_per_node=1)
+    def test_term_templates_parity(self):
+        """Required hostname anti-affinity: the D1-D5 ucnt/kcnt carries
+        shard per node; decisions must stay bit-identical (one pod per
+        node, so every assume changes later pods' masks)."""
+        nodes, init_pods = synth_cluster(12, pods_per_node=1)
         pending = [
             make_pod(
                 f"aff-{i}", cpu="50m", labels={"app": "aff"},
@@ -129,14 +132,33 @@ class TestShardedParity:
                                 match_labels={"app": "aff"}),
                             topology_key=v1.LABEL_HOSTNAME,
                         )])))
-            for i in range(4)
+            for i in range(10)
         ]
-        enc, pe = _presized_encoding(nodes, init_pods, pending)
-        arrays = _encode_all(enc, pe, pending)
-        with pytest.raises(PallasUnsupported) as ei:
-            ShardedPallasSession(
-                enc.device_state(), _templates_of(arrays), mesh=_mesh(2))
-        assert ei.value.reason == "ipa-terms"
+        ref, got = _run_pair(nodes, init_pods, pending, batch=5)
+        assert got == ref
+        placed = [d for d in got if d >= 0]
+        assert len(placed) == len(set(placed)) == 10  # one per node
+
+    def test_preferred_affinity_parity(self):
+        """Preferred zone affinity (D4/D5 score terms + presence flags
+        ride w45/gpres with the pmax'd rowany)."""
+        nodes, init_pods = synth_cluster(9, pods_per_node=1)
+        pending = [
+            make_pod(
+                f"pref-{i}", cpu="50m", labels={"app": "pref"},
+                affinity=v1.Affinity(pod_affinity=v1.PodAffinity(
+                    preferred_during_scheduling_ignored_during_execution=[
+                        v1.WeightedPodAffinityTerm(
+                            weight=10,
+                            pod_affinity_term=v1.PodAffinityTerm(
+                                label_selector=v1.LabelSelector(
+                                    match_labels={"app": "pref"}),
+                                topology_key=v1.LABEL_ZONE,
+                            ))])))
+            for i in range(8)
+        ]
+        ref, got = _run_pair(nodes, init_pods, pending, batch=4)
+        assert got == ref
 
     def test_parity_vs_hoisted_session_too(self):
         # transitively pinned already (pallas == hoisted), but one direct
