@@ -17,8 +17,25 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from ..utils.metrics import Counter, Gauge, legacy_registry
 from .quantity import Quantity
 from .types import ObjectMeta
+
+# -- controller-plane supervision metrics (controllers/manager.Supervisor) --
+# Served through the same process-wide registry every component's /metrics
+# handler exposes; the supervisor sets them on every crash/restart so a
+# flapping loop is visible without log archaeology.
+
+controller_restarts_total = legacy_registry.register(Counter(
+    "controller_restarts_total",
+    "Controller loops restarted by the supervisor after a crash.",
+    ("controller",),
+))
+controller_healthy = legacy_registry.register(Gauge(
+    "controller_healthy",
+    "1 while the controller loop runs; 0 while crashed/awaiting restart.",
+    ("controller",),
+))
 
 
 @dataclass

@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 from ..api import types as v1
+from ..store import kv
 from ..utils import serde
 from .server import APIError, APIServer, NotFound, ResourceInfo, WatchEvent
 
@@ -161,7 +162,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_error(self, e: Exception) -> None:
         code = getattr(e, "code", 500)
-        body = _status_body(code, str(e), reason=type(e).__name__)
+        body = _status_body(
+            code, str(e), reason=getattr(e, "reason", "") or type(e).__name__
+        )
         # errors can fire BEFORE the request body was read (authn,
         # routing); unread body bytes would desync the next keep-alive
         # request on this socket, so always close after an error
@@ -186,6 +189,13 @@ class _Handler(BaseHTTPRequestHandler):
             handler(resource, ns, name, sub, params)
         except _HTTPError as e:
             self._send_error(e)
+        except kv.Compacted as e:
+            # the watch-from-a-compacted-revision contract on the wire:
+            # 410 Gone, which the client rebuilds as kv.Compacted so the
+            # reflector's re-list path fires (reflector.go 410 handling)
+            gone = _HTTPError(410, str(e))
+            gone.reason = "Compacted"
+            self._send_error(gone)
         except APIError as e:
             self._send_error(e)
         except BrokenPipeError:
@@ -288,6 +298,12 @@ class _Handler(BaseHTTPRequestHandler):
             while self.hub.running:
                 ev = w.poll(timeout=0.5)
                 if ev is None:
+                    if getattr(w, "closed", False):
+                        # the store-side watch died (apiserver crash
+                        # recovery stops every stream): end the response
+                        # so the remote reflector re-lists instead of
+                        # heartbeating against a dead watch forever
+                        break
                     chunk(b" \n")  # heartbeat keeps dead peers detectable
                     continue
                 # drain everything already queued into ONE chunk: a
@@ -668,10 +684,14 @@ class RemoteAPIServer:
             return data
 
     @staticmethod
-    def _error(code: int, message: str, reason: str = "") -> APIError:
+    def _error(code: int, message: str, reason: str = ""):
         from .auth import Forbidden, Unauthorized
         from .server import AlreadyExists, Conflict, Invalid
 
+        if reason == "Compacted" or code == 410:
+            # not an APIError on purpose: the informer reflector catches
+            # kv.Compacted and re-lists — identical to the in-proc path
+            return kv.Compacted(message)
         classes = (NotFound, AlreadyExists, Conflict, Invalid,
                    Unauthorized, Forbidden)
         for cls in classes:
@@ -785,7 +805,10 @@ class RemoteAPIServer:
                 raw = resp.read()
                 data = json.loads(raw) if raw else {}
                 conn.close()
-                raise self._error(resp.status, data.get("message", ""))
+                raise self._error(
+                    resp.status, data.get("message", ""),
+                    data.get("reason", ""),
+                )
             resp._conn = conn  # keep the socket alive with the response
             return resp
 

@@ -146,6 +146,12 @@ class TypedWatch:
         watch fan-out path."""
         return self._raw
 
+    @property
+    def closed(self) -> bool:
+        """True once the underlying store watch died (e.g. an apiserver
+        crash killed every stream): reflectors re-list+re-watch."""
+        return getattr(self._raw, "closed", False)
+
     def stop(self) -> None:
         self._raw.stop()
 

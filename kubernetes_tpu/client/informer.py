@@ -75,6 +75,13 @@ class Informer:
     def has_synced(self) -> bool:
         return self._synced.is_set()
 
+    def event_handlers(self) -> List[EventHandler]:
+        """Registered handlers (copy) — lets an owner diff registrations
+        so it can retire a dead consumer's handlers (the supervisor does
+        this when it rebuilds a crashed controller)."""
+        with self._lock:
+            return list(self._handlers)
+
     def add_event_handler(self, handler: EventHandler) -> None:
         with self._lock:
             self._handlers.append(handler)
@@ -159,10 +166,11 @@ class Informer:
                 if self._stop.is_set():
                     return
                 if getattr(self._watch, "closed", False):
-                    # dead stream (HTTP disconnect, server restart):
-                    # return to _run, which re-lists and re-watches —
-                    # reflector.go's ListAndWatch retry path. In-proc
-                    # watches never set this.
+                    # dead stream: return to _run, which re-lists and
+                    # re-watches — reflector.go's ListAndWatch retry
+                    # path. Both wire watches (HTTP disconnect, server
+                    # restart) and in-proc watches (an apiserver crash
+                    # stops every store watch marked closed) end here.
                     return
                 continue
             key = meta_namespace_key(ev.object)
@@ -209,6 +217,11 @@ class SharedInformerFactory:
                     # cache-dead)
                     inf.start()
             return inf
+
+    def informers(self) -> Dict[str, Informer]:
+        """Current resource -> informer map (copy)."""
+        with self._lock:
+            return dict(self._informers)
 
     def pods(self) -> Informer:
         return self.informer_for("pods")

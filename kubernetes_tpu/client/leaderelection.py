@@ -12,6 +12,7 @@ it's a callback the embedding process decides on.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from dataclasses import dataclass
@@ -29,6 +30,10 @@ class LeaderElectionConfig:
     lease_duration: float = 15.0
     renew_deadline: float = 10.0
     retry_period: float = 2.0
+    # full-jitter factor on the acquire retry: N candidates polling on the
+    # same beat all CAS the lease in the same instant and all but one
+    # conflict, every cycle — jitter de-synchronizes the herd
+    retry_jitter: float = 0.2
 
 
 class LeaderElector:
@@ -66,8 +71,31 @@ class LeaderElector:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        t = self._thread
+        # stop() can be reached from inside run() (on_stopped_leading
+        # chains often call back into the embedding component's stop)
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5)
+        self._release()
+
+    def _release(self) -> None:
+        """leaderelection.go ReleaseOnCancel: a stopping leader vacates
+        the lease record so the successor acquires on its next retry
+        instead of waiting out the full lease_duration (graceful handoff;
+        an actual crash still pays the expiry wait — that's failover)."""
+        try:
+            lease = self._leases.get(self.cfg.lock_name, self.cfg.lock_namespace)
+        except APIError:
+            return
+        if lease.spec.holder_identity != self.cfg.identity:
+            return
+        lease.spec.holder_identity = ""
+        lease.spec.renew_time = None
+        try:
+            self._leases.update(lease)  # resourceVersion-guarded CAS
+        except APIError:
+            pass
+        self.is_leader.clear()
 
     def run(self) -> None:
         """leaderelection.go:196 Run: acquire, then renew until lost."""
@@ -88,7 +116,10 @@ class LeaderElector:
             if self._try_acquire_or_renew():
                 self.is_leader.set()
                 return True
-            self._stop.wait(self.cfg.retry_period)
+            self._stop.wait(
+                self.cfg.retry_period
+                * (1.0 + self.cfg.retry_jitter * random.random())
+            )
         return False
 
     def _renew_loop(self) -> None:

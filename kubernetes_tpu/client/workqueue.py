@@ -118,6 +118,21 @@ class RateLimitingQueue(WorkQueue):
         with self._lock:
             return self._failures.get(item, 0)
 
+    def shutdown(self) -> None:
+        """Shut down, flushing the pending delay heap (delaying_queue.go
+        ShutDown drops waiters: delayed retries belong to the loop being
+        stopped — handing them to its condemned workers would run syncs
+        concurrently with a supervisor-rebuilt replacement) and cancelling
+        the drain timer — without the join a test tearing down hundreds
+        of queues leaks a parked timer thread per queue."""
+        with self._lock:
+            self._shutting_down = True
+            self._waiting.clear()
+            self._failures.clear()
+            self._lock.notify_all()
+        if self._timer_started and self._timer is not threading.current_thread():
+            self._timer.join(timeout=2)
+
     def _drain_waiting(self) -> None:
         """Sleep until the next deadline (delaying_queue.go waitingLoop);
         woken early when add_after schedules something sooner."""

@@ -70,6 +70,7 @@ class Cluster:
         controllers: Optional[List[str]] = None,
         scheduler_backend: Optional[str] = None,
         native_store: bool = False,
+        durable_path: Optional[str] = None,
         feature_gates: str = "",
         admission: bool = True,
         proxies: bool = False,
@@ -86,6 +87,7 @@ class Cluster:
                 controllers,
                 scheduler_backend,
                 native_store,
+                durable_path,
                 feature_gates,
                 admission,
                 proxies,
@@ -103,6 +105,7 @@ class Cluster:
         controllers,
         scheduler_backend,
         native_store,
+        durable_path,
         feature_gates,
         admission,
         proxies,
@@ -112,11 +115,20 @@ class Cluster:
     ) -> None:
         if feature_gates:
             default_feature_gate.set_from_string(feature_gates)
+        if native_store and durable_path:
+            raise ValueError("native_store and durable_path are exclusive")
         store = None
         if native_store:
             from .store.native import NativeKVStore
 
             store = NativeKVStore()
+        elif durable_path:
+            # WAL+snapshot-backed control plane: survives crash_drill /
+            # ChaosMonkey crash-apiserver disruptions with zero lost
+            # acknowledged writes
+            from .store.kv import DurableKVStore
+
+            store = DurableKVStore(durable_path)
         self.api = APIServer(store=store)
         if admission:
             install_default_admission(self.api)
@@ -197,6 +209,12 @@ class Cluster:
                 continue
             try:
                 closer()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        store = getattr(self.api, "store", None)
+        if hasattr(store, "close"):  # durable store: fsync + release the WAL
+            try:
+                store.close()
             except Exception:  # noqa: BLE001 — best-effort teardown
                 pass
         # only remove OUR entries (another live cluster may have

@@ -119,6 +119,12 @@ class NativeWatch:
         self._wid = wid
         self._stopped = threading.Event()
 
+    @property
+    def closed(self) -> bool:
+        """Dead-stream marker (kv.Watch.closed parity): reflectors
+        re-list when the stream they poll has been stopped."""
+        return self._stopped.is_set()
+
     def stop(self) -> None:
         if not self._stopped.is_set():
             self._stopped.set()
@@ -177,6 +183,10 @@ class NativeKVStore:
     @property
     def revision(self) -> int:
         return self._lib.kv_rev(self._h)
+
+    @property
+    def compacted_revision(self) -> int:
+        return self._lib.kv_compacted_rev(self._h)
 
     def get(self, key: str) -> KeyValue:
         out_len = ctypes.c_int64()

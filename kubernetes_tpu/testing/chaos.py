@@ -4,9 +4,15 @@ Reference: test/e2e/chaosmonkey/chaosmonkey.go:48 — a chaosmonkey Do()s
 disruptions while registered tests run; the reboot/disruptive e2e suites
 use it to prove the control plane re-converges. Here the disruptions are
 the ones a hollow cluster can suffer: kubelet kill (node death), kubelet
-restart (recovery), and random pod deletion (workload churn). Each
-disruption is recorded so tests can assert recovery against the actual
-injection history.
+restart (recovery), random pod deletion (workload churn), and — on
+clusters wired for it — control-plane crashes: `crash-apiserver` drops
+the durable store to its on-disk state mid-churn (SIGKILL-equivalent;
+every acknowledged write survives, every live watch dies and reflectors
+re-list) and `crash-controller` kills one supervised controller loop so
+the supervisor must restart it with backoff. The crash kinds are opt-in
+via `disruptions=` (they no-op on clusters without a DurableKVStore /
+Supervisor). Each disruption is recorded so tests can assert recovery
+against the actual injection history.
 """
 
 from __future__ import annotations
@@ -20,9 +26,13 @@ from typing import Callable, List, Optional
 
 @dataclass
 class Disruption:
-    kind: str  # kill-kubelet | restart-kubelet | delete-pod
+    kind: str  # kill-kubelet | restart-kubelet | delete-pod | crash-*
     target: str
     at: float = field(default_factory=time.time)
+
+
+#: the control-plane crash kinds (opt-in: pass via `disruptions=`)
+CRASH_KINDS = ("crash-apiserver", "crash-controller")
 
 
 class ChaosMonkey:
@@ -39,6 +49,7 @@ class ChaosMonkey:
         self.kinds = disruptions or ["kill-kubelet", "restart-kubelet", "delete-pod"]
         self.history: List[Disruption] = []
         self._dead: List = []  # kubelets killed and not yet restarted
+        self._crashed_controllers: List[str] = []  # awaiting supervisor
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -60,12 +71,14 @@ class ChaosMonkey:
 
     # -- disruptions --------------------------------------------------------
 
-    def do_one(self) -> Optional[Disruption]:
-        kind = self.rng.choice(self.kinds)
+    def do_one(self, kind: Optional[str] = None) -> Optional[Disruption]:
+        kind = kind or self.rng.choice(self.kinds)
         fn = {
             "kill-kubelet": self._kill_kubelet,
             "restart-kubelet": self._restart_kubelet,
             "delete-pod": self._delete_pod,
+            "crash-apiserver": self._crash_apiserver,
+            "crash-controller": self._crash_controller,
         }[kind]
         d = fn()
         if d is not None:
@@ -116,8 +129,48 @@ class ChaosMonkey:
             "delete-pod", f"{victim.metadata.namespace}/{victim.metadata.name}"
         )
 
+    def _crash_apiserver(self) -> Optional[Disruption]:
+        """SIGKILL-equivalent on the control plane's store: drop to disk
+        state mid-churn (sometimes with a torn final record) and recover.
+        Acknowledged writes survive; live watches die and every reflector
+        re-lists. No-op unless the cluster runs a DurableKVStore."""
+        store = getattr(getattr(self.cluster, "api", None), "store", None)
+        if store is None or not hasattr(store, "crash"):
+            return None
+        store.crash(torn=bool(self.rng.getrandbits(1)))
+        return Disruption("crash-apiserver", "apiserver")
+
+    def _crash_controller(self) -> Optional[Disruption]:
+        """Kill one supervised controller loop; the supervisor must
+        restart it with capped backoff while the rest keep running.
+        No-op unless the controller manager runs a Supervisor."""
+        sup = getattr(getattr(self.cluster, "kcm", None), "supervisor", None)
+        if sup is None:
+            return None
+        candidates = [n for n in sup.names() if sup.running(n)]
+        if not candidates:
+            return None
+        victim = self.rng.choice(candidates)
+        sup.crash(victim)
+        self._crashed_controllers.append(victim)
+        return Disruption("crash-controller", victim)
+
     # -- assertions ---------------------------------------------------------
 
-    def restart_all_dead(self) -> None:
+    def restart_all_dead(self, timeout: float = 30.0) -> None:
+        """End the experiment with every component back: kubelets
+        restarted (fresh process over the same node), crashed controller
+        loops re-running under their supervisor, and the apiserver store
+        healthy (crash() recovers in place, so it already is)."""
         while self._dead:
             self._restart_kubelet()
+        sup = getattr(getattr(self.cluster, "kcm", None), "supervisor", None)
+        while self._crashed_controllers:
+            name = self._crashed_controllers.pop()
+            if sup is not None and not sup.wait_running(name, timeout):
+                # a recovery barrier that shrugs is worse than none: the
+                # test would proceed green with a controller still down
+                raise RuntimeError(
+                    f"controller {name} not restarted within {timeout}s "
+                    f"(restarts={sup.restart_count(name)})"
+                )

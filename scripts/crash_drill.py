@@ -1,0 +1,170 @@
+"""Crash drill: seed a cluster, SIGKILL-equivalently drop the apiserver
+mid-churn (plus one supervised controller), recover, and assert that
+
+  * every write acknowledged to a client is present after recovery
+    (the durable store's fsync-before-ack contract),
+  * informers re-list on their dead watches and re-converge,
+  * the crashed controller is restarted by the supervisor with capped
+    backoff while the others keep running.
+
+Standalone repro harness for the WAL+snapshot subsystem (store/kv.py
+DurableKVStore + controllers/manager.Supervisor + testing/chaos.py crash
+disruptions). Runs on CPU:
+
+    JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python scripts/crash_drill.py
+
+JAX_ENABLE_X64=1 is required (score/resource math is int64; the pytest
+conftest sets it for the suite, standalone scripts must set it
+themselves — this script defaults both vars if unset).
+"""
+
+import argparse
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.api import apps, types as v1  # noqa: E402
+from kubernetes_tpu.cluster import Cluster  # noqa: E402
+from kubernetes_tpu.testing.chaos import ChaosMonkey  # noqa: E402
+
+
+def wait_until(fn, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def deployment(name: str, replicas: int) -> apps.Deployment:
+    return apps.Deployment(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        spec=apps.DeploymentSpec(
+            replicas=replicas,
+            selector=v1.LabelSelector(match_labels={"app": name}),
+            template=apps.PodTemplateSpec(
+                metadata=v1.ObjectMeta(labels={"app": name}),
+                spec=v1.PodSpec(containers=[v1.Container(
+                    name="c", image="img:1",
+                    resources=v1.ResourceRequirements(requests={"cpu": "20m"}),
+                )]),
+            ),
+        ),
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--replicas", type=int, default=6)
+    ap.add_argument("--writes", type=int, default=60, help="churn writes")
+    ap.add_argument("--crashes", type=int, default=3, help="apiserver crashes")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--dir", default=None, help="durable store dir (tmp default)")
+    args = ap.parse_args()
+
+    path = args.dir or tempfile.mkdtemp(prefix="crash-drill-")
+    rng = random.Random(args.seed)
+    failures = []
+
+    with Cluster(
+        n_nodes=args.nodes,
+        durable_path=path,
+        scheduler_backend="oracle",
+        controllers=["replicaset", "deployment", "nodelifecycle"],
+        controller_opts={
+            "node_monitor_period": 0.3,
+            "node_monitor_grace_period": 2.0,
+            "supervisor_opts": dict(base_backoff=0.05, probe_period=0.02),
+        },
+    ) as c:
+        c.client.resource("deployments").create(deployment("ha", args.replicas))
+
+        def n_running():
+            pods, _ = c.client.pods.list(namespace="default")
+            return sum(1 for p in pods if p.status.phase == "Running")
+
+        if not wait_until(lambda: n_running() == args.replicas, timeout=60):
+            print(f"FAIL: initial convergence ({n_running()}/{args.replicas})")
+            return 1
+        print(f"seeded: {args.replicas} replicas running on {args.nodes} nodes")
+
+        monkey = ChaosMonkey(
+            c, rng=rng, disruptions=["crash-apiserver", "crash-controller"]
+        )
+
+        # churn acknowledged writes while crashes land mid-burst
+        acked = []
+        crash_at = sorted(rng.sample(range(2, args.writes - 1), args.crashes))
+        cm = c.client.resource("configmaps")
+        controller_crashed = False
+        for i in range(args.writes):
+            cm.create(v1.ConfigMap(
+                metadata=v1.ObjectMeta(name=f"acked-{i:03d}", namespace="default")
+            ))
+            acked.append(f"acked-{i:03d}")  # acked: the create returned
+            if crash_at and i == crash_at[0]:
+                crash_at.pop(0)
+                d = monkey.do_one("crash-apiserver")
+                print(f"  write {i}: {d.kind} (rev={c.api.store.revision})")
+                if not controller_crashed:
+                    d = monkey.do_one("crash-controller")
+                    print(f"  write {i}: {d.kind} -> {d.target}")
+                    controller_crashed = True
+        monkey.restart_all_dead(timeout=30)
+
+        # 1. zero lost acknowledged writes
+        names = {o.metadata.name for o in cm.list(namespace="default")[0]}
+        lost = sorted(set(acked) - names)
+        if lost:
+            failures.append(f"lost {len(lost)} acknowledged writes: {lost[:5]}...")
+        else:
+            print(f"durability: all {len(acked)} acknowledged writes present")
+
+        # 2. informers re-listed and the workload re-converged
+        if not wait_until(lambda: n_running() == args.replicas, timeout=60):
+            failures.append(
+                f"convergence after crash: {n_running()}/{args.replicas} running"
+            )
+        else:
+            print(f"convergence: {args.replicas} replicas running again")
+        pods_informer = c.kcm.informers.pods()
+        server_pods, _ = c.client.pods.list(namespace="default")
+        if not wait_until(
+            lambda: pods_informer.count() >= len(server_pods), timeout=15
+        ):
+            failures.append("informer cache never re-synced to server state")
+        else:
+            print("informers: caches re-listed and synced")
+
+        # 3. the crashed controller restarted under supervision
+        sup = c.kcm.supervisor
+        restarted = {n: sup.restart_count(n) for n in sup.names()}
+        crashed = [d.target for d in monkey.history if d.kind == "crash-controller"]
+        for name in crashed:
+            if restarted.get(name, 0) < 1:
+                failures.append(f"controller {name} was never restarted")
+        if not all(sup.running(n) for n in sup.names()):
+            failures.append("not all controllers running after the drill")
+        else:
+            print(f"supervisor: restarts={restarted}, all loops running")
+
+    if failures:
+        print("FAIL:\n  " + "\n  ".join(failures))
+        return 1
+    print(f"PASS: store dir {path} survived "
+          f"{args.crashes} apiserver crashes + a controller crash")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

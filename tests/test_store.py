@@ -1,8 +1,10 @@
-"""KV store semantics, run identically over BOTH backends: the pure-Python
-store (store/kv.py) and the native C++ library (store/native.py over
-native/kvstore.cpp) — the etcd-equivalent semantics must be
+"""KV store semantics, run identically over ALL backends: the pure-Python
+store (store/kv.py), the native C++ library (store/native.py over
+native/kvstore.cpp), and the WAL+snapshot durable store (store/kv.py
+DurableKVStore) — the etcd-equivalent semantics must be
 indistinguishable (reference: staging/src/k8s.io/apiserver/pkg/storage/
-etcd3 store semantics; SURVEY.md §2.4.2).
+etcd3 store semantics; SURVEY.md §2.4.2). Recovery/crash semantics of
+the durable backend live in tests/test_durable_store.py.
 """
 
 import threading
@@ -13,10 +15,13 @@ from kubernetes_tpu.store import kv
 from kubernetes_tpu.store.native import NativeKVStore
 
 
-@pytest.fixture(params=["python", "native"])
+@pytest.fixture(params=["python", "native", "durable"])
 def store(request):
     if request.param == "python":
         return kv.KVStore(history_limit=50)
+    if request.param == "durable":
+        tmp = request.getfixturevalue("tmp_path")
+        return kv.DurableKVStore(str(tmp / "db"), history_limit=50)
     return NativeKVStore(history_limit=50)
 
 
