@@ -55,36 +55,47 @@ def _status_body(code: int, message: str, reason: str = "") -> bytes:
 
 import collections as _collections
 
-_RAW_EVENT_MEMO: Dict[Tuple[str, int, str], bytes] = {}
-_RAW_EVENT_ORDER: "_collections.deque" = _collections.deque()
 _RAW_EVENT_CAP = 8192
-_RAW_EVENT_LOCK = threading.Lock()
 
 
-def _encode_raw_event(ev) -> bytes:
-    """One watch frame from a raw store event, memoized across watch
-    streams: (key, revision, type) is globally unique per event and every
-    watcher of the prefix streams identical bytes."""
-    memo_key = (ev.key, ev.revision, ev.type)
-    with _RAW_EVENT_LOCK:
-        hit = _RAW_EVENT_MEMO.get(memo_key)
-    if hit is not None:
-        return hit
-    obj = dict(ev.value)
-    meta = dict(obj.get("metadata") or {})
-    # the event revision is the object's resourceVersion (etcd3
-    # semantics; TypedWatch._hydrate stamps the same way)
-    meta["resourceVersion"] = str(ev.revision)
-    obj["metadata"] = meta
-    out = json.dumps({
-        "type": ev.type, "revision": ev.revision, "object": obj,
-    }).encode() + b"\n"
-    with _RAW_EVENT_LOCK:
-        _RAW_EVENT_MEMO[memo_key] = out
-        _RAW_EVENT_ORDER.append(memo_key)
-        while len(_RAW_EVENT_ORDER) > _RAW_EVENT_CAP:
-            _RAW_EVENT_MEMO.pop(_RAW_EVENT_ORDER.popleft(), None)
-    return out
+class _RawEventMemo:
+    """Cross-watcher frame memo for ONE hub/store: every watcher of a
+    prefix streams identical bytes per event, encoded once.
+
+    The memo key (store key, revision, type) is only unique WITHIN one
+    store — two apiservers in the same process (bench_configs' 17
+    sequential workloads, multi-cluster tests) mint colliding
+    (key, revision, type) triples for different objects. A process-global
+    memo served one cluster's cached frame bytes to another cluster's
+    watcher; scoping the memo to the hub makes collisions impossible."""
+
+    def __init__(self, cap: int = _RAW_EVENT_CAP):
+        self._memo: Dict[Tuple[str, int, str], bytes] = {}
+        self._order: "_collections.deque" = _collections.deque()
+        self._cap = cap
+        self._lock = threading.Lock()
+
+    def encode(self, ev) -> bytes:
+        memo_key = (ev.key, ev.revision, ev.type)
+        with self._lock:
+            hit = self._memo.get(memo_key)
+        if hit is not None:
+            return hit
+        obj = dict(ev.value)
+        meta = dict(obj.get("metadata") or {})
+        # the event revision is the object's resourceVersion (etcd3
+        # semantics; TypedWatch._hydrate stamps the same way)
+        meta["resourceVersion"] = str(ev.revision)
+        obj["metadata"] = meta
+        out = json.dumps({
+            "type": ev.type, "revision": ev.revision, "object": obj,
+        }).encode() + b"\n"
+        with self._lock:
+            self._memo[memo_key] = out
+            self._order.append(memo_key)
+            while len(self._order) > self._cap:
+                self._memo.pop(self._order.popleft(), None)
+        return out
 
 
 def _split_path(path: str) -> Tuple[str, str, str, str]:
@@ -285,7 +296,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         if raw is not None:
             w = raw
-            encode = _encode_raw_event
+            encode = self.hub.raw_event_memo.encode
         else:
             def encode(ev) -> bytes:
                 return json.dumps({
@@ -465,6 +476,8 @@ class HTTPAPIServer:
         self._httpd.hub = self  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
         self.running = False
+        # per-hub: (key, revision, type) is unique only within one store
+        self.raw_event_memo = _RawEventMemo()
 
     @property
     def address(self) -> str:

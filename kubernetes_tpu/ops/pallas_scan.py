@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os as _os
 from typing import Dict, List, NamedTuple, Optional
 
 import jax
@@ -66,6 +67,8 @@ POS_BIG = 2 ** 30
 NEG_BIG = -(2 ** 30)
 
 CARRY_KEYS = ("requested", "nzpc", "cnt_fn", "cnt_sn")
+
+_MISSING = object()  # exec-cache sentinel (None = AOT failed, use jit)
 
 
 class PallasUnsupported(Exception):
@@ -238,7 +241,10 @@ class PallasSession:
         self._ipa = self._build_ipa(c, S, tp) if self.dyn_ipa else None
         if self._ipa is not None:
             # SMEM scalar extension: [T,3] has_aff/self_match_all/aff_total,
-            # then anti_valid/aff_valid [T,8] each (offsets in _build_kernel)
+            # then anti_valid/aff_valid [T,8] each, then the w45 GCD
+            # scale (offsets in _build_kernel). The scale rides SMEM, not
+            # the static config: sessions whose weights differ only by a
+            # common factor share one compiled program.
             extra = np.concatenate([
                 np.stack([
                     self._ipa["has_aff"], self._ipa["self_match_all"],
@@ -246,10 +252,16 @@ class PallasSession:
                 ], axis=1).reshape(-1),
                 self._ipa["anti_valid"].reshape(-1),
                 self._ipa["aff_valid"].reshape(-1),
+                np.array([self._ipa["w45_scale"]]),
             ]).astype(np.int32)
             self._scalars = np.concatenate([self._scalars, extra])
         self._carry = None
         self._bundle = None
+        # (Bp, mode) -> AOT-compiled executable (None = AOT unavailable,
+        # dispatch through jit). Shared between the serving path and the
+        # warm_buckets daemon thread; plain dict ops are GIL-atomic and a
+        # rare duplicate compile is absorbed by the persistent cache.
+        self._exec: Dict = {}
 
     # -- host-side prologue remap ------------------------------------------
 
@@ -603,11 +615,31 @@ class PallasSession:
                     if p_valid[t, tau] and M_pref[t, tau, u]:
                         w45_i[t, cx(u, p_key[t, tau])] += int(p_w[t, tau])
                         gpres[t, cx(u, p_key[t, tau])] = 1.0
-        # score-dot exactness: |w|.sum * count must stay < 2^24 in f32;
-        # cap session assumed counts at 2^16 (far above any bench window)
-        if int(np.abs(w45_i).sum(axis=1).max(initial=0)) >= 256:
+        # score-dot exactness: |w|.sum * count must stay < 2^24 in f32.
+        # Weights first shed their common GCD (the kernel multiplies the
+        # int32 dot result back by w45_scale): the harness's weight-100
+        # preferred-affinity templates (sum|w| 300) ride the kernel as
+        # sum|w/g| 3 instead of downgrading to the hoisted session —
+        # the Preferred-affinity configs' silent ~4x slow path.
+        w45_scale = _gcd_all(w45_i)
+        w45_i //= w45_scale
+        # with the scaled dot cast to int32 BEFORE the multiply, only
+        # the dot itself must be exact: cap session assumed counts at
+        # 2^16 (far above any bench window) -> sum|w/g| < 2^8
+        scaled_sum = int(np.abs(w45_i).sum(axis=1).max(initial=0))
+        if scaled_sum >= 256:
             raise PallasUnsupported(
                 "IPA score weights too large for exact f32 dot",
+                reason="ipa-score-weights")
+        # ... and the RESTORED magnitude must keep int32 headroom: the
+        # multiply-back delta (scale * scaled-sum * count) has to stay
+        # clear of the 2^30 score sentinel at the same 2^16 count cap,
+        # or raw_ipa's int32 add could wrap for extreme weight mixes
+        # (e.g. {100, 25400}: gcd 100, scaled sum 255) that the
+        # pre-scale guard used to reject outright
+        if w45_scale * scaled_sum >= 2 ** 14:
+            raise PallasUnsupported(
+                "IPA score weights too large for int32 score headroom",
                 reason="ipa-score-weights")
 
         # static per-term per-node blocks (rows t*8+term)
@@ -640,7 +672,7 @@ class PallasSession:
             anti_static=anti_static, anti_konn=anti_konn,
             aff_static=aff_static,
             g1=g1, wanti=wanti, waff=waff, w3tot=w3tot,
-            w45=w45_i.astype(np.float32), gpres=gpres,
+            w45=w45_i.astype(np.float32), w45_scale=w45_scale, gpres=gpres,
             # SMEM scalar extension: per-t has_aff/self_match_all/
             # aff_total + per-term valid flags
             has_aff=np.asarray(S["ipa_has_aff"]).astype(np.int32),
@@ -730,61 +762,149 @@ class PallasSession:
             self._bundle = (cfg, statics, ipa)
         return self._bundle
 
+    def _pack_batch(self, B, Bp, tmpl, mfa, msa):
+        """Per-batch host->device payload as TWO arrays instead of four
+        (B_real, tmpl, mfT, msT): each transfer over the tunnel carries
+        fixed latency, and the per-dispatch payload is part of the ~580ms
+        fixed cost PERF_NOTES tracks. meta = [B_real | tmpl]; match lanes
+        (t*CP+c) = that constraint row per pod, filter block then score
+        block — int8 on the wire (weights are 0/1), widened on-device."""
+        T, C, CP = self.T, self.C, self.CP
+        meta = np.empty(1 + Bp, np.int32)
+        meta[0] = B
+        meta[1:] = tmpl
+        match = np.zeros((Bp, 2 * LANE), np.int8)
+        for t in range(T):
+            match[:B, t * CP:t * CP + C] = mfa[t].reshape(B, C)
+            match[:B, LANE + t * CP:LANE + t * CP + C] = msa[t].reshape(B, C)
+        return meta, match
+
     def schedule(self, pod_arrays_list: List[Dict]):
         """Enqueue one batch; returns the (8, Bp) device result rows —
         row 0 best / row 1 score / row 2 n_feasible. decisions() blocks."""
         B = len(pod_arrays_list)
         Bp, tmpl, mfa, msa = batch_prologue(
             self._fps, self._tp_np, pod_arrays_list, minimum=LANE)
-        T, C, CP = self.T, self.C, self.CP
-        # [Bp, LANE]: lane (t*CP+c) = that constraint row, per pod.
-        # int8 on the wire: match weights are 0/1 and the per-batch
-        # host->device transfer is part of the dispatch's fixed cost
-        mfT = np.zeros((Bp, LANE), np.int8)
-        msT = np.zeros((Bp, LANE), np.int8)
-        for t in range(T):
-            mfT[:B, t * CP:t * CP + C] = mfa[t].reshape(B, C)
-            msT[:B, t * CP:t * CP + C] = msa[t].reshape(B, C)
-        if self._carry is None:
-            self._carry = self._initial_carry()
-        cfg, statics, ipa = self._get_bundle()
-        out, self._carry = _dispatch(
-            cfg, statics, ipa, jnp.asarray([B], jnp.int32), self._carry,
-            jnp.asarray(tmpl), jnp.asarray(mfT), jnp.asarray(msT))
+        meta, match = self._pack_batch(B, Bp, tmpl, mfa, msa)
+        out = self._run_dispatch(meta, match)
         return {"rows": out, "n": B}
 
     @staticmethod
     def decisions(ys) -> List[int]:
         return [int(v) for v in np.asarray(ys["rows"])[0, :ys["n"]]]
 
-    def warm_buckets(self, sizes=(LANE, 256, 512, 1024, 2048)) -> None:
-        """AOT-compile the dispatch for the ragged-tail batch buckets
-        WITHOUT dispatching (no carry touch, no lock needed):
-        .lower().compile() populates jax's caches including the
-        persistent one, so a mid-window first-tail-bucket batch pays a
-        cache hit instead of a fresh ~30s Mosaic compile (a gang rep
-        that drained into a never-seen bucket measured 160 pods/s
-        against its siblings' 1300). Safe to call from a background
-        thread; failures are non-fatal (the lazy path still works)."""
+    # -- dispatch plumbing: persistent executables ------------------------
+
+    def _carry_struct(self) -> Dict:
+        """ShapeDtypeStructs of the carry, WITHOUT touching self._carry:
+        warm_buckets runs on a daemon thread concurrently with
+        schedule() — a warm-thread write of self._carry would silently
+        zero the assumes of any batch dispatched in between."""
+        structs = {
+            "requested": jax.ShapeDtypeStruct(
+                self._requested0.shape, jnp.int32),
+            "nzpc": jax.ShapeDtypeStruct(self._nzpc0.shape, jnp.int32),
+            "cnt_fn": jax.ShapeDtypeStruct(self._cnt_fn0.shape, jnp.int32),
+            "cnt_sn": jax.ShapeDtypeStruct(self._cnt_sn0.shape, jnp.int32),
+        }
+        if self._ipa is not None:
+            structs["ucnt"] = jax.ShapeDtypeStruct(
+                (self._ipa["UR"], self.Np), jnp.int32)
+            structs["kcnt"] = jax.ShapeDtypeStruct(
+                (self._ipa["UR"], LANE), jnp.int32)
+        return structs
+
+    def _compile_exec(self, Bp: int, mode: str = "full"):
+        """AOT lower+compile the dispatch for one (batch bucket, mode).
+        The compiled executable is invoked DIRECTLY on the serving path
+        (persistent executable reuse): every dispatch then runs the same
+        loaded program object — no jit-dispatch signature hashing, and no
+        per-launch program re-resolution for the runtime to pay."""
         cfg, statics, ipa = self._get_bundle()
-        if self._carry is None:
-            self._carry = self._initial_carry()
+        if mode != "full":
+            cfg = cfg._replace(mode=mode)
 
         def st(x):
             return jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype)
 
         statics_s = {k: st(v) for k, v in statics.items()}
         ipa_s = {k: st(v) for k, v in ipa.items()} if ipa else None
-        carry_s = {k: st(v) for k, v in self._carry.items()}
+        args = [
+            cfg, statics_s, ipa_s,
+            jax.ShapeDtypeStruct((1 + Bp,), jnp.int32),
+            self._carry_struct(),
+            jax.ShapeDtypeStruct((Bp, 2 * LANE), jnp.int8),
+        ]
+        if mode == "apply":
+            args.append(jax.ShapeDtypeStruct((2 * Bp,), jnp.int32))
+        return _dispatch.lower(*args).compile()
+
+    def _run_dispatch(self, meta: np.ndarray, match: np.ndarray,
+                      mode: str = "full", forced=None):
+        """Execute one dispatch through the persistent-executable cache
+        (fallback: the plain jit path). Owns the carry swap — the carry
+        buffers are donated to the launch and replaced by its outputs."""
+        if self._carry is None:
+            self._carry = self._initial_carry()
+        Bp = int(meta.shape[0]) - 1
+        meta = jnp.asarray(meta)
+        match = jnp.asarray(match)
+        key = (Bp, mode)
+        fn = self._exec.get(key, _MISSING)
+        if _os.environ.get("KTPU_PALLAS_AOT", "1") != "1":
+            fn = None  # kill switch wins even over warm-installed execs
+        elif fn is _MISSING:
+            try:
+                fn = self._compile_exec(Bp, mode)
+            except Exception:  # noqa: BLE001 — jit path still works
+                fn = None
+            self._exec[key] = fn
+        if fn is not None:
+            args = [meta, self._carry, match]
+            if mode == "apply":
+                args.append(jnp.asarray(forced, jnp.int32))
+            try:
+                out, self._carry = fn(self._get_bundle()[1],
+                                      self._get_bundle()[2], *args)
+                return out
+            except (TypeError, ValueError):
+                # arg-structure/layout mismatch is raised BEFORE
+                # execution (carry buffers untouched): retire this
+                # executable and serve through jit from now on
+                self._exec[key] = None
+        cfg, statics, ipa = self._get_bundle()
+        if mode != "full":
+            cfg = cfg._replace(mode=mode)
+        fv = None if forced is None else jnp.asarray(forced, jnp.int32)
+        out, self._carry = _dispatch(
+            cfg, statics, ipa, meta, self._carry, match, forced=fv)
+        return out
+
+    def warm_buckets(self, sizes=(LANE, 256, 512, 1024, 2048)) -> None:
+        """AOT-compile the dispatch for the ragged-tail batch buckets
+        WITHOUT dispatching: .lower().compile() populates jax's caches
+        including the persistent one, so a mid-window first-tail-bucket
+        batch pays a cache hit instead of a fresh ~30s Mosaic compile (a
+        gang rep that drained into a never-seen bucket measured 160
+        pods/s against its siblings' 1300). Compiled executables land in
+        self._exec, so the serving path reuses the very same loaded
+        program. Runs on a daemon thread: it must NEVER write
+        self._carry (a mid-warm schedule() would have its batch's
+        assumes silently zeroed by the overwrite) — all shapes come from
+        _carry_struct. Failures are non-fatal (the lazy path works)."""
+        aot = _os.environ.get("KTPU_PALLAS_AOT", "1") == "1"
         for Bp in sizes:
             try:
-                _dispatch.lower(
-                    cfg, statics_s, ipa_s,
-                    jax.ShapeDtypeStruct((1,), jnp.int32), carry_s,
-                    jax.ShapeDtypeStruct((Bp,), jnp.int32),
-                    jax.ShapeDtypeStruct((Bp, LANE), jnp.int8),
-                    jax.ShapeDtypeStruct((Bp, LANE), jnp.int8),
-                ).compile()
+                if (Bp, "full") in self._exec:
+                    # present entries stand: a None means the serving
+                    # path RETIRED this executable — do not resurrect it
+                    continue
+                compiled = self._compile_exec(Bp)
+                # with the AOT kill switch set, warming still fills the
+                # (persistent) compile caches, but the serving path must
+                # keep dispatching through jit — don't install
+                if aot:
+                    self._exec[(Bp, "full")] = compiled
             except Exception:  # noqa: BLE001 — warming is best-effort
                 return
 
@@ -802,27 +922,14 @@ class PallasSession:
         Bp, tmpl, mfa, msa = batch_prologue(
             self._fps, self._tp_np, pod_arrays_list, minimum=LANE,
             require_unbound=False)
-        T, C, CP = self.T, self.C, self.CP
-        mfT = np.zeros((Bp, LANE), np.int8)
-        msT = np.zeros((Bp, LANE), np.int8)
-        for t in range(T):
-            mfT[:B, t * CP:t * CP + C] = mfa[t].reshape(B, C)
-            msT[:B, t * CP:t * CP + C] = msa[t].reshape(B, C)
-        if self._carry is None:
-            self._carry = self._initial_carry()
-        cfg, statics, ipa = self._get_bundle()
-        cfg = cfg._replace(mode=mode)
+        meta, match = self._pack_batch(B, Bp, tmpl, mfa, msa)
         fvec = None
         if mode == "apply":
             fvec = np.zeros(2 * Bp, np.int32)
             for i, (lane, ok) in enumerate(forced):
                 fvec[2 * i] = lane
                 fvec[2 * i + 1] = ok
-            fvec = jnp.asarray(fvec)
-        out, self._carry = _dispatch(
-            cfg, statics, ipa, jnp.asarray([B], jnp.int32), self._carry,
-            jnp.asarray(tmpl), jnp.asarray(mfT), jnp.asarray(msT),
-            forced=fvec)
+        out = self._run_dispatch(meta, match, mode=mode, forced=fvec)
         return {"rows": out, "n": B}
 
     def evaluate(self, pod_arrays_list: List[Dict]):
@@ -869,6 +976,7 @@ def _build_kernel(shapes, weights, Bp: int, ur: int = 0,
     # IPA scalar extension (appended when the session has term templates)
     off_ipa_t = off_ssame + T * C * C
     off_av = off_ipa_t + 3 * T
+    off_w45s = off_av + 2 * T * SUB  # w45 GCD scale (one scalar)
     (W_F_VALID, W_S_VALID, W_F_SKEW, W_S_SKEW, W_F_SELF, W_S_FIRST,
      W_F_KEY, W_S_KEY, W_F_PERNO, W_S_PERNO) = range(10)
 
@@ -1252,7 +1360,10 @@ def _build_kernel(shapes, weights, Bp: int, ur: int = 0,
             if dyn_ipa:
                 w45row = w45_ref[pl.ds(t, 1), :]
                 dyn45 = doth(w45row, ucf, (((1,), (0,)), ((), ())))
-                raw_ipa = raw_ipa + dyn45.astype(jnp.int32)
+                # the f32 dot ran on GCD-scaled weights (exactness needs
+                # only sum|w/g| * count < 2^24); the int32 multiply
+                # restores real magnitudes exactly
+                raw_ipa = raw_ipa + dyn45.astype(jnp.int32) * sc[off_w45s]
                 rowany = jnp.max(pos, axis=1, keepdims=True)   # (UR, 1)
                 gp = gpres_ref[pl.ds(t, 1), :]
                 pres_dyn = jnp.sum(
@@ -1391,22 +1502,26 @@ def _stack_tc(sm_tc, which, T, C, TCp):
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnames=("carry",))
 def _dispatch(cfg: "_Cfg", statics: Dict, ipa: Optional[Dict],
-              B_real, carry: Dict, tmpl, mfT, msT, forced=None):
-    # B_real is a DYNAMIC (SMEM) scalar: variable batch lengths must not
-    # recompile the kernel (only the padded width Bp is static).
-    # The cluster statics arrive as DYNAMIC pytree args, NOT via the
-    # static cfg: baking them in as trace constants made every session
-    # rebuild a fresh program (different constants -> jit cache miss AND
-    # persistent-cache miss) — the 20-30s "warm" rebuild the churn
-    # workload paid mid-window. cfg hashes by VALUE, so two sessions
-    # with the same shapes share one compiled program.
-    Bp = int(tmpl.shape[0])
+              meta, carry: Dict, match, forced=None):
+    # meta = [B_real | tmpl] (int32), match = [mfT | msT] (int8): the
+    # whole per-batch payload in two transfers — the split happens here
+    # on-device. B_real stays a DYNAMIC (SMEM) scalar: variable batch
+    # lengths must not recompile the kernel (only the padded width Bp is
+    # static). The cluster statics arrive as DYNAMIC pytree args, NOT
+    # via the static cfg: baking them in as trace constants made every
+    # session rebuild a fresh program (different constants -> jit cache
+    # miss AND persistent-cache miss) — the 20-30s "warm" rebuild the
+    # churn workload paid mid-window. cfg hashes by VALUE, so two
+    # sessions with the same shapes share one compiled program.
+    Bp = int(meta.shape[0]) - 1
+    B_real = meta[:1]
+    tmpl = meta[1:]
     kernel = _build_kernel(cfg.shapes, cfg.weights, Bp, cfg.ur,
                            mode=cfg.mode)
     # widen the int8 wire format on-device (i8 VMEM rows would need
     # 32-sublane alignment in the kernel; one cheap convert avoids that)
-    mfT = mfT.astype(jnp.int32)
-    msT = msT.astype(jnp.int32)
+    mfT = match[:, :LANE].astype(jnp.int32)
+    msT = match[:, LANE:].astype(jnp.int32)
     carry_keys = cfg.carry_keys
     carry_in = [carry[k] for k in carry_keys]
     ipa_in = []

@@ -322,7 +322,10 @@ def _step_fn(cfg, statics, tables, carry, x):
     present = tables["ipa_present"][t] != 0
     if UR > 0:
         dyn45 = _doth(t_row(tables["w45"]), ucf, (((1,), (0,)), ((), ())))
-        raw_ipa = raw_ipa + dyn45.astype(jnp.int32)
+        # w45 is GCD-scaled (pallas_scan._build_ipa); the int32 multiply
+        # restores real weight magnitudes exactly — same convention as
+        # the single-device kernel
+        raw_ipa = raw_ipa + dyn45.astype(jnp.int32) * tables["w45_scale"]
         rowany = pmax(jnp.max(pos, axis=1, keepdims=True))  # (UR,1)
         pres_dyn = jnp.sum(_doth(t_row(tables["gpres"]), rowany,
                                  (((1,), (0,)), ((), ())))) > 0
@@ -587,6 +590,7 @@ class ShardedPallasSession:
             tables["waff"] = ipa["waff"].reshape(T, S8, UR)
             tables["w3tot"] = ipa["w3tot"][:T]
             tables["w45"] = ipa["w45"][:T]
+            tables["w45_scale"] = np.int32(ipa["w45_scale"])
             tables["gpres"] = ipa["gpres"][:T]
             tables["has_aff"] = ipa["has_aff"].astype(np.int32)
             tables["self_match_all"] = ipa["self_match_all"].astype(np.int32)

@@ -13,6 +13,7 @@ mustSetupScheduler (util.go:61) with a real apiserver+etcd and no kubelet.
 
 from __future__ import annotations
 
+import math
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -267,6 +268,11 @@ class Result:
     session_builds: Optional[Dict[str, int]] = None
     session_builds_total: Optional[Dict[str, int]] = None
     session_kind: str = ""
+    # WHY the config rode the session it rode: "kind/reason" -> builds
+    # since process start. A config on HoistedSession must carry its
+    # downgrade reason here — no benchmark row rides the slow path
+    # silently (the Preferred-affinity configs did for two rounds).
+    session_build_reasons: Optional[Dict[str, int]] = None
     # attempts/s over the measured window — the headline for saturating
     # workloads (headline_metric says which number to read)
     attempts_per_sec: float = 0.0
@@ -274,6 +280,28 @@ class Result:
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
+
+
+def _bind_rate_samples(bind_ts: List[float]) -> List[float]:
+    """Per-second bind rates over the exact first-bind..last-bind window,
+    computed from the bind events themselves (no polling grid). Returns
+    [] when the binding phase is shorter than one second — per-second
+    cadence is unresolvable there and the caller falls back to the
+    run-average rate (the old grid reported a 1000/k quantization
+    artifact for exactly those runs)."""
+    if not bind_ts:
+        return []
+    first, last = bind_ts[0], bind_ts[-1]
+    span = last - first
+    if span < 1.0:
+        return []
+    nb = int(math.ceil(span))
+    counts = [0] * nb
+    for t in bind_ts:
+        counts[min(nb - 1, int(t - first))] += 1
+    widths = [1.0] * (nb - 1) + [span - (nb - 1)]
+    # a sliver of a final bucket (< 0.2s) is noise, not a rate sample
+    return [c / wd for c, wd in zip(counts, widths) if wd >= 0.2]
 
 
 def _percentile(samples: List[float], p: float) -> float:
@@ -292,6 +320,20 @@ def _session_build_counts() -> Dict[str, int]:
     for key, val in session_builds.items():
         kind = key[0] if key else "unknown"
         out[kind] = out.get(kind, 0) + int(val)
+    return out
+
+
+def _session_build_reasons() -> Dict[str, int]:
+    """scheduler_tpu_session_builds_total by (kind, reason): the recorded
+    WHY behind every session build — a hoisted row names its downgrade."""
+    from ..scheduler.metrics import session_builds
+
+    out: Dict[str, int] = {}
+    for key, val in session_builds.items():
+        kind = key[0] if key else "unknown"
+        reason = key[1] if len(key) > 1 and key[1] else "-"
+        slug = f"{kind}/{reason}"
+        out[slug] = out.get(slug, 0) + int(val)
     return out
 
 
@@ -526,22 +568,23 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         attempts0 = total_attempts()
         builds0 = _session_build_counts()
         bound0 = bound_count()
+        n_ts0 = len(sched.bind_timestamps)
         t0 = time.perf_counter()
-        samples: List[float] = []
-        sample_times: List[float] = []
-        last_bound, last_t = 0, t0
+        t0_mono = time.monotonic()  # bind_timestamps' clock
+        last_bound = 0
         stall_since = t0
         deadline = t0 + w.timeout
         last_att = 0
-        bind_seconds: List[bool] = []  # sample had >=1 bind
+        # this loop is ONLY the stop condition (completion / stall /
+        # timeout): throughput comes from the scheduler's exact per-bind
+        # timestamps below, not from this 1s polling grid — the grid's
+        # quantization made every sub-second 500-node run read as a
+        # 1000/k pods/s artifact (999.4 / 499.9 / 333.3 ...)
         while time.perf_counter() < deadline:
             time.sleep(1.0)
             bound = bound_count() - bound0
             att = total_attempts() - attempts0
             now = time.perf_counter()
-            samples.append((bound - last_bound) / (now - last_t))
-            sample_times.append(now)
-            bind_seconds.append(bound != last_bound)
             # the stall clock runs only while the scheduler is live but
             # not progressing: ATTEMPTS reset it too (a preemption wave
             # records failures long before its first bind), and nothing
@@ -549,28 +592,36 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             # dispatch of a fresh shape can compile for >30s on the chip)
             if bound != last_bound or att != last_att or (bound == 0 and att == 0):
                 stall_since = now
-            last_bound, last_t, last_att = bound, now, att
+            last_bound, last_att = bound, att
             if bound >= w.num_pods:
                 break
             if w.stall_stop and now - stall_since >= w.stall_stop:
                 break
         sched.pause()  # no fresh dispatches while results are read
+        sched._drain_pipeline(timeout=30.0)  # land in-flight tail binds
         dt = time.perf_counter() - t0
+        # exact measured-phase bind timestamps (monotonic, bind-sent
+        # time; binder threads may land batches slightly out of order)
+        bind_ts = sorted(
+            t - t0_mono for t in list(sched.bind_timestamps)[n_ts0:]
+        )
+        bound_for_rate: Optional[int] = None
         if w.stall_stop and stall_since - t0 > 0 and last_bound < w.num_pods:
-            # drop the idle stall tail from the measured window — both the
-            # duration and the all-zero samples it contributed (filter by
-            # sample timestamp: loop iterations drift past 1s under load)
+            # drop the idle stall tail from the measured window — and
+            # the binds the post-pause pipeline drain landed AFTER it
+            # (counting them against a dt cut at the stall point would
+            # inflate the reported rate)
             dt = stall_since - t0
-            keep = [ts <= stall_since for ts in sample_times]
-            samples = [s for s, k in zip(samples, keep) if k] or samples[:1]
-            bind_seconds = [b for b, k in zip(bind_seconds, keep) if k] \
-                or bind_seconds[:1]
+            bind_ts = [t for t in bind_ts if t <= dt]
+            bound_for_rate = len(bind_ts)
+        elif bind_ts and last_bound >= w.num_pods:
+            # every measured pod bound: the window ends at the LAST BIND,
+            # not at the poll loop's next 1s tick
+            dt = max(bind_ts[-1], 1e-9)
         # percentile series scoped to the binding phase (see the Result
-        # field comment): first-bind .. last-bind sample, inclusive
-        if any(bind_seconds):
-            lo = bind_seconds.index(True)
-            hi = len(bind_seconds) - 1 - bind_seconds[::-1].index(True)
-            samples = samples[lo:hi + 1]
+        # field comment): per-second bind rates over the exact
+        # first-bind .. last-bind window, from the bind events themselves
+        samples = _bind_rate_samples(bind_ts)
         pods, _ = cs.pods.list(namespace="default")
         # count bound MEASURED pods by name: preemption workloads evict
         # init pods, so "total bound minus num_init" would undercount
@@ -595,13 +646,23 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             for k, v in builds_total.items()
             if v - builds0.get(k, 0)
         }
+        if not samples and dt:
+            # binding phase shorter than 1s: per-second cadence is
+            # unresolvable — the run-average is the only honest sample
+            samples = [
+                (bound_for_rate if bound_for_rate is not None
+                 else bound_measured) / dt
+            ]
         return Result(
             name=w.name,
             backend=w.backend,
             num_nodes=w.num_nodes,
             num_pods=w.num_pods,
             duration_s=round(dt, 2),
-            throughput_avg=round(bound_measured / dt, 2) if dt else 0.0,
+            throughput_avg=round(
+                (bound_for_rate if bound_for_rate is not None
+                 else bound_measured) / dt, 2
+            ) if dt else 0.0,
             throughput_p50=round(_percentile(samples, 50), 2),
             throughput_p90=round(_percentile(samples, 90), 2),
             throughput_p99=round(_percentile(samples, 99), 2),
@@ -615,6 +676,7 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             attempt_p99=round(_percentile(att, 99), 4),
             session_builds=builds,
             session_builds_total=builds_total,
+            session_build_reasons=_session_build_reasons(),
             session_kind=(
                 type(sched.tpu._session).__name__
                 if sched.tpu is not None and sched.tpu._session is not None

@@ -43,18 +43,25 @@ class Endpoint:
     __slots__ = ("namespace", "labels", "ip", "named_ports")
 
     def __init__(self, namespace: str = "", labels: Optional[Dict] = None,
-                 ip: str = "", named_ports: Optional[Dict[str, int]] = None):
+                 ip: str = "", named_ports: Optional[Dict] = None):
         self.namespace = namespace
         self.labels = labels or {}
         self.ip = ip
-        # container port name -> containerPort (named NetworkPolicyPort
-        # targets resolve against the DESTINATION pod's container specs)
-        self.named_ports = named_ports or {}
+        # container port name -> (containerPort, protocol): named
+        # NetworkPolicyPort targets resolve against the DESTINATION
+        # pod's container specs PER (name, protocol) — a UDP "web"
+        # container port must not satisfy a TCP policy port (types.go:
+        # the named lookup matches both fields). Bare-int values are
+        # accepted and read as TCP (the ContainerPort default).
+        self.named_ports = {
+            name: (v if isinstance(v, tuple) else (v, "TCP"))
+            for name, v in (named_ports or {}).items()
+        }
 
     @classmethod
     def from_pod(cls, pod: v1.Pod) -> "Endpoint":
         named = {
-            p.name: p.container_port
+            p.name: (p.container_port, getattr(p, "protocol", None) or "TCP")
             for c in pod.spec.containers or []
             for p in c.ports or []
             if getattr(p, "name", None)
@@ -145,12 +152,17 @@ class NetworkPolicyEvaluator:
             lo = p.port
             if isinstance(lo, str):
                 # named port: resolves against the destination pod's
-                # container specs; unresolvable names match nothing
+                # container specs per (name, protocol) — a name whose
+                # container port carries a different protocol resolves
+                # to nothing; unresolvable names match nothing
                 # (endPort is invalid with a named port, types.go)
-                lo = dst.named_ports.get(lo)
-                if lo is None:
+                resolved = dst.named_ports.get(lo)
+                if resolved is None:
                     continue
-                if port == lo:
+                num, proto = resolved
+                if proto != (p.protocol or "TCP"):
+                    continue
+                if port == num:
                     return True
                 continue
             hi = p.end_port if p.end_port is not None else lo

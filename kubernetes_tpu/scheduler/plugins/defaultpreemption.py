@@ -157,6 +157,16 @@ class DefaultPreemption(fwk.PostFilterPlugin):
         ]
         if not potential_victims:
             return None
+        # :612 sorts by MoreImportantPod (priority desc, earlier start
+        # first) BEFORE filterPodsWithPDBViolation: PDB allowances are
+        # consumed most-important-first, so when a budget covers more
+        # victims than it allows, the LEAST important ones are the
+        # violating group. The reprieve re-sorts each group with the
+        # same key, so the sort changes only allowance consumption.
+        potential_victims.sort(
+            key=lambda pi: (-_pod_priority(pi.pod),
+                            pi.pod.status.start_time or 0.0)
+        )
         for pi in potential_victims:
             node_info.remove_pod(pi.pod)
             self.handle.run_pre_filter_extension_remove_pod(state, pod, pi, node_info)
@@ -199,6 +209,8 @@ class DefaultPreemption(fwk.PostFilterPlugin):
     def _split_by_pdb(
         self, pods: List[PodInfo], pdbs: List[v1.PodDisruptionBudget]
     ) -> Tuple[List[PodInfo], List[PodInfo]]:
+        """Consumes allowances in the CALLER'S list order — callers pass
+        MoreImportantPod-sorted victims (:612)."""
         if not pdbs:
             return [], list(pods)
         allowed = [p.status.disruptions_allowed for p in pdbs]

@@ -218,10 +218,10 @@ class FastPreemptionPlanner:
                     if vp < p:
                         lo_sum[p][:, i] += vec
                         lo_cnt[p][i] += 1
-            # victims kept in ni.pods ORDER (filterPodsWithPDBViolation
-            # consumes PDB allowances in list order, :660); the reprieve
-            # order (highest priority, earliest start, :633) rides the
-            # _vsort permutation instead
+            # victims stored in ni.pods ORDER; both PDB allowance
+            # consumption (:612 sorts by MoreImportantPod BEFORE
+            # filterPodsWithPDBViolation) and the reprieve (highest
+            # priority, earliest start, :633) walk the _vsort permutation
             per_node.append(victims)
         self._lower_sum = lo_sum
         self._lower_cnt = lo_cnt
@@ -415,17 +415,21 @@ class FastPreemptionPlanner:
         Csz = C.size
         rows = np.arange(Csz)
         # -- filterPodsWithPDBViolation (:660), vectorized per candidate:
-        # victims consume PDB allowances in ni.pods ORDER; a victim whose
-        # matched budget is already exhausted at its turn is "violating"
+        # victims consume PDB allowances in MoreImportantPod order
+        # (priority desc, earlier start first — the :612 sort runs
+        # BEFORE the split in the reference), i.e. column-by-column
+        # through the _vsort permutation; a victim whose matched budget
+        # is already exhausted at its turn is "violating"
         violating = np.zeros((Csz, self._vmax), dtype=bool)
         if self.pdbs:
             allowed_rem = np.repeat(
                 self._pdb_allowed[:, None], Csz, axis=1
             )  # [P, C]
-            for o in range(self._vmax):
-                valid_o = self._valive[C, o] & (self._vprio[C, o] < prio)
-                m = self._pdb_match[C, o, :].T & valid_o[None, :]  # [P, C]
-                violating[:, o] = np.any(m & (allowed_rem <= 0), axis=0)
+            for v in range(self._vmax):
+                j = self._vsort[C, v]  # per-candidate column [C]
+                valid_o = self._valive[C, j] & (self._vprio[C, j] < prio)
+                m = self._pdb_match[C, j, :].T & valid_o[None, :]  # [P, C]
+                violating[rows, j] = np.any(m & (allowed_rem <= 0), axis=0)
                 allowed_rem -= m & (allowed_rem > 0)
         # -- vectorized reprieve (:633) over all candidates at once, in
         # the oracle's order: the VIOLATING group first, then the rest,

@@ -12,6 +12,16 @@ assume/forget protocol in the cache — plus the TPU twist: the scheduling
 cycle drains a RUN of pending pods from the queue and schedules them in
 one batched device dispatch (ops/batch.py) when their specs allow,
 preserving sequential assume semantics.
+
+TPU mode runs those cycles as a three-stage pipeline (pipeline_depth,
+default 2): the scheduler thread pops + encodes + dispatches batch k+1,
+the device scans batch k (double-buffered dispatches chained on the
+session carry), and a completion worker — the async bind queue —
+harvests batch k-1 and runs assume -> reserve/permit -> bind-submit ->
+failure handling strictly in dispatch order. Decisions are bit-identical
+to the sequential depth-0 path (tests/test_pipeline_parity.py): the
+device carry is the assume cache, so completion order — not completion
+TIME — is what sequential assume semantics require.
 """
 
 from __future__ import annotations
@@ -73,6 +83,7 @@ class Scheduler:
         pod_max_backoff: float = 10.0,
         extenders: Optional[List] = None,
         parallelism: int = 16,
+        pipeline_depth: int = 2,
     ):
         self.client = clientset
         self.informers = informer_factory
@@ -110,21 +121,44 @@ class Scheduler:
             extenders=self.extenders,
             rng=self.rng,
         )
+        # pipelined scheduling loop (PERF_NOTES "kernel-to-loop gap"):
+        # depth N lets N dispatched batches ride ahead of their
+        # completions. The scheduler thread only pops + encodes +
+        # dispatches; a dedicated completion worker (the async bind
+        # queue) harvests device results and runs assume -> reserve/
+        # permit -> bind-submit -> failure handling, strictly in
+        # dispatch order — so the device scans batch k while the host
+        # encodes k+1 and binds k-1. Depth 0 = fully sequential
+        # (dispatch then complete inline on the scheduler thread): the
+        # bit-parity reference path (tests/test_pipeline_parity.py).
+        self.pipeline_depth = max(0, pipeline_depth)
         if backend == "tpu":
             self.tpu = tpu_backend or TPUBackend(rng=self.rng)
+            self.tpu.max_pending = max(1, self.pipeline_depth)
             self.cache.add_listener(self.tpu)
             self._wire_volume_device()
         else:
             self.tpu = None
         self._stop = threading.Event()
         self._paused = threading.Event()
-        self._inflight_batch = None  # (todo, handle, cycle) awaiting harvest
+        # completion queue: (todo, handle, cycle) in dispatch order. The
+        # worker pops the HEAD, completes it, THEN removes it — so an
+        # empty deque means every dispatched batch has fully landed
+        # (assumed + bind submitted + failures handled).
+        self._completions: deque = deque()
+        self._completion_cv = threading.Condition()
+        self._completion_thread: Optional[threading.Thread] = None
         # exact per-pod scheduling latencies (seconds) for the perf
         # harness: (queue-admission->bind-sent, pop->bind-sent, attempts).
         # The histograms carry the same data bucket-quantized; the harness
         # wants exact percentiles (scheduler_perf util.go:177 extracts
         # Perc50/90/99 from the live histogram — ours keeps the samples).
         self.latency_samples: deque = deque(maxlen=200_000)
+        # monotonic bind-sent time per bound pod: the perf harness reads
+        # the EXACT first-bind..last-bind window from these instead of a
+        # 1s polling grid (whose quantization turned every sub-second
+        # 500-node run into a 1000/k pods/s artifact)
+        self.bind_timestamps: deque = deque(maxlen=200_000)
         # permit drainer state: pods parked at Permit (WAIT) register a
         # listener and a single thread releases them in waves
         self._permit_lock = threading.Lock()
@@ -297,9 +331,16 @@ class Scheduler:
             self._permit_thread.join(timeout=10)
         if self.backend == "tpu":
             try:
-                self._drain_inflight()  # loop is dead; land the tail batch
+                # loop is dead; the completion worker lands the tail
+                # batches (it drains the queue before honoring _stop),
+                # and their binds must enter the pool before it shuts
+                self._drain_pipeline(timeout=30.0)
             except Exception:  # noqa: BLE001 — teardown best-effort
                 traceback.print_exc()
+        if self._completion_thread is not None:
+            with self._completion_cv:
+                self._completion_cv.notify_all()
+            self._completion_thread.join(timeout=10)
         self._binders.shutdown(wait=True)
         if not self.recorder.flush(timeout=5.0):  # events are async
             logger.warning(
@@ -316,7 +357,7 @@ class Scheduler:
             try:
                 if self._paused.is_set():
                     if self.backend == "tpu":
-                        self._drain_inflight()
+                        self._drain_pipeline()
                     time.sleep(0.02)
                     continue
                 self.schedule_one(timeout=0.2)
@@ -336,7 +377,7 @@ class Scheduler:
         info = self.queue.pop(timeout=timeout)
         if info is None:
             if self.backend == "tpu":
-                self._drain_inflight()  # idle: land the tail batch
+                self._drain_pipeline()  # idle: land the tail batches
             return False
         info.pop_timestamp = _time.monotonic()
         with self._inflight_lock:
@@ -408,8 +449,12 @@ class Scheduler:
                     kernel_infos.append(i)
                     batch_claims |= claims
             todo = kernel_infos
-            for info in oracle_infos:
-                self._schedule_one_oracle(info)
+            if oracle_infos:
+                # the oracle schedules against the cache snapshot: every
+                # pipelined batch's assumes must land first
+                self._drain_pipeline()
+                for info in oracle_infos:
+                    self._schedule_one_oracle(info)
             # nominated-node short-circuit (generic_scheduler.go:235
             # evaluateNominatedNode): a preemptor whose victims were
             # evicted re-arrives with a nominated node — feasibility is
@@ -421,22 +466,87 @@ class Scheduler:
                 if (i.nominated_node or i.pod.status.nominated_node_name)
             ]
             if nominated:
+                # feasibility runs on the cache snapshot — same drain
+                # requirement as the oracle path
+                self._drain_pipeline()
                 placed = self._place_nominated(nominated)
                 if placed:
                     todo = [i for i in todo if id(i) not in placed]
-        # 1-deep pipeline: dispatch this batch (async on the live session
-        # — the device scan chains on the previous batch's carry), then
-        # harvest/bind the PREVIOUS batch while the device works. The
-        # drain paths (_drain_inflight) flush on idle, pause, and stop.
+        if not todo:
+            return
+        # pipelined dispatch: enqueue this batch's scan (async on the
+        # live session — it chains on the previous batch's carry), hand
+        # the completion (harvest -> assume -> bind -> failures) to the
+        # completion worker, and return to pop + encode the next batch.
+        # The device double-buffers (tpu.max_pending); the worker
+        # preserves dispatch order. Depth 0 completes inline — the
+        # sequential reference path the parity gate compares against.
         handle = self.tpu.dispatch_many([i.pod for i in todo])
-        prev, self._inflight_batch = self._inflight_batch, (todo, handle, cycle)
-        if prev is not None:
-            self._complete_batch(*prev)
+        if self.pipeline_depth <= 0:
+            self._complete_batch(todo, handle, cycle)
+            return
+        with self._completion_cv:
+            if self._completion_thread is None:
+                self._completion_thread = threading.Thread(
+                    target=self._completion_loop, name="batch-completions",
+                    daemon=True,
+                )
+                self._completion_thread.start()
+            self._completions.append((todo, handle, cycle))
+            self._completion_cv.notify_all()
+            # backpressure: the assume/bind lag stays bounded by the
+            # pipeline depth (an unbounded queue would let the cache
+            # trail arbitrarily far behind the device carry)
+            while (
+                len(self._completions) > self.pipeline_depth
+                and not self._stop.is_set()
+            ):
+                self._completion_cv.wait(0.2)
 
-    def _drain_inflight(self) -> None:
-        prev, self._inflight_batch = self._inflight_batch, None
-        if prev is not None:
-            self._complete_batch(*prev)
+    def _completion_loop(self) -> None:
+        """The async bind queue: completes dispatched batches strictly in
+        dispatch order, off the scheduling thread's critical path.
+        assume-before-bind: a batch's decisions enter the scheduler cache
+        (the device carry already holds them) before its bind POSTs go
+        out; a failed bind forgets the assumed pod and requeues it
+        unassigned — the reference's assume -> async bind ->
+        confirm/forget contract (scheduler.go:359,:540)."""
+        while True:
+            with self._completion_cv:
+                while not self._completions and not self._stop.is_set():
+                    self._completion_cv.wait(0.2)
+                if not self._completions:
+                    return  # stopped and fully drained
+                item = self._completions[0]
+            try:
+                self._complete_batch(*item)
+            except Exception:  # the worker must outlive batch bugs:
+                # its death would strand every queued completion
+                traceback.print_exc()
+            finally:
+                # remove AFTER completing: an empty deque means every
+                # dispatched batch has fully landed (_drain_pipeline)
+                with self._completion_cv:
+                    self._completions.popleft()
+                    self._completion_cv.notify_all()
+
+    def _drain_pipeline(self, timeout: Optional[float] = None) -> bool:
+        """Block until every dispatched batch has fully completed
+        (assumed + binds submitted + failures handled). Runs on idle,
+        pause, and stop, and before any path that reads the scheduler
+        cache as ground truth (oracle scheduling, nominated placement)."""
+        if self.pipeline_depth <= 0:
+            return True
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._completion_cv:
+            while self._completions:
+                wait = 0.2
+                if deadline is not None:
+                    wait = min(wait, deadline - _time.monotonic())
+                    if wait <= 0:
+                        return False
+                self._completion_cv.wait(wait)
+        return True
 
     def _complete_batch(self, todo: List, handle, cycle: int) -> None:
         results = self.tpu.harvest(handle)
@@ -826,7 +936,13 @@ class Scheduler:
         if batch_items:
             with self._inflight_lock:
                 self._inflight += 1
-            self._binders.submit(self._bind_batch, batch_items)
+            try:
+                self._binders.submit(self._bind_batch, batch_items)
+            except RuntimeError:
+                # pool shut down (stop() raced a lagging completion):
+                # bind inline — we're already off the scheduler thread,
+                # and stranding the batch assumed-in-cache is worse
+                self._bind_batch(batch_items)
 
     def _reserve_and_permit(
         self, state: CycleState, assumed: v1.Pod, node_name: str, info
@@ -1056,6 +1172,7 @@ class Scheduler:
         metrics.pod_scheduling_duration.observe(e2e, attempts=str(info.attempts))
         metrics.scheduling_attempt_duration.observe(attempt)
         self.latency_samples.append((e2e, attempt, info.attempts))
+        self.bind_timestamps.append(now)
 
     def _schedule_one_oracle(self, info) -> None:
         pod = info.pod
@@ -1234,9 +1351,11 @@ class Scheduler:
         while time.monotonic() < deadline:
             with self._inflight_lock:
                 inflight = self._inflight
+            with self._completion_cv:
+                completions = len(self._completions)
             if (
                 inflight == 0
-                and self._inflight_batch is None  # pipelined tail batch
+                and completions == 0  # pipelined tail batches
                 and not self.queue.pending_pods()
             ):
                 return True

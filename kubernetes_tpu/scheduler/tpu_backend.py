@@ -20,6 +20,7 @@ from __future__ import annotations
 import logging
 import random
 import threading
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -106,7 +107,15 @@ class TPUBackend(CacheListener):
         self._session_assumed: set = set()
         self._node_fps: Dict[str, tuple] = {}  # heartbeat-change gate
         self._known_templates: Dict = {}  # fingerprint -> pod arrays
-        self._pending: Optional[_BatchHandle] = None  # one in-flight batch
+        # in-flight batches, oldest first. Depth 2 double-buffers the
+        # device: batch k+1's scan is enqueued (chained on k's carry as a
+        # pure data dependency) while k still runs, so the device never
+        # drains between the host's harvest of k-1 and the dispatch of
+        # k+1. Harvests are strictly FIFO — sequential assume semantics
+        # ride the carry chain, and the host encoding applies each
+        # batch's decisions in dispatch order (_harvest_locked).
+        self._pending: deque = deque()  # of _BatchHandle
+        self.max_pending = 2
         self.MAX_SESSION_TEMPLATES = 8
         self.volume_resolver = None  # scheduler/volume_device.py
         # pallas rides only on real TPUs: on CPU (tests, dryruns) the
@@ -374,20 +383,25 @@ class TPUBackend(CacheListener):
     # The session dispatch is ASYNC (HoistedSession.schedule returns device
     # arrays without blocking; batch k+1's scan chains on k's carry as a
     # pure data dependency). dispatch_many/harvest expose that to the
-    # scheduler loop: it dispatches batch k+1, then harvests/binds batch k
-    # while the device scans — the same overlap bench.py's kernel-direct
-    # pipeline exploits, now in the production loop.
+    # scheduler loop's three-stage pipeline (scheduler.py): the scheduler
+    # thread encodes + dispatches batch k+1, the device scans batch k
+    # (double-buffered — up to max_pending enqueued scans), and the
+    # completion worker harvests + assumes + binds batch k-1. Exactness
+    # rides the PERF_NOTES invariant: batchable assumes touch only the
+    # carry (utilization + PTS pair counts), so the prologue stays valid
+    # and no host pod-table sync is needed between pipelined batches.
 
     def dispatch_many(self, pods: List[v1.Pod]) -> "_BatchHandle":
-        """Dispatch a batch; returns a handle for harvest(). One batch may
-        be outstanding — a second dispatch harvests the first. Falls back
-        to the synchronous path (ready handle) when the batch can't ride
-        the live session (bound pods, mixed shapes, unknown templates or
-        no session yet — the session builds on the synchronous path and
-        subsequent batches pipeline)."""
+        """Dispatch a batch; returns a handle for harvest(). Up to
+        `max_pending` batches may be outstanding (the device double
+        buffer) — a dispatch beyond that harvests the OLDEST first.
+        Falls back to the synchronous path (ready handle) when the batch
+        can't ride the live session (bound pods, mixed shapes, unknown
+        templates or no session yet — the session builds on the
+        synchronous path and subsequent batches pipeline)."""
         h = _BatchHandle(list(pods))
         with self._lock:
-            if self._pending is not None:
+            while len(self._pending) >= max(1, self.max_pending):
                 self._harvest_locked()
             if pods and self._session is not None and all(
                 not p.spec.node_name for p in pods
@@ -414,29 +428,43 @@ class TPUBackend(CacheListener):
                     h.ys = self._session.schedule(clean)  # async, no block
                     h.decide = type(self._session).decisions
                     h.node_names = list(self.enc.node_names)
-                    self._pending = h
+                    self._pending.append(h)
                     return h
             h.results = self.schedule_many(pods)  # re-entrant: RLock
         return h
 
     def harvest(self, handle: "_BatchHandle") -> List[Tuple[v1.Pod, Optional[str]]]:
+        ys = handle.ys
+        if ys is not None and handle.results is None:
+            # wait for the device OUTSIDE the backend lock: the
+            # completion worker parking here must not block the
+            # scheduler thread's next dispatch (the whole point of the
+            # pipeline). The ys arrays are plain outputs — only the
+            # carry is donated — so waiting on them unlocked is safe.
+            import jax
+
+            try:
+                jax.block_until_ready(ys)
+            except Exception:  # noqa: BLE001 — decode() surfaces errors
+                pass
         with self._lock:
-            if handle.results is None and self._pending is handle:
+            # strictly FIFO: older batches' decisions are ground truth
+            # for this one — land them first
+            while handle.results is None and self._pending:
                 self._harvest_locked()
         assert handle.results is not None, "harvest of an abandoned handle"
         return handle.results
 
     def _flush_pending(self) -> None:
-        """Apply an outstanding batch's assumes to the host encoding.
+        """Apply every outstanding batch's assumes to the host encoding.
         MUST run (under the lock) before anything treats the encoding as
         ground truth — session rebuilds and the one-pod schedule() path —
         or the rebuilt carry would miss those pods."""
-        if self._pending is not None:
+        while self._pending:
             self._harvest_locked()
 
     def _harvest_locked(self) -> None:
-        h = self._pending
-        self._pending = None
+        h = self._pending.popleft()
         decisions = h.decide(h.ys)
         results: List[Tuple[v1.Pod, Optional[str]]] = []
         for g, best in zip(h.group, decisions):

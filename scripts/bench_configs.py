@@ -10,7 +10,7 @@ util.go:61 mustSetupScheduler), so the headline numbers must reproduce
 through the same full loop here.
 
 Usage: python scripts/bench_configs.py [config-name ...]
-(no args = all five; names: basic, default5000, pts20k, ipachurn, gang)
+(no args = the full matrix; see CONFIGS for the names)
 """
 
 from __future__ import annotations
@@ -208,6 +208,39 @@ CONFIGS = {
         num_pods=5000,
         init_template=PodTemplate(with_pvc="zonal"),
         template=PodTemplate(with_pvc="zonal"),
+        max_batch=2048, timeout=900.0,
+    ),
+    # -- 5000-node affinity variants: the reference's matrix runs every
+    #    affinity workload at BOTH 500 and 5000 nodes
+    #    (performance-config.yaml:137-272); only the 500n halves were
+    #    recorded through r5 ---------------------------------------------
+    "podaffinity5000": Workload(
+        "SchedulingPodAffinity-5000n", num_nodes=5000, num_init_pods=2048,
+        num_pods=5000,
+        init_template=PodTemplate(labels={"app": "aff"}),
+        template=PodTemplate(pod_affinity_zone=True, labels={"app": "aff"}),
+        max_batch=2048, timeout=900.0,
+    ),
+    "prefaffinity5000": Workload(
+        "SchedulingPreferredPodAffinity-5000n", num_nodes=5000,
+        num_init_pods=2048, num_pods=5000,
+        init_template=PodTemplate(labels={"app": "aff"}),
+        template=PodTemplate(preferred_affinity_zone=True,
+                             labels={"app": "aff"}),
+        max_batch=2048, timeout=900.0,
+    ),
+    "prefantiaffinity5000": Workload(
+        "SchedulingPreferredPodAntiAffinity-5000n", num_nodes=5000,
+        num_init_pods=2048, num_pods=5000,
+        init_template=PodTemplate(labels={"app": "aff"}),
+        template=PodTemplate(preferred_anti_affinity_zone=True,
+                             labels={"app": "aff"}),
+        max_batch=2048, timeout=900.0,
+    ),
+    "nodeaffinity5000": Workload(
+        "SchedulingNodeAffinity-5000n", num_nodes=5000,
+        num_init_pods=2048, num_pods=5000,
+        template=PodTemplate(node_affinity_zones=["zone-0", "zone-1"]),
         max_batch=2048, timeout=900.0,
     ),
 }

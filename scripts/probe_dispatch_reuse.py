@@ -1,0 +1,97 @@
+"""Bisect the ~580ms fixed pallas dispatch cost on the real chip:
+AOT persistent-executable reuse vs plain jit dispatch, and the
+batch-size slope (fixed cost = extrapolation of wall(B=128) vs
+wall(B=1024) to B=0).
+
+Usage (on the chip): python scripts/probe_dispatch_reuse.py
+Env: PROBE_NODES (5000), PROBE_BATCHES (8).
+
+Every timing is taken AFTER one device->host read (the tunnel's
+deferred mode makes un-synced timings enqueue-cost illusions — see
+PERF_NOTES "The axon tunnel's two execution modes").
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+from kubernetes_tpu.utils.compilation_cache import (  # noqa: E402
+    enable_persistent_cache,
+)
+
+enable_persistent_cache()
+
+import numpy as np  # noqa: E402
+
+from kubernetes_tpu.models.encoding import ClusterEncoding  # noqa: E402
+from kubernetes_tpu.models.pod_encoder import PodEncoder  # noqa: E402
+from kubernetes_tpu.ops.hoisted import template_fingerprint  # noqa: E402
+from kubernetes_tpu.ops.pallas_scan import PallasSession  # noqa: E402
+from kubernetes_tpu.testing.synth import (  # noqa: E402
+    synth_cluster,
+    synth_pending_pods,
+)
+
+
+def _measure(aot: bool, nodes, init_pods, pending, batches, B):
+    os.environ["KTPU_PALLAS_AOT"] = "1" if aot else "0"
+    enc = ClusterEncoding()
+    enc.set_cluster(nodes, init_pods)
+    pe = PodEncoder(enc)
+    arrays = [
+        {k: v for k, v in pe.encode(p).items() if not k.startswith("_")}
+        for p in pending
+    ]
+    templates, seen = [], set()
+    for a in arrays:
+        fp = template_fingerprint(a)
+        if fp not in seen:
+            seen.add(fp)
+            templates.append(a)
+    sess = PallasSession(enc.device_state(), templates)
+    # warm: compile + flip the tunnel into honest sync mode
+    PallasSession.decisions(sess.schedule(arrays[:B]))
+    dts = []
+    for i in range(1, batches + 1):
+        t0 = time.perf_counter()
+        ys = sess.schedule(arrays[i * B:(i + 1) * B])
+        PallasSession.decisions(ys)  # blocks: one dispatch, end to end
+        dts.append(time.perf_counter() - t0)
+    return dts
+
+
+def main() -> None:
+    n_nodes = int(os.environ.get("PROBE_NODES", "5000"))
+    batches = int(os.environ.get("PROBE_BATCHES", "8"))
+    nodes, init_pods = synth_cluster(n_nodes, pods_per_node=2)
+    out = {}
+    for B in (128, 1024):
+        pending = synth_pending_pods((batches + 1) * B, spread=True)
+        for aot in (False, True):
+            dts = _measure(aot, nodes, init_pods, pending, batches, B)
+            med = sorted(dts)[len(dts) // 2]
+            out[(B, aot)] = med
+            print(f"B={B:5d} aot={int(aot)}: median {med * 1000:.1f}ms "
+                  f"/dispatch ({1000 * med / B:.2f}ms/pod); "
+                  f"all {[round(d * 1000) for d in dts]}",
+                  flush=True)
+    for aot in (False, True):
+        # wall(B) = fixed + B*marginal -> solve from the two batch sizes
+        a, b = out[(128, aot)], out[(1024, aot)]
+        marginal = (b - a) / (1024 - 128)
+        fixed = a - 128 * marginal
+        print(f"aot={int(aot)}: fixed ~{fixed * 1000:.0f}ms, "
+              f"marginal ~{marginal * 1e6:.0f}us/pod")
+
+
+if __name__ == "__main__":
+    main()

@@ -114,6 +114,42 @@ class TestStreamingWatch:
         finally:
             w.stop()
 
+    def test_watch_frames_do_not_leak_across_servers(self):
+        """Two apiservers in one process mint colliding (key, revision,
+        type) triples for DIFFERENT objects; a process-global frame memo
+        served server A's cached bytes to server B's watcher (ADVICE
+        high). The memo is per hub now: each watcher must stream its own
+        cluster's object."""
+        srv_a = HTTPAPIServer(api=APIServer()).start()
+        srv_b = HTTPAPIServer(api=APIServer()).start()
+        try:
+            ra = RemoteAPIServer(srv_a.address)
+            rb = RemoteAPIServer(srv_b.address)
+            _, rev_a = ra.list("pods", "default")
+            _, rev_b = rb.list("pods", "default")
+            wa = ra.watch("pods", "default", since_revision=rev_a)
+            wb = rb.watch("pods", "default", since_revision=rev_b)
+            try:
+                # same name + namespace -> same store key; fresh stores
+                # -> same revision: the memo keys collide exactly
+                pa = make_pod("twin")
+                pa.metadata.labels = {"cluster": "a"}
+                pb = make_pod("twin")
+                pb.metadata.labels = {"cluster": "b"}
+                ra.create("pods", pa)
+                rb.create("pods", pb)
+                ev_a = wa.poll(timeout=10)
+                ev_b = wb.poll(timeout=10)
+                assert ev_a is not None and ev_b is not None
+                assert ev_a.object.metadata.labels == {"cluster": "a"}
+                assert ev_b.object.metadata.labels == {"cluster": "b"}
+            finally:
+                wa.stop()
+                wb.stop()
+        finally:
+            srv_a.stop()
+            srv_b.stop()
+
     def test_informer_over_the_wire(self, wire):
         _, remote = wire
         cs = Clientset(remote)

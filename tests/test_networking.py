@@ -390,6 +390,39 @@ class TestAdviceR4Fixes:
         anon = Endpoint("default", {"app": "db"}, "10.0.0.3")
         assert not ev.allowed(web, anon, 5432)
 
+    def test_named_policy_port_matches_per_protocol(self):
+        """Named ports resolve per (name, protocol): a UDP "web"
+        container port must not satisfy a TCP policy port (and vice
+        versa) — the lookup matches both fields (types.go)."""
+        udp_db = Endpoint("default", {"app": "db"}, "10.0.0.2",
+                          named_ports={"web": (5432, "UDP")})
+        web = Endpoint("default", {"app": "web"}, "10.0.0.1")
+        pol = _pol("db-in", "default", {"app": "db"}, ingress=[
+            networking.NetworkPolicyIngressRule(
+                from_=[networking.NetworkPolicyPeer(
+                    pod_selector=v1.LabelSelector(match_labels={"app": "web"})
+                )],
+                ports=[networking.NetworkPolicyPort(
+                    protocol="TCP", port="web")],
+            ),
+        ])
+        ev = NetworkPolicyEvaluator([pol])
+        # the policy's TCP "web" resolves to nothing on a pod whose
+        # "web" port is UDP: no rule matches, default-deny for selected
+        assert not ev.allowed(web, udp_db, 5432)
+        assert not ev.allowed(web, udp_db, 5432, protocol="UDP")
+        # the same shape with a matching protocol passes
+        tcp_db = Endpoint("default", {"app": "db"}, "10.0.0.4",
+                          named_ports={"web": (5432, "TCP")})
+        assert ev.allowed(web, tcp_db, 5432)
+        # from_pod carries the container port's declared protocol
+        pod = make_pod("udp-pod")
+        pod.spec.containers[0].ports = [v1.ContainerPort(
+            name="web", container_port=5432, protocol="UDP")]
+        pod.metadata.labels = {"app": "db"}
+        pod.status.pod_ip = "10.0.0.5"
+        assert not ev.allowed(web, Endpoint.from_pod(pod), 5432)
+
     def test_named_port_from_pod_and_serde_roundtrip(self):
         from kubernetes_tpu.utils import serde
 
@@ -398,7 +431,7 @@ class TestAdviceR4Fixes:
             v1.ContainerPort(name="metrics", container_port=9090)]
         pod.status.pod_ip = "10.0.0.7"
         ep = Endpoint.from_pod(pod)
-        assert ep.named_ports == {"metrics": 9090}
+        assert ep.named_ports == {"metrics": (9090, "TCP")}
         npp = networking.NetworkPolicyPort(port="metrics")
         back = serde.from_dict(
             networking.NetworkPolicyPort, serde.to_dict(npp))

@@ -279,6 +279,64 @@ class TestPallasTerms:
                       pref=(40, {"w": "c"}, True)))
         assert got == ref
 
+    @staticmethod
+    def _pref_only_affinity(weight, labels, anti=False):
+        """Preferred-only terms at harness weight (no required terms) —
+        the SchedulingPreferredPod(Anti)Affinity template shape."""
+        pterm = v1.WeightedPodAffinityTerm(
+            weight=weight,
+            pod_affinity_term=v1.PodAffinityTerm(
+                label_selector=v1.LabelSelector(match_labels=dict(labels)),
+                topology_key=v1.LABEL_ZONE,
+            ),
+        )
+        if anti:
+            return v1.Affinity(pod_anti_affinity=v1.PodAntiAffinity(
+                preferred_during_scheduling_ignored_during_execution=[pterm]))
+        return v1.Affinity(pod_affinity=v1.PodAffinity(
+            preferred_during_scheduling_ignored_during_execution=[pterm]))
+
+    @pytest.mark.parametrize("anti", [False, True])
+    def test_weight100_preferred_rides_pallas(self, anti):
+        """The bench Preferred-affinity templates (weight-100 preferred
+        zone terms toward self labels) must BUILD a PallasSession — the
+        w45 GCD rescale keeps the exact-f32 guard satisfied (these
+        configs silently rode the ~4x-slower HoistedSession for two
+        rounds) — and the decisions must stay bit-identical."""
+        nodes = self._nodes(12)
+        aff = self._pref_only_affinity(100, {"app": "aff"}, anti=anti)
+        # plain init-template pods plus weighted-preferred pods, mixed:
+        # cross-template D4/D5 weight rows are where the scale applies
+        pending = []
+        for i in range(18):
+            if i % 3 == 0:
+                pending.append(make_pod(f"pl-{i}", labels={"app": "aff"}))
+            else:
+                pending.append(make_pod(
+                    f"pr-{i}", labels={"app": "aff"}, affinity=aff))
+        ref, got = _run_pair(nodes, [], pending, batch=6)
+        assert got == ref
+
+    @pytest.mark.parametrize("anti", [False, True])
+    def test_weight100_preferred_builds_pallas_session(self, anti):
+        """Construction-level gate (no kernel launch — runs on any
+        host): the weight-100 preferred template must not raise
+        PallasUnsupported(ipa-score-weights), and the GCD scale must be
+        recorded for the kernel's multiply-back."""
+        nodes = self._nodes(12)
+        aff = self._pref_only_affinity(100, {"app": "aff"}, anti=anti)
+        pending = [make_pod("pl-0", labels={"app": "aff"})] + [
+            make_pod(f"pr-{i}", labels={"app": "aff"}, affinity=aff)
+            for i in range(3)
+        ]
+        enc, pe = _presized_encoding(nodes, [], pending)
+        arrays = _encode_all(enc, pe, pending)
+        sess = PallasSession(enc.device_state(), _templates_of(arrays),
+                             interpret=True)
+        assert sess._ipa is not None
+        assert sess._ipa["w45_scale"] == 100
+        assert int(np.abs(sess._ipa["w45"]).sum(axis=1).max()) < 256
+
     def test_cross_template_anti(self):
         # template A's anti terms must repel template B pods assumed in
         # the SAME session (D1 across templates)

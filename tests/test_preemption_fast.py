@@ -365,15 +365,22 @@ class TestPDBParityFuzz:
                 f"n{i}", cpu=str(rng.choice([2, 4, 8])), memory="16Gi",
                 pods=rng.choice([4, 6, 110]),
             ))
-            for j in range(rng.randint(2, 4)):
-                pods.append(make_pod(
+            for j in range(rng.randint(2, 6)):
+                pod = make_pod(
                     f"p{i}-{j}",
                     cpu=f"{rng.choice([900, 1500, 2000, 2500])}m",
                     memory=rng.choice(["64Mi", "512Mi"]),
                     node_name=f"n{i}",
                     priority=rng.choice([0, 1, 5, 50]),
                     labels={"app": rng.choice(apps)},
-                ))
+                )
+                # randomized start times: MoreImportantPod order (prio
+                # desc, start asc) must genuinely differ from ni.pods
+                # order, or the allowance-consumption-order contract
+                # (:612 sort before filterPodsWithPDBViolation) is
+                # untested
+                pod.status.start_time = rng.random() * 100.0
+                pods.append(pod)
         pdbs = []
         for k in range(rng.randint(1, 2)):
             pdbs.append(v1.PodDisruptionBudget(
@@ -383,10 +390,53 @@ class TestPDBParityFuzz:
                         match_labels={"app": rng.choice(apps)}),
                 ),
                 status=v1.PodDisruptionBudgetStatus(
-                    disruptions_allowed=rng.choice([0, 1, 3]),
+                    # 1/2/3 with up to 6 matching victims per node: the
+                    # PARTIALLY consumable range, where which victims
+                    # land in the violating group depends entirely on
+                    # consumption order
+                    disruptions_allowed=rng.choice([0, 1, 2, 3]),
                 ),
             ))
         return nodes, pods, pdbs
+
+    def test_pdb_partial_budget_consumed_in_importance_order(self):
+        """A budget covering MORE victims than it allows must be
+        consumed in MoreImportantPod order (priority desc, earlier start
+        first — the :612 sort runs before filterPodsWithPDBViolation),
+        so the LEAST important victims land in the violating group.
+        Consuming in ni.pods order instead flips which pods violate, and
+        the violating-first eviction ORDER makes that observable."""
+        nodes = [make_node("n0", cpu="4", memory="16Gi", pods=110)]
+        specs = [  # (name, priority, start) in ni.pods order
+            ("p0", 0, 5.0), ("p1", 10, 1.0), ("p2", 10, 3.0), ("p3", 5, 2.0),
+        ]
+        pods = []
+        for name, prio, start in specs:
+            p = make_pod(name, cpu="900m", node_name="n0", priority=prio,
+                         labels={"app": "db"})
+            p.status.start_time = start
+            pods.append(p)
+        pdb = v1.PodDisruptionBudget(
+            metadata=v1.ObjectMeta(name="db-pdb", namespace="default"),
+            spec=v1.PodDisruptionBudgetSpec(
+                selector=v1.LabelSelector(match_labels={"app": "db"})),
+            status=v1.PodDisruptionBudgetStatus(disruptions_allowed=2),
+        )
+        snapshot = Snapshot.from_objects(pods, nodes)
+        # needs every victim gone: no reprieve, all four evicted
+        pending = make_pod("high", cpu="3900m", priority=100)
+        planner = FastPreemptionPlanner(snapshot, None, pdbs=[pdb])
+        (cand,) = planner.plan([pending])
+        assert cand is not None and not planner.fits_now[0]
+        # consumption order p1(10,1) p2(10,3) p3(5) p0(0): the budget's
+        # two allowances go to p1+p2, so p3+p0 violate — and evict FIRST
+        assert cand.num_pdb_violations == 2
+        assert [p.metadata.name for p in cand.victims] == \
+            ["p3", "p0", "p1", "p2"]
+        result, status = _post_filter(snapshot, pending, pdbs=[pdb])
+        assert result is not None
+        assert [p.metadata.name for p in result.victims] == \
+            [p.metadata.name for p in cand.victims]
 
     def test_matches_oracle_with_pdbs(self):
         rng = random.Random(21)

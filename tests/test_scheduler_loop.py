@@ -85,6 +85,35 @@ def test_pods_get_bound(backend):
         sched.informers.stop()
 
 
+@pytest.mark.parametrize("depth", [0, 1, 3])
+def test_pods_get_bound_at_any_pipeline_depth(depth):
+    """The full loop binds everything at every pipeline depth — depth 0
+    (sequential), 1 (single-buffered), and beyond the default. The
+    bit-parity gate over randomized churn is tests/test_pipeline_parity.py;
+    this pins the live loop's drain paths (idle/pause/stop) per depth."""
+    api, cs = _cluster()
+    factory = SharedInformerFactory(cs)
+    sched = Scheduler(cs, factory, backend="tpu", pipeline_depth=depth)
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    try:
+        for i in range(12):
+            cs.pods.create(make_pod(f"p-{i}", namespace="default", cpu="100m",
+                                    labels={"app": "web"}))
+        sched.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            pods, _ = cs.pods.list(namespace="default")
+            if all(p.spec.node_name for p in pods):
+                break
+            time.sleep(0.1)
+        pods, _ = cs.pods.list(namespace="default")
+        assert all(p.spec.node_name for p in pods)
+    finally:
+        sched.stop()
+        sched.informers.stop()
+
+
 @pytest.mark.parametrize("backend", ["oracle", "tpu"])
 def test_unschedulable_then_node_arrives(backend):
     """A pod too big for every node parks in unschedulableQ; adding a
