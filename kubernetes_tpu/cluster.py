@@ -77,6 +77,7 @@ class Cluster:
         metrics_server: bool = False,
         node_config: Optional[Dict] = None,
         controller_opts: Optional[Dict] = None,
+        fault_injector=None,
     ):
         # save the process-global gate overrides so stop() can restore them
         # (gates must not leak across Cluster instances)
@@ -94,6 +95,7 @@ class Cluster:
                 metrics_server,
                 node_config,
                 controller_opts,
+                fault_injector,
             )
         except BaseException:
             default_feature_gate.restore(self._fg_saved)
@@ -112,6 +114,7 @@ class Cluster:
         metrics_server,
         node_config,
         controller_opts,
+        fault_injector=None,
     ) -> None:
         if feature_gates:
             default_feature_gate.set_from_string(feature_gates)
@@ -160,6 +163,10 @@ class Cluster:
         self.scheduler = create_scheduler(
             self.client, self._sched_factory, self.scheduler_config
         )
+        if fault_injector is not None:
+            # fault drills (scripts/fault_drill.py, ChaosMonkey
+            # wedge-device/crash-scheduler) arm device/worker faults here
+            self.scheduler.install_fault_injector(fault_injector)
         self.metrics_server = None
         if metrics_server:
             from .api.metrics import MetricsServer
@@ -200,7 +207,10 @@ class Cluster:
     def _teardown(self) -> None:
         for closer in (
             self.metrics_server.stop if self.metrics_server is not None else None,
-            self.scheduler.stop,
+            # shutdown (vs stop) joins the pipeline worker threads and
+            # flushes the completion FIFO deterministically — tests must
+            # not lean on daemon-thread teardown
+            self.scheduler.shutdown,
             self._sched_factory.stop,
             self.kcm.stop,
             self.hollow.stop if self.hollow is not None else None,

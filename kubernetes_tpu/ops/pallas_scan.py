@@ -787,11 +787,43 @@ class PallasSession:
             self._fps, self._tp_np, pod_arrays_list, minimum=LANE)
         meta, match = self._pack_batch(B, Bp, tmpl, mfa, msa)
         out = self._run_dispatch(meta, match)
-        return {"rows": out, "n": B}
+        # bucket rides the result so a harvest-side device fault can
+        # retire exactly the executable that produced the bad payload
+        # (tpu_backend.py retry path)
+        return {"rows": out, "n": B, "bucket": Bp}
 
     @staticmethod
     def decisions(ys) -> List[int]:
         return [int(v) for v in np.asarray(ys["rows"])[0, :ys["n"]]]
+
+    def retire_exec(self, bucket: Optional[int] = None,
+                    mode: Optional[str] = None) -> int:
+        """Retire AOT executables after a device fault: a dispatch that
+        raised, wedged, or harvested garbage leaves its compiled program
+        suspect. Entries are pinned to None (= dispatch through jit), the
+        same retired state the arg-mismatch path uses — warm_buckets
+        never resurrects a retired entry, and _run_dispatch never
+        recompiles one. With `bucket` given, absent entries are pinned
+        too: the backend quarantines a suspect bucket on every REBUILT
+        session (the _exec cache dies with its session, but the fault
+        does not), and lifts it only after the bucket harvests cleanly
+        through jit. bucket/mode both None retires every existing
+        entry. Returns the number of entries pinned."""
+        n = 0
+        modes = (mode,) if mode is not None else ("full", "eval", "apply")
+        if bucket is not None:
+            for m in modes:
+                if self._exec.get((bucket, m), _MISSING) is not None:
+                    self._exec[(bucket, m)] = None
+                    n += 1
+            return n
+        for key in list(self._exec):
+            if mode is not None and key[1] != mode:
+                continue
+            if self._exec.get(key) is not None:
+                self._exec[key] = None
+                n += 1
+        return n
 
     # -- dispatch plumbing: persistent executables ------------------------
 

@@ -1,0 +1,160 @@
+"""Backend degradation ladder: pallas -> hoisted -> oracle under faults.
+
+The TPU scoring backend assumes the device answers; production hardware
+does not always oblige (preempted chips, XLA runtime errors, hung
+collectives). The ladder is the containment policy for PERSISTENT device
+faults: after `threshold` consecutive faults the backend demotes one
+rung — pallas (single-launch Mosaic scan) -> hoisted (jnp lax.scan) ->
+oracle (host Go-semantics path, no device at all) — and keeps scheduling
+at the lower rung instead of crash-looping the pipeline. A background
+probe (tpu_backend.TPUBackend._probe_loop) re-runs a canary dispatch with
+a known answer; when the device answers correctly again the ladder
+promotes one rung back, with the probe cadence backing off (capped, full
+jitter) while the device stays sick so a flapping chip cannot whipsaw the
+session cache.
+
+The active rung is exported as the `scheduler_backend_mode` gauge
+(2=pallas, 1=hoisted, 0=oracle); demotions/promotions also count on the
+ladder object itself for drills (scripts/fault_drill.py).
+
+One transient fault never demotes: the dispatch retry path (bounded
+attempts, capped exponential backoff + jitter — mirroring the
+controllers/manager.Supervisor restart policy) absorbs it, and a clean
+harvest resets the consecutive-fault count.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+from .metrics import backend_mode
+
+# ladder rungs, ordered: demotion decrements, promotion increments
+RUNG_ORACLE = 0  # host Go-semantics path; no device dispatch at all
+RUNG_HOISTED = 1  # jnp lax.scan session (the ~2.4x-slower fallback)
+RUNG_PALLAS = 2  # single-launch Mosaic scan (real-TPU fast path)
+
+RUNG_NAMES = {RUNG_ORACLE: "oracle", RUNG_HOISTED: "hoisted",
+              RUNG_PALLAS: "pallas"}
+
+
+class DeviceFault(Exception):
+    """A device dispatch failed: the launch raised, the wait exceeded the
+    watchdog, or the harvested payload failed the finite/in-range guard.
+    `kind` feeds the scheduler_device_faults_total counter."""
+
+    def __init__(self, message: str = "", kind: str = "raise"):
+        super().__init__(message)
+        self.kind = kind
+
+
+class DegradationLadder:
+    """Fault accounting + rung state machine; thread-safe (dispatches,
+    the completion worker, and the probe thread all touch it)."""
+
+    def __init__(
+        self,
+        top: int = RUNG_PALLAS,
+        threshold: int = 3,
+        probe_interval: float = 1.0,
+        probe_max: float = 30.0,
+        rng: Optional[random.Random] = None,
+    ):
+        self.top = top
+        self.threshold = max(1, threshold)
+        self._rung = top
+        self._consecutive = 0
+        self._probe_interval = probe_interval
+        self._probe_max = probe_max
+        self._probe_delay = probe_interval
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        self.demotions = 0
+        self.promotions = 0
+        backend_mode.set(self._rung)
+
+    # -- state -------------------------------------------------------------
+
+    def rung(self) -> int:
+        with self._lock:
+            return self._rung
+
+    def mode(self) -> str:
+        return RUNG_NAMES[self.rung()]
+
+    def healthy(self) -> bool:
+        with self._lock:
+            return self._rung >= self.top and self._consecutive == 0
+
+    # -- fault accounting --------------------------------------------------
+
+    def record_fault(self, kind: str = "raise") -> bool:
+        """One device fault; returns True when THIS fault crossed the
+        demotion threshold (the caller logs + starts the probe). The
+        counter is consecutive: any clean harvest resets it."""
+        with self._lock:
+            self._consecutive += 1
+            if self._consecutive >= self.threshold and self._rung > RUNG_ORACLE:
+                self._demote_locked()
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            if self._rung >= self.top:
+                # genuinely healthy at the top rung: restore the probe
+                # cadence (promotion alone does NOT — see on_probe)
+                self._probe_delay = self._probe_interval
+
+    def demote(self) -> bool:
+        """Unconditional demotion (pipeline-stall escape hatch: a drain
+        that exceeds even the watchdog-bounded budget)."""
+        with self._lock:
+            if self._rung <= RUNG_ORACLE:
+                return False
+            self._demote_locked()
+            return True
+
+    def _demote_locked(self) -> None:
+        self._rung -= 1
+        self.demotions += 1
+        self._consecutive = 0
+        # flap hysteresis: each demotion doubles the probe cadence
+        # (capped). The probe canary vouches for the DEVICE, not for the
+        # kernel at the target rung — a kernel-level fault (garbage from
+        # one workload shape) passes the probe, re-promotes, and faults
+        # again; without this the demote/promote cycle would whipsaw at
+        # probe_interval forever. With it the flap rate decays to once
+        # per probe_max.
+        self._probe_delay = min(self._probe_delay * 2, self._probe_max)
+        backend_mode.set(self._rung)
+
+    # -- probe / re-promotion ----------------------------------------------
+
+    def probe_delay(self) -> float:
+        """Next probe wait: current backoff with full jitter."""
+        with self._lock:
+            return self._probe_delay * (1 + self._rng.random())
+
+    def on_probe(self, ok: bool) -> bool:
+        """Probe verdict. A clean canary promotes ONE rung (stepwise —
+        pallas confidence is rebuilt through hoisted, not assumed); a
+        failed one doubles the cadence (capped). Promotion does NOT
+        restore the cadence — only a clean harvest at the top rung does
+        (record_success) — so a workload that faults right after every
+        re-promotion keeps the backed-off cadence and the flapping stays
+        bounded."""
+        with self._lock:
+            if ok:
+                if self._rung >= self.top:
+                    return False
+                self._rung += 1
+                self.promotions += 1
+                self._consecutive = 0
+                backend_mode.set(self._rung)
+                return True
+            self._probe_delay = min(self._probe_delay * 2, self._probe_max)
+            return False

@@ -102,6 +102,50 @@ scheduling_attempt_duration = legacy_registry.register(
         buckets=tuple(0.001 * 2**i for i in range(20)),
     )
 )
+backend_mode = legacy_registry.register(
+    Gauge(
+        "scheduler_backend_mode",
+        "Active scoring-backend rung of the degradation ladder "
+        "(TPU-build metric): 2=pallas single-launch, 1=hoisted jnp scan, "
+        "0=oracle (host Go-semantics path). Anything below the platform's "
+        "top rung means the backend demoted itself after consecutive "
+        "device faults and a background probe is working on re-promotion "
+        "— alert on a sustained drop.",
+        (),
+    )
+)
+device_faults = legacy_registry.register(
+    Counter(
+        "scheduler_device_faults_total",
+        "Device dispatch faults seen by the TPU backend, by kind: "
+        "kind=raise (launch/dispatch raised), kind=timeout (a pending "
+        "scan exceeded the dispatch watchdog — wedged device wait), "
+        "kind=invalid (harvested masks/scores failed the finite/in-range "
+        "guard before assume). Enough consecutive faults demote the "
+        "backend one ladder rung (scheduler_backend_mode).",
+        ("kind",),
+    )
+)
+dispatch_retries = legacy_registry.register(
+    Counter(
+        "scheduler_dispatch_retries_total",
+        "Device dispatches re-driven after a fault: session rebuild + "
+        "capped exponential backoff with jitter (the Supervisor's restart "
+        "policy at dispatch granularity). A retry storm without matching "
+        "binds means the retry budget is being burned on a sick device.",
+        (),
+    )
+)
+worker_restarts = legacy_registry.register(
+    Counter(
+        "scheduler_worker_restarts_total",
+        "Scheduling-pipeline worker threads (worker=scheduler | "
+        "completion) restarted by the in-process supervision wrapper "
+        "after a crash; the in-flight dispatch FIFO is drained back to "
+        "the scheduling queue before the restart.",
+        ("worker",),
+    )
+)
 session_builds = legacy_registry.register(
     Counter(
         "scheduler_tpu_session_builds_total",

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import copy
 import logging
+import os
 import random
 import threading
 import traceback
@@ -54,9 +55,23 @@ from .internal.queue import PriorityQueue
 from . import preemption as fast_preemption
 from .plugins.defaultpreemption import get_lower_priority_nominated_pods
 from .plugins.registry import default_plugins, new_in_tree_registry
+from .degradation import RUNG_ORACLE, DeviceFault
 from .tpu_backend import TPUBackend
 
 logger = logging.getLogger(__name__)
+
+
+class WorkerKilled(Exception):
+    """A pipeline worker thread was told to die (FaultInjector kill seam
+    / ChaosMonkey crash-scheduler). Escapes the per-iteration isolation
+    so the supervision wrapper sees a real crash."""
+
+
+class PipelineStalled(RuntimeError):
+    """_drain_pipeline exceeded its timeout: in-flight batches did not
+    land even though every device wait is watchdog-bounded. The raiser
+    has already demoted the ladder; callers requeue their pods instead
+    of blocking the scheduler forever."""
 
 
 def _has_required_anti_affinity(pod: v1.Pod) -> bool:
@@ -186,6 +201,15 @@ class Scheduler:
         self._victim_waiters: Dict[str, str] = {}  # victim key -> node
         self._inflight_preemptors: set = set()  # pod keys
         self._thread: Optional[threading.Thread] = None
+        # device-fault plumbing: the injector seam (None in production),
+        # and the drain budget — generous relative to the backend's
+        # dispatch watchdog, which is what actually unsticks a wedged
+        # wait; the drain timeout is the second line of defense
+        self.faults = None
+        self.drain_timeout = (
+            float(os.environ["KTPU_DRAIN_TIMEOUT"])
+            if "KTPU_DRAIN_TIMEOUT" in os.environ else None
+        )
         self._binders = ThreadPoolExecutor(max_workers=8, thread_name_prefix="binder")
         self._inflight = 0  # scheduling batches + binds not yet finished
         self._inflight_lock = threading.Lock()
@@ -303,9 +327,49 @@ class Scheduler:
 
     # -- run loop ----------------------------------------------------------
 
+    def install_fault_injector(self, inj) -> None:
+        """Wire a FaultInjector seam (testing/faults.py) into the
+        pipeline workers and the TPU backend — the ChaosMonkey
+        wedge-device / crash-scheduler disruptions arm faults on it."""
+        self.faults = inj
+        if self.tpu is not None:
+            self.tpu.faults = inj
+
+    def _check_kill(self, worker: str) -> None:
+        inj = self.faults
+        if inj is not None and inj.take_kill(worker):
+            raise WorkerKilled(worker)
+
+    def _supervised(self, name: str, fn, recover=None) -> None:
+        """Panic isolation for a pipeline worker thread (the Supervisor's
+        policy — controllers/manager.py — at thread granularity): a crash
+        is counted, recovered (in-flight work drained back to the queue),
+        and the loop restarts with fresh state under capped exponential
+        backoff + full jitter. A clean return (stop) ends supervision."""
+        backoff = 0.02
+        while not self._stop.is_set():
+            try:
+                fn()
+                return
+            except BaseException:  # noqa: BLE001 — isolation is the point
+                traceback.print_exc()
+                metrics.worker_restarts.inc(worker=name)
+                if recover is not None:
+                    try:
+                        recover()
+                    except Exception:  # noqa: BLE001 — recovery best-effort
+                        traceback.print_exc()
+                delay = min(backoff, 2.0) * (1 + 0.5 * self.rng.random())
+                backoff *= 2
+                if self._stop.wait(delay):
+                    return
+
     def start(self) -> None:
         if self._thread is None:
-            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread = threading.Thread(
+                target=self._supervised, args=("scheduler", self._run),
+                name="scheduler-loop", daemon=True,
+            )
             self._thread.start()
 
     def pause(self) -> None:
@@ -319,28 +383,52 @@ class Scheduler:
         self._paused.clear()
 
     def stop(self) -> None:
+        self.shutdown()
+
+    def shutdown(self, timeout: float = 30.0) -> bool:
+        """Deterministic teardown: stop the loop, land (or abandon) every
+        in-flight batch, JOIN every worker thread, shut the binder pool.
+        Idempotent. Returns True when every thread joined in time — the
+        test suites' no-leaked-threads contract (daemon-flag teardown is
+        the fallback, not the plan)."""
+        ok = True
         self._stop.set()
         self._permit_wake.set()  # let the permit drainer exit
         self.queue.close()
         if self._thread is not None:
-            self._thread.join(timeout=10)
+            self._thread.join(timeout=timeout)
+            ok &= not self._thread.is_alive()
         # the drainer submits released waves to the binder pool — join it
         # BEFORE the pool shuts down, or a mid-wave submit would raise and
         # strand the wave's assumed pods
         if self._permit_thread is not None:
-            self._permit_thread.join(timeout=10)
+            self._permit_thread.join(timeout=timeout)
+            ok &= not self._permit_thread.is_alive()
         if self.backend == "tpu":
             try:
                 # loop is dead; the completion worker lands the tail
                 # batches (it drains the queue before honoring _stop),
-                # and their binds must enter the pool before it shuts
-                self._drain_pipeline(timeout=30.0)
+                # and their binds must enter the pool before it shuts.
+                # Every device wait inside is watchdog-bounded, so this
+                # drain converges (or PipelineStalled demotes + escapes).
+                self._drain_pipeline(timeout=timeout)
             except Exception:  # noqa: BLE001 — teardown best-effort
                 traceback.print_exc()
         if self._completion_thread is not None:
             with self._completion_cv:
                 self._completion_cv.notify_all()
-            self._completion_thread.join(timeout=10)
+            self._completion_thread.join(timeout=timeout)
+            ok &= not self._completion_thread.is_alive()
+        if self._completions and (
+            self._completion_thread is None
+            or not self._completion_thread.is_alive()
+        ):
+            # worker gone with batches still queued (stall/crash at
+            # teardown): flush the FIFO deterministically — harvested
+            # batches bind, abandoned ones requeue their pods
+            self._recover_completions()
+        if self.tpu is not None:
+            self.tpu.close()  # stop the ladder probe thread
         self._binders.shutdown(wait=True)
         if not self.recorder.flush(timeout=5.0):  # events are async
             logger.warning(
@@ -348,12 +436,18 @@ class Scheduler:
                 "(%d events dropped during the run)",
                 self.recorder.dropped_events,
             )
+        return ok
 
     def _run(self) -> None:
         import time
 
         last_cleanup = time.monotonic()
         while not self._stop.is_set():
+            # kill seam OUTSIDE the isolation try: a WorkerKilled must
+            # reach the supervision wrapper, not the keep-alive except.
+            # It fires at the loop boundary — nothing popped, nothing in
+            # flight — so the restart needs no recovery pass.
+            self._check_kill("scheduler")
             try:
                 if self._paused.is_set():
                     if self.backend == "tpu":
@@ -429,6 +523,15 @@ class Scheduler:
     def _schedule_batch_tpu(self, infos: List) -> None:
         cycle = self.queue.scheduling_cycle
         todo = [i for i in infos if not self._skip(i.pod)]
+        if todo and self.tpu.ladder.rung() <= RUNG_ORACLE:
+            # degradation ladder fully demoted: no device dispatch at
+            # all — every pod rides the oracle until the background
+            # probe re-promotes the backend (degradation.py)
+            if not self._drain_or_requeue(todo):
+                return
+            for info in todo:
+                self._schedule_one_oracle(info)
+            return
         if self.framework is not None:
             # one partition pass: _needs_oracle runs a resolver pass for
             # PVC pods, and pending pods SHARING a claim within this
@@ -452,7 +555,8 @@ class Scheduler:
             if oracle_infos:
                 # the oracle schedules against the cache snapshot: every
                 # pipelined batch's assumes must land first
-                self._drain_pipeline()
+                if not self._drain_or_requeue(oracle_infos + todo):
+                    return
                 for info in oracle_infos:
                     self._schedule_one_oracle(info)
             # nominated-node short-circuit (generic_scheduler.go:235
@@ -468,7 +572,8 @@ class Scheduler:
             if nominated:
                 # feasibility runs on the cache snapshot — same drain
                 # requirement as the oracle path
-                self._drain_pipeline()
+                if not self._drain_or_requeue(todo):
+                    return
                 placed = self._place_nominated(nominated)
                 if placed:
                     todo = [i for i in todo if id(i) not in placed]
@@ -481,15 +586,25 @@ class Scheduler:
         # The device double-buffers (tpu.max_pending); the worker
         # preserves dispatch order. Depth 0 completes inline — the
         # sequential reference path the parity gate compares against.
-        handle = self.tpu.dispatch_many([i.pod for i in todo])
+        try:
+            handle = self.tpu.dispatch_many([i.pod for i in todo])
+        except Exception:  # noqa: BLE001 — the backend recovers its own
+            # faults internally; an escape here is defensive: the pods
+            # were never handed to the pipeline, so requeue exactly once
+            traceback.print_exc()
+            for info in todo:
+                self.queue.add(info.pod)
+            return
         if self.pipeline_depth <= 0:
             self._complete_batch(todo, handle, cycle)
             return
         with self._completion_cv:
             if self._completion_thread is None:
                 self._completion_thread = threading.Thread(
-                    target=self._completion_loop, name="batch-completions",
-                    daemon=True,
+                    target=self._supervised,
+                    args=("completion", self._completion_loop,
+                          self._recover_completions),
+                    name="batch-completions", daemon=True,
                 )
                 self._completion_thread.start()
             self._completions.append((todo, handle, cycle))
@@ -518,6 +633,10 @@ class Scheduler:
                 if not self._completions:
                     return  # stopped and fully drained
                 item = self._completions[0]
+            # kill seam OUTSIDE the per-batch isolation: the worker dies
+            # at a batch boundary (nothing harvested, nothing assumed)
+            # and the supervision wrapper recovers + restarts it
+            self._check_kill("completion")
             try:
                 self._complete_batch(*item)
             except Exception:  # the worker must outlive batch bugs:
@@ -530,23 +649,89 @@ class Scheduler:
                     self._completions.popleft()
                     self._completion_cv.notify_all()
 
+    def _recover_completions(self) -> None:
+        """Completion-worker crash recovery: restore the invariant
+        "every popped pod is either bound exactly once or back in the
+        queue" before the fresh worker starts. Not-yet-harvested device
+        batches are abandoned at the backend (their results resolve to
+        RETRY_NODE; nothing of theirs ever touched the host encoding),
+        then every queued completion is run to its terminal state:
+        already-decided batches assume + bind exactly once, abandoned
+        ones send their pods back to the scheduling queue."""
+        if self.tpu is not None:
+            self.tpu.abandon_pending()
+        while True:
+            with self._completion_cv:
+                if not self._completions:
+                    self._completion_cv.notify_all()
+                    return
+                item = self._completions[0]
+            try:
+                self._complete_batch(*item)
+            except Exception:  # noqa: BLE001 — keep flushing the FIFO
+                traceback.print_exc()
+            finally:
+                with self._completion_cv:
+                    if self._completions and self._completions[0] is item:
+                        self._completions.popleft()
+                    self._completion_cv.notify_all()
+
     def _drain_pipeline(self, timeout: Optional[float] = None) -> bool:
         """Block until every dispatched batch has fully completed
         (assumed + binds submitted + failures handled). Runs on idle,
         pause, and stop, and before any path that reads the scheduler
-        cache as ground truth (oracle scheduling, nominated placement)."""
+        cache as ground truth (oracle scheduling, nominated placement).
+
+        The wait is BOUNDED: every device wait inside the completion
+        worker is already watchdog-bounded (TPUBackend.harvest), so a
+        wedged device resolves through the fault/retry path well inside
+        the drain budget. Exceeding it anyway means the pipeline is
+        stalled beyond what retries can fix — demote the ladder and
+        raise PipelineStalled; callers requeue their pods. Blocking the
+        whole scheduler forever is the one forbidden outcome."""
         if self.pipeline_depth <= 0:
             return True
-        deadline = None if timeout is None else _time.monotonic() + timeout
+        if timeout is None:
+            timeout = self.drain_timeout
+        if timeout is None:
+            watchdog = self.tpu.watchdog_timeout if self.tpu is not None \
+                else 30.0
+            # budget: every queued batch may burn a full watchdog +
+            # retry storm before resolving
+            timeout = max(30.0, 3.0 * watchdog)
+        deadline = _time.monotonic() + timeout
         with self._completion_cv:
             while self._completions:
-                wait = 0.2
-                if deadline is not None:
-                    wait = min(wait, deadline - _time.monotonic())
-                    if wait <= 0:
-                        return False
+                wait = min(0.2, deadline - _time.monotonic())
+                if wait <= 0:
+                    stuck = len(self._completions)
+                    break
                 self._completion_cv.wait(wait)
-        return True
+            else:
+                return True
+        if self.tpu is not None and self.tpu.ladder.demote():
+            logger.warning(
+                "pipeline stalled: %d batches undrained after %.1fs — "
+                "backend demoted to %s", stuck, timeout,
+                self.tpu.ladder.mode(),
+            )
+            self.tpu._ensure_probe_thread()
+        raise PipelineStalled(
+            f"{stuck} dispatched batches failed to land within {timeout}s"
+        )
+
+    def _drain_or_requeue(self, infos: List) -> bool:
+        """_drain_pipeline for the mid-cycle callers: on a stall the
+        given (popped, not yet dispatched) infos go back to the queue
+        exactly once and the cycle aborts."""
+        try:
+            self._drain_pipeline()
+            return True
+        except PipelineStalled:
+            traceback.print_exc()
+            for info in infos:
+                self.queue.add(info.pod)
+            return False
 
     def _complete_batch(self, todo: List, handle, cycle: int) -> None:
         results = self.tpu.harvest(handle)
@@ -692,6 +877,11 @@ class Scheduler:
                         self._record_failure(
                             info, cycle, fe.filtered_nodes_statuses
                         )
+                    except DeviceFault:
+                        # retries exhausted inside schedule(): back to
+                        # the queue exactly once; the ladder (already
+                        # fault-counted) decides the next attempt's path
+                        self.queue.add(info.pod)
 
     def _preemption_args(self) -> dict:
         """The DefaultPreemption plugin's candidate-count args, so the

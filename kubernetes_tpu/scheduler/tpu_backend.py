@@ -18,8 +18,10 @@ message-string level).
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
+import time as _time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
@@ -30,6 +32,13 @@ from ..models.encoding import ClusterEncoding
 from ..models.pod_encoder import PodEncoder
 from ..ops.batch import shape_signature
 from ..ops.hoisted import HoistedSession, template_fingerprint
+from .degradation import (
+    RUNG_HOISTED,
+    RUNG_ORACLE,
+    RUNG_PALLAS,
+    DegradationLadder,
+    DeviceFault,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -62,7 +71,8 @@ class _BatchHandle:
     invalidated (by foreign cluster events) before harvest — the computed
     ys stay valid either way."""
 
-    __slots__ = ("group", "ys", "decide", "node_names", "results")
+    __slots__ = ("group", "ys", "decide", "node_names", "results",
+                 "deadline", "bucket", "timed_out")
 
     def __init__(self, group: List[v1.Pod]):
         self.group = group
@@ -73,6 +83,11 @@ class _BatchHandle:
         # so the dispatch-time table rides the handle
         self.node_names: Optional[List[str]] = None
         self.results: Optional[List[Tuple[v1.Pod, Optional[str]]]] = None
+        # dispatch watchdog: the wall-clock deadline for this scan's
+        # results; a wait past it is a device fault, not a longer wait
+        self.deadline: Optional[float] = None
+        self.bucket: Optional[int] = None  # pallas AOT-exec bucket (Bp)
+        self.timed_out = False
 
 
 class TPUBackend(CacheListener):
@@ -127,6 +142,38 @@ class TPUBackend(CacheListener):
         self.use_pallas = (
             jax.devices()[0].platform == "tpu" and mesh is None
         )
+        # -- device fault tolerance ------------------------------------
+        # Optional FaultInjector seam (testing/faults.py, duck-typed):
+        # chaos drills arm dispatch raises / NaN harvests / wedged waits
+        # through it. None in production.
+        self.faults = None
+        # watchdog: no device wait (harvest, flush, probe) may exceed
+        # this — past it the dispatch is a fault, the in-flight chain is
+        # abandoned, and the batch re-drives synchronously
+        self.watchdog_timeout = float(
+            os.environ.get("KTPU_WATCHDOG_TIMEOUT", "30"))
+        # bounded retry (capped exponential backoff + full jitter — the
+        # Supervisor's restart policy at dispatch granularity)
+        self.retry_cap = int(os.environ.get("KTPU_DISPATCH_RETRIES", "2"))
+        self.retry_base = float(os.environ.get("KTPU_RETRY_BASE", "0.05"))
+        self.retry_max = float(os.environ.get("KTPU_RETRY_MAX", "2.0"))
+        # degradation ladder: consecutive faults demote pallas -> hoisted
+        # -> oracle; the probe loop below re-promotes when a canary
+        # dispatch answers correctly again
+        self.ladder = DegradationLadder(
+            top=RUNG_PALLAS if self.use_pallas else RUNG_HOISTED,
+            threshold=int(os.environ.get("KTPU_DEMOTE_THRESHOLD", "3")),
+            probe_interval=float(os.environ.get("KTPU_PROBE_INTERVAL", "1.0")),
+            rng=self.rng,
+        )
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_lock = threading.Lock()
+        self._probe_stop = threading.Event()
+        # pallas batch buckets whose AOT executable produced a fault:
+        # quarantined (jit-only) on every rebuilt session — the _exec
+        # cache dies with its session, the suspicion must not — until
+        # the bucket harvests cleanly again (_harvest_locked)
+        self._suspect_buckets: set = set()
 
     def set_volume_resolver(self, resolver) -> None:
         """Enable the volume device path: bound-PVC pods encode their PV
@@ -207,6 +254,251 @@ class TPUBackend(CacheListener):
             _tb.print_stack(limit=8)
         self._session = None
 
+    # -- device fault tolerance --------------------------------------------
+    # Every device-touching path runs under this discipline: the dispatch
+    # is guarded (injector seam + real exceptions), the wait is bounded by
+    # the watchdog, and the harvested payload passes a finite/in-range
+    # check BEFORE its decisions reach assume(). A fault retires the
+    # suspect AOT executable, tears the session down, counts toward the
+    # ladder (demotion after `threshold` consecutive), and the batch
+    # re-drives synchronously with capped backoff; an exhausted batch
+    # resolves to RETRY_NODE so the scheduler returns its pods to the
+    # queue exactly once.
+
+    def _check_dispatch_fault(self, rung: Optional[int] = None) -> None:
+        inj = self.faults
+        if inj is not None:
+            inj.on_dispatch(rung=self.ladder.rung() if rung is None else rung)
+
+    def _wait_ready(self, ys, timeout: float) -> bool:
+        """Watchdog-bounded device wait: True when every result leaf is
+        ready, False when the deadline passes (wedged device). Polling
+        is_ready() instead of block_until_ready keeps a hung XLA wait
+        from pinning the calling thread forever — the one failure
+        PR 3's pipeline could not survive."""
+        import jax
+
+        deadline = _time.monotonic() + max(0.0, timeout)
+        leaves = [
+            x for x in jax.tree_util.tree_leaves(ys) if hasattr(x, "is_ready")
+        ]
+        while True:
+            inj = self.faults
+            wedged = inj is not None and inj.wedge_active()
+            if not wedged:
+                try:
+                    leaves = [x for x in leaves if not x.is_ready()]
+                except Exception:  # noqa: BLE001 — let decode surface it
+                    return True
+                if not leaves:
+                    return True
+            if _time.monotonic() >= deadline:
+                # an injected wedge shot is NOT consumed here: with
+                # concurrent waiters (completion worker + a locked
+                # flush) the first watchdog would otherwise absorb the
+                # shot and the second thread would harvest "cleanly" —
+                # the shot ends when the timeout FAULT is recorded
+                # (_device_fault_locked), i.e. when recovery begins
+                return False
+            _time.sleep(0.002)
+
+    def _validate_decisions(self, decisions: List[int], n_names: int,
+                            ys=None) -> None:
+        """Cheap guard between harvest and assume: every decision must be
+        a node index (or -1) against the dispatch-time node table, and
+        any float payload must be finite. Garbage from a sick device is
+        a fault to recover from, not state to propagate."""
+        for d in decisions:
+            if not (-1 <= int(d) < n_names):
+                raise DeviceFault(
+                    f"decision {d} outside [-1, {n_names})", kind="invalid")
+        if isinstance(ys, dict):
+            for k, val in ys.items():
+                if not hasattr(val, "dtype"):
+                    continue
+                a = np.asarray(val)
+                if a.dtype.kind == "f" and not np.isfinite(a).all():
+                    raise DeviceFault(
+                        f"non-finite device payload in {k!r}", kind="invalid")
+
+    def _device_fault_locked(self, kind: str, buckets=()) -> None:
+        """Record one device fault: count it, quarantine the suspect AOT
+        buckets (pallas — the quarantine outlives the session teardown
+        one line down, _build_session re-applies it to every rebuild),
+        tear the session down, and demote the ladder when this fault
+        crossed the consecutive threshold."""
+        from .metrics import device_faults
+
+        device_faults.inc(kind=kind)
+        if kind == "timeout" and self.faults is not None:
+            # injected-wedge shot accounting: the watchdog fired and the
+            # fault is now recorded — recovery's retry path must see a
+            # responsive device again
+            self.faults.consume_wedge()
+        self._suspect_buckets.update(b for b in buckets if b is not None)
+        self._invalidate_session()
+        if self.ladder.record_fault(kind):
+            logger.warning(
+                "TPU backend demoted to %s after %d consecutive device "
+                "faults (last: %s); background probe will re-promote",
+                self.ladder.mode(), self.ladder.threshold, kind,
+            )
+            self._ensure_probe_thread()
+
+    def _dispatch_with_retry(self, attempt):
+        """THE bounded-retry policy, shared by every synchronous dispatch
+        path: capped exponential backoff + full jitter (the Supervisor's
+        restart policy at dispatch granularity), one recorded fault per
+        failed attempt (so persistent faults walk the ladder down), and
+        an immediate stop once the ladder hits oracle (a sick device
+        must not be hammered with retry storms the scheduler is already
+        routing around). Returns `attempt()`'s value; raises DeviceFault
+        when retries exhaust or the backend is fully demoted."""
+        from .metrics import dispatch_retries
+
+        delay = self.retry_base
+        for n in range(self.retry_cap + 1):
+            if self.ladder.rung() <= RUNG_ORACLE:
+                break
+            if n:
+                dispatch_retries.inc()
+                _time.sleep(
+                    min(delay, self.retry_max) * (1 + self.rng.random()))
+                delay *= 2
+            try:
+                out = attempt()
+                self.ladder.record_success()
+                return out
+            except DeviceFault as e:
+                logger.warning("device dispatch fault (%s, attempt %d/%d)",
+                               e.kind, n + 1, self.retry_cap + 1)
+                self._device_fault_locked(e.kind)
+            except Exception:  # noqa: BLE001 — any device-path error
+                logger.warning("device dispatch fault (attempt %d/%d)",
+                               n + 1, self.retry_cap + 1, exc_info=True)
+                self._device_fault_locked("raise")
+        raise DeviceFault(
+            "dispatch retries exhausted (or backend demoted)", kind="raise")
+
+    def _session_schedule_guarded(self, arrays: List[Dict]) -> Optional[List[int]]:
+        """_session_schedule under the retry policy. Returns None when
+        retries exhaust or the ladder hit oracle — callers turn the
+        group into RETRY_NODE results (back to the scheduling queue
+        exactly once; the scheduler routes the re-pop through the
+        oracle while demoted)."""
+
+        def attempt():
+            self._check_dispatch_fault()
+            decisions = self._session_schedule(arrays)
+            self._validate_decisions(decisions, self.enc.n_nodes)
+            return decisions
+
+        try:
+            return self._dispatch_with_retry(attempt)
+        except DeviceFault:
+            return None
+
+    def _recover_dispatches_locked(self, kind: str, first: "_BatchHandle") -> None:
+        """Harvest-side fault: `first`'s payload is bad, and every later
+        pending batch chained its scan on the same carry — all of it is
+        suspect. Abandon the chain, record the fault, then re-decide
+        each batch synchronously IN DISPATCH ORDER (schedule_many runs
+        the guarded/retrying session path), so sequential-assume
+        semantics — and decision parity when the fault was transient —
+        survive the recovery. Nothing from the abandoned scans ever
+        touched the host encoding: pre-harvest handles carry no state."""
+        from .metrics import dispatch_retries
+
+        dropped = [first] + list(self._pending)
+        self._pending.clear()
+        buckets = {h.bucket for h in dropped if h.bucket is not None}
+        self._device_fault_locked(kind, buckets=buckets)
+        for h in dropped:
+            h.ys = None
+            dispatch_retries.inc()
+            h.results = self.schedule_many(h.group)
+
+    def abandon_pending(self) -> int:
+        """Drop every not-yet-harvested in-flight dispatch WITHOUT
+        re-deciding it (completion-worker crash recovery: the restarted
+        worker requeues the pods instead). Abandoned handles resolve to
+        RETRY_NODE results, so a completion that still holds one sends
+        its pods back to the queue exactly once; the session is torn
+        down because its device carry includes the abandoned assumes."""
+        with self._lock:
+            n = len(self._pending)
+            for h in self._pending:
+                h.ys = None
+                h.results = [(p, RETRY_NODE) for p in h.group]
+            self._pending.clear()
+            if n:
+                self._invalidate_session()
+            return n
+
+    # -- ladder probe: background re-promotion -----------------------------
+
+    def _ensure_probe_thread(self) -> None:
+        with self._probe_lock:
+            t = self._probe_thread
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(
+                target=self._probe_loop, name="tpu-ladder-probe", daemon=True)
+            self._probe_thread = t
+            t.start()
+
+    def _probe_loop(self) -> None:
+        """While demoted, periodically run a canary dispatch vouching for
+        the NEXT rung up; a correct answer promotes one rung (cadence
+        resets), a wrong/absent one doubles the cadence (capped) so a
+        flapping device cannot whipsaw the session cache. Exits once
+        fully re-promoted; a later demotion starts a fresh thread."""
+        while not self._probe_stop.is_set():
+            if self.ladder.rung() >= self.ladder.top:
+                return
+            if self._probe_stop.wait(self.ladder.probe_delay()):
+                return
+            ok = self._probe_device()
+            if self.ladder.on_probe(ok):
+                logger.warning(
+                    "TPU backend re-promoted to %s after a clean probe",
+                    self.ladder.mode(),
+                )
+                with self._lock:
+                    # the next batch must rebuild at the restored rung
+                    self._invalidate_session()
+
+    def _probe_device(self) -> bool:
+        """One canary with a known answer through the same fault seam as
+        real dispatches (rung = the rung being vouched for)."""
+        try:
+            target = min(self.ladder.rung() + 1, self.ladder.top)
+            inj = self.faults
+            if inj is not None:
+                inj.on_dispatch(rung=target, probe=True)
+            import jax.numpy as jnp
+
+            y = (jnp.arange(64, dtype=jnp.int32) * 2).sum()
+            if not self._wait_ready(y, self.watchdog_timeout):
+                # the probe's wait IS a device wait that hit the
+                # watchdog: consume an armed wedge shot here too — at
+                # the oracle rung no dispatch traffic exists to consume
+                # it, and an unconsumed shot would wedge every future
+                # probe (permanently demoted backend)
+                if inj is not None:
+                    inj.consume_wedge()
+                return False
+            return int(np.asarray(y)) == 64 * 63
+        except Exception:  # noqa: BLE001 — a raising probe is a failed probe
+            return False
+
+    def close(self) -> None:
+        """Stop the background probe (Scheduler.shutdown)."""
+        self._probe_stop.set()
+        t = self._probe_thread
+        if t is not None:
+            t.join(timeout=2)
+
     # -- CacheListener (called under the cache lock) -----------------------
 
     def on_add_pod(self, pod: v1.Pod, node_name: str) -> None:
@@ -277,15 +569,29 @@ class TPUBackend(CacheListener):
             except VolumeResolutionChanged:
                 # gate/encode race: fail this attempt; the retry re-gates
                 raise FitError(pod, self.enc.n_nodes, {})
-            c = self.enc.device_state()
-            if self.mesh is not None:
-                from ..parallel import sharded
+            def attempt(p=p):
+                self._check_dispatch_fault()
+                c = self.enc.device_state()
+                if self.mesh is not None:
+                    from ..parallel import sharded
 
-                c = sharded.shard_cluster(c, self.mesh)
-                p = sharded.replicate_pod(p, self.mesh)
-            out = schedule_pod_jit(c, p, self.weights)
-            total = np.asarray(out["total"])
-            feasible = np.asarray(out["feasible"])
+                    c = sharded.shard_cluster(c, self.mesh)
+                    p = sharded.replicate_pod(p, self.mesh)
+                out = schedule_pod_jit(c, p, self.weights)
+                if not self._wait_ready(out, self.watchdog_timeout):
+                    raise DeviceFault(
+                        "single-pod dispatch exceeded the watchdog",
+                        kind="timeout")
+                total = np.asarray(out["total"])
+                feasible = np.asarray(out["feasible"])
+                if total.dtype.kind == "f" and not np.isfinite(total).all():
+                    raise DeviceFault("non-finite scores", kind="invalid")
+                return out, total, feasible
+
+            # raises DeviceFault when retries exhaust or the ladder sits
+            # at oracle (callers requeue; the scheduler routes the
+            # re-pop through the oracle path)
+            out, total, feasible = self._dispatch_with_retry(attempt)
             n_nodes = self.enc.n_nodes
             n_feasible = int(feasible.sum())
             if n_feasible == 0:
@@ -307,6 +613,10 @@ class TPUBackend(CacheListener):
         results: List[Tuple[Optional[str], Dict]] = []
         with self._lock:
             self._flush_pending()
+            if self.ladder.rung() <= RUNG_ORACLE:
+                # fully demoted: no device dispatch at all — the pods
+                # re-gate via the queue and ride the oracle there
+                return [(RETRY_NODE, {}) for _ in pods]
             # device_state() with dirty rows donates buffers a live
             # session still references — same discipline as schedule()
             self._invalidate_session()
@@ -359,13 +669,33 @@ class TPUBackend(CacheListener):
                         from ..parallel import sharded
 
                         stacked = sharded.replicate_pod(stacked, self.mesh)
-                    outs = schedule_pods_jit(c, stacked, self.weights)
-                    outs = {k: np.asarray(v) for k, v in outs.items()}
+                    try:
+                        if self.ladder.rung() <= RUNG_ORACLE:
+                            continue  # demoted mid-loop: rest re-gates
+                        self._check_dispatch_fault()
+                        outs = schedule_pods_jit(c, stacked, self.weights)
+                        if not self._wait_ready(outs, self.watchdog_timeout):
+                            raise DeviceFault(
+                                "re-evaluation dispatch exceeded the "
+                                "watchdog", kind="timeout")
+                        outs = {k: np.asarray(v) for k, v in outs.items()}
+                    except DeviceFault as e:
+                        # chunk pods re-gate via the queue; the retry
+                        # lands after the session-rebuild/demotion the
+                        # fault just triggered
+                        self._device_fault_locked(e.kind)
+                        continue
+                    except Exception:  # noqa: BLE001 — device-path error
+                        self._device_fault_locked("raise")
+                        continue
                     for row, g in enumerate(chunk):
                         out_rows[g] = (outs, row)
             for g, pod in enumerate(pods):
                 if g in skipped:
                     results.append((RETRY_NODE, {}))  # prompt re-gate
+                    continue
+                if out_rows[g] is None:
+                    results.append((RETRY_NODE, {}))  # faulted chunk
                     continue
                 outs, row = out_rows[g]
                 feasible = outs["feasible"][row][:n_nodes]
@@ -403,7 +733,8 @@ class TPUBackend(CacheListener):
         with self._lock:
             while len(self._pending) >= max(1, self.max_pending):
                 self._harvest_locked()
-            if pods and self._session is not None and all(
+            if pods and self._session is not None \
+                    and self.ladder.rung() > RUNG_ORACLE and all(
                 not p.spec.node_name for p in pods
             ):
                 try:
@@ -425,9 +756,23 @@ class TPUBackend(CacheListener):
                         for a in clean
                     )
                 ):
-                    h.ys = self._session.schedule(clean)  # async, no block
+                    try:
+                        self._check_dispatch_fault()
+                        ys = self._session.schedule(clean)  # async, no block
+                    except Exception:  # noqa: BLE001 — dispatch-time fault:
+                        # the enqueue failed BEFORE the scan chained onto
+                        # the carry, so earlier pending batches stay
+                        # valid; this batch re-drives synchronously
+                        # through the guarded (retrying) path
+                        self._device_fault_locked("raise")
+                        h.results = self.schedule_many(pods)
+                        return h
+                    h.ys = ys
+                    if isinstance(ys, dict):
+                        h.bucket = ys.get("bucket")
                     h.decide = type(self._session).decisions
                     h.node_names = list(self.enc.node_names)
+                    h.deadline = _time.monotonic() + self.watchdog_timeout
                     self._pending.append(h)
                     return h
             h.results = self.schedule_many(pods)  # re-entrant: RLock
@@ -441,12 +786,10 @@ class TPUBackend(CacheListener):
             # scheduler thread's next dispatch (the whole point of the
             # pipeline). The ys arrays are plain outputs — only the
             # carry is donated — so waiting on them unlocked is safe.
-            import jax
-
-            try:
-                jax.block_until_ready(ys)
-            except Exception:  # noqa: BLE001 — decode() surfaces errors
-                pass
+            # The wait is watchdog-bounded: a wedged device marks the
+            # handle timed out and the locked harvest runs recovery.
+            if not self._wait_ready(ys, self.watchdog_timeout):
+                handle.timed_out = True
         with self._lock:
             # strictly FIFO: older batches' decisions are ground truth
             # for this one — land them first
@@ -465,7 +808,33 @@ class TPUBackend(CacheListener):
 
     def _harvest_locked(self) -> None:
         h = self._pending.popleft()
-        decisions = h.decide(h.ys)
+        try:
+            if h.timed_out or not self._wait_ready(
+                h.ys, self.watchdog_timeout
+                if h.deadline is None
+                else h.deadline - _time.monotonic()
+            ):
+                raise DeviceFault(
+                    "device wait exceeded the dispatch watchdog",
+                    kind="timeout")
+            ys = h.ys
+            if self.faults is not None:
+                ys = self.faults.corrupt_harvest(
+                    ys, rung=self.ladder.rung())
+            decisions = h.decide(ys)
+            self._validate_decisions(decisions, len(h.node_names), ys)
+        except DeviceFault as e:
+            self._recover_dispatches_locked(e.kind, h)
+            return
+        except Exception:  # noqa: BLE001 — decode blew up on garbage
+            logger.warning("harvest decode failed", exc_info=True)
+            self._recover_dispatches_locked("invalid", h)
+            return
+        self.ladder.record_success()
+        if h.bucket is not None:
+            # the bucket proved itself (through jit while quarantined):
+            # future session rebuilds may AOT it again
+            self._suspect_buckets.discard(h.bucket)
         results: List[Tuple[v1.Pod, Optional[str]]] = []
         for g, best in zip(h.group, decisions):
             if best < 0:
@@ -517,6 +886,11 @@ class TPUBackend(CacheListener):
                         results.append((pod, node))
                     except FitError:
                         results.append((pod, None))
+                    except DeviceFault:
+                        # single-pod retries exhausted: back to the
+                        # queue exactly once (prompt re-gate); the
+                        # ladder already recorded the faults
+                        results.append((pod, RETRY_NODE))
                     i += 1
                     continue
                 # group a maximal run of pending, shape-identical pods
@@ -547,10 +921,18 @@ class TPUBackend(CacheListener):
                 # are exactly the live session's statics (the session
                 # is self-consistent without the sync; its exactness
                 # argument is in ops/hoisted.py)
-                decisions = self._session_schedule([
+                decisions = self._session_schedule_guarded([
                     {k: v for k, v in a.items() if not k.startswith("_")}
                     for a in arrays
                 ])
+                if decisions is None:
+                    # retries exhausted (or fully demoted): the whole
+                    # group re-gates via the queue exactly once; while
+                    # the ladder sits at oracle the scheduler routes the
+                    # re-pop through _schedule_one_oracle
+                    results.extend((g, RETRY_NODE) for g in group)
+                    i = j
+                    continue
                 for g, best in zip(group, decisions):
                     if best < 0:
                         results.append((g, None))
@@ -620,7 +1002,15 @@ class TPUBackend(CacheListener):
             self._invalidate_session()
         if self._session is None:
             self._session = self._build_session()
-        return type(self._session).decisions(self._session.schedule(arrays))
+        ys = self._session.schedule(arrays)
+        # decisions() decodes through np.asarray, an UNBOUNDED device
+        # wait — bound it with the watchdog first or the synchronous
+        # re-decide path (fault recovery!) could hang on the very device
+        # wedge it is recovering from, with the backend lock held
+        if not self._wait_ready(ys, self.watchdog_timeout):
+            raise DeviceFault(
+                "synchronous dispatch exceeded the watchdog", kind="timeout")
+        return type(self._session).decisions(ys)
 
     def _build_session(self):
         """Pallas single-launch session when the cluster shape supports it
@@ -633,6 +1023,19 @@ class TPUBackend(CacheListener):
 
         templates = list(self._known_templates.values())
         cluster = self.enc.device_state()
+        # degradation ladder: a DEMOTED backend (rung below the
+        # platform's top — NOT merely a platform whose top is hoisted)
+        # builds the hoisted session even on a TPU; the probe loop
+        # re-promotes and invalidates, so the NEXT build climbs back
+        demoted = self.ladder.rung() < self.ladder.top
+        if self.mesh is not None and demoted:
+            session_builds.inc(kind="hoisted", reason="mesh-ladder-demoted")
+            from ..parallel import sharded
+
+            return HoistedSession(
+                sharded.shard_cluster(cluster, self.mesh),
+                templates, self.weights,
+            )
         if self.mesh is not None:
             # two-phase sharded session (ops/sharded_scan.py): the pallas
             # session's exact math with node-sharded carries and ICI
@@ -663,11 +1066,22 @@ class TPUBackend(CacheListener):
                 sharded.shard_cluster(cluster, self.mesh),
                 templates, self.weights,
             )
-        if self.use_pallas:
+        if self.use_pallas and demoted:
+            logger.warning(
+                "ladder-demoted session build: %s instead of pallas",
+                self.ladder.mode(),
+            )
+            session_builds.inc(kind="hoisted", reason="ladder-demoted")
+        elif self.use_pallas:
             from ..ops.pallas_scan import PallasSession, PallasUnsupported
 
             try:
                 s = PallasSession(cluster, templates, self.weights)
+                # re-apply the fault quarantine: suspect buckets stay
+                # jit-only on the rebuilt session until they harvest
+                # cleanly again
+                for b in self._suspect_buckets:
+                    s.retire_exec(bucket=b)
                 session_builds.inc(kind="pallas", reason="")
                 # AOT-warm the ragged-tail batch buckets OFF the serving
                 # path: a daemon thread populates the (persistent)

@@ -34,6 +34,13 @@ class Disruption:
 #: the control-plane crash kinds (opt-in: pass via `disruptions=`)
 CRASH_KINDS = ("crash-apiserver", "crash-controller")
 
+#: the device/scheduler fault kinds (opt-in): `wedge-device` arms one
+#: dispatch-level fault (raise / NaN harvest / wedged wait) on the TPU
+#: backend's FaultInjector; `crash-scheduler` kills one pipeline worker
+#: thread (scheduling loop or completion worker). Both no-op on clusters
+#: without a TPU-backed scheduler.
+FAULT_KINDS = ("wedge-device", "crash-scheduler")
+
 
 class ChaosMonkey:
     def __init__(
@@ -79,6 +86,8 @@ class ChaosMonkey:
             "delete-pod": self._delete_pod,
             "crash-apiserver": self._crash_apiserver,
             "crash-controller": self._crash_controller,
+            "wedge-device": self._wedge_device,
+            "crash-scheduler": self._crash_scheduler,
         }[kind]
         d = fn()
         if d is not None:
@@ -154,6 +163,45 @@ class ChaosMonkey:
         sup.crash(victim)
         self._crashed_controllers.append(victim)
         return Disruption("crash-controller", victim)
+
+    def _fault_injector(self):
+        """The scheduler's FaultInjector, installing one on first use.
+        None when the cluster has no TPU-backed scheduler (the fault
+        kinds then no-op, like the crash kinds on non-durable stores)."""
+        sched = getattr(self.cluster, "scheduler", None)
+        if sched is None or getattr(sched, "tpu", None) is None:
+            return None
+        inj = getattr(sched, "faults", None)
+        if inj is None:
+            from .faults import FaultInjector
+
+            inj = FaultInjector()
+            sched.install_fault_injector(inj)
+        return inj
+
+    def _wedge_device(self) -> Optional[Disruption]:
+        """One device-level fault on the next dispatch: an XLA launch
+        raise, a garbage (NaN/saturated) harvest payload, or a wedged
+        wait that only the dispatch watchdog ends. The backend must
+        detect it, retry with a rebuilt session, and keep every pod
+        (fault-parity: same bound set as a clean run)."""
+        inj = self._fault_injector()
+        if inj is None:
+            return None
+        kind = self.rng.choice(("raise-dispatch", "nan-harvest", "wedge-wait"))
+        inj.arm(kind, shots=1)
+        return Disruption("wedge-device", kind)
+
+    def _crash_scheduler(self) -> Optional[Disruption]:
+        """Kill one scheduling-pipeline worker thread (the scheduling
+        loop or the completion worker); the in-process supervision must
+        drain the in-flight FIFO back to the queue and restart it."""
+        inj = self._fault_injector()
+        if inj is None:
+            return None
+        kind = self.rng.choice(("kill-scheduler", "kill-completion"))
+        inj.arm(kind, shots=1)
+        return Disruption("crash-scheduler", kind)
 
     # -- assertions ---------------------------------------------------------
 

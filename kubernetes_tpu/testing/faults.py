@@ -1,0 +1,188 @@
+"""Deterministic fault seam for the TPU scheduling pipeline.
+
+The FaultInjector is the drill hook the device-fault-tolerance subsystem
+is tested through (the reference proves re-convergence with chaosmonkey-
+driven disruptive e2e suites; a wedged XLA wait or a NaN harvest needs
+the same treatment but cannot be produced by killing kubelets). The
+scheduler and TPU backend hold an OPTIONAL `faults` attribute and call
+the hooks below at the natural fault points; production code never
+imports this module — the seam is duck-typed, `None` means no injection.
+
+Kinds:
+
+  raise-dispatch   the next device dispatch raises (XLA launch error)
+  nan-harvest      the next harvested payload is corrupted (NaN floats /
+                   saturated ints) BEFORE decode — must be caught by the
+                   backend's finite/in-range validation guard
+  wedge-wait       device waits report not-ready until the dispatch
+                   watchdog fires (hung collective / preempted chip)
+  kill-scheduler   the scheduling loop thread dies at its next iteration
+  kill-completion  the completion worker dies before its next batch
+
+Faults are armed with a shot count (`-1` = until disarm) and optionally a
+`min_rung` (scheduler/degradation.py rung constants): a pallas-only
+Mosaic bug is modeled as `min_rung=RUNG_PALLAS` — dispatches and probes
+at or above that rung fault, lower rungs run clean, which is exactly the
+shape the degradation ladder must survive. `injected` counts every fired
+fault per kind; tests assert recovery against it (the ground-truth role
+plan.injected played for the HTTP fault plan).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+KINDS = (
+    "raise-dispatch",
+    "nan-harvest",
+    "wedge-wait",
+    "kill-scheduler",
+    "kill-completion",
+)
+
+
+class InjectedFault(RuntimeError):
+    """Raised by on_dispatch when raise-dispatch is armed; the backend
+    treats it like any other device-path exception."""
+
+
+class _Armed:
+    __slots__ = ("shots", "min_rung")
+
+    def __init__(self, shots: int, min_rung: Optional[int]):
+        self.shots = shots
+        self.min_rung = min_rung
+
+
+class FaultInjector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: Dict[str, _Armed] = {}
+        self.injected: Dict[str, int] = {}
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(self, kind: str, shots: int = 1,
+            min_rung: Optional[int] = None) -> None:
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}")
+        if min_rung is not None and kind == "wedge-wait":
+            # a wedged wait has no dispatch-rung context (wedge_active()
+            # is polled from inside the wait loop), so a rung-filtered
+            # wedge would wedge every rung but never consume its shot —
+            # a permanent outage masquerading as a transient fault
+            raise ValueError("wedge-wait does not support min_rung")
+        with self._lock:
+            self._armed[kind] = _Armed(shots, min_rung)
+
+    def disarm(self, kind: Optional[str] = None) -> None:
+        with self._lock:
+            if kind is None:
+                self._armed.clear()
+            else:
+                self._armed.pop(kind, None)
+
+    def armed(self, kind: str) -> bool:
+        with self._lock:
+            return kind in self._armed
+
+    def _take(self, kind: str, rung: Optional[int] = None) -> bool:
+        """Consume one shot of `kind` if armed (and the rung filter
+        passes); counts the injection."""
+        with self._lock:
+            a = self._armed.get(kind)
+            if a is None:
+                return False
+            if a.min_rung is not None and (rung is None or rung < a.min_rung):
+                return False
+            if a.shots > 0:
+                a.shots -= 1
+                if a.shots == 0:
+                    del self._armed[kind]
+            self.injected[kind] = self.injected.get(kind, 0) + 1
+            return True
+
+    # -- hooks (called by tpu_backend / scheduler) -------------------------
+
+    def on_dispatch(self, rung: Optional[int] = None,
+                    probe: bool = False) -> None:
+        """Called right before every device dispatch (and every ladder
+        probe, with the rung the probe vouches for)."""
+        if self._take("raise-dispatch", rung):
+            raise InjectedFault(
+                f"injected dispatch failure (probe={probe}, rung={rung})"
+            )
+
+    def corrupt_harvest(self, ys, rung: Optional[int] = None):
+        """Possibly corrupt one harvested payload: float leaves -> NaN,
+        int leaves -> dtype max (out of any node-index range). Returns a
+        corrupted COPY; the original device arrays are untouched."""
+        if not self._take("nan-harvest", rung):
+            return ys
+        if not isinstance(ys, dict):
+            return ys
+        bad = dict(ys)
+        for k, v in ys.items():
+            if np.ndim(v) == 0 and not hasattr(v, "dtype"):
+                continue  # host scalars ("n", "_b_real") steer decode
+            try:
+                a = np.asarray(v)
+            except Exception:  # noqa: BLE001 — leave non-arrays alone
+                continue
+            if a.dtype.kind == "f":
+                bad[k] = np.full_like(a, np.nan)
+            elif a.dtype.kind in "iu":
+                bad[k] = np.full_like(a, np.iinfo(a.dtype).max)
+        return bad
+
+    def wedge_active(self) -> bool:
+        """True while wedge-wait is armed: device waits must report
+        not-ready (the watchdog, not this hook, ends the wedge). Does not
+        consume a shot — one shot covers one full wedged wait."""
+        with self._lock:
+            return "wedge-wait" in self._armed
+
+    def consume_wedge(self) -> None:
+        """The wedged wait hit its watchdog: the shot fired; release it
+        so the retry path finds a responsive device. (arm() guarantees
+        wedge-wait carries no rung filter, so _take consumes cleanly.)"""
+        self._take("wedge-wait")
+
+    def take_kill(self, worker: str) -> bool:
+        """worker = "scheduler" | "completion"; True means the caller
+        must die now (it raises scheduler.WorkerKilled)."""
+        return self._take(f"kill-{worker}")
+
+
+class BindIntegrityChecker:
+    """Double-bind detector for fault drills: a pod whose spec.nodeName
+    moves from one non-empty node to a DIFFERENT non-empty node was bound
+    twice — the invariant the fault-tolerant pipeline must never break
+    (the apiserver's binding endpoint Conflict-rejects the second bind,
+    so a violation surfacing here means a pod object was re-created or
+    rebound around that guard). Attach to any pods informer; read
+    `violations` after the drill."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.violations = []
+
+    def attach(self, pods_informer) -> "BindIntegrityChecker":
+        from ..client.informer import EventHandler
+
+        pods_informer.add_event_handler(
+            EventHandler(on_update=self._on_update))
+        return self
+
+    def _on_update(self, old, new) -> None:
+        o = old.spec.node_name
+        n = new.spec.node_name
+        if o and n and o != n:
+            with self._lock:
+                self.violations.append(
+                    f"{new.metadata.namespace}/{new.metadata.name}: "
+                    f"rebound {o} -> {n}"
+                )

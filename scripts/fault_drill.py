@@ -1,0 +1,186 @@
+"""Fault drill: run the device-fault injection matrix against a live
+TPU-backed scheduler over a churning cluster and report recovery.
+
+Sibling of crash_drill.py (control-plane crashes); this one drills the
+SCHEDULING pipeline's fault model: raising XLA dispatches, NaN/garbage
+harvests, wedged device waits (watchdog), pipeline-worker kills
+(supervised restart + FIFO drain-back), and kubelet deaths — all while a
+ReplicaSet keeps the workload churning. Prints a recovery report (faults
+injected, dispatch retries, ladder demotions/re-promotions, worker
+restarts, final bind count) and exits nonzero on any lost or
+double-bound pod.
+
+Runs on CPU (the TPU backend rides the hoisted session there):
+
+    JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python scripts/fault_drill.py
+"""
+
+import argparse
+import os
+import random
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.api import apps, types as v1  # noqa: E402
+from kubernetes_tpu.cluster import Cluster  # noqa: E402
+from kubernetes_tpu.scheduler import metrics  # noqa: E402
+from kubernetes_tpu.testing.chaos import ChaosMonkey  # noqa: E402
+from kubernetes_tpu.testing.faults import (  # noqa: E402
+    BindIntegrityChecker,
+    FaultInjector,
+)
+
+
+def wait_until(fn, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def deployment(name: str, replicas: int) -> apps.Deployment:
+    return apps.Deployment(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        spec=apps.DeploymentSpec(
+            replicas=replicas,
+            selector=v1.LabelSelector(match_labels={"app": name}),
+            template=apps.PodTemplateSpec(
+                metadata=v1.ObjectMeta(labels={"app": name}),
+                spec=v1.PodSpec(containers=[v1.Container(
+                    name="c", image="img:1",
+                    resources=v1.ResourceRequirements(requests={"cpu": "20m"}),
+                )]),
+            ),
+        ),
+    )
+
+
+def counter_total(counter) -> float:
+    return sum(val for _, val in counter.items())
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--replicas", type=int, default=12)
+    ap.add_argument("--duration", type=float, default=8.0,
+                    help="seconds of chaos")
+    ap.add_argument("--period", type=float, default=0.25,
+                    help="disruption period")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--watchdog", type=float, default=0.5,
+                    help="dispatch watchdog (s)")
+    args = ap.parse_args()
+
+    rng = random.Random(args.seed)
+    inj = FaultInjector()
+    failures = []
+    retries0 = metrics.dispatch_retries.value()
+    restarts0 = counter_total(metrics.worker_restarts)
+    faults0 = {k: val for k, val in metrics.device_faults.items()}
+
+    with Cluster(
+        n_nodes=args.nodes,
+        controllers=["replicaset", "deployment", "nodelifecycle"],
+        controller_opts={
+            "node_monitor_period": 0.3,
+            "node_monitor_grace_period": 2.0,
+        },
+        fault_injector=inj,
+    ) as c:
+        tpu = c.scheduler.tpu
+        if tpu is None:
+            print("FAIL: drill needs the TPU scheduler backend")
+            return 1
+        tpu.watchdog_timeout = args.watchdog
+        tpu.retry_base = 0.01
+        tpu.ladder._probe_interval = 0.1
+        tpu.ladder._probe_delay = 0.1
+        checker = BindIntegrityChecker().attach(c.kcm.informers.pods())
+        c.client.resource("deployments").create(
+            deployment("ha", args.replicas))
+
+        def n_running():
+            pods, _ = c.client.pods.list(namespace="default")
+            return sum(1 for p in pods if p.status.phase == "Running")
+
+        if not wait_until(lambda: n_running() == args.replicas, timeout=60):
+            print(f"FAIL: initial convergence "
+                  f"({n_running()}/{args.replicas})")
+            return 1
+        print(f"seeded: {args.replicas} replicas on {args.nodes} nodes "
+              f"(backend rung: {tpu.ladder.mode()})")
+
+        monkey = ChaosMonkey(
+            c, period=args.period, rng=rng,
+            disruptions=[
+                "wedge-device", "crash-scheduler",
+                "kill-kubelet", "restart-kubelet", "delete-pod",
+            ],
+        )
+        monkey.run()
+        time.sleep(args.duration)
+        monkey.stop()
+        inj.disarm()  # end of the injection window
+        monkey.restart_all_dead(timeout=30)
+
+        if not wait_until(lambda: tpu.ladder.rung() >= tpu.ladder.top,
+                          timeout=30):
+            failures.append(
+                f"ladder stuck at {tpu.ladder.mode()} after faults cleared")
+
+        def converged():
+            pods, _ = c.client.pods.list(namespace="default")
+            running = [p for p in pods if p.status.phase == "Running"]
+            return len(running) == args.replicas and len(pods) == args.replicas
+
+        if not wait_until(converged, timeout=90):
+            pods, _ = c.client.pods.list(namespace="default")
+            lost = args.replicas - n_running()
+            failures.append(
+                f"lost pods: {lost} replicas missing after recovery "
+                f"({len(pods)} pod objects)")
+        if checker.violations:
+            failures.append(f"double binds: {checker.violations}")
+
+        pods, _ = c.client.pods.list(namespace="default")
+        bound = sum(1 for p in pods if p.spec.node_name)
+        by_kind = {}
+        for d in monkey.history:
+            by_kind[d.kind] = by_kind.get(d.kind, 0) + 1
+        fault_delta = {
+            k[0]: val - faults0.get(k, 0.0)
+            for k, val in metrics.device_faults.items()
+            if val - faults0.get(k, 0.0) > 0
+        }
+
+        print("--- recovery report ---")
+        print(f"disruptions:      {by_kind}")
+        print(f"faults injected:  {dict(inj.injected)}")
+        print(f"faults recorded:  {fault_delta}")
+        print(f"dispatch retries: "
+              f"{metrics.dispatch_retries.value() - retries0:.0f}")
+        print(f"worker restarts:  "
+              f"{counter_total(metrics.worker_restarts) - restarts0:.0f}")
+        print(f"ladder:           demotions={tpu.ladder.demotions} "
+              f"re-promotions={tpu.ladder.promotions} "
+              f"final={tpu.ladder.mode()}")
+        print(f"final bind count: {bound}/{args.replicas}")
+
+    if failures:
+        print("FAIL:\n  " + "\n  ".join(failures))
+        return 1
+    print("PASS: pipeline survived the injection matrix "
+          "(zero lost, zero double-bound)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

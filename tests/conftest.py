@@ -21,3 +21,10 @@ import jax  # noqa: E402
 # var; force the virtual CPU mesh after import.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soak tests, excluded from tier-1 (-m 'not slow')",
+    )
