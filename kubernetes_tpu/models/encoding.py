@@ -255,6 +255,48 @@ class ClusterEncoding:
     def update_node(self, node: v1.Node) -> None:
         self.add_node(node)
 
+    def update_node_alloc(self, node: v1.Node):
+        """Incremental allocatable/capacity-ONLY node update: rewrites the
+        node's alloc/allowed_pods columns in place (dirty-row sync covers
+        the device) instead of flagging a full rebuild. Callers (the TPU
+        backend's prologue-patch classifier) must have verified that
+        nothing else in the node fingerprint moved. Returns
+        (dalloc int64 [R], dallowed int) — the row deltas a live device
+        session patches itself with — or None when the update cannot be
+        incremental (unknown node, pending rebuild, or a scalar resource
+        name the vocab has never seen, which changes the row WIDTH)."""
+        name = node.metadata.name
+        if self._rebuild_needed or not self._arrays:
+            return None
+        i = self.node_index.get(name)
+        if i is None:
+            return None
+        from ..scheduler.framework.types import (
+            Resource,
+            is_scalar_resource_name,
+        )
+
+        alloc_map = (node.status.allocatable or node.status.capacity) or {}
+        for rname in alloc_map:
+            if is_scalar_resource_name(rname) and not self.scalar_vocab.get(
+                    rname):
+                return None  # new scalar dimension: needs the full rebuild
+        res = Resource()
+        res.add(alloc_map)
+        extra = (
+            self.volume_hook.node_extra_alloc(node)
+            if self.volume_hook is not None else None
+        )
+        vec = self._res_vec(res, extra)
+        A = self._arrays
+        dalloc = vec - A["alloc"][i]
+        dallowed = int(res.allowed_pod_number) - int(A["allowed_pods"][i])
+        A["alloc"][i] = vec
+        A["allowed_pods"][i] = res.allowed_pod_number
+        self._nodes[name] = node
+        self._dirty_nodes.add(i)
+        return dalloc, dallowed
+
     def remove_node(self, node_name: str) -> None:
         self._nodes.pop(node_name, None)
         self._node_order = [n for n in self._node_order if n != node_name]

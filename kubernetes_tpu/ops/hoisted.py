@@ -368,6 +368,54 @@ def _eval_reqs_batch_np(op, key, pairs, pair_vecs, key_vecs):
     return res.all(axis=-1)  # [B, C]
 
 
+# tp keys the HOST-side batch prep reads (match_matrices_np); sessions
+# snapshot these as numpy at construction so per-batch/per-delta match
+# evaluation never round-trips the device
+SESSION_TP_NP_KEYS = (
+    "ptsf_op", "ptsf_rkey", "ptsf_pairs",
+    "ptss_op", "ptss_rkey", "ptss_pairs", "self_ns",
+)
+
+# tp keys of the templates' OWN affinity terms — the delta classifier
+# (tpu_backend) evaluates a foreign pod's row against these: a pod that
+# matches any template term contributes to the prologue's STATIC IPA
+# counts (anti_cnt_n / aff_cnt_n / D5 score rows), so its add/remove
+# cannot ride the carry-delta fast path
+TERM_NP_KEYS = tuple(
+    f"{prefix}_{suffix}"
+    for prefix in ("ipaaa", "ipaa", "ipap")
+    for suffix in ("op", "rkey", "pairs", "ns", "valid")
+)
+
+
+def ipa_term_match_np(term_np: Dict, pod_rows: Dict) -> bool:
+    """Does this pod's self row match ANY session template's required /
+    preferred (anti-)affinity term (selector + namespaces + validity)?
+    Host twin of _term_gates.vs_entity, used by the session-delta
+    classifier: matching pods affect prologue statics, not just the
+    carry, so they force a rebuild."""
+    pp = np.asarray(pod_rows["self_ppair"]).astype(bool)[None]
+    pk = np.asarray(pod_rows["self_pkey"]).astype(bool)[None]
+    ns = int(np.asarray(pod_rows["self_ns"]))
+    t_n = term_np["ipaaa_op"].shape[0]
+    for prefix in ("ipaaa", "ipaa", "ipap"):
+        valid = term_np[f"{prefix}_valid"].astype(bool)
+        if not valid.any():
+            continue
+        op = term_np[f"{prefix}_op"]
+        rkey = term_np[f"{prefix}_rkey"]
+        pairs = term_np[f"{prefix}_pairs"]
+        ns_tbl = term_np[f"{prefix}_ns"]
+        for t in range(t_n):
+            if not valid[t].any():
+                continue
+            m = _eval_reqs_batch_np(op[t], rkey[t], pairs[t], pp, pk)[0]
+            ns_ok = ((ns_tbl[t] == ns) & (ns_tbl[t] != 0)).any(axis=-1)
+            if (m & ns_ok & valid[t]).any():
+                return True
+    return False
+
+
 def match_matrices_np(tp_np: Dict, pod_arrays_list: List[Dict]):
     """Host-side Mf/Ms [T, B, C] — numpy twin of _match_matrices.
 
@@ -835,6 +883,43 @@ def _session_prologue(c_all: Dict, tp: Dict, dyn_ipa: bool = False,
     return _prologue(c_all, tp, dyn_ipa, dyn_ports)
 
 
+@functools.partial(jax.jit, donate_argnames=("carry",))
+def _session_apply_deltas(carry, f_pair_cn, s_pair_cn, s_src,
+                          nodes, dres, dnz, dcount, mf, ms):
+    """Apply a batch of cluster-event deltas to the session carry in ONE
+    fused launch: per event e, a batchable pod landed on (sign +1) or
+    left (sign -1) node nodes[e]. The math is exactly the _step carry
+    update with `best := nodes[e]` — utilization rows plus the PTS
+    pair-count scatter through the same match vectors — so a
+    delta-patched carry is bit-identical to one whose scan assumed /
+    never saw the pod. mf/ms arrive sign-multiplied (and zeroed for
+    terminating pods, which the prologue's ~pterm gate never counted);
+    padding rows are node 0 with all-zero payloads (pure no-ops). The
+    old carry buffers are donated, chaining the patch onto any in-flight
+    scans as a pure data dependency."""
+    carry = dict(carry)
+    carry["requested"] = carry["requested"].at[nodes].add(dres)
+    carry["nz_requested"] = carry["nz_requested"].at[nodes].add(dnz)
+    carry["pod_count"] = carry["pod_count"].at[nodes].add(dcount)
+    t_n, _, c_n = f_pair_cn.shape[0], f_pair_cn.shape[1], f_pair_cn.shape[2]
+    t_ix = jnp.arange(t_n)[:, None, None]
+    c_ix = jnp.arange(c_n)[None, None, :]
+    mf_t = jnp.transpose(mf, (1, 0, 2))                   # [T, E, C]
+    ms_t = jnp.transpose(ms, (1, 0, 2))
+    pair_f = f_pair_cn[:, nodes, :]                       # [T, E, C]
+    carry["f_cnt"] = carry["f_cnt"].at[t_ix, c_ix, pair_f].add(mf_t)
+    pair_s = s_pair_cn[:, nodes, :]
+    src = s_src[:, nodes].astype(mf.dtype)                # [T, E]
+    carry["s_cnt"] = carry["s_cnt"].at[t_ix, c_ix, pair_s].add(
+        ms_t * src[:, :, None]
+    )
+    c2_ix = jnp.arange(c_n)[None, :, None]
+    carry["h_cnt"] = carry["h_cnt"].at[
+        t_ix, c2_ix, nodes[None, None, :]
+    ].add(jnp.transpose(ms, (1, 2, 0)))
+    return carry
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("weights_key", "dyn_ipa", "dyn_ports"),
@@ -901,6 +986,10 @@ class HoistedSession:
             template_fingerprint(t): i for i, t in enumerate(template_arrays_list)
         }
         self._dyn_ipa = templates_have_terms(template_arrays_list)
+        # uniform session-delta interface (tpu_backend classification):
+        # dyn_ipa names whether templates carry IPA terms — a foreign pod
+        # matching one would perturb prologue STATICS, not just the carry
+        self.dyn_ipa = self._dyn_ipa
         self._dyn_ports = templates_have_ports(template_arrays_list)
         port_adds = (
             _port_adds_for(template_arrays_list, cluster)
@@ -926,6 +1015,77 @@ class HoistedSession:
         self._S = S
         self._tp = tp
         self._c_static = {k: v for k, v in cluster.items() if k not in CARRY_KEYS}
+        # host-side numpy snapshots for the session-delta path: match
+        # evaluation (match_matrices_np) and the term-match classifier
+        # must never block behind the device stream
+        self._tp_np = {k: np.asarray(tp[k]) for k in SESSION_TP_NP_KEYS}
+        self._term_np = (
+            {k: np.asarray(tp[k]) for k in TERM_NP_KEYS}
+            if self._dyn_ipa else None
+        )
+
+    # -- incremental device-state deltas -----------------------------------
+
+    def delta_compatible(self, dres, dnz) -> bool:
+        """Every int64 utilization delta is exactly representable in this
+        session's carry (no rescale on the jnp path)."""
+        return True
+
+    def apply_deltas(self, deltas: List[Dict]) -> None:
+        """Reconcile the live session with a batch of host-encoding
+        mutations WITHOUT a rebuild. Two kinds (classified by the
+        backend, tpu_backend._queue_pod_delta):
+
+          kind=pod-add / pod-remove — a batchable pod landed on / left a
+          known node: utilization row + PTS pair counts, i.e. exactly
+          the scan's carry (the PERF_NOTES session invariant run in
+          reverse for removes). One fused launch for the whole batch.
+
+          kind=node-alloc — an allocatable-only node update: patches the
+          static alloc/allowed_pods rows (prologue products never read
+          alloc, so the carry and every other static stay valid).
+
+        Parity contract: a delta-patched session produces bit-identical
+        decisions to a fresh rebuild from the mutated encoding
+        (tests/test_session_deltas.py pins it over randomized event
+        interleavings)."""
+        pods = [d for d in deltas if d["kind"] != "node-alloc"]
+        for d in deltas:
+            if d["kind"] != "node-alloc":
+                continue
+            n = d["node"]
+            self._c_static["alloc"] = (
+                self._c_static["alloc"].at[n].add(jnp.asarray(d["dalloc"]))
+            )
+            self._c_static["allowed_pods"] = (
+                self._c_static["allowed_pods"].at[n].add(d["dallowed"])
+            )
+        if not pods:
+            return
+        e = len(pods)
+        ep = batch_bucket(e, minimum=4)  # pow2: one compile per bucket
+        r = self._carry["requested"].shape[1]
+        t_n = self._S["f_pair_cn"].shape[0]
+        c_n = self._S["f_pair_cn"].shape[2]
+        nodes = np.zeros(ep, np.int32)
+        dres = np.zeros((ep, r), np.int64)
+        dnz = np.zeros((ep, 2), np.int64)
+        dcount = np.zeros(ep, np.int32)
+        mf = np.zeros((ep, t_n, c_n), _CNT)
+        ms = np.zeros((ep, t_n, c_n), _CNT)
+        for i, d in enumerate(pods):
+            nodes[i] = d["node"]
+            dres[i] = d["dres"]
+            dnz[i] = d["dnz"]
+            dcount[i] = d["dcount"]
+            mf[i] = d["mf"]
+            ms[i] = d["ms"]
+        self._carry = _session_apply_deltas(
+            self._carry, self._S["f_pair_cn"], self._S["s_pair_cn"],
+            self._S["s_src"],
+            jnp.asarray(nodes), jnp.asarray(dres), jnp.asarray(dnz),
+            jnp.asarray(dcount), jnp.asarray(mf), jnp.asarray(ms),
+        )
 
     def schedule(self, pod_arrays_list: List[Dict]) -> Dict:
         """Enqueue one batch; returns ys (device arrays) WITHOUT blocking.

@@ -161,6 +161,37 @@ def batch_prologue(fps: Dict, tp_np: Dict, pod_arrays_list: List[Dict],
     return Bp, tmpl, mfa, msa
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _carry_delta_scan(carry, prow_f, prow_s, src_rows, perno_rows, xs):
+    """Apply a batch of cluster-event deltas to a pallas-layout carry in
+    ONE fused launch (shared by PallasSession and the sharded mirror —
+    the math is layout-identical, only Np differs). Each event is the
+    jnp twin of the kernel's _apply_updates with `best := node` and a
+    sign folded into the payload: utilization columns plus the same-pair
+    count masks (prow == prow[:, node], -1 lanes never update, exactly
+    the kernel's gating), with cnt_sn's perno/src factor reproduced
+    verbatim. lax.scan keeps the launch count at ONE regardless of the
+    event count; padding rows are node 0 with all-zero payloads."""
+
+    def step(c, x):
+        c = dict(c)
+        n = x["node"]
+        c["requested"] = c["requested"].at[:, n].add(x["dres"])
+        c["nzpc"] = c["nzpc"].at[:, n].add(x["dnzpc"])
+        pf_b = jax.lax.dynamic_index_in_dim(prow_f, n, axis=1)  # [TCp, 1]
+        same_f = (prow_f == pf_b) & (prow_f >= 0)
+        c["cnt_fn"] = c["cnt_fn"] + x["mf"][:, None] * same_f
+        ps_b = jax.lax.dynamic_index_in_dim(prow_s, n, axis=1)
+        same_s = (prow_s == ps_b) & (prow_s >= 0)
+        src_b = jax.lax.dynamic_index_in_dim(src_rows, n, axis=1)
+        factor = perno_rows + (1 - perno_rows) * src_b       # [TCp, 1]
+        c["cnt_sn"] = c["cnt_sn"] + x["ms"][:, None] * factor * same_s
+        return c, None
+
+    carry, _ = jax.lax.scan(step, carry, xs)
+    return carry
+
+
 class _Cfg(NamedTuple):
     """Value-hashable kernel configuration — the ONLY static jit input.
     Sessions with equal shapes/weights share one compiled program; the
@@ -233,6 +264,15 @@ class PallasSession:
             for k in ("ptsf_op", "ptsf_rkey", "ptsf_pairs",
                       "ptss_op", "ptss_rkey", "ptss_pairs", "self_ns")
         }
+        from .hoisted import TERM_NP_KEYS
+
+        # delta classifier input (tpu_backend): a foreign pod matching a
+        # template's own IPA terms perturbs the prologue statics, so its
+        # event cannot ride the carry-delta path
+        self._term_np = (
+            {k: np.asarray(tp[k]) for k in TERM_NP_KEYS}
+            if self.dyn_ipa else None
+        )
         S = _fetch_packed(
             _session_prologue(cluster, tp, dyn_ipa=self.dyn_ipa)
         )
@@ -290,9 +330,15 @@ class PallasSession:
         req = np.asarray(tp["req"]).astype(np.int64)            # [T, R]
         nz_requested = c["nz_requested"].astype(np.int64).T.copy()  # [2, N]
         nz_req = np.asarray(tp["nz_req"]).astype(np.int64)      # [T, 2]
+        # per-dimension rescale factors survive the build: incoming
+        # session deltas (tpu_backend carry patches) must divide by the
+        # SAME gcd to stay exact — an indivisible delta is classified
+        # structural instead (delta_compatible)
+        self._gcd = np.ones(R, np.int64)
         for r in range(R):
             extra = [nz_requested[r], nz_req[:, r]] if r < 2 else []
             g = _gcd_all(alloc[r], requested[r], req[:, r], *extra)
+            self._gcd[r] = g
             alloc[r] //= g
             requested[r] //= g
             req[:, r] //= g
@@ -465,6 +511,19 @@ class PallasSession:
 
         self._konn_f = tcn(S["f_key_on_node"])
         self._konn_s = tcn(S["s_key_on_node"])
+        # session-delta statics: row-expanded s_src (score-count node
+        # eligibility per row's template) and the per-row perno flag —
+        # the jnp twin of the kernel's _apply_updates factor, used by
+        # apply_deltas to patch cnt_sn exactly as an in-scan assume would
+        src_rows = np.zeros((TCp, Np), np.int32)
+        perno_rows = np.zeros((TCp, 1), np.int32)
+        for t in range(T):
+            for cc in range(C):
+                src_rows[t * CP + cc, :N] = S["s_src"][t].astype(np.int32)
+                perno_rows[t * CP + cc, 0] = int(self._s_perno[t, cc])
+        self._src_rows = src_rows
+        self._perno_rows = perno_rows
+        self._delta_statics = None  # device copies, built on first apply
         sha = np.zeros((_ceil(T, SUB), Np), np.int32)
         sha[:T, :N] = S["s_has_all"].astype(np.int32)
         self._shasall = sha
@@ -824,6 +883,132 @@ class PallasSession:
                 self._exec[key] = None
                 n += 1
         return n
+
+    # -- incremental device-state deltas -----------------------------------
+
+    def delta_compatible(self, dres, dnz) -> bool:
+        """A utilization delta rides this session's int32 carry only when
+        the build-time per-dimension GCD rescale stays exact on it and
+        the rescaled magnitudes keep the int32 headroom the build
+        guaranteed."""
+        dres = np.asarray(dres, np.int64)
+        if dres.shape[0] != self._gcd.shape[0]:
+            return False
+        if (dres % self._gcd != 0).any():
+            return False
+        dnz = np.asarray(dnz, np.int64)
+        if (dnz % self._gcd[:2] != 0).any():
+            return False
+        hi = max(
+            int(np.abs(dres // self._gcd).max(initial=0)),
+            int(np.abs(dnz // self._gcd[:2]).max(initial=0)),
+        )
+        return hi * (MAX_NODE_SCORE + 1) < 2 ** 31
+
+    def _delta_rows(self, d) -> tuple:
+        """One backend delta dict -> (node, dres[Rp] scaled, dnzpc[8],
+        mf[TCp], ms[TCp]) in this session's carry layout."""
+        rp = self._requested0.shape[0]
+        dres = np.zeros(rp, np.int32)
+        dnzpc = np.zeros(SUB, np.int32)
+        mf_rows = np.zeros(self.TCp, np.int32)
+        ms_rows = np.zeros(self.TCp, np.int32)
+        if d["kind"] == "node-alloc":
+            dnzpc[3] = d["dallowed"]
+        else:
+            dres[: self.R] = (
+                np.asarray(d["dres"], np.int64) // self._gcd
+            ).astype(np.int32)
+            dnzpc[0] = int(d["dnz"][0]) // int(self._gcd[0])
+            dnzpc[1] = int(d["dnz"][1]) // int(self._gcd[1])
+            dnzpc[2] = d["dcount"]
+            for t in range(self.T):
+                mf_rows[t * self.CP: t * self.CP + self.C] = d["mf"][t]
+                ms_rows[t * self.CP: t * self.CP + self.C] = d["ms"][t]
+        return d["node"], dres, dnzpc, mf_rows, ms_rows
+
+    def _patch_alloc_static(self, d) -> None:
+        """node-alloc prologue patch: the static alloc columns move (the
+        prologue never reads alloc, so nothing else needs recompute).
+        The CUMULATIVE rescaled magnitude must keep the int32 headroom
+        the build guaranteed — delta_compatible bounds one delta, not
+        the sum of many capacity bumps — so the patched column is
+        re-checked and an overflow raises (the backend's apply wrapper
+        downgrades to a rebuild, whose own envelope then decides)."""
+        scaled = (np.asarray(d["dalloc"], np.int64) // self._gcd).astype(
+            np.int32)
+        n = d["node"]
+        col = self._alloc[: self.R, n].astype(np.int64) + scaled
+        if int(np.abs(col).max(initial=0)) * (MAX_NODE_SCORE + 1) >= 2 ** 31:
+            raise ValueError(
+                "cumulative alloc patches exceed the int32 score headroom")
+        self._alloc[: self.R, n] += scaled
+        if self._bundle is not None:
+            cfg, statics, ipa = self._bundle
+            statics = dict(statics)
+            statics["alloc"] = statics["alloc"].at[:self.R, n].add(
+                jnp.asarray(scaled))
+            self._bundle = (cfg, statics, ipa)
+
+    def apply_deltas(self, deltas: List[Dict]) -> None:
+        """Absorb batched cluster-event deltas into the carry (and the
+        alloc statics) without a session rebuild — the pallas face of
+        the session-delta contract (see HoistedSession.apply_deltas).
+        With no dispatch yet (carry unmaterialized) the numpy seed
+        arrays are patched host-side; otherwise one fused
+        _carry_delta_scan launch chains onto the in-flight carry."""
+        for d in deltas:
+            if d["kind"] == "node-alloc":
+                self._patch_alloc_static(d)
+        rows = [self._delta_rows(d) for d in deltas]
+        if self._carry is None:
+            for n, dres, dnzpc, mf_rows, ms_rows in rows:
+                self._requested0[:, n] += dres
+                self._nzpc0[:, n] += dnzpc
+                same_f = (
+                    (self._prow_f == self._prow_f[:, n][:, None])
+                    & (self._prow_f >= 0)
+                )
+                self._cnt_fn0 += mf_rows[:, None] * same_f
+                same_s = (
+                    (self._prow_s == self._prow_s[:, n][:, None])
+                    & (self._prow_s >= 0)
+                )
+                factor = (
+                    self._perno_rows
+                    + (1 - self._perno_rows) * self._src_rows[:, n][:, None]
+                )
+                self._cnt_sn0 += ms_rows[:, None] * factor * same_s
+            return
+        e = len(rows)
+        from .hoisted import batch_bucket
+
+        ep = batch_bucket(e, minimum=8)  # pow2: one compile per bucket
+        xs = {
+            "node": np.zeros(ep, np.int32),
+            "dres": np.zeros((ep, self._requested0.shape[0]), np.int32),
+            "dnzpc": np.zeros((ep, SUB), np.int32),
+            "mf": np.zeros((ep, self.TCp), np.int32),
+            "ms": np.zeros((ep, self.TCp), np.int32),
+        }
+        for i, (n, dres, dnzpc, mf_rows, ms_rows) in enumerate(rows):
+            xs["node"][i] = n
+            xs["dres"][i] = dres
+            xs["dnzpc"][i] = dnzpc
+            xs["mf"][i] = mf_rows
+            xs["ms"][i] = ms_rows
+        if self._delta_statics is None:
+            self._delta_statics = {
+                "prow_f": jnp.asarray(self._prow_f),
+                "prow_s": jnp.asarray(self._prow_s),
+                "src_rows": jnp.asarray(self._src_rows),
+                "perno_rows": jnp.asarray(self._perno_rows),
+            }
+        ds = self._delta_statics
+        self._carry = _carry_delta_scan(
+            self._carry, ds["prow_f"], ds["prow_s"], ds["src_rows"],
+            ds["perno_rows"], {k: jnp.asarray(v) for k, v in xs.items()},
+        )
 
     # -- dispatch plumbing: persistent executables ------------------------
 

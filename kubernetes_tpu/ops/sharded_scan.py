@@ -66,6 +66,7 @@ from .pallas_scan import (
     SUB as SUB_IPA,
     PallasSession,
     PallasUnsupported,
+    _carry_delta_scan,
     _ceil,
     batch_prologue,
 )
@@ -503,6 +504,16 @@ class ShardedPallasSession:
         self.weights = inner.weights
         self._fps = inner._fps
         self._tp_np = inner._tp_np
+        # session-delta interface (tpu_backend classification + apply):
+        # same GCD-divisibility envelope and term-match gate as the
+        # single-device pallas carry this mirrors
+        self._gcd = inner._gcd
+        self.dyn_ipa = inner.dyn_ipa
+        self._term_np = inner._term_np
+        # host mirror of the scaled alloc columns: apply_deltas re-checks
+        # the CUMULATIVE int32 score headroom on node-alloc patches (the
+        # same guard as PallasSession._patch_alloc_static)
+        self._alloc = inner._alloc
         self.T, self.C, self.CP = inner.T, inner.C, inner.CP
         self.R, self.SR, self.K = inner.R, inner.SR, inner.K
         self.TCp = inner.TCp
@@ -613,6 +624,16 @@ class ShardedPallasSession:
         repl = NamedSharding(mesh, P())
         self._tables = {k: jax.device_put(jnp.asarray(v), repl)
                         for k, v in tables.items()}
+        # session-delta statics (apply_deltas): the same-pair masks read
+        # prow_f/prow_s (already node-sharded above); the cnt_sn factor
+        # needs the row-expanded s_src (node-sharded) + perno flags
+        self._delta_statics = {
+            "src_rows": jax.device_put(
+                jnp.asarray(padn(inner._src_rows, 1)),
+                NamedSharding(mesh, P(None, NODE_AXIS))),
+            "perno_rows": jax.device_put(
+                jnp.asarray(inner._perno_rows), repl),
+        }
         shard = NamedSharding(mesh, P(None, NODE_AXIS))
         self._carry = {
             "requested": jax.device_put(
@@ -662,6 +683,79 @@ class ShardedPallasSession:
     def decisions(ys: Dict) -> List[int]:
         best = np.asarray(ys["best"])
         return [int(v) for v in best[: ys["_b_real"]]]
+
+    # -- incremental device-state deltas -----------------------------------
+
+    # same GCD-divisibility / int32-headroom envelope as the pallas carry
+    # this mirrors (self._gcd is the inner session's)
+    delta_compatible = PallasSession.delta_compatible
+
+    def apply_deltas(self, deltas: List[Dict]) -> None:
+        """Sharded face of the session-delta contract (see
+        HoistedSession.apply_deltas): per-shard counts patch through the
+        SAME fused _carry_delta_scan — the node-sharded carry and the
+        sharded prow/src statics flow through GSPMD, so each shard
+        updates only its node slice and the per-shard kcnt partials are
+        untouched (batchable pods never enter the assumed-term counts)."""
+        rp = int(self._carry["requested"].shape[0])
+        rows = []
+        for d in deltas:
+            dres = np.zeros(rp, np.int32)
+            dnzpc = np.zeros(SUB_IPA, np.int32)
+            mf_rows = np.zeros(self.TCp, np.int32)
+            ms_rows = np.zeros(self.TCp, np.int32)
+            if d["kind"] == "node-alloc":
+                scaled = (
+                    np.asarray(d["dalloc"], np.int64) // self._gcd
+                ).astype(np.int32)
+                n = d["node"]
+                col = self._alloc[: self.R, n].astype(np.int64) + scaled
+                if int(np.abs(col).max(initial=0)) \
+                        * (MAX_NODE_SCORE + 1) >= 2 ** 31:
+                    # cumulative capacity bumps outgrew the int32 score
+                    # headroom the build guaranteed: rebuild decides
+                    raise ValueError(
+                        "cumulative alloc patches exceed the int32 "
+                        "score headroom")
+                self._alloc[: self.R, n] += scaled
+                self._statics["alloc"] = (
+                    self._statics["alloc"].at[: self.R, n].add(
+                        jnp.asarray(scaled))
+                )
+                dnzpc[3] = d["dallowed"]
+            else:
+                dres[: self.R] = (
+                    np.asarray(d["dres"], np.int64) // self._gcd
+                ).astype(np.int32)
+                dnzpc[0] = int(d["dnz"][0]) // int(self._gcd[0])
+                dnzpc[1] = int(d["dnz"][1]) // int(self._gcd[1])
+                dnzpc[2] = d["dcount"]
+                for t in range(self.T):
+                    mf_rows[t * self.CP: t * self.CP + self.C] = d["mf"][t]
+                    ms_rows[t * self.CP: t * self.CP + self.C] = d["ms"][t]
+            rows.append((d["node"], dres, dnzpc, mf_rows, ms_rows))
+        from .hoisted import batch_bucket
+
+        ep = batch_bucket(len(rows), minimum=8)
+        xs = {
+            "node": np.zeros(ep, np.int32),
+            "dres": np.zeros((ep, rp), np.int32),
+            "dnzpc": np.zeros((ep, SUB_IPA), np.int32),
+            "mf": np.zeros((ep, self.TCp), np.int32),
+            "ms": np.zeros((ep, self.TCp), np.int32),
+        }
+        for i, (n, dres, dnzpc, mf_rows, ms_rows) in enumerate(rows):
+            xs["node"][i] = n
+            xs["dres"][i] = dres
+            xs["dnzpc"][i] = dnzpc
+            xs["mf"][i] = mf_rows
+            xs["ms"][i] = ms_rows
+        self._carry = _carry_delta_scan(
+            self._carry, self._statics["prow_f"], self._statics["prow_s"],
+            self._delta_statics["src_rows"],
+            self._delta_statics["perno_rows"],
+            {k: jnp.asarray(v) for k, v in xs.items()},
+        )
 
 
 def _perno_rows(s_perno: np.ndarray, T: int, C: int, CP: int) -> np.ndarray:

@@ -273,6 +273,14 @@ class Result:
     # downgrade reason here — no benchmark row rides the slow path
     # silently (the Preferred-affinity configs did for two rounds).
     session_build_reasons: Optional[Dict[str, int]] = None
+    # WHY live sessions were torn down during the measured window
+    # (scheduler_session_rebuilds_total{reason}, IN-WINDOW delta): the
+    # rebuild-storm attribution — churn reasons (foreign-pod-add /
+    # pod-remove) here mean events fell off the delta fast path
+    session_rebuild_reasons: Optional[Dict[str, int]] = None
+    # cluster events absorbed as incremental session deltas instead of
+    # teardowns (scheduler_session_delta_applies_total{kind}, in-window)
+    session_delta_applies: Optional[Dict[str, int]] = None
     # attempts/s over the measured window — the headline for saturating
     # workloads (headline_metric says which number to read)
     attempts_per_sec: float = 0.0
@@ -312,15 +320,21 @@ def _percentile(samples: List[float], p: float) -> float:
     return s[idx]
 
 
+def _label_counts(counter, default: str = "-") -> Dict[str, int]:
+    """first-label counter aggregation -> {label: total} (session-build
+    kinds, rebuild reasons, delta kinds)."""
+    out: Dict[str, int] = {}
+    for key, val in counter.items():
+        slug = key[0] if key else default
+        out[slug] = out.get(slug, 0) + int(val)
+    return out
+
+
 def _session_build_counts() -> Dict[str, int]:
     """scheduler_tpu_session_builds_total by kind, from the live registry."""
     from ..scheduler.metrics import session_builds
 
-    out: Dict[str, int] = {}
-    for key, val in session_builds.items():
-        kind = key[0] if key else "unknown"
-        out[kind] = out.get(kind, 0) + int(val)
-    return out
+    return _label_counts(session_builds, default="unknown")
 
 
 def _session_build_reasons() -> Dict[str, int]:
@@ -335,6 +349,12 @@ def _session_build_reasons() -> Dict[str, int]:
         slug = f"{kind}/{reason}"
         out[slug] = out.get(slug, 0) + int(val)
     return out
+
+
+def _counter_window(now: Dict[str, int], base: Dict[str, int]) -> Dict[str, int]:
+    return {
+        k: v - base.get(k, 0) for k, v in now.items() if v - base.get(k, 0)
+    }
 
 
 def run_workload(w: Workload, quiet: bool = True) -> Result:
@@ -565,8 +585,15 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
                 if sched_metrics.SCHEDULED in k
             ))
 
+        from ..scheduler.metrics import (
+            session_delta_applies,
+            session_rebuilds,
+        )
+
         attempts0 = total_attempts()
         builds0 = _session_build_counts()
+        rebuild_reasons0 = _label_counts(session_rebuilds)
+        delta_applies0 = _label_counts(session_delta_applies)
         bound0 = bound_count()
         n_ts0 = len(sched.bind_timestamps)
         t0 = time.perf_counter()
@@ -677,6 +704,12 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             session_builds=builds,
             session_builds_total=builds_total,
             session_build_reasons=_session_build_reasons(),
+            session_rebuild_reasons=_counter_window(
+                _label_counts(session_rebuilds), rebuild_reasons0
+            ),
+            session_delta_applies=_counter_window(
+                _label_counts(session_delta_applies), delta_applies0
+            ),
             session_kind=(
                 type(sched.tpu._session).__name__
                 if sched.tpu is not None and sched.tpu._session is not None

@@ -146,6 +146,32 @@ worker_restarts = legacy_registry.register(
         ("worker",),
     )
 )
+session_rebuilds = legacy_registry.register(
+    Counter(
+        "scheduler_session_rebuilds_total",
+        "Live device sessions torn down, by WHY (TPU-build metric). "
+        "Every teardown costs the next batch a full rebuild (prologue "
+        "sweeps + cluster upload, ~seconds on a tunneled chip), so this "
+        "counter is the rebuild-storm detector: cluster-churn reasons "
+        "(foreign-pod-add, pod-remove) should be near zero now that "
+        "batchable pod events apply as carry deltas "
+        "(scheduler_session_delta_applies_total) — a sustained rate "
+        "there means events are falling off the delta fast path.",
+        ("reason",),
+    )
+)
+session_delta_applies = legacy_registry.register(
+    Counter(
+        "scheduler_session_delta_applies_total",
+        "Cluster events absorbed into the LIVE device session as "
+        "incremental state deltas instead of session teardowns "
+        "(TPU-build metric): kind=pod-add / pod-remove are batchable-pod "
+        "carry deltas (utilization row + PTS pair-count patch), "
+        "kind=node-alloc is an allocatable-only prologue patch. Each "
+        "apply replaces a full rebuild on the old path.",
+        ("kind",),
+    )
+)
 session_builds = legacy_registry.register(
     Counter(
         "scheduler_tpu_session_builds_total",

@@ -31,7 +31,12 @@ from ..api import types as v1
 from ..models.encoding import ClusterEncoding
 from ..models.pod_encoder import PodEncoder
 from ..ops.batch import shape_signature
-from ..ops.hoisted import HoistedSession, template_fingerprint
+from ..ops.hoisted import (
+    HoistedSession,
+    ipa_term_match_np,
+    match_matrices_np,
+    template_fingerprint,
+)
 from .degradation import (
     RUNG_HOISTED,
     RUNG_ORACLE,
@@ -120,6 +125,24 @@ class TPUBackend(CacheListener):
         # batch rebuilds it from the synced encoding.
         self._session = None  # HoistedSession or pallas PallasSession
         self._session_assumed: set = set()
+        # incremental device-state deltas: cluster events the classifier
+        # proved touch ONLY the session's carry (batchable pod add/remove
+        # on a known node) or template-invariant statics (allocatable-only
+        # node updates) queue here instead of tearing the session down,
+        # and the next dispatch applies them in one fused launch
+        # (_apply_session_deltas_locked). Teardown stays the path for
+        # everything structural: node add/remove, pods with affinity
+        # terms or host ports, vocab/capacity growth. The kill switch
+        # exists for A/B parity runs (tests + probe_session_deltas.py).
+        self._deltas: List[Dict] = []
+        self.delta_patching = (
+            os.environ.get("KTPU_SESSION_DELTAS", "1") == "1"
+        )
+        # backstop for an idle scheduler accumulating events with no
+        # dispatch to flush them: past this the rebuild is cheaper than
+        # the queue is worth, and the teardown path absorbs everything
+        self.max_queued_deltas = int(
+            os.environ.get("KTPU_MAX_QUEUED_DELTAS", "4096"))
         self._node_fps: Dict[str, tuple] = {}  # heartbeat-change gate
         self._known_templates: Dict = {}  # fingerprint -> pod arrays
         # in-flight batches, oldest first. Depth 2 double-buffers the
@@ -190,7 +213,7 @@ class TPUBackend(CacheListener):
         limit column (reads 0 = limit 0) — rebuild before the next
         dispatch treats every node as attach-full."""
         with self._lock:
-            self._invalidate_session()
+            self._invalidate_session("volume-driver")
             self.enc._rebuild_needed = True
 
     def volume_kernel_safe(self, pod: v1.Pod) -> bool:
@@ -215,7 +238,7 @@ class TPUBackend(CacheListener):
             resolver.bump()
             if not self._volume_obj_encoded(kind, obj, resolver):
                 return
-            self._invalidate_session()
+            self._invalidate_session("volume-change")
             self.enc._rebuild_needed = True
 
     @staticmethod
@@ -239,18 +262,26 @@ class TPUBackend(CacheListener):
             return True
         return True
 
-    def _invalidate_session(self) -> None:
+    def _invalidate_session(self, reason: str = "unspecified") -> None:
         # _session_assumed survives invalidation deliberately: an assume
         # echo (cache confirming a pod the torn-down session scheduled)
         # is host-bookkeeping either way and must not tear down the NEXT
-        # session too
+        # session too. Queued deltas do NOT survive: they reconcile the
+        # LIVE session with the encoding, and the fresh session builds
+        # from the already-mutated encoding.
         import os as _os
 
-        if self._session is not None and _os.environ.get(
-                "KTPU_DEBUG_INVALIDATE"):
+        self._deltas.clear()
+        if self._session is None:
+            return
+        from .metrics import session_rebuilds
+
+        session_rebuilds.inc(reason=reason)
+        if _os.environ.get("KTPU_DEBUG_INVALIDATE"):
             import traceback as _tb
 
-            print("SESSION INVALIDATED BY:", file=__import__("sys").stderr)
+            print(f"SESSION INVALIDATED ({reason}) BY:",
+                  file=__import__("sys").stderr)
             _tb.print_stack(limit=8)
         self._session = None
 
@@ -336,7 +367,7 @@ class TPUBackend(CacheListener):
             # responsive device again
             self.faults.consume_wedge()
         self._suspect_buckets.update(b for b in buckets if b is not None)
-        self._invalidate_session()
+        self._invalidate_session("device-fault")
         if self.ladder.record_fault(kind):
             logger.warning(
                 "TPU backend demoted to %s after %d consecutive device "
@@ -432,7 +463,7 @@ class TPUBackend(CacheListener):
                 h.results = [(p, RETRY_NODE) for p in h.group]
             self._pending.clear()
             if n:
-                self._invalidate_session()
+                self._invalidate_session("abandon-pending")
             return n
 
     # -- ladder probe: background re-promotion -----------------------------
@@ -466,7 +497,7 @@ class TPUBackend(CacheListener):
                 )
                 with self._lock:
                     # the next batch must rebuild at the restored rung
-                    self._invalidate_session()
+                    self._invalidate_session("probe-promoted")
 
     def _probe_device(self) -> bool:
         """One canary with a known answer through the same fault seam as
@@ -500,6 +531,24 @@ class TPUBackend(CacheListener):
             t.join(timeout=2)
 
     # -- CacheListener (called under the cache lock) -----------------------
+    # Classification contract (the session-delta design): every event is
+    # one of
+    #   carry-delta     — a batchable pod (no affinity terms, no host
+    #                     ports) added to / removed from a KNOWN node,
+    #                     whose row fits the encoding incrementally and
+    #                     whose labels match no session template's IPA
+    #                     term: exactly (a) a utilization row and (b) PTS
+    #                     pair counts move — both ARE the session carry
+    #                     (the PERF_NOTES exactness invariant), so the
+    #                     event queues as a device-side patch;
+    #   prologue-patch  — a node update whose fingerprint moved ONLY in
+    #                     allocatable/capacity: alloc is read in-step,
+    #                     never by the prologue, so the static column
+    #                     patches in place;
+    #   structural      — everything else (node add/remove, term/port
+    #                     pods, vocab or capacity growth, volume-world
+    #                     changes): the old path — session teardown, full
+    #                     rebuild at the next dispatch.
 
     def on_add_pod(self, pod: v1.Pod, node_name: str) -> None:
         with self._lock:
@@ -508,19 +557,40 @@ class TPUBackend(CacheListener):
                 # the cache confirming an assume the session already
                 # applied on-device: host bookkeeping only
                 self._session_assumed.discard(key)
-            else:
-                self._invalidate_session()
-            self.enc.add_pod(pod, node_name)
+                self.enc.add_pod(pod, node_name)
+                return
+            if v1.pod_key(pod) in self.enc._pods:
+                # duplicate add (re-add of a key the encoding already
+                # holds nets a remove+add inside enc.add_pod — the old
+                # row's counts are not reconstructible here)
+                self._invalidate_session("foreign-pod-add")
+                self.enc.add_pod(pod, node_name)
+                return
+            if not self._queue_pod_delta(
+                pod, node_name, +1,
+                lambda: self.enc.add_pod(pod, node_name),
+            ):
+                self._invalidate_session("foreign-pod-add")
 
     def on_remove_pod(self, pod: v1.Pod, node_name: str) -> None:
         with self._lock:
-            self._invalidate_session()
-            self.enc.remove_pod(pod)
+            # mirror of the add path's assume-echo gate: removing a pod
+            # the encoding never contained (never encoded, or bound to
+            # no node) is a no-op, not a session teardown
+            if not node_name or v1.pod_key(pod) not in self.enc._pods:
+                return
+            self._session_assumed.discard(
+                (pod.metadata.namespace, pod.metadata.name, node_name)
+            )
+            if not self._queue_pod_delta(
+                pod, node_name, -1, lambda: self.enc.remove_pod(pod),
+            ):
+                self._invalidate_session("pod-remove")
 
     def on_add_node(self, node: v1.Node) -> None:
         with self._lock:
             self._node_fps[node.metadata.name] = ClusterEncoding.node_fingerprint(node)
-            self._invalidate_session()
+            self._invalidate_session("node-add")
             self.enc.add_node(node)
 
     def on_update_node(self, node: v1.Node) -> None:
@@ -532,18 +602,200 @@ class TPUBackend(CacheListener):
             # cross-batch session useless in a live cluster. Only
             # scheduling-relevant changes (labels, annotations, taints,
             # unschedulable, allocatable/capacity, images) invalidate.
+            name = node.metadata.name
             fp = ClusterEncoding.node_fingerprint(node)
-            if self._node_fps.get(node.metadata.name) == fp:
+            old = self._node_fps.get(name)
+            if old == fp:
                 return
-            self._node_fps[node.metadata.name] = fp
-            self._invalidate_session()
+            self._node_fps[name] = fp
+            if self._queue_alloc_patch(node, old, fp):
+                return
+            self._invalidate_session("node-update")
             self.enc.update_node(node)
+
+    def _queue_alloc_patch(self, node: v1.Node, old, fp) -> bool:
+        """Prologue-patch classification for a node update: when ONLY the
+        allocatable/capacity slot of the fingerprint moved, the encoding
+        updates the row in place and the live session patches its static
+        alloc column — no other prologue product reads alloc (fit and
+        the utilization scores consume it in-step), so nothing else
+        needs recomputing. False -> caller takes the structural path."""
+        sess = self._session
+        if (
+            not self.delta_patching
+            or sess is None
+            or old is None
+            or len(self._deltas) >= self.max_queued_deltas
+            or self.enc._rebuild_needed
+            # fingerprint slots: labels, avoid-annotation, taints,
+            # unschedulable, alloc, images — everything but alloc equal
+            or old[:4] != fp[:4]
+            or old[5] != fp[5]
+        ):
+            return False
+        got = self.enc.update_node_alloc(node)
+        if got is None:
+            return False
+        dalloc, dallowed = got
+        if not sess.delta_compatible(dalloc, np.zeros(2, np.int64)):
+            # the row is already patched in the host encoding (dirty-row
+            # sync covers the next build); only the session must go
+            self._invalidate_session("node-update")
+            return True
+        nidx = self.enc.node_index[node.metadata.name]
+        self._deltas.append({
+            "kind": "node-alloc", "node": nidx,
+            "dalloc": dalloc, "dallowed": dallowed,
+        })
+        return True
 
     def on_remove_node(self, node_name: str) -> None:
         with self._lock:
             self._node_fps.pop(node_name, None)
-            self._invalidate_session()
+            self._invalidate_session("node-remove")
             self.enc.remove_node(node_name)
+
+    # -- session-delta classification + apply ------------------------------
+
+    def _pod_self_rows(self, pod: v1.Pod) -> Dict:
+        """The pod's label/namespace bit rows at current vocab widths —
+        what match_matrices_np and the term-match classifier evaluate.
+        Built with get() (never intern): a label pair the vocab has
+        never seen cannot appear in any compiled selector, so the zero
+        sentinel is exact."""
+        enc = self.enc
+        pp = np.zeros(enc.pod_pair_vocab.capacity, bool)
+        pk = np.zeros(enc.pod_key_vocab.capacity, bool)
+        for k, val in (pod.metadata.labels or {}).items():
+            kid = enc.pod_key_vocab.get(k)
+            pid = enc.pod_pair_vocab.get((k, val))
+            if kid:
+                pk[kid] = True
+            if pid:
+                pp[pid] = True
+        return {
+            "self_ppair": pp, "self_pkey": pk,
+            "self_ns": np.int32(enc.ns_vocab.get(pod.metadata.namespace)),
+        }
+
+    @staticmethod
+    def _pod_structural(pod: v1.Pod) -> bool:
+        """Pods whose assume/remove touches term/port tables (the exact
+        complement of ops/batch.py pod_batchable, from the spec)."""
+        from .framework.types import PodInfo
+
+        pi = PodInfo(pod)
+        if (
+            pi.required_affinity_terms
+            or pi.required_anti_affinity_terms
+            or pi.preferred_affinity_terms
+            or pi.preferred_anti_affinity_terms
+        ):
+            return True
+        return any(
+            port.host_port > 0
+            for c in pod.spec.containers
+            for port in c.ports or []
+        )
+
+    def _queue_pod_delta(self, pod: v1.Pod, node_name: str, sign: int,
+                         mutate) -> bool:
+        """Run `mutate` (the host-encoding update) and try to absorb the
+        event into the live session as a carry delta. True -> the event
+        is fully reconciled (delta queued, or no live session to
+        reconcile); False -> structural, the caller tears the session
+        down. The utilization delta is captured as the host ROW diff
+        around the mutation, so volume attach-scalar extras and every
+        other row-math subtlety transfer exactly."""
+        sess = self._session
+        enc = self.enc
+        nidx = None
+        snap = None
+        if (
+            self.delta_patching
+            and sess is not None
+            and len(self._deltas) < self.max_queued_deltas
+            and not enc._rebuild_needed
+            # a remove must hit the row the encoding actually holds: a
+            # relocated pod (informer-wins path) removes from its STORED
+            # node, which is the node_name the cache passes — verify
+            and (sign > 0
+                 or enc._pods.get(v1.pod_key(pod), (None, node_name))[1]
+                 == node_name)
+        ):
+            nidx = enc.node_index.get(node_name)
+            if nidx is not None:
+                A = enc._arrays
+                snap = (
+                    A["requested"][nidx].copy(),
+                    A["nz_requested"][nidx].copy(),
+                    int(A["pod_count"][nidx]),
+                )
+        mutate()
+        if sess is None:
+            # nothing device-resident to reconcile; the next session
+            # builds from the mutated encoding
+            return True
+        if snap is None or enc._rebuild_needed:
+            return False  # structural: unknown node or capacity growth
+        if self._pod_structural(pod):
+            return False
+        rows = self._pod_self_rows(pod)
+        if getattr(sess, "dyn_ipa", False) and ipa_term_match_np(
+                sess._term_np, rows):
+            # the pod counts toward a template's own-term statics
+            # (anti/aff counts, D5 score rows) — not carry-only
+            return False
+        A = enc._arrays
+        dres = A["requested"][nidx] - snap[0]
+        dnz = A["nz_requested"][nidx] - snap[1]
+        dcount = int(A["pod_count"][nidx]) - snap[2]
+        if not sess.delta_compatible(dres, dnz):
+            return False  # pallas int32/GCD envelope
+        t_n = sess._tp_np["self_ns"].shape[0]
+        c_n = sess._tp_np["ptsf_op"].shape[1]
+        if pod.metadata.deletion_timestamp is not None:
+            # terminating pods never enter the prologue's PTS counts
+            # (the ~pterm gate); only utilization moves
+            mf = np.zeros((t_n, c_n), np.int32)
+            ms = np.zeros((t_n, c_n), np.int32)
+        else:
+            mfa, msa = match_matrices_np(sess._tp_np, [rows])
+            mf = mfa[:, 0, :].astype(np.int32) * sign
+            ms = msa[:, 0, :].astype(np.int32) * sign
+        self._deltas.append({
+            "kind": "pod-add" if sign > 0 else "pod-remove",
+            "node": nidx, "dres": dres, "dnz": dnz, "dcount": dcount,
+            "mf": mf, "ms": ms,
+        })
+        return True
+
+    def _apply_session_deltas_locked(self) -> None:
+        """Flush the queued deltas into the live session in one fused
+        launch — called right before a dispatch rides the session, so
+        patches chain onto any in-flight scans as pure data
+        dependencies. An apply failure downgrades to the structural
+        path (teardown + rebuild from the already-mutated encoding) —
+        never to wrong state."""
+        if not self._deltas:
+            return
+        if self._session is None:
+            self._deltas.clear()
+            return
+        deltas, self._deltas = self._deltas, []
+        from .metrics import session_delta_applies
+
+        try:
+            self._session.apply_deltas(deltas)
+        except Exception:  # noqa: BLE001 — rebuild is always correct
+            logger.warning(
+                "session delta apply failed; falling back to a rebuild",
+                exc_info=True,
+            )
+            self._invalidate_session("delta-apply-failed")
+            return
+        for d in deltas:
+            session_delta_applies.inc(kind=d["kind"])
 
     # -- scheduling --------------------------------------------------------
 
@@ -562,7 +814,7 @@ class TPUBackend(CacheListener):
             # scheduler core's unschedulable re-dispatch (scheduler.py
             # _schedule_batch_tpu), whose enc.add_pod()s would otherwise
             # leave a surviving session's carry missing those pods.
-            self._invalidate_session()
+            self._invalidate_session("single-pod-dispatch")
             try:
                 p = {k: v for k, v in self.pe.encode(pod).items()
                      if not k.startswith("_")}
@@ -619,7 +871,7 @@ class TPUBackend(CacheListener):
                 return [(RETRY_NODE, {}) for _ in pods]
             # device_state() with dirty rows donates buffers a live
             # session still references — same discipline as schedule()
-            self._invalidate_session()
+            self._invalidate_session("reevaluate")
             c = self.enc.device_state()
             if self.mesh is not None:
                 from ..parallel import sharded
@@ -757,6 +1009,14 @@ class TPUBackend(CacheListener):
                     )
                 ):
                     try:
+                        # queued cluster-event deltas land first (one
+                        # fused launch chained on the carry) so this
+                        # scan evaluates the reconciled state
+                        self._apply_session_deltas_locked()
+                        if self._session is None:
+                            # delta apply failed: structural fallback
+                            h.results = self.schedule_many(pods)
+                            return h
                         self._check_dispatch_fault()
                         ys = self._session.schedule(clean)  # async, no block
                     except Exception:  # noqa: BLE001 — dispatch-time fault:
@@ -963,7 +1223,7 @@ class TPUBackend(CacheListener):
             # the session down first
             from ..ops.hoisted import schedule_batch_hoisted
 
-            self._invalidate_session()
+            self._invalidate_session("template-overflow")
             cluster = self.enc.device_state()
             if self.mesh is not None:
                 from ..parallel import sharded
@@ -984,7 +1244,7 @@ class TPUBackend(CacheListener):
         if stale:
             for fp in stale:
                 del self._known_templates[fp]
-            self._invalidate_session()
+            self._invalidate_session("shape-change")
         new = [fp for fp in uniq if fp not in self._known_templates]
         if new:
             for fp in new:
@@ -999,9 +1259,18 @@ class TPUBackend(CacheListener):
                         break
                 else:
                     break
-            self._invalidate_session()
+            self._invalidate_session("new-template")
         if self._session is None:
             self._session = self._build_session()
+        else:
+            # a surviving session may carry queued cluster-event deltas:
+            # reconcile before this scan chains on the carry (a FRESH
+            # build needs none — the encoding it built from already
+            # holds every mutation, and _invalidate_session cleared the
+            # queue)
+            self._apply_session_deltas_locked()
+            if self._session is None:  # apply failed -> rebuild now
+                self._session = self._build_session()
         ys = self._session.schedule(arrays)
         # decisions() decodes through np.asarray, an UNBOUNDED device
         # wait — bound it with the watchdog first or the synchronous

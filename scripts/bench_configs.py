@@ -295,6 +295,18 @@ def main() -> None:
         line["reps"] = reps
         line["throughput_avg_runs"] = [r["throughput_avg"] for r in runs]
         line["attempts_per_sec_runs"] = [r["attempts_per_sec"] for r in runs]
+        # per-rep session accounting: the rebuild storm was invisible
+        # when only the median rep's dict survived (Preemption-PDB's
+        # [62.4, 123.6, 123.1] reps hid 60+ rebuilds in rep 0)
+        line["session_builds_runs"] = [
+            r.get("session_builds") for r in runs
+        ]
+        line["session_rebuild_reasons_runs"] = [
+            r.get("session_rebuild_reasons") for r in runs
+        ]
+        line["session_delta_applies_runs"] = [
+            r.get("session_delta_applies") for r in runs
+        ]
         line["throughput_avg_min"] = min(r["throughput_avg"] for r in runs)
         line["throughput_avg_median"] = _median(
             [r["throughput_avg"] for r in runs]

@@ -506,9 +506,18 @@ class TestPipelinedDispatch:
         b.schedule_many(self._pods("warm2", 2))
         h = b.dispatch_many(self._pods("x", 3))
         assert h.results is None, "post-warm batch should pipeline"
-        # foreign mutation invalidates the session mid-flight
+        # a foreign BATCHABLE pod whose labels match no template term is
+        # absorbed as a carry delta mid-flight — the session survives
         foreign = make_pod("foreign", cpu="10m", node_name="n-0")
         b.on_add_pod(foreign, b.enc.node_names[0])
+        assert b._session is not None and b._deltas, (
+            "batchable foreign add should queue a carry delta"
+        )
+        # a foreign pod MATCHING a template's own anti-affinity term
+        # perturbs prologue statics: still a mid-flight teardown
+        matcher = make_pod("matcher", cpu="10m", node_name="n-0",
+                           labels={"app": "pl"})
+        b.on_add_pod(matcher, b.enc.node_names[0])
         assert b._session is None
         results = b.harvest(h)  # ys stay valid; decode fn was captured
         assert len(results) == 3
