@@ -252,7 +252,13 @@ def main() -> None:
             )
 
             try:
-                sess = PallasSession(enc.device_state(), templates)
+                # multipod_k=1: the harvest below treats decisions() as
+                # final (no conflict-suffix replay loop), and the headline
+                # must stay comparable across rounds — one-pod-per-step.
+                # Multipod rates are probed by scripts/probe_multipod.py
+                # and measured in the bench rows' own counters.
+                sess = PallasSession(enc.device_state(), templates,
+                                     multipod_k=1)
                 log("scan kernel: pallas single-launch")
             except PallasUnsupported as e:
                 log(f"pallas unsupported ({e}); using jnp session")

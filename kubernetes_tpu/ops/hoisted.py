@@ -161,9 +161,20 @@ def _term_gates(tp: Dict):
         jnp.all(jnp.where(tp["ipaa_valid"][:, :, None], m_aff, True), axis=1)
         & has_aff[:, None]
     )  # [T(owner), T(entity)]
+    # template-level IPA interference for the multipod conflict test:
+    # G[u, t] true when assuming a template-u pod can perturb ANY of the
+    # D1-D5 quantities a template-t evaluation reads (u_cnt[u]/k_cnt[u]
+    # flow through M_anti[u,:,t] / M_anti[t,:,u] / match_all[t,u] /
+    # M_aff[u,:,t] / M_pref[u,:,t] / M_pref[t,:,u]). Symmetrized: a
+    # conservative superset is sound — a false positive only costs a
+    # replay, never a wrong decision.
+    a1 = jnp.any(m_anti, axis=1)
+    a2 = jnp.any(m_aff, axis=1)
+    a3 = jnp.any(m_pref, axis=1)
+    g = (a1 | a1.T | a2 | a2.T | a3 | a3.T | match_all | match_all.T)
     return {
         "M_anti": m_anti, "M_aff": m_aff, "M_pref": m_pref,
-        "match_all": match_all,
+        "match_all": match_all, "G_ipa": g,
     }
 
 
@@ -462,10 +473,14 @@ def match_matrices_np(tp_np: Dict, pod_arrays_list: List[Dict]):
 # the scan step
 
 
-def _step(S: Dict, c_static: Dict, weights: Dict, dyn_ipa: bool,
-          dyn_ports: bool, carry: Dict, x: Dict):
-    tj = x["tmpl"]
-    j = x["j"]
+def _eval_pod(S: Dict, c_static: Dict, weights: Dict, dyn_ipa: bool,
+              dyn_ports: bool, carry: Dict, tj):
+    """Filter + score one pod of template `tj` against `carry` WITHOUT
+    committing: returns (feasible [N] bool, total [N] int64 with -1 at
+    infeasible nodes, n_feasible scalar). The one-pod _step and the
+    multipod _step_multi both build on this — the eval math exists
+    exactly once, so the speculative k-wide evaluation cannot drift
+    from the sequential reference."""
     n = c_static["valid"].shape[0]
     vnp = c_static["npair"].shape[1]
     col = jnp.arange(vnp)[None, :]
@@ -669,9 +684,17 @@ def _step(S: Dict, c_static: Dict, weights: Dict, dyn_ipa: bool,
         + sc_taint * weights["taint"]
     )
     total = jnp.where(feasible, total, -1)
+    return feasible, total, jnp.sum(feasible.astype(jnp.int32))
 
-    best = jnp.argmax(total).astype(jnp.int32)
-    ok = (total[best] >= 0) & x["valid"]
+
+def _commit_pod(S: Dict, c_static: Dict, dyn_ipa: bool, dyn_ports: bool,
+                carry: Dict, tj, j, best, ok):
+    """Apply one decided pod (batch row j, template tj, node `best`) to
+    the carry — the assume side of the step, shared verbatim by _step
+    and _step_multi. All updates are gated on `ok` (no-op for failed /
+    padding rows)."""
+    req = S["req"][tj]
+    nz_req = S["nz_req"][tj]
     add64 = ok.astype(_I64)
     addc = ok.astype(_CNT)
 
@@ -705,13 +728,144 @@ def _step(S: Dict, c_static: Dict, weights: Dict, dyn_ipa: bool,
         carry["cp_any"] = carry["cp_any"].at[best].add(S["padd_any"][tj] * addc)
         carry["cp_wild"] = carry["cp_wild"].at[best].add(S["padd_wild"][tj] * addc)
         carry["cp_trip"] = carry["cp_trip"].at[best].add(S["padd_trip"][tj] * addc)
+    return carry
 
+
+def _step(S: Dict, c_static: Dict, weights: Dict, dyn_ipa: bool,
+          dyn_ports: bool, carry: Dict, x: Dict):
+    feasible, total, n_feasible = _eval_pod(
+        S, c_static, weights, dyn_ipa, dyn_ports, carry, x["tmpl"]
+    )
+    best = jnp.argmax(total).astype(jnp.int32)
+    ok = (total[best] >= 0) & x["valid"]
+    carry = _commit_pod(
+        S, c_static, dyn_ipa, dyn_ports, carry, x["tmpl"], x["j"], best, ok
+    )
     y = {
         "best": jnp.where(ok, best, -1),
         "score": jnp.where(ok, total[best], -1),
-        "n_feasible": jnp.sum(feasible.astype(jnp.int32)),
+        "n_feasible": n_feasible,
     }
     return carry, y
+
+
+def _step_multi(S: Dict, c_static: Dict, weights: Dict, dyn_ipa: bool,
+                dyn_ports: bool, k: int, carry: Dict, xk: Dict):
+    """k pods per scan step with EXACT conflict replay (PERF_NOTES
+    round 9): all k pods are filtered + scored in ONE vmapped evaluation
+    against the step-initial carry (the device-parallel win — the common
+    no-conflict case costs one eval for k pods), then a cheap inner scan
+    commits them in order. A pod's speculative decision stands only when
+    NONE of the step's earlier committed pods could have perturbed what
+    its evaluation read:
+
+      same-node  — an earlier pod consumed capacity on the chosen node
+                   (the stale score there cannot stand);
+      PTS        — an earlier pod's row matches one of this template's
+                   VALID spread selectors (Mf/Ms gated by f/s_valid):
+                   the f_cnt/s_cnt/h_cnt rows this pod reads moved.
+                   Counts written to invalid constraint slots are never
+                   read (f_same_key/terms are valid-gated), so the gate
+                   is exact at template granularity;
+      IPA        — template-level interference via the prologue's G_ipa
+                   superset (u_cnt/k_cnt flow through the D1-D5 gates);
+      fit flip / — the shared utilization algebra
+      overtake     (kernel.multipod_utilization_conflicts): fit /
+                   balanced / least are the ONLY carry-reading plugins
+                   left once the count gates are clean, so re-evaluating
+                   exactly those three against the current carry decides
+                   exactness.
+
+    A conflicted pod REPLAYS in-device (lax.cond) — the full eval against
+    the current carry, i.e. the sequential reference computation — so
+    decisions, scores and n_feasible stay bit-identical to
+    one-pod-per-step whatever the conflict rate. Replays are counted in
+    ys["conflicts"] (scheduler_multipod_conflicts_total)."""
+    carry0 = carry
+    ev_feas, ev_total, ev_nfeas = jax.vmap(
+        lambda t: _eval_pod(S, c_static, weights, dyn_ipa, dyn_ports,
+                            carry0, t)
+    )(xk["tmpl"])
+    n = c_static["valid"].shape[0]
+    lane = jnp.arange(n, dtype=jnp.int32)
+    w_bal = weights["balanced"]
+    w_least = weights["least"]
+    alloc = c_static["alloc"]
+
+    def wbl(nz_requested, nz_req):
+        return (
+            K.balanced_score(nz_requested, nz_req, alloc) * w_bal
+            + K.least_allocated_score(nz_requested, nz_req, alloc) * w_least
+        )
+
+    def inner(state, i):
+        carry_i, best_arr, ok_arr = state
+        tj = xk["tmpl"][i]
+        jj = xk["j"][i]
+        valid_i = xk["valid"][i]
+        total_i = ev_total[i]
+        feas_i = ev_feas[i]
+        best_spec = jnp.argmax(total_i).astype(jnp.int32)
+        score_spec = total_i[best_spec]
+        # committed earlier pods of this step (placed: best_arr >= 0)
+        prior = (jnp.arange(k) < i) & ok_arr
+        same = jnp.any(prior & (best_arr == best_spec)) & (score_spec >= 0)
+        mf_k = (S["Mf"][tj][xk["j"]] != 0) & S["f_valid"][tj][None, :]
+        ms_k = (S["Ms"][tj][xk["j"]] != 0) & S["s_valid"][tj][None, :]
+        pts_conf = jnp.any(
+            prior & (jnp.any(mf_k, axis=1) | jnp.any(ms_k, axis=1))
+        )
+        if dyn_ipa:
+            ipa_conf = jnp.any(prior & S["G_ipa"][xk["tmpl"], tj])
+        else:
+            ipa_conf = jnp.bool_(False)
+        nz_req = S["nz_req"][tj]
+        fit_new = K.fit_mask(
+            carry_i["requested"], carry_i["pod_count"], alloc,
+            c_static["allowed_pods"], S["req"][tj], S["req_check"][tj],
+            S["req_has_any"][tj],
+        )
+        flip_row, over_row = K.multipod_utilization_conflicts(
+            feas_i, total_i, best_spec, score_spec, lane, fit_new,
+            wbl(carry0["nz_requested"], nz_req),
+            wbl(carry_i["nz_requested"], nz_req),
+        )
+        util_conf = jnp.any(flip_row) | (
+            jnp.any(over_row) & (score_spec >= 0)
+        )
+        conflict = (same | pts_conf | ipa_conf | util_conf) & valid_i
+
+        def replay(c):
+            _, t2, nf2 = _eval_pod(
+                S, c_static, weights, dyn_ipa, dyn_ports, c, tj
+            )
+            b2 = jnp.argmax(t2).astype(jnp.int32)
+            return b2, t2[b2], nf2
+
+        def spec(c):
+            return best_spec, score_spec, ev_nfeas[i]
+
+        best, score, n_feasible = jax.lax.cond(conflict, replay, spec,
+                                               carry_i)
+        ok = (score >= 0) & valid_i
+        carry_i = _commit_pod(
+            S, c_static, dyn_ipa, dyn_ports, carry_i, tj, jj, best, ok
+        )
+        y = {
+            "best": jnp.where(ok, best, -1),
+            "score": jnp.where(ok, score, -1),
+            "n_feasible": n_feasible,
+            "conflicts": conflict.astype(jnp.int32),
+        }
+        return (
+            (carry_i, best_arr.at[i].set(jnp.where(ok, best, -1)),
+             ok_arr.at[i].set(ok)),
+            y,
+        )
+
+    state = (carry, jnp.full(k, -1, jnp.int32), jnp.zeros(k, bool))
+    (carry, _, _), ys = jax.lax.scan(inner, state, jnp.arange(k))
+    return carry, ys
 
 
 # tp keys the step reads directly when the dynamic-IPA / dynamic-ports
@@ -922,20 +1076,33 @@ def _session_apply_deltas(carry, f_pair_cn, s_pair_cn, s_src,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("weights_key", "dyn_ipa", "dyn_ports"),
+    static_argnames=("weights_key", "dyn_ipa", "dyn_ports", "k"),
     donate_argnames=("carry",),
 )
 def _session_scan(S, c_static, tp, carry, batch_self, xs, weights_key,
-                  dyn_ipa: bool = False, dyn_ports: bool = False):
+                  dyn_ipa: bool = False, dyn_ports: bool = False,
+                  k: int = 1):
     weights = dict(weights_key)
     S = dict(S)
     S["Mf"], S["Ms"] = _match_matrices(tp, batch_self)
-    step = functools.partial(_step, S, c_static, weights, dyn_ipa, dyn_ports)
     # unroll: the tunnel pays a fixed cost per fused-kernel launch, and
     # launches scale with scan iterations; unrolling trades compile time
     # for fewer iterations (semantics identical) — see PERF_NOTES.md
     unroll = int(os.environ.get("KTPU_SCAN_UNROLL", "1"))
-    return jax.lax.scan(step, carry, xs, unroll=unroll)
+    if k <= 1:
+        step = functools.partial(_step, S, c_static, weights, dyn_ipa,
+                                 dyn_ports)
+        return jax.lax.scan(step, carry, xs, unroll=unroll)
+    # multipod: fold the batch axis into [steps, k] — every pow2 bucket
+    # divides by the pow2 k (kernel.multipod_k clamps it) — and run the
+    # k-wide step; ys come back [steps, k, ...] and unfold to [Bp, ...]
+    bp = int(xs["tmpl"].shape[0])
+    xk = {key: v.reshape((bp // k, k) + v.shape[1:]) for key, v in xs.items()}
+    step = functools.partial(_step_multi, S, c_static, weights, dyn_ipa,
+                             dyn_ports, k)
+    carry, ys = jax.lax.scan(step, carry, xk, unroll=unroll)
+    ys = {key: v.reshape((bp,) + v.shape[2:]) for key, v in ys.items()}
+    return carry, ys
 
 
 class HoistedSession:
@@ -980,6 +1147,7 @@ class HoistedSession:
         cluster: Dict,
         template_arrays_list: List[Dict],
         weights: Optional[Dict[str, int]] = None,
+        multipod_k: Optional[int] = None,
     ):
         self._weights_key = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
         self._fps = {
@@ -1023,6 +1191,11 @@ class HoistedSession:
             {k: np.asarray(tp[k]) for k in TERM_NP_KEYS}
             if self._dyn_ipa else None
         )
+        # multi-pod scan steps (PERF_NOTES round 9): k pods decided per
+        # step with exact in-device conflict replay (_step_multi).
+        # Port-carrying sessions are pinned to k=1 — the carried NodePorts
+        # tables sit outside the conflict algebra (kernel.multipod_k)
+        self.multipod_k = K.multipod_k(multipod_k, dyn_ports=self._dyn_ports)
 
     # -- incremental device-state deltas -----------------------------------
 
@@ -1104,7 +1277,7 @@ class HoistedSession:
         self._carry, ys = _session_scan(
             self._S, self._c_static, self._tp, self._carry,
             batch_self, xs, self._weights_key,
-            self._dyn_ipa, self._dyn_ports,
+            self._dyn_ipa, self._dyn_ports, self.multipod_k,
         )
         ys = dict(ys)
         ys["_b_real"] = b  # padding rows carry no decision
@@ -1116,3 +1289,16 @@ class HoistedSession:
         unschedulable), bucket-padding rows stripped."""
         best = np.asarray(ys["best"])
         return [int(v) for v in best[: ys.get("_b_real", best.shape[0])]]
+
+    @staticmethod
+    def conflict_stats(ys: Dict):
+        """(n_conflicts, replay_suffix_start) for one harvested batch.
+        The hoisted scan replays conflicted pods IN-DEVICE (_step_multi
+        lax.cond), so every decision is already exact: the suffix is
+        always None and the count is observability only
+        (scheduler_multipod_conflicts_total)."""
+        c = ys.get("conflicts")
+        if c is None:
+            return 0, None
+        arr = np.asarray(c)
+        return int(arr[: ys.get("_b_real", arr.shape[0])].sum()), None

@@ -673,3 +673,86 @@ def schedule_pods_jit(c: Dict, P: Dict, weights: Dict[str, int] = None) -> Dict:
     the tunnel; one vmapped launch amortizes all of it."""
     key = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     return _jitted_vmapped(c, P, key)
+
+
+# ---------------------------------------------------------------------------
+# Multi-pod scan steps (PERF_NOTES round 9): k pods decided per scan step
+# with EXACT conflict replay. The policy knob and the shared
+# utilization-side conflict algebra live here so the hoisted, pallas and
+# sharded steps cannot drift apart.
+
+DEFAULT_MULTIPOD_K = 4
+
+
+def multipod_k(explicit=None, dyn_ports: bool = False,
+               platform: str = "") -> int:
+    """Resolve the multi-pod step width for a session build.
+
+    Precedence: port-carrying sessions are pinned to 1 (the carried
+    NodePorts tables are OUTSIDE the conflict algebra — a same-step port
+    clash would not be detected); then an explicit constructor argument;
+    then KTPU_MULTIPOD_K (the kill switch: =1 restores one-pod-per-step
+    everywhere); then the platform default — DEFAULT_MULTIPOD_K on TPU,
+    1 elsewhere (the CPU build env runs the whole test suite through
+    these scans; paying the k-wide vmapped eval compile there buys
+    nothing, and the parity suites pass k explicitly). The result is
+    clamped to a power of two <= 64 so every pow2 batch bucket divides
+    into whole steps."""
+    import os as _os
+
+    if dyn_ports:
+        return 1
+    if explicit is not None:
+        k = int(explicit)
+    else:
+        env = _os.environ.get("KTPU_MULTIPOD_K", "")
+        if env:
+            k = int(env)
+        else:
+            if not platform:
+                import jax as _jax
+
+                platform = _jax.devices()[0].platform
+            k = DEFAULT_MULTIPOD_K if platform == "tpu" else 1
+    k = max(1, k)
+    p = 1
+    while p * 2 <= min(k, 64):
+        p *= 2
+    return p
+
+
+def multipod_utilization_conflicts(feasible, total, best, score, lane,
+                                   fit_new, wbl_old, wbl_new):
+    """The utilization side of the exact conflict test, shared by the
+    multipod steps (hoisted in-device replay, sharded suffix flags; the
+    pallas kernel mirrors it in Mosaic — divergences are bugs).
+
+    Premise: with the PTS/IPA count gates already clean, committing the
+    step's earlier pods changed this pod's true score vector ONLY
+    through NodeResourcesFit / BalancedAllocation / LeastAllocated at
+    the committed nodes — every other plugin reads statics or counts,
+    and the normalization sets are untouched as long as feasibility did
+    not move. So re-evaluating exactly those three against the current
+    carry decides exactness:
+
+      fit_flip  — a speculatively-feasible node no longer fits (the
+                  carry only grows, so fit is monotone non-increasing):
+                  the feasible SET changed, which perturbs the PTS/IPA/
+                  taint/node-affinity normalizations at every node —
+                  the speculative decision cannot stand;
+      overtake  — a still-feasible node's new total now beats (or
+                  first-max-ties below) the speculative winner: the
+                  argmax moved. At untouched nodes wbl_new == wbl_old,
+                  so the test degenerates to comparisons the spec argmax
+                  already won — no touched-node bookkeeping is needed.
+
+    All args are per-node rows (any layout: [N] vectors, (1, Np) shard
+    blocks); returns (fit_flip_row, overtake_row) for the caller to
+    any()/reduce — the sharded step pmax-reduces them globally."""
+    new_total = total + (wbl_new - wbl_old)
+    fit_flip = feasible & ~fit_new
+    overtake = (
+        feasible & fit_new
+        & ((new_total > score) | ((new_total == score) & (lane < best)))
+    )
+    return fit_flip, overtake

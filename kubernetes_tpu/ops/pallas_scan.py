@@ -203,6 +203,7 @@ class _Cfg(NamedTuple):
     carry_keys: tuple
     interpret: bool
     mode: str = "full"  # full | eval | apply (see _build_kernel)
+    mk: int = 1  # multi-pod step width (full mode only; pow2, <= 64)
 
 
 class PallasSession:
@@ -217,7 +218,16 @@ class PallasSession:
 
     def __init__(self, cluster: Dict, template_arrays_list: List[Dict],
                  weights: Optional[Dict[str, int]] = None,
-                 interpret: bool = False):
+                 interpret: bool = False,
+                 multipod_k: Optional[int] = None):
+        from .kernel import multipod_k as _resolve_mk
+
+        # multi-pod scan steps (conflict-SUFFIX contract: the kernel
+        # defers commits within a group, detects conflicts with the
+        # shared algebra, and leaves the conflicted suffix uncommitted
+        # + flagged in out row 3 for the backend's host replay).
+        # KTPU_MULTIPOD_K=1 is the kill switch.
+        self.multipod_k = _resolve_mk(multipod_k)
         if templates_have_ports(template_arrays_list):
             # the jnp HoistedSession carries host-port tables; the pallas
             # kernel does not (yet) — signal a fallback, not an error
@@ -546,6 +556,13 @@ class PallasSession:
                 eye[i, i] = 1.0
         self._eye = eye
 
+        # multipod IPA interference superset (filled by _build_ipa when
+        # the session carries term templates; zeros otherwise): row u,
+        # lane t != 0 means assuming a template-u pod can perturb a
+        # template-t evaluation through the D1-D5 term machinery — the
+        # multipod conflict test then replays instead of speculating
+        self._gmat = np.zeros((_ceil(T, SUB), LANE), np.float32)
+
         # SMEM scalar table
         self._scalars = self._pack_scalars(S)
 
@@ -625,6 +642,15 @@ class PallasSession:
         M_pref = np.asarray(S["M_pref"]).astype(bool)   # [T, TP, T]
         match_all = np.asarray(S["match_all"]).astype(bool)  # [T, T]
         hard_w = int(np.asarray(c["hard_pod_affinity_weight"]))
+
+        # multipod template-interference superset (the host twin of the
+        # hoisted prologue's G_ipa; symmetrized — a false positive only
+        # costs a replay, never a wrong decision)
+        a1 = M_anti.any(axis=1)
+        a2 = M_aff.any(axis=1)
+        a3 = M_pref.any(axis=1)
+        g = (a1 | a1.T | a2 | a2.T | a3 | a3.T | match_all | match_all.T)
+        self._gmat[:T, :T] = g.astype(np.float32)
 
         t_pad = _ceil(T, SUB)  # per-template matrices: row t (T can be >8)
         g1 = np.zeros((t_pad, UR), np.float32)
@@ -808,6 +834,7 @@ class PallasSession:
                 "shasall": z(self._shasall), "valid_n": z(self._valid_n),
                 "rowt": z(self._rowt), "eye": z(self._eye),
                 "prow_f": z(self._prow_f), "prow_s": z(self._prow_s),
+                "gmat": z(self._gmat),
                 "scalars": z(self._scalars),
             }
             cfg = _Cfg(
@@ -817,6 +844,7 @@ class PallasSession:
                 ur=(self._ipa["UR"] if self._ipa else 0),
                 carry_keys=carry_keys,
                 interpret=self.interpret,
+                mk=self.multipod_k,
             )
             self._bundle = (cfg, statics, ipa)
         return self._bundle
@@ -849,11 +877,30 @@ class PallasSession:
         # bucket rides the result so a harvest-side device fault can
         # retire exactly the executable that produced the bad payload
         # (tpu_backend.py retry path)
-        return {"rows": out, "n": B, "bucket": Bp}
+        return {"rows": out, "n": B, "bucket": Bp, "mk": self.multipod_k}
 
     @staticmethod
     def decisions(ys) -> List[int]:
         return [int(v) for v in np.asarray(ys["rows"])[0, :ys["n"]]]
+
+    @staticmethod
+    def conflict_stats(ys):
+        """(n_conflicts, replay_suffix_start) from out row 3: the kernel
+        leaves the conflicted suffix UNCOMMITTED (flag 1) — the backend
+        replays exactly those pods through the session, whose carry
+        holds the committed prefix. n_conflicts is 1 — ONE detection
+        headed the suffix; the flags after it are collateral (the
+        kernel cannot know which of them would conflict against the
+        replayed carry), and any genuine later conflict is re-detected
+        — and re-counted — when the replayed suffix runs. (0, None)
+        when the batch ran one-pod-per-step (row 3 is the -1 init
+        then)."""
+        if ys.get("mk", 1) <= 1:
+            return 0, None
+        flags = np.asarray(ys["rows"])[3, :ys["n"]] > 0
+        if not flags.any():
+            return 0, None
+        return 1, int(np.argmax(flags))
 
     def retire_exec(self, bucket: Optional[int] = None,
                     mode: Optional[str] = None) -> int:
@@ -1173,12 +1220,23 @@ class PallasSession:
 
 
 def _build_kernel(shapes, weights, Bp: int, ur: int = 0,
-                  mode: str = "full"):
+                  mode: str = "full", mk: int = 1):
     """mode: "full" = eval + select + apply own decision (single-device
     session); "eval" = masks/scores/local-best only, carries untouched;
     "apply" = apply an externally-decided (cross-shard) placement to the
     carries. The sharded session alternates eval/apply around an ICI
-    argmax (ShardedPallasSession)."""
+    argmax (ShardedPallasSession).
+
+    mk > 1 (full mode): multi-pod steps with exact conflict detection —
+    mk pods are evaluated against the GROUP-START carry (their evals
+    share no data dependency), then committed in order; a pod whose
+    evaluation an earlier commit could have perturbed (same node, PTS
+    match-gate, IPA template gate, or the fit/balanced/least recheck —
+    the same algebra as ops/hoisted.py _step_multi) starts the CONFLICT
+    SUFFIX: it and every later pod of the batch stay UNCOMMITTED, out
+    row 3 flags them, and the host replays exactly that suffix through
+    the session (tpu_backend._harvest_locked) — bit-identical to
+    one-pod-per-step either way."""
     import os as _os
 
     skip = frozenset(
@@ -1205,8 +1263,8 @@ def _build_kernel(shapes, weights, Bp: int, ur: int = 0,
         (breal_ref, tmpl_ref, sc_ref, mf_ref, ms_ref,
          alloc_ref, stat_ref, onehot_ref, regrowf_ref, zvnode_ref,
          zvalid_ref, konnf_ref, konns_ref, shasall_ref, validn_ref,
-         rowt_ref, eye_ref, prowf_ref, prows_ref) = refs[:19]
-        i = 19
+         rowt_ref, eye_ref, prowf_ref, prows_ref, gmat_ref) = refs[:20]
+        i = 20
         if ur > 0:
             (ipastat_ref, antic_ref, antik_ref, affc_ref, prowipa_ref,
              g1_ref, wanti_ref, waff_ref, w3tot_ref, w45_ref,
@@ -1351,23 +1409,69 @@ def _build_kernel(shapes, weights, Bp: int, ur: int = 0,
                     + hask * okf
                 ).astype(jnp.int32)
 
-        def one_pod(b):
+        def fit_row(t):
+            """NodeResourcesFit row against the CURRENT carry refs —
+            shared by the eval and the multipod conflict recheck (the
+            fit leg of kernel.multipod_utilization_conflicts)."""
+            over = jnp.zeros((1, Np), jnp.bool_)
+            for r in range(R):
+                free = alloc_ref[r:r + 1, :] - requested_ref[r:r + 1, :]
+                over = over | ((sm_t(t, r) > free) & (sm_t(t, R + r) != 0))
+            fail_dims = (sm_t(t, 2 * R) != 0) & over
+            fail_count = (nzpc_ref[2:3, :] + jnp.int32(1)) > nzpc_in[3:4, :]
+            return jnp.logical_not(fail_count | fail_dims)
+
+        def resource_rows(t):
+            """(balanced, least) rows against the CURRENT carry refs —
+            shared by the eval and the multipod wbl recheck."""
+            nz_cpu = (nzpc_ref[0:1, :] + sm_t(t, 2 * R + 1)).astype(f32)
+            nz_mem = (nzpc_ref[1:2, :] + sm_t(t, 2 * R + 2)).astype(f32)
+            cap_cpu = alloc_ref[0:1, :].astype(f32)
+            cap_mem = alloc_ref[1:2, :].astype(f32)
+            frac_c = jnp.where(cap_cpu == 0, f32(1.0), nz_cpu / cap_cpu)
+            frac_m = jnp.where(cap_mem == 0, f32(1.0), nz_mem / cap_mem)
+            balanced = ((f32(1.0) - jnp.abs(frac_c - frac_m))
+                        * MAX_NODE_SCORE).astype(jnp.int32)
+            balanced = jnp.where((frac_c >= 1) | (frac_m >= 1),
+                                 jnp.int32(0), balanced)
+
+            def least_dim(cap, reqq):
+                d = ((cap - reqq) * MAX_NODE_SCORE
+                     // jnp.where(cap == 0, jnp.int32(1), cap))
+                return jnp.where((cap == 0) | (reqq > cap), jnp.int32(0), d)
+
+            least = (least_dim(alloc_ref[0:1, :],
+                               nzpc_ref[0:1, :] + sm_t(t, 2 * R + 1))
+                     + least_dim(alloc_ref[1:2, :],
+                                 nzpc_ref[1:2, :] + sm_t(t, 2 * R + 2))
+                     ) // jnp.int32(2)
+            return balanced, least
+
+        def lane_gate(which, t):
+            """(1, LANE) gate over match lanes: 1.0 at lane (t*CP+c) for
+            template t's VALID constraint slots — counts written to
+            invalid slots are never read, so gating the multipod PTS
+            conflict test on them is what makes it exact."""
+            lanei1 = jax.lax.broadcasted_iota(jnp.int32, (1, LANE), 1)
+            out = jnp.zeros((1, LANE), f32)
+            for tt in range(T):
+                sel = (t == tt).astype(f32)
+                for cc in range(C):
+                    e = (lanei1 == (tt * CP + cc)).astype(f32)
+                    out = out + sel * sm_tc(which, tt, cc).astype(f32) * e
+            return out
+
+        def eval_pod(b):
+            """Filter + score pod b against the CURRENT carry refs
+            WITHOUT committing — the eval half of one_pod, reused by the
+            multipod group body (where all mk pods run it against the
+            group-start refs before any commit)."""
             t = tmpl_ref[b]
-            if mode == "apply":
-                # forced decision (the cross-shard winner, mapped to this
-                # shard's local lanes or -1): updates only, no eval
-                lane_n = jax.lax.broadcasted_iota(jnp.int32, (1, Np), 1)
-                best = forced_ref[2 * b]
-                oki = forced_ref[2 * b + 1]
-                okf = oki.astype(f32)
-                _apply_updates(b, t, lane_n, best, oki, okf)
-                return jnp.int32(0)
             # NOTHING big is hoisted out of the loop: values live across
             # iterations spill out of vector registers and the
             # spill/restore swamps the step (measured; see PERF_NOTES)
             lane_n = jax.lax.broadcasted_iota(jnp.int32, (1, Np), 1)
             valid_n = validn_ref[0:1, :]
-            allowed = nzpc_in[3:4, :]
 
             def trow(i):
                 return stat_ref[pl.ds(t * SR + i, 1), :]
@@ -1382,13 +1486,7 @@ def _build_kernel(shapes, weights, Bp: int, ur: int = 0,
 
 
             # ---- NodeResourcesFit (exact int32 after GCD rescale) ----
-            over = jnp.zeros((1, Np), jnp.bool_)
-            for r in range(R):
-                free = alloc_ref[r:r + 1, :] - requested_ref[r:r + 1, :]
-                over = over | ((sm_t(t, r) > free) & (sm_t(t, R + r) != 0))
-            fail_dims = (sm_t(t, 2 * R) != 0) & over
-            fail_count = (nzpc_ref[2:3, :] + jnp.int32(1)) > allowed
-            mask_fit = jnp.logical_not(fail_count | fail_dims)
+            mask_fit = fit_row(t)
 
             # ---- PTS filter (per-node counts; all C constraints as one
             # (C, Np) block — fewer dynamic reads, wider VPU ops) ----
@@ -1476,27 +1574,7 @@ def _build_kernel(shapes, weights, Bp: int, ur: int = 0,
             n_feasible = jnp.sum(feasible.astype(f32)).astype(jnp.int32)
 
             # ---- resource scores ----
-            nz_cpu = (nzpc_ref[0:1, :] + sm_t(t, 2 * R + 1)).astype(f32)
-            nz_mem = (nzpc_ref[1:2, :] + sm_t(t, 2 * R + 2)).astype(f32)
-            cap_cpu = alloc_ref[0:1, :].astype(f32)
-            cap_mem = alloc_ref[1:2, :].astype(f32)
-            frac_c = jnp.where(cap_cpu == 0, f32(1.0), nz_cpu / cap_cpu)
-            frac_m = jnp.where(cap_mem == 0, f32(1.0), nz_mem / cap_mem)
-            balanced = ((f32(1.0) - jnp.abs(frac_c - frac_m))
-                        * MAX_NODE_SCORE).astype(jnp.int32)
-            balanced = jnp.where((frac_c >= 1) | (frac_m >= 1),
-                                 jnp.int32(0), balanced)
-
-            def least_dim(cap, reqq):
-                d = ((cap - reqq) * MAX_NODE_SCORE
-                     // jnp.where(cap == 0, jnp.int32(1), cap))
-                return jnp.where((cap == 0) | (reqq > cap), jnp.int32(0), d)
-
-            least = (least_dim(alloc_ref[0:1, :],
-                               nzpc_ref[0:1, :] + sm_t(t, 2 * R + 1))
-                     + least_dim(alloc_ref[1:2, :],
-                                 nzpc_ref[1:2, :] + sm_t(t, 2 * R + 2))
-                     ) // jnp.int32(2)
+            balanced, least = resource_rows(t)
 
             # ---- PTS score ----
             shasall = shasall_ref[pl.ds(t, 1), :]
@@ -1627,6 +1705,21 @@ def _build_kernel(shapes, weights, Bp: int, ur: int = 0,
             idx = jnp.where(tf >= m, lane_n, jnp.int32(POS_BIG))
             best = jnp.min(idx).astype(jnp.int32)
             ok = (m >= 0) & (b < breal_ref[0])
+            wbl = balanced * W["balanced"] + least * W["least"]
+            return t, lane_n, best, m, ok, n_feasible, total, wbl
+
+        def one_pod(b):
+            if mode == "apply":
+                # forced decision (the cross-shard winner, mapped to this
+                # shard's local lanes or -1): updates only, no eval
+                t = tmpl_ref[b]
+                lane_n = jax.lax.broadcasted_iota(jnp.int32, (1, Np), 1)
+                best = forced_ref[2 * b]
+                oki = forced_ref[2 * b + 1]
+                okf = oki.astype(f32)
+                _apply_updates(b, t, lane_n, best, oki, okf)
+                return jnp.int32(0)
+            t, lane_n, best, m, ok, n_feasible, total, wbl = eval_pod(b)
             oki = ok.astype(jnp.int32)
             okf = oki.astype(f32)
 
@@ -1659,6 +1752,94 @@ def _build_kernel(shapes, weights, Bp: int, ur: int = 0,
                           o)
             o = jnp.where(at_b & (subi == 2), n_feasible, o)
             out_ref[:] = o
+
+        def write_multi(b, best, score, nfeas, okc, flag):
+            """Out rows for one multipod-group pod: 0 best / 1 score /
+            2 n_feasible / 3 conflict-suffix flag (1 = NOT committed,
+            host must replay)."""
+            subi = jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 0)
+            lanei = jax.lax.broadcasted_iota(jnp.int32, (SUB, Bp), 1)
+            at_b = lanei == b
+            placed = okc != 0
+            o = out_ref[:]
+            o = jnp.where(at_b & (subi == 0),
+                          jnp.where(placed, best, jnp.int32(-1)), o)
+            o = jnp.where(at_b & (subi == 1),
+                          jnp.where(placed, score, jnp.int32(-1)), o)
+            o = jnp.where(at_b & (subi == 2), nfeas, o)
+            o = jnp.where(at_b & (subi == 3), flag, o)
+            out_ref[:] = o
+
+        def multi_group(j, seen):
+            """mk pods per step: parallel-in-spirit evals against the
+            group-start carry refs (commits are DEFERRED, so nothing a
+            later eval reads has moved), then in-order commits gated by
+            the exact conflict test. `seen` carries the suffix flag
+            ACROSS groups: later groups' evals chained on a carry
+            missing suffix commits are invalid too."""
+            base = j.astype(jnp.int32) * jnp.int32(mk)
+            evs = [eval_pod(base + jnp.int32(i)) for i in range(mk)]
+            conf_seen = seen
+            committed = []  # (best, okc, tmpl) of this group's prefix
+            for i in range(mk):
+                b = base + jnp.int32(i)
+                t, lane_n, best, m, ok, nfeas, total, wbl = evs[i]
+                score_i = jnp.max(total)  # int32 twin of the f32 argmax m
+                conf = jnp.int32(0)
+                if i > 0:
+                    gate_f = lane_gate(W_F_VALID, t)
+                    gate_s = lane_gate(W_S_VALID, t)
+                for e, (be, oke, te) in enumerate(committed):
+                    same = oke * ((be == best)
+                                  & (m >= 0)).astype(jnp.int32)
+                    # PTS: pod e's Mf/Ms lanes of template t, valid-gated
+                    mf_e = mf_ref[pl.ds(base + jnp.int32(e), 1),
+                                  :].astype(f32)
+                    ms_e = ms_ref[pl.ds(base + jnp.int32(e), 1),
+                                  :].astype(f32)
+                    hit = (jnp.sum(mf_e * gate_f)
+                           + jnp.sum(ms_e * gate_s)) > 0
+                    conf = jnp.maximum(conf, jnp.maximum(
+                        same, oke * hit.astype(jnp.int32)))
+                    if ur > 0:
+                        # IPA template-interference superset (gmat)
+                        grow = gmat_ref[pl.ds(te, 1), :]
+                        lanei1 = jax.lax.broadcasted_iota(
+                            jnp.int32, (1, LANE), 1)
+                        gv = jnp.sum(jnp.where(lanei1 == t, grow,
+                                               f32(0.0)))
+                        conf = jnp.maximum(
+                            conf, oke * (gv > 0).astype(jnp.int32))
+                # utilization legs (kernel.multipod_utilization_conflicts
+                # mirrored in Mosaic): fit/balanced/least are the only
+                # carry-reading plugins left once the count gates are
+                # clean — recheck them against the CURRENT refs
+                fit_new = fit_row(t)
+                bal2, least2 = resource_rows(t)
+                new_tot = total - wbl + (bal2 * W["balanced"]
+                                         + least2 * W["least"])
+                feas_old = total >= 0
+                flip = jnp.max(jnp.where(
+                    feas_old & jnp.logical_not(fit_new),
+                    f32(1.0), f32(0.0))) > 0
+                over = jnp.max(jnp.where(
+                    feas_old & fit_new
+                    & ((new_tot > score_i)
+                       | ((new_tot == score_i) & (lane_n < best))),
+                    f32(1.0), f32(0.0))) > 0
+                util = (flip | (over & (m >= 0))).astype(jnp.int32)
+                conf = jnp.maximum(conf, util)
+                conf = conf * (b < breal_ref[0]).astype(jnp.int32)
+                conf_seen = jnp.maximum(conf_seen, conf)
+                okc = ok.astype(jnp.int32) * (jnp.int32(1) - conf_seen)
+                _apply_updates(b, t, lane_n, best, okc, okc.astype(f32))
+                committed.append((best, okc, t))
+                write_multi(b, best, score_i, nfeas, okc, conf_seen)
+            return conf_seen
+
+        if mode == "full" and mk > 1 and "updates" not in skip:
+            jax.lax.fori_loop(0, Bp // mk, multi_group, jnp.int32(0))
+            return
 
         # manual unroll: U pods per loop iteration amortizes Mosaic's
         # per-iteration bookkeeping (the marginal-cost floor; partial
@@ -1734,7 +1915,7 @@ def _dispatch(cfg: "_Cfg", statics: Dict, ipa: Optional[Dict],
     B_real = meta[:1]
     tmpl = meta[1:]
     kernel = _build_kernel(cfg.shapes, cfg.weights, Bp, cfg.ur,
-                           mode=cfg.mode)
+                           mode=cfg.mode, mk=cfg.mk)
     # widen the int8 wire format on-device (i8 VMEM rows would need
     # 32-sublane alignment in the kernel; one cheap convert avoids that)
     mfT = match[:, :LANE].astype(jnp.int32)
@@ -1758,7 +1939,7 @@ def _dispatch(cfg: "_Cfg", statics: Dict, ipa: Optional[Dict],
     if cfg.mode == "apply":
         pre_args = (forced.astype(jnp.int32),)
         pre_specs = [sm]
-    n_pre = len(pre_specs) + 19 + len(ipa_in)  # inputs before the carries
+    n_pre = len(pre_specs) + 20 + len(ipa_in)  # inputs before the carries
     # trace the kernel with x64 OFF: every input is explicitly 32-bit,
     # and weak python literals must not widen ops to i64/f64 (Mosaic has
     # no 64-bit types)
@@ -1768,7 +1949,7 @@ def _dispatch(cfg: "_Cfg", statics: Dict, ipa: Optional[Dict],
         results = pl.pallas_call(
             kernel,
             out_shape=out_shape,
-            in_specs=(pre_specs + [sm, sm, sm, vm, vm] + [vm] * 14
+            in_specs=(pre_specs + [sm, sm, sm, vm, vm] + [vm] * 15
                       + [vm] * len(ipa_in) + [vm] * len(carry_in)),
             out_specs=tuple([vm] * (1 + len(carry_in))),
             input_output_aliases={n_pre + i: 1 + i
@@ -1780,5 +1961,5 @@ def _dispatch(cfg: "_Cfg", statics: Dict, ipa: Optional[Dict],
           statics["zvalid_s"], statics["konn_f"], statics["konn_s"],
           statics["shasall"], statics["valid_n"], statics["rowt"],
           statics["eye"], statics["prow_f"], statics["prow_s"],
-          *ipa_in, *carry_in)
+          statics["gmat"], *ipa_in, *carry_in)
     return results[0], dict(zip(carry_keys, results[1:]))

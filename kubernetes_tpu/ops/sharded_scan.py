@@ -58,6 +58,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.sharded import NODE_AXIS
+from . import kernel as K_ops
 from .hoisted import template_fingerprint
 from .kernel import MAX_NODE_SCORE
 from .pallas_scan import (
@@ -91,11 +92,64 @@ def _doth(a, b, dims):
         precision=jax.lax.Precision.HIGHEST)
 
 
-def _step_fn(cfg, statics, tables, carry, x):
-    """One pod through the two-phase step (runs per shard, inside
-    shard_map): local partials -> collectives -> finish -> winner-shard
-    carry updates. Mirrors ops/pallas_scan.py _build_kernel one_pod
-    (mode="full") line for line; divergences are bugs."""
+def _fit_row(cfg, statics, tables, carry, t):
+    """NodeResourcesFit row for template t against `carry` (local, no
+    collectives) — shared by the eval and the multipod step's conflict
+    recheck (the fit leg of kernel.multipod_utilization_conflicts)."""
+    (T, C, CP, R, SR, K, Npl, TCp, UR) = cfg[0]
+    requested, nzpc = carry["requested"], carry["nzpc"]
+    alloc = statics["alloc"]
+    req_t = jax.lax.dynamic_index_in_dim(tables["req"], t, 0,
+                                         keepdims=False)          # (R,)
+    req_check = jax.lax.dynamic_index_in_dim(tables["req_check"], t, 0,
+                                             keepdims=False)
+    over = jnp.zeros((1, Npl), jnp.bool_)
+    for r in range(R):
+        free = alloc[r:r + 1, :] - requested[r:r + 1, :]
+        over = over | ((req_t[r] > free) & (req_check[r] != 0))
+    fail_dims = (tables["req_has_any"][t] != 0) & over
+    fail_count = (nzpc[2:3, :] + jnp.int32(1)) > nzpc[3:4, :]
+    return jnp.logical_not(fail_count | fail_dims)
+
+
+def _resource_scores(cfg, statics, tables, carry, t):
+    """(balanced, least) rows for template t against `carry` (local, no
+    collectives) — shared by the eval and the multipod step's wbl
+    recheck (the balanced/least legs of the conflict algebra)."""
+    (T, C, CP, R, SR, K, Npl, TCp, UR) = cfg[0]
+    f32 = jnp.float32
+    nzpc = carry["nzpc"]
+    alloc = statics["alloc"]
+    nz_req = jax.lax.dynamic_index_in_dim(tables["nz_req"], t, 0,
+                                          keepdims=False)         # (2,)
+    nz_cpu = (nzpc[0:1, :] + nz_req[0]).astype(f32)
+    nz_mem = (nzpc[1:2, :] + nz_req[1]).astype(f32)
+    cap_cpu = alloc[0:1, :].astype(f32)
+    cap_mem = alloc[1:2, :].astype(f32)
+    frac_c = jnp.where(cap_cpu == 0, f32(1.0), nz_cpu / cap_cpu)
+    frac_m = jnp.where(cap_mem == 0, f32(1.0), nz_mem / cap_mem)
+    balanced = ((f32(1.0) - jnp.abs(frac_c - frac_m))
+                * MAX_NODE_SCORE).astype(jnp.int32)
+    balanced = jnp.where((frac_c >= 1) | (frac_m >= 1),
+                         jnp.int32(0), balanced)
+
+    def least_dim(cap, reqq):
+        d = ((cap - reqq) * MAX_NODE_SCORE
+             // jnp.where(cap == 0, jnp.int32(1), cap))
+        return jnp.where((cap == 0) | (reqq > cap), jnp.int32(0), d)
+
+    least = (least_dim(alloc[0:1, :], nzpc[0:1, :] + nz_req[0])
+             + least_dim(alloc[1:2, :], nzpc[1:2, :] + nz_req[1])
+             ) // jnp.int32(2)
+    return balanced, least
+
+
+def _eval_fn(cfg, statics, tables, carry, x):
+    """Filter + score one pod against `carry` WITHOUT carry updates
+    (local partials -> collectives -> finish -> cross-shard argmax).
+    Mirrors ops/pallas_scan.py _build_kernel one_pod (mode="full")
+    line for line; divergences are bugs. Returns everything the commit
+    and the multipod conflict test need."""
     (T, C, CP, R, SR, K, Npl, TCp, UR) = cfg[0]
     W = dict(cfg[1])
     f32 = jnp.float32
@@ -112,7 +166,7 @@ def _step_fn(cfg, statics, tables, carry, x):
     def pmin(v):
         return jax.lax.pmin(v, NODE_AXIS)
 
-    requested, nzpc = carry["requested"], carry["nzpc"]
+    nzpc = carry["nzpc"]
     cnt_fn, cnt_sn = carry["cnt_fn"], carry["cnt_sn"]
     alloc = statics["alloc"]
     valid_n = statics["valid_n"][0:1, :]
@@ -139,19 +193,7 @@ def _step_fn(cfg, statics, tables, carry, x):
         return jax.lax.dynamic_slice_in_dim(a, t * CP, CP, axis=0)
 
     # ---- NodeResourcesFit (exact int32 after the session's GCD rescale)
-    req_t = jax.lax.dynamic_index_in_dim(tables["req"], t, 0,
-                                         keepdims=False)          # (R,)
-    req_check = jax.lax.dynamic_index_in_dim(tables["req_check"], t, 0,
-                                             keepdims=False)
-    over = jnp.zeros((1, Npl), jnp.bool_)
-    for r in range(R):
-        free = alloc[r:r + 1, :] - requested[r:r + 1, :]
-        over = over | ((req_t[r] > free) & (req_check[r] != 0))
-    nz_req = jax.lax.dynamic_index_in_dim(tables["nz_req"], t, 0,
-                                          keepdims=False)         # (2,)
-    fail_dims = (tables["req_has_any"][t] != 0) & over
-    fail_count = (nzpc[2:3, :] + jnp.int32(1)) > nzpc[3:4, :]
-    mask_fit = jnp.logical_not(fail_count | fail_dims)
+    mask_fit = _fit_row(cfg, statics, tables, carry, t)
 
     # ---- PTS filter: local shifted counts, GLOBAL per-constraint min
     cntf = block(cnt_fn).astype(f32)                              # (CP,Npl)
@@ -236,25 +278,7 @@ def _step_fn(cfg, statics, tables, carry, x):
     n_feasible = psum(jnp.sum(feasible.astype(jnp.int32)))
 
     # ---- resource scores (local) ----
-    nz_cpu = (nzpc[0:1, :] + nz_req[0]).astype(f32)
-    nz_mem = (nzpc[1:2, :] + nz_req[1]).astype(f32)
-    cap_cpu = alloc[0:1, :].astype(f32)
-    cap_mem = alloc[1:2, :].astype(f32)
-    frac_c = jnp.where(cap_cpu == 0, f32(1.0), nz_cpu / cap_cpu)
-    frac_m = jnp.where(cap_mem == 0, f32(1.0), nz_mem / cap_mem)
-    balanced = ((f32(1.0) - jnp.abs(frac_c - frac_m))
-                * MAX_NODE_SCORE).astype(jnp.int32)
-    balanced = jnp.where((frac_c >= 1) | (frac_m >= 1),
-                         jnp.int32(0), balanced)
-
-    def least_dim(cap, reqq):
-        d = ((cap - reqq) * MAX_NODE_SCORE
-             // jnp.where(cap == 0, jnp.int32(1), cap))
-        return jnp.where((cap == 0) | (reqq > cap), jnp.int32(0), d)
-
-    least = (least_dim(alloc[0:1, :], nzpc[0:1, :] + nz_req[0])
-             + least_dim(alloc[1:2, :], nzpc[1:2, :] + nz_req[1])
-             ) // jnp.int32(2)
+    balanced, least = _resource_scores(cfg, statics, tables, carry, t)
 
     # ---- PTS score: zone presence is a cross-shard OR ----
     shasall = jax.lax.dynamic_index_in_dim(
@@ -369,10 +393,35 @@ def _step_fn(cfg, statics, tables, carry, x):
     cand = jnp.min(jnp.where(tf >= m, glane, jnp.int32(POS_BIG)))
     best = pmin(cand).astype(jnp.int32)
     ok = (m >= 0) & x["valid"]
-    oki = ok.astype(jnp.int32)
-    okf = oki.astype(f32)
+    return dict(
+        feasible=feasible, total=total, n_feasible=n_feasible,
+        best=best, score=m, ok=ok, glane=glane,
+        balanced=balanced, least=least,
+    )
 
-    # ---- apply: winner shard only (hot == 0 everywhere else) ----
+
+def _commit_fn(cfg, statics, tables, carry, x, t, best, oki):
+    """Winner-shard carry updates for one decided pod (hot == 0 on every
+    other shard) — the apply side of the step, shared by _step_fn and
+    the multipod step (where `oki` additionally carries the
+    conflict-suffix gate: flagged pods must NOT commit; the host
+    replays them)."""
+    (T, C, CP, R, SR, K, Npl, TCp, UR) = cfg[0]
+    f32 = jnp.float32
+
+    def psum(v):
+        return jax.lax.psum(v, NODE_AXIS)
+
+    shard = jax.lax.axis_index(NODE_AXIS)
+    glane = shard * Npl + jnp.arange(Npl, dtype=jnp.int32)[None, :]
+    requested, nzpc = carry["requested"], carry["nzpc"]
+    cnt_fn, cnt_sn = carry["cnt_fn"], carry["cnt_sn"]
+    stat3 = statics["stat"]
+    req_t = jax.lax.dynamic_index_in_dim(tables["req"], t, 0,
+                                         keepdims=False)
+    nz_req = jax.lax.dynamic_index_in_dim(tables["nz_req"], t, 0,
+                                          keepdims=False)
+    okf = oki.astype(f32)
     hot = (glane == best).astype(jnp.int32) * oki                 # (1,Npl)
     hotf = hot.astype(f32)
     new_requested = requested
@@ -440,12 +489,118 @@ def _step_fn(cfg, statics, tables, carry, x):
             base_u, 0)
         new_carry["ucnt"] = new_ucnt
         new_carry["kcnt"] = new_kcnt
+    return new_carry
+
+
+def _step_fn(cfg, statics, tables, carry, x):
+    """One pod through the two-phase step (runs per shard, inside
+    shard_map): _eval_fn -> _commit_fn, the one-pod-per-step reference
+    path."""
+    e = _eval_fn(cfg, statics, tables, carry, x)
+    ok, best = e["ok"], e["best"]
+    new_carry = _commit_fn(cfg, statics, tables, carry, x, x["tmpl"],
+                           best, ok.astype(jnp.int32))
     y = {
         "best": jnp.where(ok, best, jnp.int32(-1)),
-        "score": jnp.where(ok, m.astype(jnp.int32), jnp.int32(-1)),
-        "n_feasible": n_feasible,
+        "score": jnp.where(ok, e["score"].astype(jnp.int32),
+                           jnp.int32(-1)),
+        "n_feasible": e["n_feasible"],
     }
     return new_carry, y
+
+
+def _step_multi_fn(cfg, statics, tables, k, carry, xk, seen_in):
+    """k pods per scan step for the sharded session: every pod of the
+    group is evaluated against the GROUP-START carry (k independent
+    evals — no carry chain between them), then committed in order with
+    the exact conflict test of the hoisted multipod step
+    (ops/hoisted.py _step_multi; the utilization legs ride the shared
+    kernel.multipod_utilization_conflicts, pmax-reduced globally).
+
+    Unlike the hoisted step there is NO in-device replay: a replay
+    branch would put collectives under lax.cond inside shard_map.
+    Instead the step uses the CONFLICT-SUFFIX contract the pallas
+    kernel shares: the first conflicted pod and everything after it in
+    the group are left UNCOMMITTED and flagged in ys["conflicts"]; the
+    backend replays exactly that suffix sequentially through the live
+    session (tpu_backend._harvest_locked), which chains on the
+    committed-prefix carry — bit-identical to one-pod-per-step either
+    way. Every conflict predicate is built from replicated scalars
+    (pmax/psum-reduced), so all shards gate commits identically."""
+    (T, C, CP, R, SR, K, Npl, TCp, UR) = cfg[0]
+    W = dict(cfg[1])
+    f32 = jnp.float32
+    w_bal = W["balanced"]
+    w_least = W["least"]
+
+    def x_at(i):
+        return {kk: xk[kk][i] for kk in xk}
+
+    evs = [_eval_fn(cfg, statics, tables, carry, x_at(i)) for i in range(k)]
+    carry_i = carry
+    # the suffix flag rides the SCAN carry (`seen_in`): a conflict in an
+    # earlier group invalidates every later group too — their evals
+    # chained on a carry missing the suffix commits — so once set,
+    # nothing later in the batch commits and everything is flagged for
+    # the host replay
+    conf_seen = seen_in
+    committed = []  # (best, okc) of the already-committed prefix
+    ys = {"best": [], "score": [], "n_feasible": [], "conflicts": []}
+    for i in range(k):
+        e = evs[i]
+        x = x_at(i)
+        t = x["tmpl"]
+        # global int32 winner score for the exact overtake comparison
+        # (e["score"] is the f32 argmax value; totals are int32)
+        score_i = jax.lax.pmax(jnp.max(e["total"]), NODE_AXIS)
+        same = jnp.bool_(False)
+        pts = jnp.bool_(False)
+        ipa = jnp.bool_(False)
+        fv = jnp.pad(jax.lax.dynamic_index_in_dim(
+            tables["f_valid"], t, 0, keepdims=False), (0, CP - C)
+        ).astype(f32)
+        sv = jnp.pad(jax.lax.dynamic_index_in_dim(
+            tables["s_valid"], t, 0, keepdims=False), (0, CP - C)
+        ).astype(f32)
+        for j2 in range(i):
+            bj, okj = committed[j2]
+            prior = okj != 0
+            same = same | (prior & (bj == e["best"]))
+            # PTS: pod j2's Mf/Ms lanes of template t, valid-gated —
+            # nonzero means the f/s/h counts this pod read moved
+            mfj = jax.lax.dynamic_slice_in_dim(xk["mf"][j2], t * CP, CP)
+            msj = jax.lax.dynamic_slice_in_dim(xk["ms"][j2], t * CP, CP)
+            pts = pts | (prior
+                         & ((jnp.sum(mfj * fv) + jnp.sum(msj * sv)) > 0))
+            if UR > 0:
+                g = tables["gmat"][xk["tmpl"][j2], t]
+                ipa = ipa | (prior & (g > 0))
+        same = same & (score_i >= 0)
+        fit_new = _fit_row(cfg, statics, tables, carry_i, t)
+        bal_new, least_new = _resource_scores(cfg, statics, tables,
+                                              carry_i, t)
+        flip_row, over_row = K_ops.multipod_utilization_conflicts(
+            e["feasible"], e["total"], e["best"], score_i, e["glane"],
+            fit_new,
+            e["balanced"] * w_bal + e["least"] * w_least,
+            bal_new * w_bal + least_new * w_least,
+        )
+        util_local = jnp.any(flip_row) | (jnp.any(over_row)
+                                          & (score_i >= 0))
+        util = jax.lax.psum(util_local.astype(jnp.int32), NODE_AXIS) > 0
+        conf_i = (same | pts | ipa | util) & x["valid"]
+        conf_seen = conf_seen | conf_i
+        okc = (e["ok"] & jnp.logical_not(conf_seen)).astype(jnp.int32)
+        carry_i = _commit_fn(cfg, statics, tables, carry_i, x, t,
+                             e["best"], okc)
+        committed.append((e["best"], okc))
+        placed = okc != 0
+        ys["best"].append(jnp.where(placed, e["best"], jnp.int32(-1)))
+        ys["score"].append(jnp.where(placed, e["score"].astype(jnp.int32),
+                                     jnp.int32(-1)))
+        ys["n_feasible"].append(e["n_feasible"])
+        ys["conflicts"].append(conf_seen.astype(jnp.int32))
+    return carry_i, {kk: jnp.stack(v) for kk, v in ys.items()}, conf_seen
 
 
 def _node_spec(k, ndim):
@@ -455,29 +610,50 @@ def _node_spec(k, ndim):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "mesh"),
+    static_argnames=("cfg", "mesh", "k"),
     donate_argnames=("carry",),
 )
-def _sharded_scan(cfg, mesh, statics, tables, carry, xs):
+def _sharded_scan(cfg, mesh, statics, tables, carry, xs, k: int = 1):
     statics_spec = {
-        k: _node_spec(k, np.ndim(v)) if k in _NODE_DIM else P()
-        for k, v in statics.items()
+        kk: _node_spec(kk, np.ndim(v)) if kk in _NODE_DIM else P()
+        for kk, v in statics.items()
     }
-    carry_spec = {k: P(None, NODE_AXIS) for k in carry}
-    tables_spec = {k: P() for k in tables}
-    xs_spec = {k: P() for k in xs}
+    carry_spec = {kk: P(None, NODE_AXIS) for kk in carry}
+    tables_spec = {kk: P() for kk in tables}
+    xs_spec = {kk: P() for kk in xs}
     ys_spec = {"best": P(), "score": P(), "n_feasible": P()}
+    if k > 1:
+        ys_spec["conflicts"] = P()
+        # fold the batch axis into [steps, k] (pow2 buckets divide by
+        # the pow2 k) — the k-wide step evaluates a whole group against
+        # the step-initial carry
+        bp = int(np.shape(xs["tmpl"])[0])
+        xs = {kk: v.reshape((bp // k, k) + v.shape[1:])
+              for kk, v in xs.items()}
 
     def body(statics, tables, carry, xs):
+        if k > 1:
+            def step(state, x):
+                c, seen = state
+                c, y, seen = _step_multi_fn(cfg, statics, tables, k,
+                                            c, x, seen)
+                return (c, seen), y
+
+            (carry, _), ys = jax.lax.scan(
+                step, (carry, jnp.bool_(False)), xs)
+            return carry, ys
         step = functools.partial(_step_fn, cfg, statics, tables)
         return jax.lax.scan(step, carry, xs)
 
-    return jax.shard_map(
+    carry, ys = jax.shard_map(
         body, mesh=mesh,
         in_specs=(statics_spec, tables_spec, carry_spec, xs_spec),
         out_specs=(carry_spec, ys_spec),
         check_vma=False,
     )(statics, tables, carry, xs)
+    if k > 1:
+        ys = {kk: v.reshape((-1,) + v.shape[2:]) for kk, v in ys.items()}
+    return carry, ys
 
 
 class ShardedPallasSession:
@@ -495,11 +671,15 @@ class ShardedPallasSession:
 
     def __init__(self, cluster: Dict, template_arrays_list: List[Dict],
                  weights: Optional[Dict[str, int]] = None,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 multipod_k: Optional[int] = None):
         assert mesh is not None, "ShardedPallasSession needs a mesh"
         if len(mesh.devices.ravel()) < 1:
             raise PallasUnsupported("empty mesh", reason="other")
         inner = PallasSession(cluster, template_arrays_list, weights)
+        # multi-pod steps (conflict-SUFFIX contract: flagged pods are
+        # uncommitted; the backend replays them through the live session)
+        self.multipod_k = K_ops.multipod_k(multipod_k)
         self.mesh = mesh
         self.weights = inner.weights
         self._fps = inner._fps
@@ -580,6 +760,10 @@ class ShardedPallasSession:
             "s_same": same_pad(tb["s_same_key"]),
             "ipa_present": tb["ipa_present"].astype(np.int32),
             "s_perno_rows": _perno_rows(inner._s_perno, T, self.C, CP),
+            # multipod IPA interference superset (pallas _build_ipa; all
+            # zeros for term-free sessions): G[u, t] != 0 means assuming
+            # a template-u pod can perturb a template-t evaluation
+            "gmat": inner._gmat[:T, :T],
         }
         if self.UR:
             # IPA term machinery (pallas _build_ipa products): node-axis
@@ -673,16 +857,38 @@ class ShardedPallasSession:
             "mf": jnp.asarray(mfx),
             "ms": jnp.asarray(msx),
         }
+        k = min(self.multipod_k, Bp)
         self._carry, ys = _sharded_scan(
             self._cfg, self.mesh, self._statics, self._tables,
-            self._carry, xs)
-        return {"best": ys["best"], "score": ys["score"],
-                "n_feasible": ys["n_feasible"], "_b_real": B}
+            self._carry, xs, k=k)
+        out = {"best": ys["best"], "score": ys["score"],
+               "n_feasible": ys["n_feasible"], "_b_real": B}
+        if k > 1:
+            out["conflicts"] = ys["conflicts"]
+        return out
 
     @staticmethod
     def decisions(ys: Dict) -> List[int]:
         best = np.asarray(ys["best"])
         return [int(v) for v in best[: ys["_b_real"]]]
+
+    @staticmethod
+    def conflict_stats(ys: Dict):
+        """(n_conflicts, replay_suffix_start): the sharded multipod step
+        does NOT replay in-device (collectives under lax.cond) — the
+        first flagged pod and everything after it in the batch were left
+        uncommitted, and the caller must replay exactly that suffix
+        through the session (the carry holds the committed prefix).
+        n_conflicts is 1 — one detection headed the suffix; later flags
+        are collateral, and genuine later conflicts are re-detected and
+        re-counted when the replayed suffix runs."""
+        c = ys.get("conflicts")
+        if c is None:
+            return 0, None
+        flags = np.asarray(c)[: ys["_b_real"]] != 0
+        if not flags.any():
+            return 0, None
+        return 1, int(np.argmax(flags))
 
     # -- incremental device-state deltas -----------------------------------
 

@@ -225,6 +225,14 @@ class Workload:
     # planner's vectorized PDB partitioning is on the measured path);
     # None disables, an int is status.disruptionsAllowed
     pdb_disruptions_allowed: Optional[int] = None
+    # measure the kernel-direct rate for THIS config in-process after
+    # the loop phase (same templates, same session, no queue/cache/bind
+    # path) and record loop_kernel_ratio = full-loop / kernel-direct —
+    # the adjudicating number for the "close the loop-vs-kernel gap"
+    # target (full-loop >= 50% of kernel-direct on Default-5000n).
+    # Off by default: CI-size harness tests must not pay the extra
+    # dispatches; scripts/bench_configs.py turns it on for every row.
+    kernel_direct: bool = False
 
 
 @dataclass
@@ -285,6 +293,21 @@ class Result:
     # workloads (headline_metric says which number to read)
     attempts_per_sec: float = 0.0
     headline_metric: str = "pods_per_sec"
+    # multi-pod scan steps + speculative dispatch (in-window counter
+    # deltas): conflicts = speculative per-step decisions invalidated by
+    # an earlier pod of the same step; replays = the sequential
+    # re-decisions that kept them exact; hits/misses = pipelined
+    # dispatches chained on a not-yet-harvested carry that landed
+    # cleanly / were re-driven
+    multipod_conflicts: int = 0
+    conflict_replays: int = 0
+    speculative_hits: int = 0
+    speculative_misses: int = 0
+    # kernel-direct pods/s measured in-process for the same config
+    # (Workload.kernel_direct), and the ratio the roadmap target reads:
+    # loop_kernel_ratio = throughput_avg / kernel_direct_pods_per_sec
+    kernel_direct_pods_per_sec: float = 0.0
+    loop_kernel_ratio: float = 0.0
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -355,6 +378,59 @@ def _counter_window(now: Dict[str, int], base: Dict[str, int]) -> Dict[str, int]
     return {
         k: v - base.get(k, 0) for k, v in now.items() if v - base.get(k, 0)
     }
+
+
+def _counter_total(counter) -> int:
+    return int(sum(v for _, v in counter.items()))
+
+
+def _kernel_direct_rate(sched, w: "Workload", reps: int = 3) -> float:
+    """Kernel-direct pods/s for THIS config, measured in-process on the
+    run's own backend right after the loop phase (scheduler paused,
+    pipeline drained): encode a batch stamped from the measured
+    template and time raw session dispatches — no queue, no cache, no
+    bind path. The same-config full-loop/kernel-direct ratio is what
+    the ROADMAP "close the loop-vs-kernel gap" target regresses
+    (>= 50% on Default-5000n).
+
+    The measurement runs on a THROWAWAY session: the live session is
+    torn down first (device_state() may donate dirty-row buffers a live
+    session still references, and the phantom kdirect assumes must
+    never land in a carry real pods could be decided against), the
+    fresh session absorbs the build + bucket compile on the warm
+    dispatch, and the polluted session is dropped again afterwards —
+    the host encoding never sees the phantom pods, so a later real
+    dispatch rebuilds clean. Callers freeze every in-window counter
+    BEFORE calling this (the teardown/build pair is accounting noise).
+    Failures (PVC templates the raw encoder cannot resolve, demoted
+    backends) report 0.0 — the ratio is then omitted, never
+    fabricated."""
+    tpu = sched.tpu
+    if tpu is None or not w.kernel_direct:
+        return 0.0
+    nb = max(1, min(w.max_batch, w.num_pods or 1, 512))
+    pods = [w.template.build(f"kdirect-{i}") for i in range(nb)]
+    try:
+        with tpu._lock:
+            tpu._flush_pending()
+            arrays = []
+            for p in pods:
+                enc = tpu.pe.encode(p)
+                arrays.append(
+                    {k: v for k, v in enc.items() if not k.startswith("_")}
+                )
+            tpu._invalidate_session("kernel-direct")
+            try:
+                tpu._session_schedule(arrays)  # build + bucket compile
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    tpu._session_schedule(arrays)
+                dt = time.perf_counter() - t0
+            finally:
+                tpu._invalidate_session("kernel-direct")
+        return nb * reps / dt if dt > 0 else 0.0
+    except Exception:  # noqa: BLE001 — report the loop numbers regardless
+        return 0.0
 
 
 def run_workload(w: Workload, quiet: bool = True) -> Result:
@@ -586,14 +662,20 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             ))
 
         from ..scheduler.metrics import (
+            conflict_replays,
+            multipod_conflicts,
             session_delta_applies,
             session_rebuilds,
+            speculative_dispatches,
         )
 
         attempts0 = total_attempts()
         builds0 = _session_build_counts()
         rebuild_reasons0 = _label_counts(session_rebuilds)
         delta_applies0 = _label_counts(session_delta_applies)
+        conflicts0 = _counter_total(multipod_conflicts)
+        replays0 = _counter_total(conflict_replays)
+        spec0 = _label_counts(speculative_dispatches)
         bound0 = bound_count()
         n_ts0 = len(sched.bind_timestamps)
         t0 = time.perf_counter()
@@ -680,16 +762,37 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
                 (bound_for_rate if bound_for_rate is not None
                  else bound_measured) / dt
             ]
+        tp_avg = round(
+            (bound_for_rate if bound_for_rate is not None
+             else bound_measured) / dt, 2
+        ) if dt else 0.0
+        # freeze EVERY in-window counter before the kernel-direct
+        # measurement: its throwaway session teardown/build pair (and
+        # any multipod replays it takes) must not leak into the
+        # loop-phase accounting
+        build_reasons = _session_build_reasons()
+        rebuild_reasons = _counter_window(
+            _label_counts(session_rebuilds), rebuild_reasons0
+        )
+        delta_applies = _counter_window(
+            _label_counts(session_delta_applies), delta_applies0
+        )
+        n_conflicts = _counter_total(multipod_conflicts) - conflicts0
+        n_replays = _counter_total(conflict_replays) - replays0
+        spec_now = _label_counts(speculative_dispatches)
+        session_kind = (
+            type(sched.tpu._session).__name__
+            if sched.tpu is not None and sched.tpu._session is not None
+            else ""
+        )
+        kd_rate = round(_kernel_direct_rate(sched, w), 2)
         return Result(
             name=w.name,
             backend=w.backend,
             num_nodes=w.num_nodes,
             num_pods=w.num_pods,
             duration_s=round(dt, 2),
-            throughput_avg=round(
-                (bound_for_rate if bound_for_rate is not None
-                 else bound_measured) / dt, 2
-            ) if dt else 0.0,
+            throughput_avg=tp_avg,
             throughput_p50=round(_percentile(samples, 50), 2),
             throughput_p90=round(_percentile(samples, 90), 2),
             throughput_p99=round(_percentile(samples, 99), 2),
@@ -703,23 +806,24 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             attempt_p99=round(_percentile(att, 99), 4),
             session_builds=builds,
             session_builds_total=builds_total,
-            session_build_reasons=_session_build_reasons(),
-            session_rebuild_reasons=_counter_window(
-                _label_counts(session_rebuilds), rebuild_reasons0
-            ),
-            session_delta_applies=_counter_window(
-                _label_counts(session_delta_applies), delta_applies0
-            ),
-            session_kind=(
-                type(sched.tpu._session).__name__
-                if sched.tpu is not None and sched.tpu._session is not None
-                else ""
-            ),
+            session_build_reasons=build_reasons,
+            session_rebuild_reasons=rebuild_reasons,
+            session_delta_applies=delta_applies,
+            session_kind=session_kind,
             attempts_per_sec=(
                 round((total_attempts() - attempts0) / dt, 2) if dt else 0.0
             ),
             headline_metric=(
                 "attempts_per_sec" if w.saturating else "pods_per_sec"
+            ),
+            multipod_conflicts=n_conflicts,
+            conflict_replays=n_replays,
+            speculative_hits=spec_now.get("hit", 0) - spec0.get("hit", 0),
+            speculative_misses=spec_now.get("miss", 0)
+            - spec0.get("miss", 0),
+            kernel_direct_pods_per_sec=kd_rate,
+            loop_kernel_ratio=(
+                round(tp_avg / kd_rate, 4) if kd_rate else 0.0
             ),
         )
     finally:

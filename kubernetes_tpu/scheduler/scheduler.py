@@ -150,6 +150,12 @@ class Scheduler:
         if backend == "tpu":
             self.tpu = tpu_backend or TPUBackend(rng=self.rng)
             self.tpu.max_pending = max(1, self.pipeline_depth)
+            # with a completion worker present (depth >= 1), a full
+            # _pending FIFO back-pressures dispatch_many on a condition
+            # variable instead of harvesting inline — the scheduler
+            # thread never decodes a harvest (the dispatch critical
+            # path never pays harvest+assume+decode)
+            self.tpu.async_harvest_drain = self.pipeline_depth >= 1
             self.cache.add_listener(self.tpu)
             self._wire_volume_device()
         else:

@@ -77,12 +77,24 @@ class _BatchHandle:
     ys stay valid either way."""
 
     __slots__ = ("group", "ys", "decide", "node_names", "results",
-                 "deadline", "bucket", "timed_out")
+                 "deadline", "bucket", "timed_out", "speculative",
+                 "conflicts")
 
     def __init__(self, group: List[v1.Pod]):
         self.group = group
         self.ys = None
         self.decide = None
+        # speculative dispatch: this scan was enqueued while EARLIER
+        # batches were still in flight — it chained on a carry whose
+        # decisions had not been harvested/validated yet. A clean FIFO
+        # harvest is a speculation hit; a re-drive because that carry
+        # was invalidated (fault, validation failure, conflict suffix,
+        # worker-crash abandon) is a miss.
+        self.speculative = False
+        # session-captured conflict decoder (like `decide`): maps ys to
+        # (n_conflicts, replay_suffix_start) — None for sessions without
+        # multipod support
+        self.conflicts = None
         # decisions are node INDICES into the cluster as of dispatch; a
         # node remove/rebuild before harvest would shift enc.node_names,
         # so the dispatch-time table rides the handle
@@ -154,6 +166,19 @@ class TPUBackend(CacheListener):
         # batch's decisions in dispatch order (_harvest_locked).
         self._pending: deque = deque()  # of _BatchHandle
         self.max_pending = 2
+        # back-pressure seam: when _pending is full, dispatch_many
+        # either waits on this condition for the completion worker to
+        # drain (async_harvest_drain=True — set by the Scheduler at
+        # pipeline_depth >= 1, so the scheduler thread NEVER decodes a
+        # harvest) or harvests inline (direct backend users: bench,
+        # depth-0). Signalled whenever _pending shrinks.
+        self._pending_cv = threading.Condition(self._lock)
+        self.async_harvest_drain = False
+        # speculative dispatch kill switch (KTPU_SPECULATION=0): with
+        # speculation off, a new scan never chains on a not-yet-
+        # harvested carry — dispatch_many flushes the pipeline first
+        # (serializing; the A/B lever for the bench matrix)
+        self.speculation = os.environ.get("KTPU_SPECULATION", "1") == "1"
         self.MAX_SESSION_TEMPLATES = 8
         self.volume_resolver = None  # scheduler/volume_device.py
         # pallas rides only on real TPUs: on CPU (tests, dryruns) the
@@ -442,6 +467,11 @@ class TPUBackend(CacheListener):
 
         dropped = [first] + list(self._pending)
         self._pending.clear()
+        self._pending_cv.notify_all()
+        # every later batch was a speculative dispatch chained on the
+        # carry this fault just invalidated — count the misses (the
+        # faulting batch itself is the fault, not a miss)
+        self._miss_speculative(dropped[1:])
         buckets = {h.bucket for h in dropped if h.bucket is not None}
         self._device_fault_locked(kind, buckets=buckets)
         for h in dropped:
@@ -458,10 +488,12 @@ class TPUBackend(CacheListener):
         down because its device carry includes the abandoned assumes."""
         with self._lock:
             n = len(self._pending)
+            self._miss_speculative(self._pending)
             for h in self._pending:
                 h.ys = None
                 h.results = [(p, RETRY_NODE) for p in h.group]
             self._pending.clear()
+            self._pending_cv.notify_all()
             if n:
                 self._invalidate_session("abandon-pending")
             return n
@@ -984,7 +1016,21 @@ class TPUBackend(CacheListener):
         h = _BatchHandle(list(pods))
         with self._lock:
             while len(self._pending) >= max(1, self.max_pending):
+                if self.async_harvest_drain:
+                    # back-pressure WITHOUT charging harvest+assume+
+                    # decode to the dispatch critical path: the
+                    # completion worker drains the FIFO and signals;
+                    # the timeout re-checks liveness (a crashed worker
+                    # is restarted by the Scheduler's supervision, and
+                    # abandon_pending also signals)
+                    self._pending_cv.wait(0.2)
+                    continue
                 self._harvest_locked()
+            if pods and not self.speculation:
+                # KTPU_SPECULATION=0: never chain a scan on a carry
+                # whose decisions have not been harvested + validated —
+                # land everything first (serializes the device)
+                self._flush_pending()
             if pods and self._session is not None \
                     and self.ladder.rung() > RUNG_ORACLE and all(
                 not p.spec.node_name for p in pods
@@ -1031,8 +1077,12 @@ class TPUBackend(CacheListener):
                     if isinstance(ys, dict):
                         h.bucket = ys.get("bucket")
                     h.decide = type(self._session).decisions
+                    h.conflicts = getattr(
+                        type(self._session), "conflict_stats", None)
                     h.node_names = list(self.enc.node_names)
                     h.deadline = _time.monotonic() + self.watchdog_timeout
+                    # chained on a not-yet-harvested carry: speculative
+                    h.speculative = bool(self._pending)
                     self._pending.append(h)
                     return h
             h.results = self.schedule_many(pods)  # re-entrant: RLock
@@ -1066,8 +1116,38 @@ class TPUBackend(CacheListener):
         while self._pending:
             self._harvest_locked()
 
+    def _apply_decisions_locked(
+        self, pods: List[v1.Pod], decisions: List[int],
+        node_names: List[str],
+    ) -> List[Tuple[v1.Pod, Optional[str]]]:
+        """Land a batch's harvested decisions in the host encoding (the
+        host half of the assume; the device carry already holds them)."""
+        results: List[Tuple[v1.Pod, Optional[str]]] = []
+        for g, best in zip(pods, decisions):
+            if best < 0:
+                results.append((g, None))
+            else:
+                node = node_names[best]
+                if self._session is not None:
+                    self._session_assumed.add(
+                        (g.metadata.namespace, g.metadata.name, node)
+                    )
+                self.enc.add_pod(g, node)
+                results.append((g, node))
+        return results
+
+    def _miss_speculative(self, handles) -> None:
+        """Speculation-miss accounting for handles whose chained-on
+        carry was invalidated before they could harvest."""
+        from .metrics import speculative_dispatches
+
+        n = sum(1 for h in handles if h.speculative)
+        if n:
+            speculative_dispatches.inc(n, outcome="miss")
+
     def _harvest_locked(self) -> None:
         h = self._pending.popleft()
+        self._pending_cv.notify_all()  # back-pressured dispatchers
         try:
             if h.timed_out or not self._wait_ready(
                 h.ys, self.watchdog_timeout
@@ -1095,19 +1175,54 @@ class TPUBackend(CacheListener):
             # the bucket proved itself (through jit while quarantined):
             # future session rebuilds may AOT it again
             self._suspect_buckets.discard(h.bucket)
-        results: List[Tuple[v1.Pod, Optional[str]]] = []
-        for g, best in zip(h.group, decisions):
-            if best < 0:
-                results.append((g, None))
-            else:
-                node = h.node_names[best]
-                if self._session is not None:
-                    self._session_assumed.add(
-                        (g.metadata.namespace, g.metadata.name, node)
-                    )
-                self.enc.add_pod(g, node)
-                results.append((g, node))
+        from .metrics import (
+            conflict_replays,
+            multipod_conflicts,
+            speculative_dispatches,
+        )
+
+        if h.speculative:
+            speculative_dispatches.inc(outcome="hit")
+        n_conf, suffix = (
+            h.conflicts(ys) if h.conflicts is not None else (0, None)
+        )
+        if n_conf:
+            multipod_conflicts.inc(n_conf)
+        if suffix is None:
+            if n_conf:
+                # hoisted multipod: conflicts were replayed IN-DEVICE
+                # (exact); decisions below are final
+                conflict_replays.inc(n_conf)
+            h.results = self._apply_decisions_locked(
+                h.group, decisions, h.node_names)
+            return
+        # conflict SUFFIX (pallas/sharded multipod): pods [suffix:] were
+        # left UNCOMMITTED by the kernel — the carry holds exactly the
+        # committed prefix. Land the prefix, then replay the suffix
+        # sequentially through the session. Any LATER pending batches
+        # chained their scans on a carry missing the suffix commits AND
+        # polluted it with their own — speculation misses: abandon the
+        # chain, tear the session down, and re-decide them in dispatch
+        # order (the PR-4 re-drive discipline, minus the fault: the
+        # ladder is untouched and nothing is quarantined).
+        results = self._apply_decisions_locked(
+            h.group[:suffix], decisions[:suffix], h.node_names)
+        conflict_replays.inc(len(h.group) - suffix)
+        dropped = list(self._pending)
+        self._pending.clear()
+        self._pending_cv.notify_all()
+        if dropped:
+            self._miss_speculative(dropped)
+            for hd in dropped:
+                hd.ys = None
+            self._invalidate_session("conflict-replay")
+        # with no dropped batches the live session replays the suffix
+        # chained on its committed-prefix carry (exact); after a drop it
+        # rebuilds from the encoding, which now holds the prefix assumes
+        results.extend(self.schedule_many(h.group[suffix:]))
         h.results = results
+        for hd in dropped:
+            hd.results = self.schedule_many(hd.group)
 
     def schedule_many(self, pods: List[v1.Pod]) -> List[Tuple[v1.Pod, Optional[str]]]:
         """Batched sequential scheduling: groups batchable same-shape pods
@@ -1193,18 +1308,8 @@ class TPUBackend(CacheListener):
                     results.extend((g, RETRY_NODE) for g in group)
                     i = j
                     continue
-                for g, best in zip(group, decisions):
-                    if best < 0:
-                        results.append((g, None))
-                    else:
-                        node = self.enc.node_names[best]
-                        if self._session is not None:
-                            # remember before cache.assume echoes it back
-                            self._session_assumed.add(
-                                (g.metadata.namespace, g.metadata.name, node)
-                            )
-                        self.enc.add_pod(g, node)
-                        results.append((g, node))
+                results.extend(self._apply_decisions_locked(
+                    group, decisions, self.enc.node_names))
                 i = j
         return results
 
@@ -1271,15 +1376,48 @@ class TPUBackend(CacheListener):
             self._apply_session_deltas_locked()
             if self._session is None:  # apply failed -> rebuild now
                 self._session = self._build_session()
-        ys = self._session.schedule(arrays)
-        # decisions() decodes through np.asarray, an UNBOUNDED device
-        # wait — bound it with the watchdog first or the synchronous
-        # re-decide path (fault recovery!) could hang on the very device
-        # wedge it is recovering from, with the backend lock held
-        if not self._wait_ready(ys, self.watchdog_timeout):
-            raise DeviceFault(
-                "synchronous dispatch exceeded the watchdog", kind="timeout")
-        return type(self._session).decisions(ys)
+        from .metrics import conflict_replays, multipod_conflicts
+
+        decisions: List[int] = []
+        while arrays:
+            ys = self._session.schedule(arrays)
+            # decisions() decodes through np.asarray, an UNBOUNDED device
+            # wait — bound it with the watchdog first or the synchronous
+            # re-decide path (fault recovery!) could hang on the very
+            # device wedge it is recovering from, with the backend lock
+            # held
+            if not self._wait_ready(ys, self.watchdog_timeout):
+                raise DeviceFault(
+                    "synchronous dispatch exceeded the watchdog",
+                    kind="timeout")
+            got = type(self._session).decisions(ys)
+            stats = getattr(type(self._session), "conflict_stats", None)
+            n_conf, suffix = stats(ys) if stats is not None else (0, None)
+            if n_conf:
+                multipod_conflicts.inc(n_conf)
+            if suffix is None:
+                if n_conf:
+                    # hoisted multipod: conflicts replayed IN-DEVICE
+                    conflict_replays.inc(n_conf)
+                decisions.extend(got)
+                break
+            # conflict-SUFFIX contract (pallas/sharded multipod): pods
+            # [suffix:] were left UNCOMMITTED by the kernel — keep the
+            # prefix and replay exactly the suffix through the live
+            # session, whose carry holds the committed prefix. The step
+            # algebra guarantees a batch's FIRST pod never conflicts
+            # (its eval ran against the very carry it commits to), so
+            # every round lands at least one pod and the loop
+            # terminates; a suffix of 0 would mean that invariant broke
+            # — fail loudly as a device fault rather than loop.
+            if suffix <= 0:
+                raise DeviceFault(
+                    "conflict suffix at batch head (kernel invariant "
+                    "violation)", kind="invalid")
+            conflict_replays.inc(len(arrays) - suffix)
+            decisions.extend(got[:suffix])
+            arrays = arrays[suffix:]
+        return decisions
 
     def _build_session(self):
         """Pallas single-launch session when the cluster shape supports it

@@ -265,10 +265,15 @@ def main() -> None:
                             else "BENCH_CONFIGS.json")
     mode = "a" if sys.argv[1:] else "w"  # full runs rewrite; partials append
     for name in names:
-        w = CONFIGS[name]
-        if wire:
-            import dataclasses
+        import dataclasses
 
+        # every bench row measures the same-config kernel-direct rate
+        # in-process after the loop phase and records loop_kernel_ratio
+        # — the adjudicating number for the ROADMAP "close the
+        # loop-vs-kernel gap" target (full-loop >= 50% of kernel-direct
+        # on Default-5000n)
+        w = dataclasses.replace(CONFIGS[name], kernel_direct=True)
+        if wire:
             w = dataclasses.replace(w, wire=True)
         # heavy (>=5000-node) configs used to halve the reps; VERDICT r4
         # weak #2: never below 3 — a single sample is not a measurement
@@ -306,6 +311,25 @@ def main() -> None:
         ]
         line["session_delta_applies_runs"] = [
             r.get("session_delta_applies") for r in runs
+        ]
+        # per-rep multipod/speculation accounting (round 9): same
+        # reasoning as the session counters above — a conflict storm or
+        # a speculation-miss cascade in one rep must not hide behind
+        # the median rep's dict
+        line["multipod_conflicts_runs"] = [
+            r.get("multipod_conflicts") for r in runs
+        ]
+        line["conflict_replays_runs"] = [
+            r.get("conflict_replays") for r in runs
+        ]
+        line["speculative_hits_runs"] = [
+            r.get("speculative_hits") for r in runs
+        ]
+        line["speculative_misses_runs"] = [
+            r.get("speculative_misses") for r in runs
+        ]
+        line["loop_kernel_ratio_runs"] = [
+            r.get("loop_kernel_ratio") for r in runs
         ]
         line["throughput_avg_min"] = min(r["throughput_avg"] for r in runs)
         line["throughput_avg_median"] = _median(

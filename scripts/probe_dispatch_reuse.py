@@ -57,7 +57,9 @@ def _measure(aot: bool, nodes, init_pods, pending, batches, B):
         if fp not in seen:
             seen.add(fp)
             templates.append(a)
-    sess = PallasSession(enc.device_state(), templates)
+    # multipod_k=1: no conflict-suffix replay loop here — probe the
+    # one-pod-per-step dispatch path
+    sess = PallasSession(enc.device_state(), templates, multipod_k=1)
     # warm: compile + flip the tunnel into honest sync mode
     PallasSession.decisions(sess.schedule(arrays[:B]))
     dts = []

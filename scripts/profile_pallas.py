@@ -37,7 +37,9 @@ for a in arrays:
 print("templates:", len(templates), "device:", jax.devices()[0])
 
 t0 = time.perf_counter()
-ps = PallasSession(enc.device_state(), templates)
+# multipod_k=1: this script treats decisions() as final (no
+# conflict-suffix replay loop) — profile the one-pod-per-step path
+ps = PallasSession(enc.device_state(), templates, multipod_k=1)
 print(f"session build (prologue + remap): {time.perf_counter()-t0:.1f}s")
 t0 = time.perf_counter()
 ys = ps.schedule(arrays[:B])
