@@ -208,6 +208,11 @@ class ClusterEncoding:
         # subtract the same vector even if the resolver's view of the
         # PVC/PV world changed in between
         self._pod_extras: Dict[str, Dict[str, int]] = {}
+        # monotonic mutation counter: bumps on every object-level change.
+        # Consumers that cache derived read-only views (the preemption
+        # what-if context keys its scratch snapshot off this) compare it
+        # instead of re-deriving per use.
+        self.version = 0
 
     def reserve(self, pods: int = 0, anti_terms: int = 0,
                 score_terms: int = 0) -> None:
@@ -238,6 +243,7 @@ class ClusterEncoding:
 
     def set_cluster(self, nodes: List[v1.Node], pods: List[v1.Pod]) -> None:
         """Full state load (snapshot ingest)."""
+        self.version += 1
         self._nodes = {n.metadata.name: n for n in nodes}
         self._node_order = [n.metadata.name for n in nodes]
         self._pods = {}
@@ -247,6 +253,7 @@ class ClusterEncoding:
         self._rebuild_needed = True
 
     def add_node(self, node: v1.Node) -> None:
+        self.version += 1
         if node.metadata.name not in self._nodes:
             self._node_order.append(node.metadata.name)
         self._nodes[node.metadata.name] = node
@@ -266,6 +273,7 @@ class ClusterEncoding:
         incremental (unknown node, pending rebuild, or a scalar resource
         name the vocab has never seen, which changes the row WIDTH)."""
         name = node.metadata.name
+        self.version += 1
         if self._rebuild_needed or not self._arrays:
             return None
         i = self.node_index.get(name)
@@ -298,6 +306,7 @@ class ClusterEncoding:
         return dalloc, dallowed
 
     def remove_node(self, node_name: str) -> None:
+        self.version += 1
         self._nodes.pop(node_name, None)
         self._node_order = [n for n in self._node_order if n != node_name]
         self._rebuild_needed = True
@@ -306,6 +315,7 @@ class ClusterEncoding:
         """Assume/confirm a pod onto a node (cache AssumePod analog,
         reference: pkg/scheduler/internal/cache/cache.go:361)."""
         node_name = node_name or pod.spec.node_name
+        self.version += 1
         key = v1.pod_key(pod)
         if key in self._pods:
             self.remove_pod(pod)
@@ -328,6 +338,7 @@ class ClusterEncoding:
             self._rebuild_needed = True
 
     def remove_pod(self, pod: v1.Pod) -> None:
+        self.version += 1
         key = v1.pod_key(pod)
         entry = self._pods.pop(key, None)
         if entry is None:
@@ -487,6 +498,11 @@ class ClusterEncoding:
 
     def rebuild(self) -> None:
         """Full re-encode from object state (node changes, capacity growth)."""
+        # a rebuild is a new array epoch even when no object-level call
+        # bumped the counter itself (volume events set _rebuild_needed
+        # directly; capacity growth triggers here): derived-view caches
+        # keyed on `version` must refresh
+        self.version += 1
         for node_name in self._node_order:
             self._intern_node_vocabs(self._nodes[node_name])
         pod_infos: Dict[str, PodInfo] = {}
@@ -877,6 +893,43 @@ class ClusterEncoding:
         # n_nodes/img_nodes only change via node mutations, which force a
         # rebuild (full re-upload above) — nothing further to sync here.
         return dev
+
+    def host_snapshot(self) -> dict:
+        """Numpy COPIES of the current host arrays (rebuilding first if
+        pending) — a consistent point-in-time view a caller can carry
+        OUTSIDE the owning lock (the live arrays mutate in place under
+        it). The memcpy is cheap relative to the device upload /
+        prologue build the caller does with it. Pair with `version` to
+        cache derived views."""
+        if self._rebuild_needed or self._caps_grew():
+            self.rebuild()
+        host = dict(self._arrays)
+        host.update(self._term_arrays())
+        out = {k: np.array(a, copy=True) for k, a in host.items()}
+        out["n_nodes"] = np.array(self.n_nodes, np.int32)
+        return out
+
+    def scratch_state(self) -> dict:
+        """Fresh device upload of the CURRENT host arrays — a read-only
+        snapshot that neither donates nor replaces the cached device
+        buffers (device_state()'s dirty-row scatter DONATES them, which
+        a live session may still reference). The preemption what-if
+        planner plans on this scratch copy; a live session and its
+        in-flight carry chain are never touched. Pair with `version` to
+        cache the upload across launches."""
+        import jax.numpy as jnp
+
+        return {k: jnp.asarray(a) for k, a in self.host_snapshot().items()}
+
+    def pod_row_delta(self, pod: v1.Pod):
+        """(requested-row [R], nz-row [2]) contribution of one pod to its
+        node's utilization rows — exactly what _encode_pod_row added /
+        _remove_pod_arrays subtracts, attach extras included. The
+        preemption what-if kernel ships these as inverse carry deltas
+        per candidate victim."""
+        res, nz_cpu, nz_mem = calculate_resource(pod)
+        vec = self._res_vec(res, self._pod_extras.get(v1.pod_key(pod)))
+        return vec, np.array([nz_cpu, nz_mem], np.int64)
 
     @staticmethod
     def _scatter_rows(dev: dict, host: dict, keys, dirty: Set[int]) -> None:

@@ -308,6 +308,14 @@ class Result:
     # loop_kernel_ratio = throughput_avg / kernel_direct_pods_per_sec
     kernel_direct_pods_per_sec: float = 0.0
     loop_kernel_ratio: float = 0.0
+    # preemption planner-ladder accounting (in-window deltas): which
+    # rung planned the wave pods (path -> count), how many fused
+    # what-if launches ran, and why any device-rung pod fell a rung —
+    # the counters that adjudicate the oracle-bound -> dispatch-bound
+    # claim on the chip rerun
+    preemption_planner_paths: Optional[Dict[str, int]] = None
+    whatif_launches: int = 0
+    whatif_fallbacks: Optional[Dict[str, int]] = None
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -664,9 +672,12 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         from ..scheduler.metrics import (
             conflict_replays,
             multipod_conflicts,
+            preemption_planner,
             session_delta_applies,
             session_rebuilds,
             speculative_dispatches,
+            whatif_fallbacks,
+            whatif_launches,
         )
 
         attempts0 = total_attempts()
@@ -676,6 +687,9 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         conflicts0 = _counter_total(multipod_conflicts)
         replays0 = _counter_total(conflict_replays)
         spec0 = _label_counts(speculative_dispatches)
+        planner0 = _label_counts(preemption_planner)
+        whatif0 = _counter_total(whatif_launches)
+        whatif_fb0 = _label_counts(whatif_fallbacks)
         bound0 = bound_count()
         n_ts0 = len(sched.bind_timestamps)
         t0 = time.perf_counter()
@@ -780,6 +794,13 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         n_conflicts = _counter_total(multipod_conflicts) - conflicts0
         n_replays = _counter_total(conflict_replays) - replays0
         spec_now = _label_counts(speculative_dispatches)
+        planner_paths = _counter_window(
+            _label_counts(preemption_planner), planner0
+        )
+        n_whatif = _counter_total(whatif_launches) - whatif0
+        whatif_fb = _counter_window(
+            _label_counts(whatif_fallbacks), whatif_fb0
+        )
         session_kind = (
             type(sched.tpu._session).__name__
             if sched.tpu is not None and sched.tpu._session is not None
@@ -825,6 +846,9 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             loop_kernel_ratio=(
                 round(tp_avg / kd_rate, 4) if kd_rate else 0.0
             ),
+            preemption_planner_paths=planner_paths,
+            whatif_launches=n_whatif,
+            whatif_fallbacks=whatif_fb,
         )
     finally:
         sched.stop()

@@ -212,6 +212,45 @@ conflict_replays = legacy_registry.register(
         (),
     )
 )
+preemption_planner = legacy_registry.register(
+    Counter(
+        "scheduler_preemption_planner_total",
+        "Preemptors planned, by planner-ladder rung (TPU-build metric): "
+        "path=device is the batched what-if scan (one fused launch per "
+        "preemptor over every candidate node — covers affinity/spread "
+        "preemptors); path=fast is the numpy vectorized planner "
+        "(resource-fit envelope); path=oracle is the per-pod "
+        "DefaultPreemption dry-run via redispatch. A preemption-heavy "
+        "workload sitting on path=oracle is the crawl this ladder "
+        "exists to prevent — check "
+        "scheduler_whatif_fallbacks_total{reason} for why.",
+        ("path",),
+    )
+)
+whatif_launches = legacy_registry.register(
+    Counter(
+        "scheduler_whatif_launches_total",
+        "Fused what-if device launches (one per device-planned "
+        "preemptor: base feasibility + the full reprieve walk across "
+        "all candidate nodes). Launches never touch the live session "
+        "carry — scheduler_session_rebuilds_total must not move with "
+        "this counter.",
+        (),
+    )
+)
+whatif_fallbacks = legacy_registry.register(
+    Counter(
+        "scheduler_whatif_fallbacks_total",
+        "Device-rung preemptors that fell a rung, by reason: "
+        "reason=fault (device fault mid-what-if — counted in "
+        "scheduler_device_faults_total and ladder-recorded, live "
+        "session untouched), reason=disabled (KTPU_WHATIF=0 kill "
+        "switch), reason=demoted (degradation ladder at oracle), "
+        "reason=template/context/encode/node-skew (preemptor outside "
+        "the what-if view), reason=error (host-side prep failure).",
+        ("reason",),
+    )
+)
 speculative_dispatches = legacy_registry.register(
     Counter(
         "scheduler_speculative_dispatches_total",

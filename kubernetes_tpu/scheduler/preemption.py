@@ -57,7 +57,48 @@ def _prio(pod: v1.Pod) -> int:
     return pod.spec.priority or 0
 
 
-def fast_eligible(pod: v1.Pod, snapshot, pdbs: Sequence, extenders: Sequence) -> bool:
+class WaveAntiTerms:
+    """ONE cluster pass per failure wave over the pods with required
+    anti-affinity, memoized per preemptor identity.
+
+    fast_eligible's existing-anti check used to re-walk every
+    pod-with-anti-affinity node list for EACH failed pod in the wave —
+    O(wave x cluster) for a check whose inputs repeat: the terms are a
+    wave-constant cluster property, and wave pods are stamped from a
+    handful of templates, so the match verdict depends only on the
+    preemptor's (namespace, labels) row. The memo key is that row (the
+    template-identity analog of _affinity_fingerprint for the
+    label-match side): template-stamped waves pay one term walk per
+    template instead of one cluster walk per pod."""
+
+    def __init__(self, snapshot):
+        self.terms = [
+            term
+            for ni in snapshot.have_pods_with_required_anti_affinity_list
+            for existing in ni.pods_with_required_anti_affinity
+            for term in existing.required_anti_affinity_terms
+        ]
+        self._memo: Dict[Tuple, bool] = {}
+
+    def matches(self, pod: v1.Pod) -> bool:
+        """True when ANY existing pod's required anti-affinity term
+        matches this preemptor (the filtering.go existing-anti check the
+        planner envelopes cannot express under victim eviction)."""
+        if not self.terms:
+            return False
+        key = (
+            pod.metadata.namespace,
+            tuple(sorted((pod.metadata.labels or {}).items())),
+        )
+        hit = self._memo.get(key)
+        if hit is None:
+            hit = any(t.matches(pod) for t in self.terms)
+            self._memo[key] = hit
+        return hit
+
+
+def fast_eligible(pod: v1.Pod, snapshot, pdbs: Sequence, extenders: Sequence,
+                  anti_terms: Optional[WaveAntiTerms] = None) -> bool:
     """True when the planner's envelope provably matches the oracle
     dry-run for this pod: every filter that victims could influence is
     the resource-fit filter. PDBs are INSIDE the envelope (the planner
@@ -69,12 +110,11 @@ def fast_eligible(pod: v1.Pod, snapshot, pdbs: Sequence, extenders: Sequence) ->
     the cluster are irrelevant to this pod's dry-run."""
     if extenders:
         return False
-    for ni in snapshot.have_pods_with_required_anti_affinity_list:
-        for existing in ni.pods_with_required_anti_affinity:
-            for term in existing.required_anti_affinity_terms:
-                if term.matches(pod):
-                    return False
-    if pod.spec.preemption_policy == "Never":
+    if anti_terms is None:
+        anti_terms = WaveAntiTerms(snapshot)  # single-pod callers
+    if anti_terms.matches(pod):
+        return False
+    if not eviction_invariant_gates(pod):
         return False
     spec = pod.spec
     if spec.affinity is not None and (
@@ -83,6 +123,18 @@ def fast_eligible(pod: v1.Pod, snapshot, pdbs: Sequence, extenders: Sequence) ->
     ):
         return False
     if spec.topology_spread_constraints:
+        return False
+    return True
+
+
+def eviction_invariant_gates(pod: v1.Pod) -> bool:
+    """The planner-envelope gates victim EVICTION cannot express —
+    shared by fast_eligible and device_eligible so the two envelopes
+    cannot drift: Never-policy, a pinned spec.nodeName, host ports
+    (NodePorts reads the preemptor's wants, not the victims'), and PVC
+    volumes (binding decisions are host-side)."""
+    spec = pod.spec
+    if spec.preemption_policy == "Never":
         return False
     if spec.node_name:
         return False
@@ -374,6 +426,9 @@ class FastPreemptionPlanner:
         return vec, cnt
 
     def _plan_one(self, pod: v1.Pod, limit: int) -> Optional[Candidate]:
+        from . import metrics
+
+        metrics.preemption_planner.inc(path="fast")
         prio = _prio(pod)
         req = self._req_vec(pod)
         static = self._static_mask(pod)
@@ -414,23 +469,7 @@ class FastPreemptionPlanner:
         C = idxs[:limit]
         Csz = C.size
         rows = np.arange(Csz)
-        # -- filterPodsWithPDBViolation (:660), vectorized per candidate:
-        # victims consume PDB allowances in MoreImportantPod order
-        # (priority desc, earlier start first — the :612 sort runs
-        # BEFORE the split in the reference), i.e. column-by-column
-        # through the _vsort permutation; a victim whose matched budget
-        # is already exhausted at its turn is "violating"
-        violating = np.zeros((Csz, self._vmax), dtype=bool)
-        if self.pdbs:
-            allowed_rem = np.repeat(
-                self._pdb_allowed[:, None], Csz, axis=1
-            )  # [P, C]
-            for v in range(self._vmax):
-                j = self._vsort[C, v]  # per-candidate column [C]
-                valid_o = self._valive[C, j] & (self._vprio[C, j] < prio)
-                m = self._pdb_match[C, j, :].T & valid_o[None, :]  # [P, C]
-                violating[rows, j] = np.any(m & (allowed_rem <= 0), axis=0)
-                allowed_rem -= m & (allowed_rem > 0)
+        violating = self._pdb_violating(C, prio)
         # -- vectorized reprieve (:633) over all candidates at once, in
         # the oracle's order: the VIOLATING group first, then the rest,
         # each (highest priority, earliest start) via the _vsort
@@ -471,11 +510,53 @@ class FastPreemptionPlanner:
         latest = np.max(
             np.where(hi_mask, self._vstart[C], -np.inf), axis=1
         )
-        # -- pickOneNodeForPreemption (:457), vectorized with the same
-        # tie-break ladder as DefaultPreemption._pick_one (fewest PDB
-        # violations first); final tie -> first candidate in snapshot
-        # order
-        alive = n_vict > 0
+        ci = self._pick_index(n_vict > 0, n_pdbv, max_prio, sum_prio,
+                              n_vict, latest)
+        if ci is None:
+            return None
+        i = int(C[ci])
+        victims = _ordered_victims(
+            self._vpods[i], victim_mask[ci], violating[ci],
+            self._vsort[i], self._vmax,
+        )
+        best = Candidate(
+            self.nodes[i].node.metadata.name, victims,
+            num_pdb_violations=int(n_pdbv[ci]),
+        )
+        self._claim(best, pod, prio, req)
+        return best
+
+    def _pdb_violating(self, C: np.ndarray, prio: int) -> np.ndarray:
+        """filterPodsWithPDBViolation (:660), vectorized per candidate:
+        victims consume PDB allowances in MoreImportantPod order
+        (priority desc, earlier start first — the :612 sort runs BEFORE
+        the split in the reference), i.e. column-by-column through the
+        _vsort permutation; a victim whose matched budget is already
+        exhausted at its turn is "violating". Shared verbatim by the
+        numpy reprieve and the device what-if planner (PDB accounting
+        is host bookkeeping on both rungs)."""
+        Csz = C.size
+        rows = np.arange(Csz)
+        violating = np.zeros((Csz, self._vmax), dtype=bool)
+        if self.pdbs:
+            allowed_rem = np.repeat(
+                self._pdb_allowed[:, None], Csz, axis=1
+            )  # [P, C]
+            for v in range(self._vmax):
+                j = self._vsort[C, v]  # per-candidate column [C]
+                valid_o = self._valive[C, j] & (self._vprio[C, j] < prio)
+                m = self._pdb_match[C, j, :].T & valid_o[None, :]  # [P, C]
+                violating[rows, j] = np.any(m & (allowed_rem <= 0), axis=0)
+                allowed_rem -= m & (allowed_rem > 0)
+        return violating
+
+    @staticmethod
+    def _pick_index(alive, n_pdbv, max_prio, sum_prio, n_vict, latest):
+        """pickOneNodeForPreemption (:457), vectorized with the same
+        tie-break ladder as DefaultPreemption._pick_one (fewest PDB
+        violations first); final tie -> first candidate in snapshot
+        order. Returns the winning index into the candidate axis, or
+        None when no candidate is alive."""
         if not alive.any():
             return None
         best_mask = alive
@@ -489,18 +570,7 @@ class FastPreemptionPlanner:
             best_mask = best_mask & (vals == target)
             if best_mask.sum() == 1:
                 break
-        ci = int(np.flatnonzero(best_mask)[0])
-        i = int(C[ci])
-        victims = _ordered_victims(
-            self._vpods[i], victim_mask[ci], violating[ci],
-            self._vsort[i], self._vmax,
-        )
-        best = Candidate(
-            self.nodes[i].node.metadata.name, victims,
-            num_pdb_violations=int(n_pdbv[ci]),
-        )
-        self._claim(best, pod, prio, req)
-        return best
+        return int(np.flatnonzero(best_mask)[0])
 
     def _claim(self, cand: Candidate, pod: v1.Pod, prio: int, req: np.ndarray) -> None:
         """Apply a chosen candidate to the wave books: the preemptor

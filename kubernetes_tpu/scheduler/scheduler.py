@@ -808,13 +808,35 @@ class Scheduler:
                 if _has_required_anti_affinity(p)
                 for t in _PI(p).required_anti_affinity_terms
             ]
+            from .preemption_device import (
+                ORACLE_FALLBACK,
+                DevicePreemptionPlanner,
+                device_eligible,
+            )
+
+            # ONE cluster pass over the pods with required anti-affinity
+            # for the whole wave (satellite of the planner-ladder PR):
+            # fast_eligible used to re-walk them per failed pod
+            anti_terms = fast_preemption.WaveAntiTerms(self.snapshot)
+            use_device = self.tpu is not None and self.tpu.whatif_enabled()
             fast: List = []
+            eligibility: Dict[str, Tuple[bool, bool]] = {}
             for info in preemptable:
-                if not any(
-                    t.matches(info.pod) for t in nominated_anti_terms
-                ) and fast_preemption.fast_eligible(
-                    info.pod, self.snapshot, pdbs, self.extenders
-                ):
+                pod = info.pod
+                nominated_hit = any(
+                    t.matches(pod) for t in nominated_anti_terms
+                )
+                fast_ok = not nominated_hit and fast_preemption.fast_eligible(
+                    pod, self.snapshot, pdbs, self.extenders,
+                    anti_terms=anti_terms,
+                )
+                dev_ok = (
+                    use_device
+                    and not nominated_hit
+                    and device_eligible(pod, self.extenders, anti_terms)
+                )
+                if fast_ok or dev_ok:
+                    eligibility[v1.pod_key(pod)] = (dev_ok, fast_ok)
                     fast.append(info)
                 else:
                     redispatch.append(info)
@@ -825,16 +847,32 @@ class Scheduler:
                 # claiming preemptor's nominator entry)
                 with self._preempt_lock:
                     claimed = set(self._victim_waiters)
-                planner = fast_preemption.FastPreemptionPlanner(
-                    self.snapshot, self.nominator,
-                    args=self._preemption_args(),
-                    claimed_victims=claimed,
-                    pdbs=pdbs,
-                )
+                if use_device:
+                    # three-rung planner ladder: device what-if scan ->
+                    # numpy fast planner -> oracle redispatch, one shared
+                    # set of wave books so rungs never double-claim
+                    planner = DevicePreemptionPlanner(
+                        self.snapshot, self.nominator, self.tpu,
+                        args=self._preemption_args(),
+                        claimed_victims=claimed,
+                        pdbs=pdbs,
+                        eligibility=eligibility,
+                    )
+                else:
+                    planner = fast_preemption.FastPreemptionPlanner(
+                        self.snapshot, self.nominator,
+                        args=self._preemption_args(),
+                        claimed_victims=claimed,
+                        pdbs=pdbs,
+                    )
                 cands = planner.plan([i.pod for i in fast])
                 preempted: List[Tuple] = []
                 for info, cand, fits in zip(fast, cands, planner.fits_now):
-                    if fits:
+                    if cand is ORACLE_FALLBACK:
+                        # mid-wave rung exhaustion (device fault on a pod
+                        # the numpy envelope rejects): the oracle rung
+                        redispatch.append(info)
+                    elif fits:
                         # cluster state moved since the batch dispatched:
                         # the pod fits without preemption — let the
                         # kernel re-evaluate (scores + sequential assume)
@@ -1431,6 +1469,7 @@ class Scheduler:
             if st is not None and not st.is_success():
                 return
         metrics.preemption_attempts.inc()
+        metrics.preemption_planner.inc(path="oracle")
         result, status = self.framework.run_post_filter_plugins(state, pod, statuses)
         if result is None or status is None or not status.is_success():
             return

@@ -190,6 +190,19 @@ class TPUBackend(CacheListener):
         self.use_pallas = (
             jax.devices()[0].platform == "tpu" and mesh is None
         )
+        # device-side preemption planning (ops/whatif.py): the what-if
+        # context is a SCRATCH view of the cluster (live-session carry
+        # copy, or a non-donating encoding snapshot for pallas/sharded
+        # sessions) — launches never chain onto or invalidate the live
+        # session. Platform default mirrors kernel.multipod_k: ON where
+        # the launch is a real device dispatch (TPU), OFF on CPU where
+        # the jnp what-if pays XLA compiles the numpy fast rung + oracle
+        # don't (the parity suites and probe enable it explicitly).
+        # KTPU_WHATIF=0 is the kill switch / =1 the CPU opt-in.
+        self.whatif = os.environ.get(
+            "KTPU_WHATIF",
+            "1" if jax.devices()[0].platform == "tpu" else "0",
+        ) == "1"
         # -- device fault tolerance ------------------------------------
         # Optional FaultInjector seam (testing/faults.py, duck-typed):
         # chaos drills arm dispatch raises / NaN harvests / wedged waits
@@ -222,6 +235,8 @@ class TPUBackend(CacheListener):
         # cache dies with its session, the suspicion must not — until
         # the bucket harvests cleanly again (_harvest_locked)
         self._suspect_buckets: set = set()
+        self._whatif_cache: Dict = {}
+        self._whatif_cache_version = -1
 
     def set_volume_resolver(self, resolver) -> None:
         """Enable the volume device path: bound-PVC pods encode their PV
@@ -497,6 +512,105 @@ class TPUBackend(CacheListener):
             if n:
                 self._invalidate_session("abandon-pending")
             return n
+
+    # -- device-side preemption: what-if context ---------------------------
+
+    def whatif_enabled(self) -> bool:
+        """True when the planner's device rung may run: kill switch on
+        and the degradation ladder above oracle."""
+        return self.whatif and self.ladder.rung() > RUNG_ORACLE
+
+    def whatif_context(self, pod_arrays: Dict):
+        """A WhatifContext for this preemptor template against CURRENT
+        cluster state. Preference order: the live HoistedSession when it
+        knows the template (queued deltas reconciled first, carry
+        snapshotted on-device — zero uploads); otherwise a throwaway
+        hoisted view over a non-donating encoding snapshot (the pallas /
+        sharded sessions keep their carry in kernel-private scaled
+        layouts, and the host encoding is their exact mirror after
+        harvest). Neither path invalidates the live session or counts a
+        session build. Cached per encoding version."""
+        from ..ops.whatif import WhatifContext, WhatifUnavailable
+
+        with self._lock:
+            if not self.whatif:
+                raise WhatifUnavailable("KTPU_WHATIF=0", reason="disabled")
+            if self.ladder.rung() <= RUNG_ORACLE:
+                raise WhatifUnavailable("backend demoted to oracle",
+                                        reason="demoted")
+            if self.enc.n_nodes == 0:
+                raise WhatifUnavailable("empty cluster", reason="context")
+            # settle the array epoch BEFORE keying the cache: volume
+            # events flag _rebuild_needed without an object-level
+            # version bump, and rebuild() bumps the version itself
+            if self.enc._rebuild_needed or self.enc._caps_grew():
+                self.enc.rebuild()
+            if self._whatif_cache_version != self.enc.version:
+                self._whatif_cache.clear()
+                self._whatif_cache_version = self.enc.version
+            fp = template_fingerprint(pod_arrays)
+            sess = self._session
+            if isinstance(sess, HoistedSession) and fp in sess._fps:
+                ctx = self._whatif_cache.get(("sess",))
+                if ctx is not None and ctx._sess is sess:
+                    return ctx
+                # reconcile queued cluster-event deltas into the live
+                # carry first (the normal pre-dispatch apply — the
+                # scratch copy must see them); an apply failure falls
+                # through to the encoding path
+                self._apply_session_deltas_locked()
+                sess = self._session
+                if isinstance(sess, HoistedSession) and fp in sess._fps:
+                    ctx = WhatifContext.from_session(
+                        sess, self.enc.node_names)
+                    self._whatif_cache[("sess",)] = ctx
+                    return ctx
+            ctx = self._whatif_cache.get(("enc", fp))
+            if ctx is not None:
+                return ctx
+            # the throwaway hoisted view costs a device upload + a
+            # prologue build — carry a consistent host copy out and do
+            # the expensive part WITHOUT the lock (dispatch/harvest
+            # contend on it); double-checked insert below
+            host = self.enc.host_snapshot()
+            node_names = list(self.enc.node_names)
+            version = self.enc.version
+        ctx = WhatifContext.from_host_snapshot(host, node_names, pod_arrays)
+        with self._lock:
+            if (self._whatif_cache_version == version
+                    and self.enc.version == version):
+                self._whatif_cache[("enc", fp)] = ctx
+        return ctx
+
+    def check_whatif_fault(self) -> None:
+        """Injector seam for the what-if launch path (testing/faults.py
+        raise-whatif)."""
+        inj = self.faults
+        if inj is not None:
+            inj.on_whatif()
+
+    def record_whatif_fault(self, kind: str) -> None:
+        """A what-if launch faulted: count it and walk the PR 4 ladder
+        (consecutive faults demote and wake the probe), but DO NOT
+        invalidate the live session — the what-if ran on a scratch
+        snapshot, so there is nothing to quarantine or rebuild, and
+        tearing the session down would charge planning with a rebuild
+        storm (the acceptance contract pins session_rebuilds_total
+        unchanged by planning)."""
+        from .metrics import device_faults
+
+        device_faults.inc(kind=kind)
+        with self._lock:
+            self._whatif_cache.clear()
+            self._whatif_cache_version = -1
+        if self.ladder.record_fault(kind):
+            logger.warning(
+                "TPU backend demoted to %s after %d consecutive device "
+                "faults (last: what-if %s); background probe will "
+                "re-promote", self.ladder.mode(), self.ladder.threshold,
+                kind,
+            )
+            self._ensure_probe_thread()
 
     # -- ladder probe: background re-promotion -----------------------------
 

@@ -11,6 +11,10 @@ imports this module — the seam is duck-typed, `None` means no injection.
 Kinds:
 
   raise-dispatch   the next device dispatch raises (XLA launch error)
+  raise-whatif     the next preemption what-if launch raises — the
+                   planner must fall one rung (device -> fast/oracle)
+                   with no victim double-claim and no live-session
+                   invalidation (the PR-7 drill)
   nan-harvest      the next harvested payload is corrupted (NaN floats /
                    saturated ints) BEFORE decode — must be caught by the
                    backend's finite/in-range validation guard
@@ -37,6 +41,7 @@ import numpy as np
 
 KINDS = (
     "raise-dispatch",
+    "raise-whatif",
     "nan-harvest",
     "wedge-wait",
     "kill-scheduler",
@@ -115,6 +120,12 @@ class FaultInjector:
             raise InjectedFault(
                 f"injected dispatch failure (probe={probe}, rung={rung})"
             )
+
+    def on_whatif(self) -> None:
+        """Called right before every preemption what-if launch
+        (tpu_backend.check_whatif_fault)."""
+        if self._take("raise-whatif"):
+            raise InjectedFault("injected what-if launch failure")
 
     def corrupt_harvest(self, ys, rung: Optional[int] = None):
         """Possibly corrupt one harvested payload: float leaves -> NaN,

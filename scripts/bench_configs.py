@@ -202,6 +202,23 @@ CONFIGS = {
         max_batch=512, timeout=900.0, stall_stop=30.0,
         pdb_disruptions_allowed=2000,
     ),
+    # Preemption with AFFINITY-carrying preemptors: the measured pods
+    # carry a required pod-affinity term toward the victims' app label
+    # (zone topology), putting every preemptor OUTSIDE the numpy fast
+    # planner's envelope — before the device what-if planner this row
+    # walked the oracle dry-run per candidate node. The per-rep
+    # planner-path + what-if-launch counters adjudicate the
+    # oracle-bound -> dispatch-bound claim on the chip rerun.
+    "preemptionipa": Workload(
+        "Preemption-IPA-500n-500hi", num_nodes=500, num_init_pods=2000,
+        num_pods=500,
+        init_template=PodTemplate(cpu="900m", memory="64Mi", priority=1,
+                                  labels={"app": "victim"}),
+        template=PodTemplate(cpu="900m", memory="64Mi", priority=100,
+                             pod_affinity_zone=True,
+                             labels={"app": "victim"}),
+        max_batch=512, timeout=900.0, stall_stop=30.0,
+    ),
     # 5000-node PV variant: the volume class at headline scale
     "intreepvs5000": Workload(
         "SchedulingInTreePVs-5000n", num_nodes=5000, num_init_pods=2048,
@@ -330,6 +347,19 @@ def main() -> None:
         ]
         line["loop_kernel_ratio_runs"] = [
             r.get("loop_kernel_ratio") for r in runs
+        ]
+        # per-rep preemption planner-ladder accounting (round 10): the
+        # device/fast/oracle split and what-if launch/fallback counts
+        # must survive per rep — a fallback storm in one rep must not
+        # hide behind the median rep's dict
+        line["preemption_planner_paths_runs"] = [
+            r.get("preemption_planner_paths") for r in runs
+        ]
+        line["whatif_launches_runs"] = [
+            r.get("whatif_launches") for r in runs
+        ]
+        line["whatif_fallbacks_runs"] = [
+            r.get("whatif_fallbacks") for r in runs
         ]
         line["throughput_avg_min"] = min(r["throughput_avg"] for r in runs)
         line["throughput_avg_median"] = _median(

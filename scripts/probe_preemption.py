@@ -1,0 +1,240 @@
+"""Probe: what-if preemption-launch cost vs candidate count and
+eviction depth (ISSUE 7 tooling satellite).
+
+Builds saturated clusters directly against a TPU backend (no apiserver —
+this measures the planner, not the loop) and, for each (nodes,
+victims-per-node) point, plans a preemptor wave three ways:
+
+  * device — DevicePreemptionPlanner: one fused what-if launch per
+             preemptor (base feasibility + the full reprieve walk over
+             every candidate node);
+  * fast   — the numpy FastPreemptionPlanner (the pre-PR-7 best case,
+             resource-fit envelope only);
+  * oracle — the DefaultPreemption plugin dry-run (the per-candidate
+             filter-chain walk the device rung replaces).
+
+Every point PARITY-ASSERTS the three planners (node choice + victim
+sets) before reporting timings, and a second sweep runs an
+affinity-carrying preemptor (outside the numpy envelope) device-vs-
+oracle only. Reports per-preemptor plan cost and the implied speedup.
+
+CPU-runnable as-is (the what-if program runs through the hoisted-view
+scratch context); on a TPU the same script probes real launch cost:
+
+    JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python scripts/probe_preemption.py
+
+Exit is nonzero on any parity divergence.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+from kubernetes_tpu.api import types as v1  # noqa: E402
+from kubernetes_tpu.scheduler.framework.snapshot import Snapshot  # noqa: E402
+from kubernetes_tpu.scheduler.internal.nominator import PodNominator  # noqa: E402
+from kubernetes_tpu.scheduler.preemption import (  # noqa: E402
+    FastPreemptionPlanner,
+)
+from kubernetes_tpu.scheduler.preemption_device import (  # noqa: E402
+    DevicePreemptionPlanner,
+)
+from kubernetes_tpu.scheduler.tpu_backend import TPUBackend  # noqa: E402
+from kubernetes_tpu.testing.synth import make_node, make_pod  # noqa: E402
+
+
+def saturated_cluster(n_nodes: int, victims_per_node: int,
+                      labels=None, zones: int = 3):
+    cpu_m = 4000 // max(victims_per_node + 1, 1)
+    nodes = [
+        make_node(f"n{i}", cpu="4", pods=2 * victims_per_node + 4,
+                  labels={"zone": f"z{i % zones}",
+                          v1.LABEL_HOSTNAME: f"n{i}"})
+        for i in range(n_nodes)
+    ]
+    pods = []
+    for i in range(n_nodes):
+        for j in range(victims_per_node):
+            p = make_pod(
+                f"low-{i}-{j}", cpu=f"{cpu_m}m", memory="64Mi",
+                node_name=f"n{i}", priority=1, labels=labels or {},
+            )
+            p.status.start_time = float((i * 31 + j * 7) % 97)
+            pods.append(p)
+    return nodes, pods, cpu_m
+
+
+def mk_backend(nodes, pods):
+    b = TPUBackend()
+    b.whatif = True  # CPU platform default is off; the probe opts in
+    for n in nodes:
+        b.on_add_node(n)
+    for p in pods:
+        b.on_add_pod(p, p.spec.node_name)
+    return b
+
+
+def oracle_plan(snapshot, pending, pdbs=()):
+    from tests.test_preemption import _post_filter  # noqa: E402
+
+    result, _ = _post_filter(snapshot, pending, pdbs=list(pdbs))
+    if result is None:
+        return None
+    return (result.nominated_node_name,
+            sorted(p.metadata.name for p in result.victims))
+
+
+def cand_key(c):
+    from kubernetes_tpu.scheduler.preemption_device import ORACLE_FALLBACK
+
+    if c is None:
+        return None
+    if c is ORACLE_FALLBACK:  # device rung failed; report, don't crash
+        return "oracle-fallback"
+    return (c.node_name, sorted(p.metadata.name for p in c.victims))
+
+
+def time_wave(plan_fn, reps: int):
+    # warm (compiles) then measure
+    plan_fn()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = plan_fn()
+    return (time.perf_counter() - t0) / reps, out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", default="50x2,200x4,500x4,500x8",
+                    help="comma list of <nodes>x<victims-per-node>")
+    ap.add_argument("--wave", type=int, default=8,
+                    help="preemptors per wave")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--oracle-cap", type=int, default=200,
+                    help="skip the oracle timing above this node count "
+                         "(it is the slow thing being replaced)")
+    args = ap.parse_args()
+    platform = jax.devices()[0].platform
+    print(f"platform={platform} wave={args.wave} reps={args.reps}",
+          file=sys.stderr)
+    diverged = 0
+    rows = []
+    for point in args.points.split(","):
+        n_nodes, vpn = (int(x) for x in point.strip().split("x"))
+        nodes, pods, cpu_m = saturated_cluster(n_nodes, vpn)
+        snapshot = Snapshot.from_objects(pods, nodes)
+        backend = mk_backend(nodes, pods)
+        wave = [
+            make_pod(f"hi-{k}", cpu=f"{cpu_m}m", memory="64Mi",
+                     priority=100)
+            for k in range(args.wave)
+        ]
+        elig = {v1.pod_key(p): (True, True) for p in wave}
+
+        def dev_plan():
+            pl = DevicePreemptionPlanner(
+                snapshot, PodNominator(), backend, eligibility=elig)
+            return pl.plan(list(wave))
+
+        def fast_plan():
+            pl = FastPreemptionPlanner(snapshot, PodNominator())
+            return pl.plan(list(wave))
+
+        dt_dev, dev_out = time_wave(dev_plan, args.reps)
+        dt_fast, fast_out = time_wave(fast_plan, args.reps)
+        if [cand_key(c) for c in dev_out] != \
+                [cand_key(c) for c in fast_out]:
+            print(f"!! {point}: device vs fast DIVERGED", file=sys.stderr)
+            diverged += 1
+        dt_oracle = None
+        if n_nodes <= args.oracle_cap:
+            t0 = time.perf_counter()
+            ok = oracle_plan(snapshot, wave[0])
+            dt_oracle = time.perf_counter() - t0
+            if cand_key(dev_out[0]) != ok:
+                print(f"!! {point}: device vs oracle DIVERGED",
+                      file=sys.stderr)
+                diverged += 1
+        row = {
+            "point": point, "nodes": n_nodes, "victims_per_node": vpn,
+            "wave": args.wave, "platform": platform,
+            "device_ms_per_preemptor": round(
+                1e3 * dt_dev / args.wave, 3),
+            "fast_ms_per_preemptor": round(1e3 * dt_fast / args.wave, 3),
+            "oracle_ms_per_preemptor": (
+                round(1e3 * dt_oracle, 3) if dt_oracle is not None
+                else None),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # affinity-carrying preemptors: outside the numpy envelope —
+    # device vs oracle only (the capability extension)
+    for point in ("50x2", "200x4"):
+        n_nodes, vpn = (int(x) for x in point.split("x"))
+        nodes, pods, cpu_m = saturated_cluster(
+            n_nodes, vpn, labels={"app": "victim"})
+        snapshot = Snapshot.from_objects(pods, nodes)
+        backend = mk_backend(nodes, pods)
+        aff = v1.Affinity(pod_affinity=v1.PodAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                v1.PodAffinityTerm(
+                    label_selector=v1.LabelSelector(
+                        match_labels={"app": "victim"}),
+                    topology_key="zone",
+                )
+            ]
+        ))
+        wave = [
+            make_pod(f"ahi-{k}", cpu=f"{cpu_m}m", memory="64Mi",
+                     priority=100, labels={"app": "victim"},
+                     affinity=aff)
+            for k in range(args.wave)
+        ]
+        elig = {v1.pod_key(p): (True, False) for p in wave}
+
+        def dev_plan():
+            pl = DevicePreemptionPlanner(
+                snapshot, PodNominator(), backend, eligibility=elig)
+            return pl.plan(list(wave))
+
+        dt_dev, dev_out = time_wave(dev_plan, args.reps)
+        dt_oracle = None
+        if n_nodes <= args.oracle_cap:
+            t0 = time.perf_counter()
+            ok = oracle_plan(snapshot, wave[0])
+            dt_oracle = time.perf_counter() - t0
+            if cand_key(dev_out[0]) != ok:
+                print(f"!! affinity {point}: device vs oracle DIVERGED",
+                      file=sys.stderr)
+                diverged += 1
+        row = {
+            "point": point, "profile": "ipa-affinity",
+            "nodes": n_nodes, "victims_per_node": vpn,
+            "wave": args.wave, "platform": platform,
+            "device_ms_per_preemptor": round(
+                1e3 * dt_dev / args.wave, 3),
+            "oracle_ms_per_preemptor": (
+                round(1e3 * dt_oracle, 3) if dt_oracle is not None
+                else None),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if diverged:
+        print(f"{diverged} parity divergences", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

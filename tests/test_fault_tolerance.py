@@ -564,3 +564,110 @@ class TestDrainAndShutdown:
         probe = sched.tpu._probe_thread
         assert probe is None or not probe.is_alive(), "leaked probe thread"
         assert sched.shutdown() is True  # idempotent
+
+
+# -- what-if (device preemption planner) fault drills -----------------------
+
+
+class TestWhatifFaults:
+    """PR-7 drill: a device fault MID-WHAT-IF falls the preemptor one
+    planner rung (device -> fast) with zero double-claimed victims, a
+    clean BindIntegrityChecker, and ZERO live-session invalidations —
+    the what-if runs on a scratch snapshot, so planning must never
+    charge the session-rebuild counter."""
+
+    def _preemption_cluster(self):
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.client import Clientset, SharedInformerFactory
+        from kubernetes_tpu.scheduler.scheduler import Scheduler
+        from kubernetes_tpu.testing.synth import make_node
+
+        api = APIServer()
+        cs = Clientset(api)
+        cs.nodes.create(make_node("n0", cpu="4", pods=10))
+        for j in range(4):
+            cs.pods.create(make_pod(
+                f"low{j}", namespace="default", cpu="900m", memory="64Mi",
+                priority=1,
+            ))
+        factory = SharedInformerFactory(cs)
+        sched = Scheduler(cs, factory, backend="tpu",
+                          pod_initial_backoff=30.0, pod_max_backoff=30.0)
+        sched.tpu.whatif = True  # platform default is off on CPU
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        return cs, factory, sched
+
+    def _run_drill(self, arm_fault: bool):
+        from kubernetes_tpu.testing.faults import BindIntegrityChecker
+
+        cs, factory, sched = self._preemption_cluster()
+        checker = BindIntegrityChecker().attach(
+            factory.informer_for("pods"))
+        inj = FaultInjector()
+        sched.install_fault_injector(inj)
+        sched.start()
+        try:
+            assert wait_until(
+                lambda: sum(
+                    1 for p in cs.pods.list(namespace="default")[0]
+                    if p.spec.node_name
+                ) == 4,
+                timeout=30,
+            ), "low pods did not bind"
+            rebuilds0 = sum(
+                v for _, v in metrics.session_rebuilds.items())
+            paths0 = dict(metrics.preemption_planner.items())
+            fb0 = dict(metrics.whatif_fallbacks.items())
+            if arm_fault:
+                inj.arm("raise-whatif", shots=1)
+            hi = make_pod("hi", namespace="default", cpu="900m",
+                          memory="64Mi", priority=100)
+            cs.pods.create(hi)
+            assert wait_until(
+                lambda: bool(
+                    cs.pods.get("hi", "default").spec.node_name),
+                timeout=20,
+            ), "preemptor did not bind"
+            assert cs.pods.get("hi", "default").spec.node_name == "n0"
+            # exactly one victim evicted (no double-claim): 3 low pods
+            # survive bound
+            pods, _ = cs.pods.list(namespace="default")
+            survivors = [
+                p for p in pods
+                if p.metadata.name.startswith("low") and p.spec.node_name
+            ]
+            assert len(survivors) == 3
+            assert checker.violations == []
+            # planning never tore the live session down
+            assert sum(
+                v for _, v in metrics.session_rebuilds.items()
+            ) == rebuilds0
+            paths = {
+                k: v - paths0.get(k, 0)
+                for k, v in metrics.preemption_planner.items()
+                if v - paths0.get(k, 0)
+            }
+            fb = {
+                k: v - fb0.get(k, 0)
+                for k, v in metrics.whatif_fallbacks.items()
+                if v - fb0.get(k, 0)
+            }
+            return paths, fb, inj
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_clean_run_plans_on_device_without_rebuilds(self):
+        paths, fb, _ = self._run_drill(arm_fault=False)
+        assert paths.get(("device",), 0) >= 1, paths
+        assert not fb, fb
+
+    def test_injected_fault_falls_one_rung_cleanly(self):
+        before = _counter_snapshot()
+        paths, fb, inj = self._run_drill(arm_fault=True)
+        assert inj.injected.get("raise-whatif") == 1
+        assert fb.get(("fault",), 0) >= 1, fb
+        assert paths.get(("fast",), 0) >= 1, paths
+        # the fault is a real device fault to the ladder/counters
+        assert _fault_delta(before, "raise") >= 1

@@ -1,4 +1,4 @@
-"""FastPreemptionPlanner parity vs the oracle DefaultPreemption plugin.
+"""Preemption-planner parity vs the oracle DefaultPreemption plugin.
 
 The fast planner (scheduler/preemption.py) replaces the per-node
 selectVictimsOnNode dry-run with one vectorized pass whenever the
@@ -7,6 +7,13 @@ Inside that envelope its decisions must be EXACTLY the oracle's —
 default_preemption.go:320 dryRunPreemption semantics — which this suite
 pins with randomized clusters (the same strategy test_kernel_parity.py
 uses for the scheduling kernel).
+
+The DEVICE planner (scheduler/preemption_device.py + ops/whatif.py) is
+the rung above: victim search as one fused what-if launch per preemptor.
+Its parity surface is pinned three ways here: device vs fast vs oracle
+on the fast envelope (randomized, PDBs, nominated load, start times),
+and device vs oracle on the affinity / topology-spread extension the
+numpy envelope must reject.
 """
 
 from __future__ import annotations
@@ -19,11 +26,41 @@ from kubernetes_tpu.scheduler.framework.snapshot import Snapshot
 from kubernetes_tpu.scheduler.internal.nominator import PodNominator
 from kubernetes_tpu.scheduler.preemption import (
     FastPreemptionPlanner,
+    WaveAntiTerms,
     fast_eligible,
 )
+from kubernetes_tpu.scheduler.preemption_device import (
+    DevicePreemptionPlanner,
+    device_eligible,
+)
+from kubernetes_tpu.scheduler.tpu_backend import TPUBackend
 from kubernetes_tpu.testing.synth import make_node, make_pod
 
 from .test_preemption import _post_filter
+
+
+def _mk_backend(nodes, pods) -> TPUBackend:
+    """A CPU TPUBackend with the cluster mirrored into its encoding via
+    the CacheListener hooks — the device planner's what-if context then
+    builds from a scratch snapshot of that encoding (no session needed:
+    the same path the pallas/sharded sessions take)."""
+    b = TPUBackend()
+    b.whatif = True  # CPU default is off (platform-gated); tests opt in
+    for n in nodes:
+        b.on_add_node(n)
+    for p in pods:
+        b.on_add_pod(p, p.spec.node_name)
+    return b
+
+
+def _device_plan(snapshot, wave, backend, nominator=None, pdbs=None,
+                 fast_ok=False):
+    planner = DevicePreemptionPlanner(
+        snapshot, nominator, backend, pdbs=pdbs,
+        eligibility={v1.pod_key(p): (True, fast_ok) for p in wave},
+    )
+    cands = planner.plan(wave)
+    return planner, cands
 
 
 def _random_cluster(rng: random.Random, n_nodes: int):
@@ -526,3 +563,611 @@ class TestPDBParityFuzz:
         victim_keys = [v1.pod_key(v) for c in cands for v in c.victims]
         assert len(victim_keys) == len(set(victim_keys))
         assert all(c.num_pdb_violations == 0 for c in cands)
+
+
+class TestDeviceParityFuzz:
+    """Three-way parity: device what-if planner vs numpy fast planner vs
+    the oracle DefaultPreemption plugin, on the fast envelope (where all
+    three run). The device rung must be bit-identical on node choice,
+    victim sets, victim ORDER, and PDB accounting."""
+
+    def test_three_way_random_clusters(self):
+        rng = random.Random(7)
+        agree = none = 0
+        for trial in range(25):
+            nodes, pods = _random_cluster(rng, rng.randint(3, 10))
+            snapshot = Snapshot.from_objects(pods, nodes)
+            backend = _mk_backend(nodes, pods)
+            pending = make_pod(
+                "high",
+                cpu=f"{rng.choice([1000, 2500, 3500, 9000])}m",
+                memory="1Gi", priority=100,
+            )
+            dp, (dc,) = _device_plan(
+                snapshot, [pending], backend, nominator=PodNominator())
+            assert dp.planner_paths == ["device"], (trial, dp.planner_paths)
+            fp = FastPreemptionPlanner(snapshot, PodNominator())
+            (fc,) = fp.plan([pending])
+            assert dp.fits_now == fp.fits_now, trial
+            if dp.fits_now[0]:
+                continue
+            result, _ = _post_filter(snapshot, pending)
+            if dc is None:
+                assert fc is None and result is None, trial
+                none += 1
+            else:
+                assert fc is not None and result is not None, trial
+                assert dc.node_name == fc.node_name \
+                    == result.nominated_node_name, trial
+                assert [p.metadata.name for p in dc.victims] == [
+                    p.metadata.name for p in fc.victims
+                ], trial
+                assert sorted(p.metadata.name for p in dc.victims) == sorted(
+                    p.metadata.name for p in result.victims
+                ), trial
+                agree += 1
+        assert agree >= 4 and none >= 1
+
+    def test_three_way_with_pdbs(self):
+        """Random partial budgets + random start times: the violating
+        split, violating-first reprieve ORDER, and the violations-first
+        pick ladder ride the device rung bit-identically."""
+        helper = TestPDBParityFuzz()
+        rng = random.Random(33)
+        agree = saw_violations = 0
+        for trial in range(15):
+            nodes, pods, pdbs = helper._random_pdb_cluster(
+                rng, rng.randint(3, 8))
+            snapshot = Snapshot.from_objects(pods, nodes)
+            backend = _mk_backend(nodes, pods)
+            pending = make_pod(
+                "high",
+                cpu=f"{rng.choice([1000, 2500, 3500, 9000])}m",
+                memory="1Gi", priority=100,
+            )
+            dp, (dc,) = _device_plan(snapshot, [pending], backend, pdbs=pdbs)
+            assert dp.planner_paths == ["device"], trial
+            fp = FastPreemptionPlanner(snapshot, None, pdbs=pdbs)
+            (fc,) = fp.plan([pending])
+            assert dp.fits_now == fp.fits_now, trial
+            if dp.fits_now[0]:
+                continue
+            result, _ = _post_filter(snapshot, pending, pdbs=pdbs)
+            if dc is None:
+                assert fc is None and result is None, trial
+            else:
+                assert dc.node_name == fc.node_name \
+                    == result.nominated_node_name, trial
+                assert [p.metadata.name for p in dc.victims] \
+                    == [p.metadata.name for p in fc.victims] \
+                    == [p.metadata.name for p in result.victims], trial
+                assert dc.num_pdb_violations == fc.num_pdb_violations, trial
+                agree += 1
+                if dc.num_pdb_violations:
+                    saw_violations += 1
+        assert agree >= 4
+        assert saw_violations >= 1
+
+    def test_device_pdb_partial_budget_order(self):
+        """The directed allowance-consumption-ORDER pin, through the
+        device rung: violating victims evict FIRST."""
+        nodes = [make_node("n0", cpu="4", memory="16Gi", pods=110)]
+        specs = [("p0", 0, 5.0), ("p1", 10, 1.0), ("p2", 10, 3.0),
+                 ("p3", 5, 2.0)]
+        pods = []
+        for name, prio, start in specs:
+            p = make_pod(name, cpu="900m", node_name="n0", priority=prio,
+                         labels={"app": "db"})
+            p.status.start_time = start
+            pods.append(p)
+        pdb = v1.PodDisruptionBudget(
+            metadata=v1.ObjectMeta(name="db-pdb", namespace="default"),
+            spec=v1.PodDisruptionBudgetSpec(
+                selector=v1.LabelSelector(match_labels={"app": "db"})),
+            status=v1.PodDisruptionBudgetStatus(disruptions_allowed=2),
+        )
+        snapshot = Snapshot.from_objects(pods, nodes)
+        pending = make_pod("high", cpu="3900m", priority=100)
+        dp, (dc,) = _device_plan(
+            snapshot, [pending], _mk_backend(nodes, pods), pdbs=[pdb])
+        assert dp.planner_paths == ["device"]
+        assert dc is not None
+        assert [p.metadata.name for p in dc.victims] == \
+            ["p3", "p0", "p1", "p2"]
+        assert dc.num_pdb_violations == 2
+
+    def test_three_way_with_nominated_load(self):
+        """A nominated ghost consumes capacity on its node through the
+        framework's two-pass filter; the device rung must see it."""
+        rng = random.Random(11)
+        checked = 0
+        for trial in range(12):
+            nodes, pods = _random_cluster(rng, rng.randint(2, 6))
+            snapshot = Snapshot.from_objects(pods, nodes)
+            backend = _mk_backend(nodes, pods)
+            nominator = PodNominator()
+            ghost = make_pod("ghost", cpu="2", memory="1Gi", priority=100)
+            nominator.add_nominated_pod(
+                ghost, nodes[rng.randrange(len(nodes))].metadata.name
+            )
+            pending = make_pod("high", cpu="2500m", memory="1Gi",
+                               priority=100)
+            dp, (dc,) = _device_plan(
+                snapshot, [pending], backend, nominator=nominator)
+            fp = FastPreemptionPlanner(snapshot, nominator)
+            (fc,) = fp.plan([pending])
+            assert dp.fits_now == fp.fits_now, trial
+            if dp.fits_now[0]:
+                continue
+            if dc is None:
+                assert fc is None, trial
+            else:
+                assert fc is not None, trial
+                assert dc.node_name == fc.node_name, trial
+                assert [p.metadata.name for p in dc.victims] == [
+                    p.metadata.name for p in fc.victims
+                ], trial
+                checked += 1
+        assert checked >= 2
+
+
+class TestDeviceEnvelope:
+    """The capability extension: preemptors with pod (anti-)affinity and
+    topology-spread constraints plan on the DEVICE rung — fast_eligible
+    rejects them — and must match the oracle exactly."""
+
+    def _anti_hostname(self, sel_labels):
+        return v1.Affinity(pod_anti_affinity=v1.PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                v1.PodAffinityTerm(
+                    label_selector=v1.LabelSelector(match_labels=sel_labels),
+                    topology_key="kubernetes.io/hostname",
+                )
+            ]
+        ))
+
+    def _check_oracle(self, nodes, pods, pending, pdbs=None):
+        snapshot = Snapshot.from_objects(pods, nodes)
+        backend = _mk_backend(nodes, pods)
+        assert not fast_eligible(
+            pending, snapshot, pdbs or [], []
+        ) or pending.spec.topology_spread_constraints is None
+        dp, (dc,) = _device_plan(
+            snapshot, [pending], backend, nominator=PodNominator(),
+            pdbs=pdbs)
+        assert dp.planner_paths == ["device"], dp.planner_paths
+        result, _ = _post_filter(snapshot, pending, pdbs=pdbs or [])
+        if dp.fits_now[0]:
+            return "fits", dc, result
+        if dc is None:
+            assert result is None
+            return "none", dc, result
+        assert result is not None
+        assert dc.node_name == result.nominated_node_name
+        assert sorted(p.metadata.name for p in dc.victims) == sorted(
+            p.metadata.name for p in result.victims
+        )
+        return "cand", dc, result
+
+    def test_anti_affinity_preemptor_evicts_repeller(self):
+        """The preemptor's own required anti-affinity term matches a
+        victim: evicting it clears the node — a candidate the numpy
+        envelope can never produce."""
+        nodes = [make_node("n0", cpu="4", pods=10, labels={"zone": "z0"})]
+        pods = [make_pod("vx", cpu="3500m", node_name="n0", priority=1,
+                         labels={"app": "x"})]
+        pending = make_pod("hi", cpu="1", priority=100,
+                           affinity=self._anti_hostname({"app": "x"}))
+        assert not fast_eligible(
+            pending, Snapshot.from_objects(pods, nodes), [], [])
+        anti = WaveAntiTerms(Snapshot.from_objects(pods, nodes))
+        assert device_eligible(pending, [], anti)
+        outcome, dc, _ = self._check_oracle(nodes, pods, pending)
+        assert outcome == "cand"
+        assert [p.metadata.name for p in dc.victims] == ["vx"]
+
+    def test_affinity_preemptor_base_state_semantics(self):
+        """A required-affinity preemptor whose term pods are all
+        lower-priority: the oracle's base state (every victim removed)
+        breaks the affinity, so NO candidate — the anti-monotone case
+        the reprieve order makes observable. Parity, not intuition, is
+        the contract."""
+        aff = v1.Affinity(pod_affinity=v1.PodAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                v1.PodAffinityTerm(
+                    label_selector=v1.LabelSelector(
+                        match_labels={"app": "y"}),
+                    topology_key="zone",
+                )
+            ]
+        ))
+        nodes = [make_node("n0", cpu="4", pods=10, labels={"zone": "z0"})]
+        pods = [
+            make_pod("vy", cpu="1900m", node_name="n0", priority=1,
+                     labels={"app": "y"}),
+            make_pod("vz", cpu="1900m", node_name="n0", priority=1,
+                     labels={"app": "z"}),
+        ]
+        pending = make_pod("hi", cpu="1900m", priority=100, affinity=aff)
+        outcome, _, _ = self._check_oracle(nodes, pods, pending)
+        assert outcome == "none"
+
+    def test_affinity_preemptor_anchor_survives(self):
+        """Same shape but the affinity anchor outranks the preemptor
+        (never a victim): base feasibility holds, the filler evicts,
+        and the reprieve keeps the anchor's zone count intact."""
+        aff = v1.Affinity(pod_affinity=v1.PodAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                v1.PodAffinityTerm(
+                    label_selector=v1.LabelSelector(
+                        match_labels={"app": "y"}),
+                    topology_key="zone",
+                )
+            ]
+        ))
+        nodes = [make_node("n0", cpu="4", pods=10, labels={"zone": "z0"})]
+        pods = [
+            make_pod("anchor", cpu="1900m", node_name="n0", priority=200,
+                     labels={"app": "y"}),
+            make_pod("vz", cpu="1900m", node_name="n0", priority=1,
+                     labels={"app": "z"}),
+        ]
+        pending = make_pod("hi", cpu="1900m", priority=100, affinity=aff)
+        outcome, dc, _ = self._check_oracle(nodes, pods, pending)
+        assert outcome == "cand"
+        assert [p.metadata.name for p in dc.victims] == ["vz"]
+
+    def test_spread_preemptor(self):
+        """DoNotSchedule maxSkew=1 on zone: the what-if must re-derive
+        the global min count per candidate (evictions on the candidate
+        can lower it) to pick the right node."""
+        nodes = [
+            make_node("n0", cpu="4", pods=10, labels={"zone": "z0"}),
+            make_node("n1", cpu="4", pods=10, labels={"zone": "z1"}),
+        ]
+        pods = [
+            make_pod("s0", cpu="3700m", node_name="n0", priority=1,
+                     labels={"app": "s"}),
+            make_pod("s1", cpu="500m", node_name="n1", priority=1,
+                     labels={"app": "s"}),
+            make_pod("f1", cpu="3300m", node_name="n1", priority=1,
+                     labels={"app": "f"}),
+        ]
+        pending = make_pod("hi", cpu="1", priority=100,
+                           labels={"app": "s"})
+        pending.spec.topology_spread_constraints = [
+            v1.TopologySpreadConstraint(
+                max_skew=1, topology_key="zone",
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=v1.LabelSelector(
+                    match_labels={"app": "s"}),
+            )
+        ]
+        snapshot = Snapshot.from_objects(pods, nodes)
+        assert not fast_eligible(pending, snapshot, [], [])
+        outcome, dc, _ = self._check_oracle(nodes, pods, pending)
+        assert outcome == "cand"
+        assert dc.node_name == "n0"
+        assert [p.metadata.name for p in dc.victims] == ["s0"]
+
+    def test_spread_fuzz_vs_oracle(self):
+        """Randomized spread-preemptor clusters (zones, mixed labels)
+        against the oracle."""
+        rng = random.Random(91)
+        agree = 0
+        for trial in range(12):
+            zones = [f"z{i}" for i in range(rng.randint(2, 3))]
+            nodes = [
+                make_node(f"n{i}", cpu=str(rng.choice([2, 4])), pods=8,
+                          labels={"zone": zones[i % len(zones)]})
+                for i in range(rng.randint(3, 6))
+            ]
+            pods = []
+            for i, node in enumerate(nodes):
+                for j in range(rng.randint(1, 3)):
+                    pods.append(make_pod(
+                        f"p{i}-{j}",
+                        cpu=f"{rng.choice([900, 1500, 1900])}m",
+                        node_name=node.metadata.name,
+                        priority=rng.choice([0, 1, 5]),
+                        labels={"app": rng.choice(["s", "t"])},
+                    ))
+            pending = make_pod("hi", cpu="1500m", priority=100,
+                               labels={"app": "s"})
+            pending.spec.topology_spread_constraints = [
+                v1.TopologySpreadConstraint(
+                    max_skew=1, topology_key="zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=v1.LabelSelector(
+                        match_labels={"app": "s"}),
+                )
+            ]
+            snapshot = Snapshot.from_objects(pods, nodes)
+            backend = _mk_backend(nodes, pods)
+            dp, (dc,) = _device_plan(
+                snapshot, [pending], backend, nominator=PodNominator())
+            assert dp.planner_paths == ["device"], trial
+            if dp.fits_now[0]:
+                continue
+            result, _ = _post_filter(snapshot, pending)
+            if dc is None:
+                assert result is None, trial
+            else:
+                assert result is not None, trial
+                assert dc.node_name == result.nominated_node_name, trial
+                assert sorted(
+                    p.metadata.name for p in dc.victims
+                ) == sorted(p.metadata.name for p in result.victims), trial
+                agree += 1
+        assert agree >= 2
+
+    def test_device_eligibility_gates(self):
+        nodes = [make_node("n0")]
+        snapshot = Snapshot.from_objects([], nodes)
+        anti = WaveAntiTerms(snapshot)
+        spread = make_pod("p", cpu="1", priority=10)
+        spread.spec.topology_spread_constraints = [
+            v1.TopologySpreadConstraint(
+                max_skew=1, topology_key="zone",
+                when_unsatisfiable="DoNotSchedule",
+            )
+        ]
+        # affinity/spread are INSIDE the device envelope
+        assert device_eligible(spread, [], anti)
+        aff_pod = make_pod("p2", cpu="1", priority=10,
+                           affinity=self._anti_hostname({"a": "b"}))
+        assert device_eligible(aff_pod, [], anti)
+        # extenders / Never / matched existing-anti stay outside
+        assert not device_eligible(spread, [object()], anti)
+        never = make_pod("p3", cpu="1", priority=10)
+        never.spec.preemption_policy = "Never"
+        assert not device_eligible(never, [], anti)
+        anti_pod = make_pod(
+            "anti", cpu="1", node_name="n0",
+            affinity=self._anti_hostname({"app": "x"}),
+        )
+        snapshot2 = Snapshot.from_objects([anti_pod], nodes)
+        anti2 = WaveAntiTerms(snapshot2)
+        matched = make_pod("pm", cpu="1", priority=10,
+                           labels={"app": "x"})
+        assert not device_eligible(matched, [], anti2)
+
+
+class TestDeviceWave:
+    def test_wave_distinct_victims_shared_books(self):
+        """A device-planned wave claims distinct victims and matches the
+        pure-fast wave bit for bit (shared books across rungs)."""
+        nodes = [make_node(f"n{i}", cpu="4", pods=10) for i in range(8)]
+        pods = [
+            make_pod(f"low-{i}-{j}", cpu="900m", memory="64Mi",
+                     node_name=f"n{i}", priority=1)
+            for i in range(8) for j in range(4)
+        ]
+        snapshot = Snapshot.from_objects(pods, nodes)
+        wave = [
+            make_pod(f"hi-{k}", cpu="900m", memory="64Mi", priority=100)
+            for k in range(8)
+        ]
+        dp, cands = _device_plan(
+            snapshot, wave, _mk_backend(nodes, pods),
+            nominator=PodNominator())
+        assert dp.planner_paths == ["device"] * 8
+        assert all(c is not None for c in cands)
+        vk = [v1.pod_key(v) for c in cands for v in c.victims]
+        assert len(vk) == len(set(vk)), "victim claimed twice"
+        fp = FastPreemptionPlanner(snapshot, PodNominator())
+        fcands = fp.plan([
+            make_pod(f"hi-{k}", cpu="900m", memory="64Mi", priority=100)
+            for k in range(8)
+        ])
+        assert [
+            (c.node_name, sorted(p.metadata.name for p in c.victims))
+            for c in cands
+        ] == [
+            (c.node_name, sorted(p.metadata.name for p in c.victims))
+            for c in fcands
+        ]
+
+    def test_wave_saturates_then_fails(self):
+        nodes = [make_node("n0", cpu="4", pods=10)]
+        pods = [
+            make_pod(f"low{j}", cpu="1900m", memory="64Mi",
+                     node_name="n0", priority=1)
+            for j in range(2)
+        ]
+        snapshot = Snapshot.from_objects(pods, nodes)
+        wave = [
+            make_pod(f"hi-{k}", cpu="1900m", memory="64Mi", priority=100)
+            for k in range(4)
+        ]
+        dp, cands = _device_plan(
+            snapshot, wave, _mk_backend(nodes, pods),
+            nominator=PodNominator())
+        assert sum(1 for c in cands if c is not None) == 2
+        assert sum(1 for c in cands if c is None) == 2
+
+    def test_mixed_rung_wave_shares_books(self):
+        """Half the wave rides the device rung, half the fast rung (per
+        eligibility): no victim is claimed by both."""
+        nodes = [make_node(f"n{i}", cpu="4", pods=10) for i in range(4)]
+        pods = [
+            make_pod(f"low-{i}-{j}", cpu="900m", memory="64Mi",
+                     node_name=f"n{i}", priority=1)
+            for i in range(4) for j in range(4)
+        ]
+        snapshot = Snapshot.from_objects(pods, nodes)
+        wave = [
+            make_pod(f"hi-{k}", cpu="900m", memory="64Mi", priority=100)
+            for k in range(6)
+        ]
+        elig = {
+            v1.pod_key(p): ((k % 2 == 0), True)
+            for k, p in enumerate(wave)
+        }
+        planner = DevicePreemptionPlanner(
+            snapshot, PodNominator(), _mk_backend(nodes, pods),
+            eligibility=elig,
+        )
+        cands = planner.plan(wave)
+        assert planner.planner_paths == [
+            "device", "fast", "device", "fast", "device", "fast"
+        ]
+        assert all(c is not None for c in cands)
+        vk = [v1.pod_key(v) for c in cands for v in c.victims]
+        assert len(vk) == len(set(vk))
+
+
+class TestDeviceLadder:
+    def test_kill_switch_falls_to_fast(self, monkeypatch):
+        nodes = [make_node("n0", cpu="4", pods=10)]
+        pods = [make_pod("low", cpu="3500m", node_name="n0", priority=1)]
+        snapshot = Snapshot.from_objects(pods, nodes)
+        backend = _mk_backend(nodes, pods)
+        backend.whatif = False  # KTPU_WHATIF=0
+        pending = make_pod("hi", cpu="2", priority=100)
+        dp, (dc,) = _device_plan(snapshot, [pending], backend, fast_ok=True)
+        assert dp.planner_paths == ["fast"]
+        assert dc is not None and dc.node_name == "n0"
+
+    def test_injected_fault_falls_to_fast_no_double_claim(self):
+        """raise-whatif mid-wave: the faulted pod falls to the fast
+        rung on the SAME books — candidates stay disjoint and the live
+        session is not invalidated."""
+        from kubernetes_tpu.scheduler.metrics import session_rebuilds
+        from kubernetes_tpu.testing.faults import FaultInjector
+
+        nodes = [make_node(f"n{i}", cpu="4", pods=10) for i in range(3)]
+        pods = [
+            make_pod(f"low-{i}-{j}", cpu="900m", memory="64Mi",
+                     node_name=f"n{i}", priority=1)
+            for i in range(3) for j in range(4)
+        ]
+        snapshot = Snapshot.from_objects(pods, nodes)
+        backend = _mk_backend(nodes, pods)
+        inj = FaultInjector()
+        inj.arm("raise-whatif", shots=1)
+        backend.faults = inj
+        r0 = sum(v for _, v in session_rebuilds.items())
+        wave = [
+            make_pod(f"hi-{k}", cpu="900m", memory="64Mi", priority=100)
+            for k in range(3)
+        ]
+        dp, cands = _device_plan(
+            snapshot, wave, backend, nominator=PodNominator(),
+            fast_ok=True)
+        # first pod faulted -> fast; the rest ride the device rung
+        assert dp.planner_paths == ["fast", "device", "device"]
+        assert inj.injected.get("raise-whatif") == 1
+        assert all(c is not None for c in cands)
+        vk = [v1.pod_key(v) for c in cands for v in c.victims]
+        assert len(vk) == len(set(vk)), "double-claimed victim"
+        assert sum(v for _, v in session_rebuilds.items()) == r0
+
+    def test_fault_on_device_only_pod_falls_to_oracle_sentinel(self):
+        from kubernetes_tpu.scheduler.preemption_device import (
+            ORACLE_FALLBACK,
+        )
+        from kubernetes_tpu.testing.faults import FaultInjector
+
+        nodes = [make_node("n0", cpu="4", pods=10)]
+        pods = [make_pod("low", cpu="3500m", node_name="n0", priority=1,
+                         labels={"app": "x"})]
+        snapshot = Snapshot.from_objects(pods, nodes)
+        backend = _mk_backend(nodes, pods)
+        inj = FaultInjector()
+        inj.arm("raise-whatif", shots=1)
+        backend.faults = inj
+        pending = make_pod(
+            "hi", cpu="2", priority=100,
+            affinity=v1.Affinity(pod_anti_affinity=v1.PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    v1.PodAffinityTerm(
+                        label_selector=v1.LabelSelector(
+                            match_labels={"app": "x"}),
+                        topology_key="kubernetes.io/hostname",
+                    )
+                ]
+            )),
+        )
+        dp, (dc,) = _device_plan(snapshot, [pending], backend)
+        assert dc is ORACLE_FALLBACK
+        assert dp.planner_paths == ["oracle"]
+        assert dp.fits_now == [False]
+
+    def test_live_session_scratch_snapshot(self):
+        """With a live HoistedSession holding the preemptor's template,
+        the what-if context snapshots ITS carry (no encoding upload) and
+        planning never invalidates the session."""
+        from kubernetes_tpu.ops.hoisted import HoistedSession
+        from kubernetes_tpu.scheduler.metrics import session_rebuilds
+
+        nodes = [make_node(f"n{i}", cpu="4", pods=10) for i in range(4)]
+        pods = [
+            make_pod(f"low-{i}-{j}", cpu="900m", memory="64Mi",
+                     node_name=f"n{i}", priority=1)
+            for i in range(4) for j in range(4)
+        ]
+        snapshot = Snapshot.from_objects(pods, nodes)
+        backend = _mk_backend(nodes, pods)
+        probe = make_pod("probe", cpu="900m", memory="64Mi", priority=100)
+        (res,) = backend.schedule_many([probe])
+        assert res[1] is None  # saturated by design
+        sess = backend._session
+        assert isinstance(sess, HoistedSession)
+        r0 = sum(v for _, v in session_rebuilds.items())
+        pending = make_pod("hi", cpu="900m", memory="64Mi", priority=100)
+        dp, (dc,) = _device_plan(
+            snapshot, [pending], backend, nominator=PodNominator())
+        assert dp.planner_paths == ["device"]
+        assert dc is not None
+        ctx = backend.whatif_context({
+            k: v for k, v in backend.pe.encode(pending).items()
+            if not k.startswith("_")
+        })
+        assert ctx._sess is backend._session
+        assert backend._session is sess  # never torn down
+        assert sum(v for _, v in session_rebuilds.items()) == r0
+        # parity against the oracle from the same state
+        result, _ = _post_filter(snapshot, pending)
+        assert result is not None
+        assert dc.node_name == result.nominated_node_name
+
+    def test_pallas_session_routes_through_encoding_snapshot(self):
+        """A live PallasSession keeps its carry in a kernel-private
+        scaled layout; the what-if context must build from the
+        non-donating encoding snapshot instead (construction-level on
+        CPU — no pallas kernel run), leave the session untouched, and
+        still match the oracle."""
+        from kubernetes_tpu.ops.pallas_scan import PallasSession
+        from kubernetes_tpu.scheduler.metrics import session_rebuilds
+
+        nodes = [make_node(f"n{i}", cpu="4", pods=10) for i in range(3)]
+        pods = [
+            make_pod(f"low-{i}-{j}", cpu="900m", memory="64Mi",
+                     node_name=f"n{i}", priority=1)
+            for i in range(3) for j in range(4)
+        ]
+        snapshot = Snapshot.from_objects(pods, nodes)
+        backend = _mk_backend(nodes, pods)
+        pending = make_pod("hi", cpu="900m", memory="64Mi", priority=100)
+        pa = {
+            k: v for k, v in backend.pe.encode(pending).items()
+            if not k.startswith("_")
+        }
+        sess = PallasSession(
+            backend.enc.scratch_state(), [pa], multipod_k=1)
+        backend._session = sess
+        r0 = sum(v for _, v in session_rebuilds.items())
+        dp, (dc,) = _device_plan(
+            snapshot, [pending], backend, nominator=PodNominator())
+        assert dp.planner_paths == ["device"]
+        ctx = backend.whatif_context(pa)
+        assert ctx._sess is not sess  # encoding-based scratch view
+        assert backend._session is sess  # live session untouched
+        assert sum(v for _, v in session_rebuilds.items()) == r0
+        result, _ = _post_filter(snapshot, pending)
+        assert dc is not None and result is not None
+        assert dc.node_name == result.nominated_node_name
+        assert sorted(p.metadata.name for p in dc.victims) == sorted(
+            p.metadata.name for p in result.victims
+        )
