@@ -316,6 +316,20 @@ class Result:
     preemption_planner_paths: Optional[Dict[str, int]] = None
     whatif_launches: int = 0
     whatif_fallbacks: Optional[Dict[str, int]] = None
+    # per-stage latency attribution (KTPU_TRACE >= 1): flight-recorder
+    # span summaries over the measured window, stage -> {count, total_s,
+    # p50_s, p99_s} for pop / encode / delta-apply / dispatch / wait /
+    # harvest / replay / assume / reserve-permit / bind / planner /
+    # session — the breakdown that says WHICH stage owns the
+    # loop-vs-kernel gap instead of one end-to-end number. None with
+    # tracing off (the headline path is bit-identical to pre-trace
+    # behavior there).
+    stage_latency: Optional[Dict[str, Dict[str, float]]] = None
+    # wall-clock coverage of the recorded spans (first span start ->
+    # last span end): the reconciliation anchor against duration_s /
+    # the first-bind..last-bind window
+    stage_window_s: float = 0.0
+    trace_level: int = 0
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -692,6 +706,9 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         whatif_fb0 = _label_counts(whatif_fallbacks)
         bound0 = bound_count()
         n_ts0 = len(sched.bind_timestamps)
+        from ..utils import tracing
+
+        trace_mark = tracing.RECORDER.mark() if tracing.enabled() else 0
         t0 = time.perf_counter()
         t0_mono = time.monotonic()  # bind_timestamps' clock
         last_bound = 0
@@ -806,6 +823,18 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             if sched.tpu is not None and sched.tpu._session is not None
             else ""
         )
+        # per-stage latency attribution, scoped to the measured window
+        # (the mark() anchor above) and frozen BEFORE the kernel-direct
+        # measurement, whose throwaway dispatches must not pollute the
+        # stage breakdown. Ring capacity bounds the window: a run that
+        # out-writes KTPU_TRACE_CAPACITY keeps only the newest spans
+        # (stage_window_s shows the actual coverage).
+        stage_latency = None
+        stage_window = 0.0
+        if tracing.enabled():
+            trace_events = tracing.RECORDER.snapshot(since=trace_mark)
+            stage_latency = tracing.stage_stats(trace_events)
+            stage_window = round(tracing.window_span(trace_events), 3)
         kd_rate = round(_kernel_direct_rate(sched, w), 2)
         return Result(
             name=w.name,
@@ -849,6 +878,9 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             preemption_planner_paths=planner_paths,
             whatif_launches=n_whatif,
             whatif_fallbacks=whatif_fb,
+            stage_latency=stage_latency,
+            stage_window_s=stage_window,
+            trace_level=tracing.level(),
         )
     finally:
         sched.stop()

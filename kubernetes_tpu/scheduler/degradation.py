@@ -27,8 +27,10 @@ from __future__ import annotations
 
 import random
 import threading
-from typing import Optional
+import time
+from typing import List, Optional, Tuple
 
+from ..utils import tracing
 from .metrics import backend_mode
 
 # ladder rungs, ordered: demotion decrements, promotion increments
@@ -73,6 +75,9 @@ class DegradationLadder:
         self._lock = threading.Lock()
         self.demotions = 0
         self.promotions = 0
+        # transition history for drills + flight-recorder dumps:
+        # (monotonic time, "demote" | "promote", new rung). Bounded.
+        self.transitions: List[Tuple[float, str, int]] = []
         backend_mode.set(self._rung)
 
     # -- state -------------------------------------------------------------
@@ -122,6 +127,7 @@ class DegradationLadder:
         self._rung -= 1
         self.demotions += 1
         self._consecutive = 0
+        self._record_transition_locked("demote")
         # flap hysteresis: each demotion doubles the probe cadence
         # (capped). The probe canary vouches for the DEVICE, not for the
         # kernel at the target rung — a kernel-level fault (garbage from
@@ -154,7 +160,16 @@ class DegradationLadder:
                 self._rung += 1
                 self.promotions += 1
                 self._consecutive = 0
+                self._record_transition_locked("promote")
                 backend_mode.set(self._rung)
                 return True
             self._probe_delay = min(self._probe_delay * 2, self._probe_max)
             return False
+
+    def _record_transition_locked(self, kind: str) -> None:
+        """Ledger + flight-recorder marker for a rung change (the event
+        the dump timeline anchors a demotion's surrounding spans to)."""
+        self.transitions.append((time.monotonic(), kind, self._rung))
+        del self.transitions[:-64]  # bounded
+        tracing.event(f"ladder-{kind}", "fault",
+                      rung=RUNG_NAMES[self._rung])

@@ -251,6 +251,36 @@ whatif_fallbacks = legacy_registry.register(
         ("reason",),
     )
 )
+trace_dumps = legacy_registry.register(
+    Counter(
+        "scheduler_trace_dumps_total",
+        "Flight-recorder dumps emitted at pipeline fault seams, by seam: "
+        "seam=device-fault-<kind> (watchdog timeout / harvest validation "
+        "/ dispatch raise), seam=pipeline-stalled (_drain_pipeline budget "
+        "exceeded), seam=ladder-demoted, seam=whatif-fault, "
+        "seam=worker-restart-<worker>. Each dump snapshots the last N "
+        "span events (utils/tracing.py) to the log/file before recovery "
+        "proceeds — nonzero here means a fault seam fired with a "
+        "triageable record attached.",
+        ("seam",),
+    )
+)
+def dump_seam(seam: str, **attrs) -> None:
+    """Flight-recorder dump + scheduler_trace_dumps_total bump, PAIRED.
+    Every fault seam goes through here so the counter and the dump can
+    never drift apart — fault_drill's --dump-trace integrity check
+    counts faults against dumps, and a seam that bumps without dumping
+    (or vice versa) would silently break that accounting. No-op with
+    tracing off (the ring is empty there and the fault path stays
+    cheap)."""
+    from ..utils import tracing
+
+    if not tracing.enabled():
+        return
+    trace_dumps.inc(seam=seam)
+    tracing.dump(seam, **attrs)
+
+
 speculative_dispatches = legacy_registry.register(
     Counter(
         "scheduler_speculative_dispatches_total",

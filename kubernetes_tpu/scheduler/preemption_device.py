@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from ..api import types as v1
+from ..utils import tracing
 from . import metrics
 from .degradation import DeviceFault
 from .plugins.defaultpreemption import Candidate
@@ -215,7 +216,18 @@ class DevicePreemptionPlanner(FastPreemptionPlanner):
                                                (False, True))
         if dev_ok:
             try:
-                fits, cand = self._plan_one_device(pod, limit)
+                # own stage (not "planner"): this span nests inside the
+                # wave-level planner span, and stage_stats sums per
+                # stage — sharing the stage would double-count the
+                # wave's wall-clock in the attribution tables. The
+                # pod-key attr is gated on enabled(): this is per-POD
+                # code, and the disabled path must not pay a string
+                # build per preemptor
+                sp = tracing.span(
+                    "whatif", "whatif", pod=v1.pod_key(pod),
+                ) if tracing.enabled() else tracing.NOOP_SPAN
+                with sp:
+                    fits, cand = self._plan_one_device(pod, limit)
                 self.fits_now.append(fits)
                 self.planner_paths.append("device")
                 metrics.preemption_planner.inc(path="device")

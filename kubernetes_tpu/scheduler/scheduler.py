@@ -43,7 +43,7 @@ from ..apiserver.server import APIError
 from ..client.clientset import Clientset
 from ..client.events import EventRecorder
 from ..client.informer import EventHandler, SharedInformerFactory, meta_namespace_key
-from ..utils import serde
+from ..utils import serde, tracing
 from . import metrics
 from .core import GenericScheduler, ScheduleResult
 from .framework.interface import Code, CycleState, FitError
@@ -223,7 +223,45 @@ class Scheduler:
             self.framework.profile_name if self.framework else "default-scheduler"
         )
         self.recorder = EventRecorder(clientset, self.profile_name)
+        # backend-health Events involve the SCHEDULER itself (there is
+        # no single pod to attach a ladder demotion to); observers watch
+        # Events on this pseudo-object the way they watch node Events
+        import types as _pytypes
+
+        self._self_ref = _pytypes.SimpleNamespace(
+            kind="Scheduler",
+            metadata=v1.ObjectMeta(
+                name=self.profile_name, namespace="default", uid=""),
+        )
+        if self.tpu is not None:
+            self.tpu.health_cb = self._health_event
+        from ..utils import configz
+
+        configz.install_knobs(
+            "ktpu",
+            pipeline_depth=self.pipeline_depth,
+            max_batch=self.max_batch,
+            # the RESOLVED drain budget (the /configz contract is
+            # runtime-effective values): mirror _drain_pipeline's
+            # default derivation when KTPU_DRAIN_TIMEOUT is unset
+            drain_timeout=(
+                self.drain_timeout
+                if self.drain_timeout is not None
+                else max(30.0, 3.0 * (self.tpu.watchdog_timeout
+                                      if self.tpu is not None else 30.0))
+            ),
+            backend=self.backend,
+        )
         self._add_event_handlers()
+
+    def _health_event(self, event_type: str, reason: str,
+                      message: str) -> None:
+        """Backend/pipeline health transition -> k8s Event on the
+        scheduler pseudo-object (the TPUBackend's health_cb target and
+        the pipeline seams' own reporter). Repeats aggregate into one
+        Event with a bumped count (EventRecorder semantics), so a miss
+        storm or a flapping ladder stays one line per transition kind."""
+        self.recorder.event(self._self_ref, event_type, reason, message)
 
     # -- event wiring (eventhandlers.go:364) -------------------------------
 
@@ -360,6 +398,14 @@ class Scheduler:
             except BaseException:  # noqa: BLE001 — isolation is the point
                 traceback.print_exc()
                 metrics.worker_restarts.inc(worker=name)
+                tracing.event("worker-crash", "fault", worker=name)
+                metrics.dump_seam(f"worker-restart-{name}", worker=name)
+                self._health_event(
+                    "Warning", "WorkerRestart",
+                    f"supervised pipeline worker '{name}' crashed and "
+                    f"was restarted (in-flight work drained back to the "
+                    f"queue)",
+                )
                 if recover is not None:
                     try:
                         recover()
@@ -487,12 +533,14 @@ class Scheduler:
         try:
             if self.backend == "tpu":
                 infos = [info]
-                while len(infos) < self.max_batch:
-                    nxt = self.queue.pop(timeout=0)
-                    if nxt is None:
-                        break
-                    nxt.pop_timestamp = info.pop_timestamp
-                    infos.append(nxt)
+                with tracing.span("pop", "pop") as sp:
+                    while len(infos) < self.max_batch:
+                        nxt = self.queue.pop(timeout=0)
+                        if nxt is None:
+                            break
+                        nxt.pop_timestamp = info.pop_timestamp
+                        infos.append(nxt)
+                    sp.set(n=len(infos))
                 n_scheduled = len(infos)
                 metrics.batch_size.observe(n_scheduled)
                 self._schedule_batch_tpu(infos)
@@ -715,7 +763,16 @@ class Scheduler:
                 self._completion_cv.wait(wait)
             else:
                 return True
-        if self.tpu is not None and self.tpu.ladder.demote():
+        tracing.event("pipeline-stalled", "fault", stuck=stuck,
+                      timeout=timeout)
+        metrics.dump_seam("pipeline-stalled", stuck=stuck)
+        demoted = self.tpu is not None and self.tpu.ladder.demote()
+        self._health_event(
+            "Warning", "PipelineStalled",
+            "dispatched batches failed to land within the drain budget"
+            + ("; backend demoted" if demoted else ""),
+        )
+        if demoted:
             logger.warning(
                 "pipeline stalled: %d batches undrained after %.1fs — "
                 "backend demoted to %s", stuck, timeout,
@@ -865,7 +922,19 @@ class Scheduler:
                         claimed_victims=claimed,
                         pdbs=pdbs,
                     )
-                cands = planner.plan([i.pod for i in fast])
+                with tracing.span("preemption-plan", "planner",
+                                  n=len(fast)) as psp:
+                    cands = planner.plan([i.pod for i in fast])
+                    paths = getattr(planner, "planner_paths", None)
+                    if paths and tracing.enabled():
+                        mix: Dict[str, int] = {}
+                        for p in paths:
+                            mix[p] = mix.get(p, 0) + 1
+                        psp.set(**mix)
+                        if tracing.RECORDER.pod_level():
+                            for info, path in zip(fast, paths):
+                                tracing.provenance(
+                                    v1.pod_key(info.pod), planner=path)
                 preempted: List[Tuple] = []
                 for info, cand, fits in zip(fast, cands, planner.fits_now):
                     if cand is ORACLE_FALLBACK:
@@ -1159,14 +1228,19 @@ class Scheduler:
             assumed.spec = copy.copy(info.pod.spec)
             assumed.spec.node_name = node
             assumed_list.append(assumed)
-        ok = self.cache.assume_pods(assumed_list)
+        with tracing.span("assume", "assume", n=len(assumed_list)):
+            ok = self.cache.assume_pods(assumed_list)
         batch_items: List[Tuple] = []  # (assumed, node, state, info)
-        for (info, node), assumed, assumed_ok in zip(bound, assumed_list, ok):
-            if not assumed_ok:
-                continue  # already in cache (informer raced us)
-            state = CycleState()
-            if self._reserve_and_permit(state, assumed, node, info) == "bind":
-                batch_items.append((assumed, node, state, info))
+        with tracing.span("reserve-permit", "reserve-permit",
+                          n=len(assumed_list)):
+            for (info, node), assumed, assumed_ok in zip(
+                    bound, assumed_list, ok):
+                if not assumed_ok:
+                    continue  # already in cache (informer raced us)
+                state = CycleState()
+                if self._reserve_and_permit(
+                        state, assumed, node, info) == "bind":
+                    batch_items.append((assumed, node, state, info))
         if batch_items:
             with self._inflight_lock:
                 self._inflight += 1
@@ -1330,6 +1404,8 @@ class Scheduler:
         FINISHED — an assumed pod that never reaches finish_binding has
         no expiry)."""
         unsettled = {id(assumed): assumed for assumed, _, _, _ in items}
+        bind_sp = tracing.span("bind", "bind", n=len(items))
+        bind_sp.__enter__()
         try:
             fwk = self.framework
             ready: List[Tuple] = []
@@ -1385,6 +1461,7 @@ class Scheduler:
                 except Exception:  # noqa: BLE001 — keep releasing the rest
                     traceback.print_exc()
         finally:
+            bind_sp.__exit__(None, None, None)
             with self._inflight_lock:
                 self._inflight -= 1
 

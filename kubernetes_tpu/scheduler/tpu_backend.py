@@ -37,6 +37,7 @@ from ..ops.hoisted import (
     match_matrices_np,
     template_fingerprint,
 )
+from ..utils import tracing
 from .degradation import (
     RUNG_HOISTED,
     RUNG_ORACLE,
@@ -78,7 +79,7 @@ class _BatchHandle:
 
     __slots__ = ("group", "ys", "decide", "node_names", "results",
                  "deadline", "bucket", "timed_out", "speculative",
-                 "conflicts")
+                 "conflicts", "prov")
 
     def __init__(self, group: List[v1.Pod]):
         self.group = group
@@ -105,6 +106,11 @@ class _BatchHandle:
         self.deadline: Optional[float] = None
         self.bucket: Optional[int] = None  # pallas AOT-exec bucket (Bp)
         self.timed_out = False
+        # flight-recorder provenance captured at dispatch time (rung,
+        # session kind, build reason, ...). None unless KTPU_TRACE >= 2
+        # — the disabled path must not allocate per batch beyond the
+        # handle itself (pinned by the overhead test)
+        self.prov: Optional[Dict] = None
 
 
 class TPUBackend(CacheListener):
@@ -237,6 +243,52 @@ class TPUBackend(CacheListener):
         self._suspect_buckets: set = set()
         self._whatif_cache: Dict = {}
         self._whatif_cache_version = -1
+        # backend-health event hook: the Scheduler wires this to its
+        # EventRecorder so ladder demote/promote, supervised-worker
+        # restarts and speculation-miss re-drives surface as k8s Events
+        # (cluster-level observers see device health without scraping
+        # metrics). Signature: (event_type, reason, message). Must never
+        # raise into the dispatch path — _notify_health guards it.
+        self.health_cb = None
+        # flight-recorder provenance context: the last session build
+        # ("kind/reason") and the last teardown reason — what the
+        # per-pod provenance records (KTPU_TRACE=2) report as the
+        # session half of "where did this pod's time go"
+        self._last_build = ""
+        self._last_invalidate = ""
+        # runtime-effective KTPU_* knob surface (utils/configz.py):
+        # today the env vars are invisible at runtime; /configz shows
+        # the values this backend actually resolved
+        from ..ops.kernel import multipod_k as _mk
+        from ..utils import configz
+
+        configz.install_knobs(
+            "ktpu",
+            multipod_k=_mk(platform=jax.devices()[0].platform),
+            speculation=self.speculation,
+            whatif=self.whatif,
+            session_deltas=self.delta_patching,
+            max_queued_deltas=self.max_queued_deltas,
+            use_pallas=self.use_pallas,
+            watchdog_timeout=self.watchdog_timeout,
+            dispatch_retries=self.retry_cap,
+            demote_threshold=self.ladder.threshold,
+            trace_level=tracing.level(),
+            trace_capacity=tracing.RECORDER.capacity,
+        )
+
+    def _notify_health(self, event_type: str, reason: str,
+                       message: str) -> None:
+        """Best-effort backend-health event (ladder transitions, worker
+        restarts, speculation-miss re-drives). Never raises: health
+        reporting must not add a failure mode to the fault path."""
+        cb = self.health_cb
+        if cb is None:
+            return
+        try:
+            cb(event_type, reason, message)
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            logger.warning("backend health event failed", exc_info=True)
 
     def set_volume_resolver(self, resolver) -> None:
         """Enable the volume device path: bound-PVC pods encode their PV
@@ -317,6 +369,8 @@ class TPUBackend(CacheListener):
         from .metrics import session_rebuilds
 
         session_rebuilds.inc(reason=reason)
+        self._last_invalidate = reason
+        tracing.event("session-teardown", "session", reason=reason)
         if _os.environ.get("KTPU_DEBUG_INVALIDATE"):
             import traceback as _tb
 
@@ -392,13 +446,17 @@ class TPUBackend(CacheListener):
                     raise DeviceFault(
                         f"non-finite device payload in {k!r}", kind="invalid")
 
-    def _device_fault_locked(self, kind: str, buckets=()) -> None:
+    def _device_fault_locked(self, kind: str, buckets=(),
+                             attrs: Optional[Dict] = None) -> None:
         """Record one device fault: count it, quarantine the suspect AOT
         buckets (pallas — the quarantine outlives the session teardown
         one line down, _build_session re-applies it to every rebuild),
         tear the session down, and demote the ladder when this fault
-        crossed the consecutive threshold."""
-        from .metrics import device_faults
+        crossed the consecutive threshold. The flight recorder dumps its
+        ring BEFORE recovery proceeds: a watchdog timeout or validation
+        fault leaves the faulted dispatch's span trail (bucket, rung,
+        speculation state) in the log, not just a counter bump."""
+        from .metrics import device_faults, dump_seam
 
         device_faults.inc(kind=kind)
         if kind == "timeout" and self.faults is not None:
@@ -407,12 +465,25 @@ class TPUBackend(CacheListener):
             # responsive device again
             self.faults.consume_wedge()
         self._suspect_buckets.update(b for b in buckets if b is not None)
+        fault_attrs = dict(attrs or ())
+        fault_attrs.update(
+            kind=kind, rung=self.ladder.mode(),
+            buckets=sorted(b for b in buckets if b is not None),
+        )
+        tracing.event("device-fault", "fault", **fault_attrs)
+        dump_seam(f"device-fault-{kind}", **fault_attrs)
         self._invalidate_session("device-fault")
         if self.ladder.record_fault(kind):
             logger.warning(
                 "TPU backend demoted to %s after %d consecutive device "
                 "faults (last: %s); background probe will re-promote",
                 self.ladder.mode(), self.ladder.threshold, kind,
+            )
+            dump_seam("ladder-demoted", **fault_attrs)
+            self._notify_health(
+                "Warning", "BackendDemoted",
+                f"scoring backend demoted to {self.ladder.mode()} after "
+                f"consecutive device faults (last: {kind})",
             )
             self._ensure_probe_thread()
 
@@ -433,6 +504,8 @@ class TPUBackend(CacheListener):
                 break
             if n:
                 dispatch_retries.inc()
+                tracing.event("dispatch-retry", "fault", attempt=n,
+                              rung=self.ladder.mode())
                 _time.sleep(
                     min(delay, self.retry_max) * (1 + self.rng.random()))
                 delay *= 2
@@ -488,11 +561,19 @@ class TPUBackend(CacheListener):
         # faulting batch itself is the fault, not a miss)
         self._miss_speculative(dropped[1:])
         buckets = {h.bucket for h in dropped if h.bucket is not None}
-        self._device_fault_locked(kind, buckets=buckets)
+        self._device_fault_locked(
+            kind, buckets=buckets,
+            attrs={
+                "n_batches": len(dropped), "n_pods": len(first.group),
+                "bucket": first.bucket, "speculative": first.speculative,
+            },
+        )
         for h in dropped:
             h.ys = None
             dispatch_retries.inc()
-            h.results = self.schedule_many(h.group)
+            with tracing.span("re-drive", "replay", n=len(h.group),
+                              speculative=h.speculative, kind=kind):
+                h.results = self.schedule_many(h.group)
 
     def abandon_pending(self) -> int:
         """Drop every not-yet-harvested in-flight dispatch WITHOUT
@@ -600,6 +681,11 @@ class TPUBackend(CacheListener):
         from .metrics import device_faults
 
         device_faults.inc(kind=kind)
+        tracing.event("whatif-fault", "fault", kind=kind,
+                      rung=self.ladder.mode())
+        from .metrics import dump_seam
+
+        dump_seam("whatif-fault", kind=kind)
         with self._lock:
             self._whatif_cache.clear()
             self._whatif_cache_version = -1
@@ -609,6 +695,11 @@ class TPUBackend(CacheListener):
                 "faults (last: what-if %s); background probe will "
                 "re-promote", self.ladder.mode(), self.ladder.threshold,
                 kind,
+            )
+            self._notify_health(
+                "Warning", "BackendDemoted",
+                f"scoring backend demoted to {self.ladder.mode()} after "
+                f"consecutive device faults (last: {kind})",
             )
             self._ensure_probe_thread()
 
@@ -640,6 +731,11 @@ class TPUBackend(CacheListener):
                 logger.warning(
                     "TPU backend re-promoted to %s after a clean probe",
                     self.ladder.mode(),
+                )
+                self._notify_health(
+                    "Normal", "BackendPromoted",
+                    f"scoring backend re-promoted to {self.ladder.mode()} "
+                    f"after a clean probe",
                 )
                 with self._lock:
                     # the next batch must rebuild at the restored rung
@@ -932,7 +1028,9 @@ class TPUBackend(CacheListener):
         from .metrics import session_delta_applies
 
         try:
-            self._session.apply_deltas(deltas)
+            with tracing.span("queued-delta-apply", "delta-apply",
+                              n=len(deltas)):
+                self._session.apply_deltas(deltas)
         except Exception:  # noqa: BLE001 — rebuild is always correct
             logger.warning(
                 "session delta apply failed; falling back to a rebuild",
@@ -1150,11 +1248,12 @@ class TPUBackend(CacheListener):
                 not p.spec.node_name for p in pods
             ):
                 try:
-                    clean = [
-                        {k: v for k, v in self.pe.encode(p).items()
-                         if not k.startswith("_")}
-                        for p in pods
-                    ]
+                    with tracing.span("encode", "encode", n=len(pods)):
+                        clean = [
+                            {k: v for k, v in self.pe.encode(p).items()
+                             if not k.startswith("_")}
+                            for p in pods
+                        ]
                 except VolumeResolutionChanged:
                     clean = None  # schedule_many handles it per pod
                 if clean is None:
@@ -1178,7 +1277,19 @@ class TPUBackend(CacheListener):
                             h.results = self.schedule_many(pods)
                             return h
                         self._check_dispatch_fault()
-                        ys = self._session.schedule(clean)  # async, no block
+                        # span attrs (incl. the ladder-lock rung read)
+                        # are only evaluated when tracing is on: the
+                        # disabled dispatch path stays one predicate
+                        # check per instrumentation point
+                        sp = tracing.span(
+                            "dispatch", "dispatch", n=len(pods),
+                            rung=self.ladder.rung(),
+                            speculative=bool(self._pending),
+                            pipelined=True,
+                            group_pos=len(self._pending),
+                        ) if tracing.enabled() else tracing.NOOP_SPAN
+                        with sp:
+                            ys = self._session.schedule(clean)  # async
                     except Exception:  # noqa: BLE001 — dispatch-time fault:
                         # the enqueue failed BEFORE the scan chained onto
                         # the carry, so earlier pending batches stay
@@ -1197,6 +1308,14 @@ class TPUBackend(CacheListener):
                     h.deadline = _time.monotonic() + self.watchdog_timeout
                     # chained on a not-yet-harvested carry: speculative
                     h.speculative = bool(self._pending)
+                    if tracing.RECORDER.pod_level():
+                        h.prov = {
+                            "rung": self.ladder.mode(),
+                            "session": type(self._session).__name__,
+                            "build_reason": self._last_build,
+                            "bucket": h.bucket,
+                            "speculative": h.speculative,
+                        }
                     self._pending.append(h)
                     return h
             h.results = self.schedule_many(pods)  # re-entrant: RLock
@@ -1212,8 +1331,12 @@ class TPUBackend(CacheListener):
             # carry is donated — so waiting on them unlocked is safe.
             # The wait is watchdog-bounded: a wedged device marks the
             # handle timed out and the locked harvest runs recovery.
-            if not self._wait_ready(ys, self.watchdog_timeout):
-                handle.timed_out = True
+            with tracing.span("wait", "wait", n=len(handle.group),
+                              bucket=handle.bucket,
+                              speculative=handle.speculative) as sp:
+                if not self._wait_ready(ys, self.watchdog_timeout):
+                    handle.timed_out = True
+                    sp.set(timed_out=True)
         with self._lock:
             # strictly FIFO: older batches' decisions are ground truth
             # for this one — land them first
@@ -1232,14 +1355,20 @@ class TPUBackend(CacheListener):
 
     def _apply_decisions_locked(
         self, pods: List[v1.Pod], decisions: List[int],
-        node_names: List[str],
+        node_names: List[str], prov: Optional[Dict] = None,
     ) -> List[Tuple[v1.Pod, Optional[str]]]:
         """Land a batch's harvested decisions in the host encoding (the
-        host half of the assume; the device carry already holds them)."""
+        host half of the assume; the device carry already holds them).
+        `prov` carries the dispatch-time provenance for KTPU_TRACE=2
+        per-pod records (rung, session kind, build reason, bucket,
+        speculation) — None below level 2 keeps this loop allocation-free."""
         results: List[Tuple[v1.Pod, Optional[str]]] = []
+        rec = tracing.RECORDER
+        pod_level = rec.pod_level()
         for g, best in zip(pods, decisions):
             if best < 0:
                 results.append((g, None))
+                node = None
             else:
                 node = node_names[best]
                 if self._session is not None:
@@ -1248,6 +1377,10 @@ class TPUBackend(CacheListener):
                     )
                 self.enc.add_pod(g, node)
                 results.append((g, node))
+            if pod_level:
+                rec.provenance(
+                    v1.pod_key(g), node=node, **(prov or {}),
+                )
         return results
 
     def _miss_speculative(self, handles) -> None:
@@ -1258,25 +1391,38 @@ class TPUBackend(CacheListener):
         n = sum(1 for h in handles if h.speculative)
         if n:
             speculative_dispatches.inc(n, outcome="miss")
+            tracing.event("speculation-miss", "fault", n=n)
+            for _ in range(n):
+                # constant message: repeats AGGREGATE on the recorder
+                # side (count bumps), so a miss storm is one event with
+                # a large count, not an event flood
+                self._notify_health(
+                    "Warning", "SpeculationMissRedrive",
+                    "speculative dispatch re-driven: the carry it "
+                    "chained on was invalidated",
+                )
 
     def _harvest_locked(self) -> None:
         h = self._pending.popleft()
         self._pending_cv.notify_all()  # back-pressured dispatchers
+        hsp = tracing.span("harvest", "harvest", n=len(h.group),
+                           bucket=h.bucket, speculative=h.speculative)
         try:
-            if h.timed_out or not self._wait_ready(
-                h.ys, self.watchdog_timeout
-                if h.deadline is None
-                else h.deadline - _time.monotonic()
-            ):
-                raise DeviceFault(
-                    "device wait exceeded the dispatch watchdog",
-                    kind="timeout")
-            ys = h.ys
-            if self.faults is not None:
-                ys = self.faults.corrupt_harvest(
-                    ys, rung=self.ladder.rung())
-            decisions = h.decide(ys)
-            self._validate_decisions(decisions, len(h.node_names), ys)
+            with hsp:
+                if h.timed_out or not self._wait_ready(
+                    h.ys, self.watchdog_timeout
+                    if h.deadline is None
+                    else h.deadline - _time.monotonic()
+                ):
+                    raise DeviceFault(
+                        "device wait exceeded the dispatch watchdog",
+                        kind="timeout")
+                ys = h.ys
+                if self.faults is not None:
+                    ys = self.faults.corrupt_harvest(
+                        ys, rung=self.ladder.rung())
+                decisions = h.decide(ys)
+                self._validate_decisions(decisions, len(h.node_names), ys)
         except DeviceFault as e:
             self._recover_dispatches_locked(e.kind, h)
             return
@@ -1302,13 +1448,16 @@ class TPUBackend(CacheListener):
         )
         if n_conf:
             multipod_conflicts.inc(n_conf)
+        if h.prov is not None:
+            h.prov["spec_outcome"] = "hit" if h.speculative else None
+            h.prov["conflicts"] = n_conf
         if suffix is None:
             if n_conf:
                 # hoisted multipod: conflicts were replayed IN-DEVICE
                 # (exact); decisions below are final
                 conflict_replays.inc(n_conf)
             h.results = self._apply_decisions_locked(
-                h.group, decisions, h.node_names)
+                h.group, decisions, h.node_names, prov=h.prov)
             return
         # conflict SUFFIX (pallas/sharded multipod): pods [suffix:] were
         # left UNCOMMITTED by the kernel — the carry holds exactly the
@@ -1320,7 +1469,8 @@ class TPUBackend(CacheListener):
         # order (the PR-4 re-drive discipline, minus the fault: the
         # ladder is untouched and nothing is quarantined).
         results = self._apply_decisions_locked(
-            h.group[:suffix], decisions[:suffix], h.node_names)
+            h.group[:suffix], decisions[:suffix], h.node_names,
+            prov=h.prov)
         conflict_replays.inc(len(h.group) - suffix)
         dropped = list(self._pending)
         self._pending.clear()
@@ -1333,7 +1483,10 @@ class TPUBackend(CacheListener):
         # with no dropped batches the live session replays the suffix
         # chained on its committed-prefix carry (exact); after a drop it
         # rebuilds from the encoding, which now holds the prefix assumes
-        results.extend(self.schedule_many(h.group[suffix:]))
+        with tracing.span("conflict-suffix-replay", "replay",
+                          n=len(h.group) - suffix,
+                          n_dropped=len(dropped), bucket=h.bucket):
+            results.extend(self.schedule_many(h.group[suffix:]))
         h.results = results
         for hd in dropped:
             hd.results = self.schedule_many(hd.group)
@@ -1410,10 +1563,16 @@ class TPUBackend(CacheListener):
                 # are exactly the live session's statics (the session
                 # is self-consistent without the sync; its exactness
                 # argument is in ops/hoisted.py)
-                decisions = self._session_schedule_guarded([
-                    {k: v for k, v in a.items() if not k.startswith("_")}
-                    for a in arrays
-                ])
+                sp = tracing.span(
+                    "dispatch-sync", "dispatch", n=len(group),
+                    rung=self.ladder.rung(), pipelined=False,
+                ) if tracing.enabled() else tracing.NOOP_SPAN
+                with sp:
+                    decisions = self._session_schedule_guarded([
+                        {k: v for k, v in a.items()
+                         if not k.startswith("_")}
+                        for a in arrays
+                    ])
                 if decisions is None:
                     # retries exhausted (or fully demoted): the whole
                     # group re-gates via the queue exactly once; while
@@ -1422,8 +1581,17 @@ class TPUBackend(CacheListener):
                     results.extend((g, RETRY_NODE) for g in group)
                     i = j
                     continue
+                prov = None
+                if tracing.RECORDER.pod_level():
+                    prov = {
+                        "rung": self.ladder.mode(),
+                        "session": type(self._session).__name__
+                        if self._session is not None else "",
+                        "build_reason": self._last_build,
+                        "speculative": False,
+                    }
                 results.extend(self._apply_decisions_locked(
-                    group, decisions, self.enc.node_names))
+                    group, decisions, self.enc.node_names, prov=prov))
                 i = j
         return results
 
@@ -1534,6 +1702,20 @@ class TPUBackend(CacheListener):
         return decisions
 
     def _build_session(self):
+        """Span-wrapped _build_session_impl: records the build as a
+        "session" span (builds are the seconds-scale cost rebuild storms
+        are made of) and pins the session-kind/rebuild-reason pair the
+        per-pod provenance records report."""
+        with tracing.span("session-build", "session",
+                          reason=self._last_invalidate) as sp:
+            s = self._build_session_impl()
+            self._last_build = (
+                f"{type(s).__name__}/{self._last_invalidate or 'initial'}"
+            )
+            sp.set(kind=type(s).__name__)
+            return s
+
+    def _build_session_impl(self):
         """Pallas single-launch session when the cluster shape supports it
         (ops/pallas_scan.py), else the jnp lax.scan session — identical
         decisions either way (tests/test_pallas_scan.py). Downgrades are

@@ -24,6 +24,21 @@ def install(name: str, config: Any) -> None:
         _registry[name] = config
 
 
+def install_knobs(name: str, **knobs: Any) -> None:
+    """Merge key/value knobs into a named dict entry. The KTPU_* env-var
+    surface registers its RUNTIME-EFFECTIVE values here (the resolved
+    multipod k, speculation/whatif/session-delta switches, trace level,
+    watchdog/drain timeouts) so a running scheduler's configuration is
+    inspectable via /configz instead of invisible process environment.
+    Multiple components (TPUBackend, Scheduler) contribute to one entry."""
+    with _lock:
+        entry = _registry.get(name)
+        if not isinstance(entry, dict):
+            entry = {}
+            _registry[name] = entry
+        entry.update(knobs)
+
+
 def delete(name: str) -> None:
     with _lock:
         _registry.pop(name, None)

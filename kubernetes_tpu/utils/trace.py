@@ -4,6 +4,12 @@ Reference: the scheduler wraps each cycle in a utiltrace span and logs the
 step breakdown only when it exceeds a threshold
 (pkg/scheduler/core/generic_scheduler.go:96-97, 100ms); apiserver handlers
 do the same per request (endpoints/handlers/create.go:52).
+
+The structured sibling lives in utils/tracing.py (span recorder +
+flight recorder): a Trace answers "was this ONE cycle slow?" at a log
+line; record_spans() forwards its step breakdown into the flight
+recorder so threshold traces and pipeline spans land in the same
+exportable record.
 """
 
 from __future__ import annotations
@@ -25,6 +31,21 @@ class Trace:
 
     def total_seconds(self) -> float:
         return time.perf_counter() - self.start
+
+    def record_spans(self, stage: str = "cycle") -> None:
+        """Mirror the step breakdown into the flight recorder (one span
+        per step, named "<trace>/<step>"); no-op when tracing is off."""
+        from . import tracing
+
+        if not tracing.enabled():
+            return
+        last = self.start
+        for t, msg in self.steps:
+            tracing.RECORDER.record(
+                f"{self.name}/{msg}", stage, last, t - last,
+                self.fields or None,
+            )
+            last = t
 
     def log_if_long(self, threshold: float, out=sys.stderr) -> bool:
         total = self.total_seconds()

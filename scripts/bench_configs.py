@@ -361,6 +361,13 @@ def main() -> None:
         line["whatif_fallbacks_runs"] = [
             r.get("whatif_fallbacks") for r in runs
         ]
+        # per-rep stage-latency attribution (round 11): with KTPU_TRACE
+        # on, each rep's per-stage p50/p99 breakdown survives — the chip
+        # rerun reads WHICH stage owns the loop-vs-kernel gap per rep,
+        # not a median rep's summary (None per rep with tracing off)
+        line["stage_latency_runs"] = [
+            r.get("stage_latency") for r in runs
+        ]
         line["throughput_avg_min"] = min(r["throughput_avg"] for r in runs)
         line["throughput_avg_median"] = _median(
             [r["throughput_avg"] for r in runs]

@@ -77,14 +77,29 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--watchdog", type=float, default=0.5,
                     help="dispatch watchdog (s)")
+    ap.add_argument("--dump-trace", nargs="?", const="fault_drill_trace.json",
+                    default="", metavar="PATH",
+                    help="run with KTPU_TRACE=2, write the end-of-drill "
+                         "flight-recorder snapshot to PATH, render it via "
+                         "scripts/trace_report.py, and fail the drill if "
+                         "any fault seam fired WITHOUT dumping or the "
+                         "dump does not render")
     args = ap.parse_args()
 
+    from kubernetes_tpu.utils import tracing
+
+    if args.dump_trace:
+        # per-pod provenance on: the drill's dump must name the faulted
+        # batch's bucket, rung and speculation state
+        tracing.set_level(max(tracing.level(), 2))
     rng = random.Random(args.seed)
     inj = FaultInjector()
     failures = []
     retries0 = metrics.dispatch_retries.value()
     restarts0 = counter_total(metrics.worker_restarts)
     faults0 = {k: val for k, val in metrics.device_faults.items()}
+    dumps0 = counter_total(metrics.trace_dumps)
+    ndumps0 = len(tracing.RECORDER.dump_history)
 
     with Cluster(
         n_nodes=args.nodes,
@@ -173,6 +188,29 @@ def main() -> int:
               f"re-promotions={tpu.ladder.promotions} "
               f"final={tpu.ladder.mode()}")
         print(f"final bind count: {bound}/{args.replicas}")
+
+        if args.dump_trace:
+            # flight-recorder integrity: every fault seam that fired
+            # must have dumped, and the end-of-drill snapshot must
+            # render (chrome trace + stage report) — a seam that leaves
+            # no triageable record fails the drill
+            n_faults = sum(fault_delta.values())
+            n_dumps = counter_total(metrics.trace_dumps) - dumps0
+            seam_dumps = tracing.RECORDER.dump_history[ndumps0:]
+            print(f"trace dumps:      {n_dumps:.0f} "
+                  f"({sorted({d['reason'] for d in seam_dumps})})")
+            if n_faults > 0 and n_dumps == 0:
+                failures.append(
+                    f"{n_faults:.0f} device faults recorded but no "
+                    f"flight-recorder dump fired")
+            tracing.dump("fault-drill-final", path=args.dump_trace,
+                         faults=dict(inj.injected))
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            import trace_report
+
+            if trace_report.render(args.dump_trace) != 0:
+                failures.append(
+                    f"trace_report could not render {args.dump_trace}")
 
     if failures:
         print("FAIL:\n  " + "\n  ".join(failures))

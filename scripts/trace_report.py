@@ -1,0 +1,94 @@
+"""Render a flight-recorder dump: Chrome-trace JSON + text stage report.
+
+Input: a dump file written by the flight recorder (utils/tracing.py
+FlightRecorder.dump — the KTPU_TRACE_DUMP_DIR files every fault seam
+emits, or scripts/fault_drill.py --dump-trace's end-of-drill snapshot).
+
+Output:
+  - <dump>.chrome.json (or --chrome PATH): Chrome-trace "trace event
+    format" — load in chrome://tracing or https://ui.perfetto.dev
+  - stdout: per-stage latency summary (count, total, p50/p99) plus the
+    provenance mix (rung / session / planner path / speculation) when
+    the dump was taken at KTPU_TRACE=2
+
+Exits nonzero on an unreadable/empty dump — the fault drill runs this
+renderer as one of its integrity checks, so a fault seam that emitted a
+record nothing can render fails the drill, not just the retro.
+
+Usage: python scripts/trace_report.py DUMP.json [--chrome OUT.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.utils import tracing  # noqa: E402
+
+
+def render(dump_path: str, chrome_path: str = "") -> int:
+    """Render one dump file; returns a process exit code."""
+    try:
+        with open(dump_path) as f:
+            record = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"FAIL: unreadable dump {dump_path}: {e}", file=sys.stderr)
+        return 1
+    events = record.get("events") or []
+    if not events:
+        print(f"FAIL: dump {dump_path} holds no events "
+              f"(reason={record.get('reason')!r})", file=sys.stderr)
+        return 1
+
+    chrome = tracing.chrome_trace(events)
+    out_path = chrome_path or (os.path.splitext(dump_path)[0]
+                               + ".chrome.json")
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": chrome,
+                   "metadata": {"reason": record.get("reason"),
+                                "level": record.get("level")}}, f)
+
+    print(f"dump: {dump_path}")
+    print(f"reason: {record.get('reason')}  level: {record.get('level')}  "
+          f"events: {len(events)}")
+    if record.get("attrs"):
+        print(f"fault attrs: {record['attrs']}")
+    print(f"chrome trace: {out_path}  (load in chrome://tracing)")
+    print()
+    print(f"{'stage':<16}{'count':>7}{'total_s':>10}{'p50_ms':>9}"
+          f"{'p99_ms':>9}")
+    for stage, s in tracing.stage_stats(events).items():
+        print(f"{stage:<16}{s['count']:>7}{s['total_s']:>10.4f}"
+              f"{s['p50_s'] * 1e3:>9.2f}{s['p99_s'] * 1e3:>9.2f}")
+    mix = tracing.provenance_mix(events)
+    if mix:
+        print()
+        print("provenance mix (per decided pod):")
+        for field, vals in sorted(mix.items()):
+            pretty = ", ".join(
+                f"{k}={v}" for k, v in
+                sorted(vals.items(), key=lambda kv: -kv[1])
+            )
+            print(f"  {field:<14}{pretty}")
+    window = tracing.window_span(events)
+    print()
+    print(f"window: {window:.3f}s covered by recorded spans")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dump", help="flight-recorder dump JSON")
+    ap.add_argument("--chrome", default="",
+                    help="chrome-trace output path "
+                         "(default: <dump>.chrome.json)")
+    args = ap.parse_args()
+    return render(args.dump, args.chrome)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

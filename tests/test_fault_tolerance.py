@@ -671,3 +671,112 @@ class TestWhatifFaults:
         assert paths.get(("fast",), 0) >= 1, paths
         # the fault is a real device fault to the ladder/counters
         assert _fault_delta(before, "raise") >= 1
+
+
+# -- flight-recorder dump-on-fault drills (observability PR) ----------------
+# The fault seams must leave a TRIAGEABLE record, not just counters: a
+# watchdog timeout / validation fault dumps the ring (with the faulted
+# batch's bucket/rung/speculation state in the fault attrs and the
+# faulted dispatch's spans in the events) BEFORE recovery proceeds, and
+# the recovery re-drive itself lands in the ring after. With KTPU_TRACE=0
+# the dispatch path allocates nothing for tracing (the overhead pin).
+
+
+class TestFlightRecorderDumpDrills:
+    @pytest.fixture(autouse=True)
+    def _traced(self):
+        from kubernetes_tpu.utils import tracing
+
+        old = tracing.set_level(tracing.TRACE_PODS)
+        tracing.RECORDER.clear()
+        yield
+        tracing.set_level(old)
+        tracing.RECORDER.clear()
+
+    def _dump_drill(self, seed, kind, watchdog=0.5):
+        from kubernetes_tpu.utils import tracing
+
+        h0 = len(tracing.RECORDER.dump_history)
+        dumps0 = sum(v for _, v in metrics.trace_dumps.items())
+        maps, inj = _drive_with_faults(seed, {1: kind}, watchdog=watchdog)
+        assert inj.injected.get(kind, 0) >= 1
+        assert maps[0] == maps[2], "fault recovery changed decisions"
+        new_dumps = tracing.RECORDER.dump_history[h0:]
+        assert sum(v for _, v in metrics.trace_dumps.items()) > dumps0
+        return maps, new_dumps
+
+    def test_wedge_dump_names_faulted_batch_and_redrives(self):
+        from kubernetes_tpu.utils import tracing
+
+        _, dumps = self._dump_drill(11, "wedge-wait", watchdog=0.3)
+        timeout_dumps = [
+            d for d in dumps if d["reason"] == "device-fault-timeout"
+        ]
+        assert timeout_dumps, "watchdog fault fired without a dump"
+        d = timeout_dumps[0]
+        # the dump names the faulted batch's bucket, rung, speculation
+        assert d["attrs"]["kind"] == "timeout"
+        assert d["attrs"]["rung"] in ("pallas", "hoisted", "oracle")
+        assert "speculative" in d["attrs"] and "bucket" in d["attrs"]
+        stages = {e["stage"] for e in d["events"]}
+        assert "dispatch" in stages, "faulted dispatch's spans missing"
+        assert any(
+            e["stage"] == "fault" and e.get("kind") == "timeout"
+            for e in d["events"]
+        )
+        # the recovery re-drive is recorded after the dump: a final
+        # snapshot holds the synchronous replay span, and the snapshot
+        # itself lands in the dump history like any other dump
+        events = tracing.RECORDER.dump("drill-final")
+        assert any(
+            e[2] == "replay" and e[1] == "re-drive"
+            and e[6] and e[6].get("kind") == "timeout"
+            for e in events
+        ), "recovery re-drive span missing from the record"
+        assert tracing.RECORDER.dump_history[-1]["reason"] == "drill-final"
+
+    def test_nan_harvest_dump_fires_on_validation_fault(self):
+        _, dumps = self._dump_drill(4, "nan-harvest")
+        invalid = [
+            d for d in dumps if d["reason"] == "device-fault-invalid"
+        ]
+        assert invalid, "validation fault fired without a dump"
+        assert invalid[0]["attrs"]["kind"] == "invalid"
+        assert "rung" in invalid[0]["attrs"]
+        stages = {e["stage"] for e in invalid[0]["events"]}
+        assert "dispatch" in stages
+
+    def test_disabled_trace_adds_no_per_pod_state_on_dispatch(self):
+        """KTPU_TRACE=0 overhead pin: the dispatch path must not
+        allocate tracing state — span() returns the shared no-op
+        singleton, handles carry prov=None, the ring stays empty, and
+        no dump fires on a clean run."""
+        from kubernetes_tpu.utils import tracing
+
+        tracing.set_level(0)
+        tracing.RECORDER.clear()
+        h0 = len(tracing.RECORDER.dump_history)
+        assert tracing.span("dispatch", "dispatch", n=8) \
+            is tracing.NOOP_SPAN
+        assert tracing.span("harvest", "harvest") is tracing.NOOP_SPAN
+        _, cs = _cluster()
+        sched = _mk_scheduler(cs, 2)
+        handles = []
+        orig = type(sched.tpu).dispatch_many
+
+        def capture(self, pods, _orig=orig):
+            h = _orig(self, pods)
+            handles.append(h)
+            return h
+
+        sched.tpu.dispatch_many = capture.__get__(sched.tpu)
+        try:
+            pods = _pod_stream(random.Random(3), 16)
+            _drive(sched, cs, pods, [4, 4, 4, 4])
+        finally:
+            sched.shutdown()
+            sched.informers.stop()
+        assert handles, "no batches dispatched"
+        assert all(h.prov is None for h in handles)
+        assert tracing.RECORDER.snapshot() == []
+        assert len(tracing.RECORDER.dump_history) == h0
