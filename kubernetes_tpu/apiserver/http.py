@@ -171,6 +171,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, body: str, content_type: str) -> None:
+        raw = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
     def _send_error(self, e: Exception) -> None:
         code = getattr(e, "code", 500)
         body = _status_body(
@@ -195,6 +203,18 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._discovery()
             if url.path in ("/healthz", "/readyz", "/livez"):
                 return self._send_json(200, {"status": "ok"})
+            if url.path in ("/configz", "/metricsz"):
+                # component debug surface (component-base configz/metrics):
+                # /configz = the registered live configs as JSON, /metricsz
+                # = Prometheus text exposition of every scheduler_* metric
+                from ..utils import configz
+
+                if url.path == "/configz":
+                    return self._send_text(
+                        200, configz.handler_body(), "application/json")
+                return self._send_text(
+                    200, configz.metricsz_body(),
+                    "text/plain; version=0.0.4; charset=utf-8")
             resource, ns, name, sub = _split_path(url.path)
             handler = getattr(self, f"_verb_{method.lower()}")
             handler(resource, ns, name, sub, params)

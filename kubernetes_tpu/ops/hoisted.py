@@ -50,6 +50,24 @@ CARRY_KEYS = ("requested", "nz_requested", "pod_count")
 
 TEMPLATE_KEYS_EXCLUDED = ("node_name_idx", "has_node_name")
 
+# Explain mode (KTPU_EXPLAIN): canonical per-plugin attribution orders.
+# Filter verdicts pack into ONE int32 per node — bit i set = plugin i
+# passed the node — in EXPLAIN_FILTER_PLUGINS order (the oracle filter
+# plugins the kernel models; volume constraints ride the NodeAffinity /
+# NodeResourcesFit masks). Score rows stack in EXPLAIN_SCORE_KEYS order
+# and are already WEIGHTED, matching kernel.schedule_pod's
+# score_<key> = normalized * weight convention, so a row sums to the
+# decision total on feasible nodes.
+EXPLAIN_FILTER_PLUGINS = (
+    "NodeName", "NodeUnschedulable", "TaintToleration", "NodePorts",
+    "NodeResourcesFit", "NodeAffinity", "PodTopologySpread",
+    "InterPodAffinity",
+)
+EXPLAIN_SCORE_KEYS = (
+    "balanced", "image", "ipa", "least", "node_affinity",
+    "prefer_avoid", "pts", "taint",
+)
+
 
 _FP_MEMO = None  # id(anchor array) -> fingerprint; finalizer-evicted
 
@@ -297,12 +315,17 @@ def _pts_template_static(c: Dict, p: Dict, node_match):
     )
 
 
-def _prologue(c: Dict, tp: Dict, dyn_ipa: bool = False, dyn_ports: bool = False):
+def _prologue(c: Dict, tp: Dict, dyn_ipa: bool = False, dyn_ports: bool = False,
+              explain: bool = False):
     """Per-template static arrays, stacked over the template axis.
 
     dyn_ipa/dyn_ports: leave the InterPodAffinity mask / NodePorts mask
     OUT of static_mask and expose their static parts separately, so the
-    scan step can recombine them with in-scan dynamic counts."""
+    scan step can recombine them with in-scan dynamic counts.
+
+    explain: additionally keep the individual pre-fold masks (normally
+    folded into static_mask and discarded) so the step can attribute a
+    rejected node to the exact plugin that filtered it."""
 
     def one(p):
         node_match = K._node_match(c, p)
@@ -325,6 +348,13 @@ def _prologue(c: Dict, tp: Dict, dyn_ipa: bool = False, dyn_ports: bool = False)
             sc_image=K._score_image(c, p),
             sc_avoid=K._score_prefer_avoid(c, p),
         )
+        if explain:
+            out.update(
+                expl_unsched=mask_unsched,
+                expl_taint=mask_taint,
+                expl_ports=mask_ports,
+                expl_ipa=mask_ipa,
+            )
         if dyn_ipa:
             out.update({f"ipa_{k}": v for k, v in parts.items()})
         out.update(_pts_template_static(c, p, node_match))
@@ -474,13 +504,20 @@ def match_matrices_np(tp_np: Dict, pod_arrays_list: List[Dict]):
 
 
 def _eval_pod(S: Dict, c_static: Dict, weights: Dict, dyn_ipa: bool,
-              dyn_ports: bool, carry: Dict, tj):
+              dyn_ports: bool, carry: Dict, tj, explain: bool = False):
     """Filter + score one pod of template `tj` against `carry` WITHOUT
     committing: returns (feasible [N] bool, total [N] int64 with -1 at
-    infeasible nodes, n_feasible scalar). The one-pod _step and the
+    infeasible nodes, n_feasible scalar, expl). The one-pod _step and the
     multipod _step_multi both build on this — the eval math exists
     exactly once, so the speculative k-wide evaluation cannot drift
-    from the sequential reference."""
+    from the sequential reference.
+
+    expl is None unless `explain`: then a dict with `bits` ([N] int32,
+    per-plugin filter verdicts packed in EXPLAIN_FILTER_PLUGINS bit
+    order) and `scores` ([8, N] weighted per-plugin components in
+    EXPLAIN_SCORE_KEYS order) — the SAME intermediates the total is
+    built from, kept instead of folded, so attribution cannot drift
+    from the decision."""
     n = c_static["valid"].shape[0]
     vnp = c_static["npair"].shape[1]
     col = jnp.arange(vnp)[None, :]
@@ -684,7 +721,38 @@ def _eval_pod(S: Dict, c_static: Dict, weights: Dict, dyn_ipa: bool,
         + sc_taint * weights["taint"]
     )
     total = jnp.where(feasible, total, -1)
-    return feasible, total, jnp.sum(feasible.astype(jnp.int32))
+    n_feasible = jnp.sum(feasible.astype(jnp.int32))
+    if not explain:
+        return feasible, total, n_feasible, None
+    # pack the per-plugin verdicts/components the fold normally discards.
+    # NodeName is identically true — session pods are unbound
+    # (prepare_batch / schedule assert has_node_name is false).
+    plugin_masks = (
+        jnp.ones(n, bool),
+        sel("expl_unsched"),
+        sel("expl_taint"),
+        mask_ports if dyn_ports else sel("expl_ports"),
+        mask_fit,
+        sel("node_match"),
+        mask_pts,
+        mask_ipa if dyn_ipa else sel("expl_ipa"),
+    )
+    bits = jnp.zeros(n, jnp.int32)
+    for i, m in enumerate(plugin_masks):
+        bits = bits | (m.astype(jnp.int32) << i)
+    scores = jnp.stack(
+        [
+            sc_balanced * weights["balanced"],
+            sel("sc_image") * weights["image"],
+            sc_ipa * weights["ipa"],
+            sc_least * weights["least"],
+            sc_nodeaff * weights["node_affinity"],
+            sel("sc_avoid") * weights["prefer_avoid"],
+            sc_pts * weights["pts"],
+            sc_taint * weights["taint"],
+        ]
+    )
+    return feasible, total, n_feasible, {"bits": bits, "scores": scores}
 
 
 def _commit_pod(S: Dict, c_static: Dict, dyn_ipa: bool, dyn_ports: bool,
@@ -732,9 +800,10 @@ def _commit_pod(S: Dict, c_static: Dict, dyn_ipa: bool, dyn_ports: bool,
 
 
 def _step(S: Dict, c_static: Dict, weights: Dict, dyn_ipa: bool,
-          dyn_ports: bool, carry: Dict, x: Dict):
-    feasible, total, n_feasible = _eval_pod(
-        S, c_static, weights, dyn_ipa, dyn_ports, carry, x["tmpl"]
+          dyn_ports: bool, explain_k: int, carry: Dict, x: Dict):
+    feasible, total, n_feasible, expl = _eval_pod(
+        S, c_static, weights, dyn_ipa, dyn_ports, carry, x["tmpl"],
+        explain=explain_k > 0,
     )
     best = jnp.argmax(total).astype(jnp.int32)
     ok = (total[best] >= 0) & x["valid"]
@@ -746,6 +815,16 @@ def _step(S: Dict, c_static: Dict, weights: Dict, dyn_ipa: bool,
         "score": jnp.where(ok, total[best], -1),
         "n_feasible": n_feasible,
     }
+    if explain_k > 0:
+        # top-k candidates with full attribution; lax.top_k breaks ties
+        # toward lower indices, the same first-max convention argmax
+        # uses, so topk_idx[0] IS the decision
+        kk = min(int(explain_k), int(total.shape[0]))
+        topv, topi = jax.lax.top_k(total, kk)
+        y["expl_bits"] = expl["bits"]
+        y["expl_topk_idx"] = topi.astype(jnp.int32)
+        y["expl_topk_total"] = topv
+        y["expl_topk_scores"] = expl["scores"][:, topi].T  # [kk, 8]
     return carry, y
 
 
@@ -782,7 +861,7 @@ def _step_multi(S: Dict, c_static: Dict, weights: Dict, dyn_ipa: bool,
     one-pod-per-step whatever the conflict rate. Replays are counted in
     ys["conflicts"] (scheduler_multipod_conflicts_total)."""
     carry0 = carry
-    ev_feas, ev_total, ev_nfeas = jax.vmap(
+    ev_feas, ev_total, ev_nfeas, _ = jax.vmap(
         lambda t: _eval_pod(S, c_static, weights, dyn_ipa, dyn_ports,
                             carry0, t)
     )(xk["tmpl"])
@@ -836,7 +915,7 @@ def _step_multi(S: Dict, c_static: Dict, weights: Dict, dyn_ipa: bool,
         conflict = (same | pts_conf | ipa_conf | util_conf) & valid_i
 
         def replay(c):
-            _, t2, nf2 = _eval_pod(
+            _, t2, nf2, _ = _eval_pod(
                 S, c_static, weights, dyn_ipa, dyn_ports, c, tj
             )
             b2 = jnp.argmax(t2).astype(jnp.int32)
@@ -909,12 +988,14 @@ def _init_dynamic_carries(carry: Dict, c_all: Dict, n_templates: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("weights_key", "dyn_ipa", "dyn_ports")
+    jax.jit, static_argnames=("weights_key", "dyn_ipa", "dyn_ports",
+                              "explain_k")
 )
 def _run(c_all: Dict, tp: Dict, batch_self: Dict, xs: Dict, weights_key,
-         dyn_ipa: bool = False, dyn_ports: bool = False, port_adds=None):
+         dyn_ipa: bool = False, dyn_ports: bool = False, port_adds=None,
+         explain_k: int = 0):
     weights = dict(weights_key)
-    S = _prologue(c_all, tp, dyn_ipa, dyn_ports)
+    S = _prologue(c_all, tp, dyn_ipa, dyn_ports, explain=explain_k > 0)
     mf, ms = _match_matrices(tp, batch_self)
     S["Mf"], S["Ms"] = mf, ms
     _merge_step_inputs(S, tp, dyn_ipa, dyn_ports, port_adds)
@@ -928,7 +1009,8 @@ def _run(c_all: Dict, tp: Dict, batch_self: Dict, xs: Dict, weights_key,
     }
     _init_dynamic_carries(carry, c_all, tp["req"].shape[0], dyn_ipa, dyn_ports)
     c_static = {k: v for k, v in c_all.items() if k not in CARRY_KEYS}
-    step = functools.partial(_step, S, c_static, weights, dyn_ipa, dyn_ports)
+    step = functools.partial(_step, S, c_static, weights, dyn_ipa, dyn_ports,
+                             explain_k)
     return jax.lax.scan(step, carry, xs)
 
 
@@ -1014,16 +1096,23 @@ def schedule_batch_hoisted(
     cluster: Dict,
     pod_arrays_list: List[Dict],
     weights: Optional[Dict[str, int]] = None,
+    explain_k: int = 0,
 ) -> Tuple[List[int], Dict]:
     """Schedule a batch with template hoisting (affinity/port pods
     included — their assume effects ride the dynamic carries). Pods must
-    be unbound (no spec.nodeName). Returns (decisions, ys)."""
+    be unbound (no spec.nodeName). Returns (decisions, ys).
+
+    explain_k > 0 additionally returns per-pod attribution in ys
+    (expl_bits / expl_topk_*; see HoistedSession.explain_payload).
+    Decisions are bit-identical either way — explain only KEEPS
+    intermediates the fold otherwise discards."""
     tp, batch_self, xs, templates = prepare_batch(pod_arrays_list)
     dyn_ipa = templates_have_terms(templates)
     dyn_ports = templates_have_ports(templates)
     port_adds = _port_adds_for(templates, cluster) if dyn_ports else None
     key = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
-    _, ys = _run(cluster, tp, batch_self, xs, key, dyn_ipa, dyn_ports, port_adds)
+    _, ys = _run(cluster, tp, batch_self, xs, key, dyn_ipa, dyn_ports,
+                 port_adds, explain_k)
     return [int(v) for v in np.asarray(ys["best"])], ys
 
 
@@ -1031,10 +1120,11 @@ def schedule_batch_hoisted(
 # cross-batch session: carry lives on-device, prologue runs ONCE
 
 
-@functools.partial(jax.jit, static_argnames=("dyn_ipa", "dyn_ports"))
+@functools.partial(jax.jit, static_argnames=("dyn_ipa", "dyn_ports",
+                                             "explain"))
 def _session_prologue(c_all: Dict, tp: Dict, dyn_ipa: bool = False,
-                      dyn_ports: bool = False) -> Dict:
-    return _prologue(c_all, tp, dyn_ipa, dyn_ports)
+                      dyn_ports: bool = False, explain: bool = False) -> Dict:
+    return _prologue(c_all, tp, dyn_ipa, dyn_ports, explain)
 
 
 @functools.partial(jax.jit, donate_argnames=("carry",))
@@ -1076,12 +1166,13 @@ def _session_apply_deltas(carry, f_pair_cn, s_pair_cn, s_src,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("weights_key", "dyn_ipa", "dyn_ports", "k"),
+    static_argnames=("weights_key", "dyn_ipa", "dyn_ports", "k",
+                     "explain_k"),
     donate_argnames=("carry",),
 )
 def _session_scan(S, c_static, tp, carry, batch_self, xs, weights_key,
                   dyn_ipa: bool = False, dyn_ports: bool = False,
-                  k: int = 1):
+                  k: int = 1, explain_k: int = 0):
     weights = dict(weights_key)
     S = dict(S)
     S["Mf"], S["Ms"] = _match_matrices(tp, batch_self)
@@ -1089,9 +1180,11 @@ def _session_scan(S, c_static, tp, carry, batch_self, xs, weights_key,
     # launches scale with scan iterations; unrolling trades compile time
     # for fewer iterations (semantics identical) — see PERF_NOTES.md
     unroll = int(os.environ.get("KTPU_SCAN_UNROLL", "1"))
-    if k <= 1:
+    if k <= 1 or explain_k > 0:
+        # explain rides the one-pod-per-step scan (the session pins
+        # multipod_k to 1 in explain mode; decisions are identical)
         step = functools.partial(_step, S, c_static, weights, dyn_ipa,
-                                 dyn_ports)
+                                 dyn_ports, explain_k)
         return jax.lax.scan(step, carry, xs, unroll=unroll)
     # multipod: fold the batch axis into [steps, k] — every pow2 bucket
     # divides by the pow2 k (kernel.multipod_k clamps it) — and run the
@@ -1107,6 +1200,11 @@ def _session_scan(S, c_static, tp, carry, batch_self, xs, weights_key,
 
 class HoistedSession:
     """Hoisted scheduling with the carry kept ON-DEVICE across batches.
+
+    The one session kind with explain support (supports_explain): with
+    explain_k > 0 every scan step also returns packed per-plugin filter
+    bits and the top-k candidates' weighted score stacks, decoded by
+    explain_payload (decisions stay bit-identical; multipod pins to 1).
 
     schedule_batch_hoisted pays the prologue (per-template pod-table
     sweeps + count bases) and a full cluster upload on EVERY dispatch
@@ -1142,14 +1240,18 @@ class HoistedSession:
     device-resident arrays: the device carry IS the assume cache.
     """
 
+    supports_explain = True
+
     def __init__(
         self,
         cluster: Dict,
         template_arrays_list: List[Dict],
         weights: Optional[Dict[str, int]] = None,
         multipod_k: Optional[int] = None,
+        explain_k: int = 0,
     ):
         self._weights_key = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+        self.explain_k = max(0, int(explain_k or 0))
         self._fps = {
             template_fingerprint(t): i for i, t in enumerate(template_arrays_list)
         }
@@ -1164,7 +1266,8 @@ class HoistedSession:
             if self._dyn_ports else None
         )
         tp = _stack_templates(template_arrays_list)
-        S = dict(_session_prologue(cluster, tp, self._dyn_ipa, self._dyn_ports))
+        S = dict(_session_prologue(cluster, tp, self._dyn_ipa,
+                                   self._dyn_ports, self.explain_k > 0))
         # copies: _session_scan donates the carry, and the cluster arrays
         # are also held by the encoder's device-state cache
         self._carry = {
@@ -1196,6 +1299,13 @@ class HoistedSession:
         # Port-carrying sessions are pinned to k=1 — the carried NodePorts
         # tables sit outside the conflict algebra (kernel.multipod_k)
         self.multipod_k = K.multipod_k(multipod_k, dyn_ports=self._dyn_ports)
+        if self.explain_k:
+            # explain mode pins one-pod-per-step: attribution is per
+            # decided pod against its exact decision-time carry, which
+            # the k-wide speculative evaluation cannot provide for
+            # conflicted pods. Decisions are bit-identical either way
+            # (the multipod contract).
+            self.multipod_k = 1
 
     # -- incremental device-state deltas -----------------------------------
 
@@ -1278,6 +1388,7 @@ class HoistedSession:
             self._S, self._c_static, self._tp, self._carry,
             batch_self, xs, self._weights_key,
             self._dyn_ipa, self._dyn_ports, self.multipod_k,
+            self.explain_k,
         )
         ys = dict(ys)
         ys["_b_real"] = b  # padding rows carry no decision
@@ -1302,3 +1413,32 @@ class HoistedSession:
             return 0, None
         arr = np.asarray(c)
         return int(arr[: ys.get("_b_real", arr.shape[0])].sum()), None
+
+    @staticmethod
+    def explain_payload(ys: Dict):
+        """Per-pod attribution from an explain-mode batch, or None when
+        the batch ran with explain off (any session kind — the keys are
+        simply absent then, so the backend can call this unconditionally
+        on harvested ys). Padding rows stripped; each entry:
+
+          bits        [N] int32 — bit i set = EXPLAIN_FILTER_PLUGINS[i]
+                      passed the node (a rejected node's zero bits name
+                      the plugins that filtered it);
+          topk_idx    [k] candidate node indices, best first (index 0 is
+                      the decision when the pod was placed);
+          topk_total  [k] decision totals (-1 = infeasible);
+          topk_scores [k, 8] weighted per-plugin split in
+                      EXPLAIN_SCORE_KEYS order (rows sum to the total on
+                      feasible nodes)."""
+        if "expl_bits" not in ys:
+            return None
+        bits = np.asarray(ys["expl_bits"])
+        idx = np.asarray(ys["expl_topk_idx"])
+        tot = np.asarray(ys["expl_topk_total"])
+        sc = np.asarray(ys["expl_topk_scores"])
+        b = ys.get("_b_real", bits.shape[0])
+        return [
+            {"bits": bits[i], "topk_idx": idx[i], "topk_total": tot[i],
+             "topk_scores": sc[i]}
+            for i in range(b)
+        ]

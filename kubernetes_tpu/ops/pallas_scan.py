@@ -216,6 +216,15 @@ class PallasSession:
     than 128 distinct values).
     """
 
+    # KTPU_EXPLAIN: the Mosaic kernel's scan does not surface per-plugin
+    # mask/score sections — explain mode rides the jnp hoisted session
+    # (TPUBackend demotes with session_builds{reason="explain"})
+    supports_explain = False
+
+    @staticmethod
+    def explain_payload(ys):
+        return None
+
     def __init__(self, cluster: Dict, template_arrays_list: List[Dict],
                  weights: Optional[Dict[str, int]] = None,
                  interpret: bool = False,

@@ -669,6 +669,15 @@ class ShardedPallasSession:
     ride psum/pmax. Raises PallasUnsupported exactly where the pallas
     kernel would."""
 
+    # KTPU_EXPLAIN demotes the mesh to the GSPMD hoisted session — the
+    # two-phase scan's phase-A argmax discards the per-plugin sections
+    # explain mode needs (same contract as PallasSession)
+    supports_explain = False
+
+    @staticmethod
+    def explain_payload(ys):
+        return None
+
     def __init__(self, cluster: Dict, template_arrays_list: List[Dict],
                  weights: Optional[Dict[str, int]] = None,
                  mesh: Optional[Mesh] = None,

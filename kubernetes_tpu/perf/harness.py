@@ -233,6 +233,12 @@ class Workload:
     # Off by default: CI-size harness tests must not pay the extra
     # dispatches; scripts/bench_configs.py turns it on for every row.
     kernel_direct: bool = False
+    # shadow parity sentinel sampling rate (KTPU_SHADOW_SAMPLE semantics,
+    # 0..1): sampled decided pods are replayed through the oracle chain
+    # in the completion worker and drift is counted per plugin. 0 (the
+    # default) is decision-inert and launch-free — benchmark rows only
+    # pay the audit when they opt in.
+    shadow_sample: float = 0.0
 
 
 @dataclass
@@ -330,6 +336,12 @@ class Result:
     # the first-bind..last-bind window
     stage_window_s: float = 0.0
     trace_level: int = 0
+    # shadow parity sentinel accounting (in-window deltas): decided pods
+    # sampled for the oracle replay, and drift counted by plugin — the
+    # production signal the chip rerun adjudicates (None/0 with
+    # shadow_sample=0, where the sentinel never runs)
+    shadow_samples: int = 0
+    shadow_drift: Optional[Dict[str, int]] = None
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -522,6 +534,8 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             pods=int(total * 1.25),
             anti_terms=w.num_pods * anti_per_pod + w.num_init_pods * init_anti,
         )
+        if w.shadow_sample:
+            sched.tpu.set_shadow_sample(w.shadow_sample)
     if w.backend == "oracle" or w.gang_size > 1:
         plugins = default_plugins_without("DefaultPreemption")
         plugin_config = {}
@@ -686,9 +700,11 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         from ..scheduler.metrics import (
             conflict_replays,
             multipod_conflicts,
+            parity_drift,
             preemption_planner,
             session_delta_applies,
             session_rebuilds,
+            shadow_samples as shadow_samples_ctr,
             speculative_dispatches,
             whatif_fallbacks,
             whatif_launches,
@@ -704,6 +720,8 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         planner0 = _label_counts(preemption_planner)
         whatif0 = _counter_total(whatif_launches)
         whatif_fb0 = _label_counts(whatif_fallbacks)
+        shadow0 = _counter_total(shadow_samples_ctr)
+        drift0 = _label_counts(parity_drift)
         bound0 = bound_count()
         n_ts0 = len(sched.bind_timestamps)
         from ..utils import tracing
@@ -818,6 +836,8 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         whatif_fb = _counter_window(
             _label_counts(whatif_fallbacks), whatif_fb0
         )
+        n_shadow = _counter_total(shadow_samples_ctr) - shadow0
+        shadow_drift = _counter_window(_label_counts(parity_drift), drift0)
         session_kind = (
             type(sched.tpu._session).__name__
             if sched.tpu is not None and sched.tpu._session is not None
@@ -881,6 +901,8 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             stage_latency=stage_latency,
             stage_window_s=stage_window,
             trace_level=tracing.level(),
+            shadow_samples=n_shadow,
+            shadow_drift=shadow_drift,
         )
     finally:
         sched.stop()

@@ -19,7 +19,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ...api import types as v1
 from ..framework.snapshot import Snapshot
@@ -269,6 +269,22 @@ class SchedulerCache:
         Coscheduling Permit plugin to count reserved gang members."""
         with self._lock:
             return [s.pod for s in self._pod_states.values()]
+
+    def dump(self) -> "Tuple[List[v1.Node], List[v1.Pod]]":
+        """One consistent read of the raw cluster objects: every node and
+        every PLACED pod (assumed included). The shadow parity sentinel's
+        read path — unlike update_snapshot it touches no generation
+        bookkeeping (a throwaway snapshot from the completion worker must
+        not starve the scheduling thread's incremental refreshes) and
+        shares no NodeInfos (callers rebuild their own)."""
+        with self._lock:
+            nodes = [
+                ni.node for ni in self._nodes.values() if ni.node is not None
+            ]
+            pods = [
+                pi.pod for ni in self._nodes.values() for pi in ni.pods
+            ]
+            return nodes, pods
 
     # -- snapshot (cache.go:203 UpdateSnapshot) ----------------------------
 

@@ -258,7 +258,10 @@ trace_dumps = legacy_registry.register(
         "seam=device-fault-<kind> (watchdog timeout / harvest validation "
         "/ dispatch raise), seam=pipeline-stalled (_drain_pipeline budget "
         "exceeded), seam=ladder-demoted, seam=whatif-fault, "
-        "seam=worker-restart-<worker>. Each dump snapshots the last N "
+        "seam=worker-restart-<worker>, seam=shadow-drift (the parity "
+        "sentinel caught a device decision the oracle replay disagrees "
+        "with — scheduler_parity_drift_total names the plugin). Each "
+        "dump snapshots the last N "
         "span events (utils/tracing.py) to the log/file before recovery "
         "proceeds — nonzero here means a fault seam fired with a "
         "triageable record attached.",
@@ -281,6 +284,48 @@ def dump_seam(seam: str, **attrs) -> None:
     tracing.dump(seam, **attrs)
 
 
+shadow_samples = legacy_registry.register(
+    Counter(
+        "scheduler_shadow_samples_total",
+        "Decided pods replayed through the oracle filter/score chain by "
+        "the shadow parity sentinel (KTPU_SHADOW_SAMPLE > 0): each "
+        "sample re-derives the decision read-only against the "
+        "decision-time cache state the completion worker already holds "
+        "for assume ordering. The denominator for "
+        "scheduler_parity_drift_total.",
+        (),
+    )
+)
+parity_drift = legacy_registry.register(
+    Counter(
+        "scheduler_parity_drift_total",
+        "Shadow-sentinel mismatches between a device decision and the "
+        "oracle replay, by the plugin whose filter verdict or weighted "
+        "score diverged (plugin=decision when the totals disagree "
+        "without a per-plugin culprit, e.g. explain attribution was "
+        "unavailable). Every drift dumps the flight-recorder ring "
+        "(seam=shadow-drift) and writes a repro bundle that "
+        "scripts/replay_drift.py re-adjudicates offline — on chips this "
+        "counter IS the continuously-measured form of the CI parity "
+        "gate, so any sustained nonzero rate is a page. Informer events "
+        "landing between dispatch and completion can produce isolated "
+        "false positives; the bundle replay tells them apart.",
+        ("plugin",),
+    )
+)
+explain_harvests = legacy_registry.register(
+    Counter(
+        "scheduler_explain_harvests_total",
+        "Batches harvested WITH per-pod decision attribution attached "
+        "(KTPU_EXPLAIN / shadow sampling): the sessions returned "
+        "per-plugin filter verdicts and weighted score splits alongside "
+        "decisions. Explain mode pins the hoisted one-pod-per-step "
+        "kernel (scheduler_tpu_session_builds_total reason=explain), so "
+        "this counter moving on a pallas-class platform names the "
+        "audit-mode throughput cost.",
+        (),
+    )
+)
 speculative_dispatches = legacy_registry.register(
     Counter(
         "scheduler_speculative_dispatches_total",

@@ -801,6 +801,17 @@ class Scheduler:
         by_key = {v1.pod_key(p): node for p, node in results}
         from .tpu_backend import RETRY_NODE
 
+        if self.tpu.shadow_sample > 0:
+            # shadow parity sentinel: audit BEFORE this batch's assumes
+            # land — the cache still holds the decision-time state for
+            # pod 0 (completion is strictly FIFO, so every earlier
+            # batch's assumes are already in)
+            try:
+                self._shadow_audit(results, handle)
+            except Exception:  # noqa: BLE001 — the auditor observes the
+                # pipeline, it must never break it
+                traceback.print_exc()
+
         bound: List[Tuple] = []  # (info, node)
         failed: List = []
         for info in todo:
@@ -817,6 +828,98 @@ class Scheduler:
             self._assume_and_bind_batch(bound)
         if failed:
             self._handle_failure_wave(failed, cycle)
+
+    def _shadow_audit(self, results: List[Tuple], handle) -> None:
+        """Shadow parity sentinel (KTPU_SHADOW_SAMPLE): replay sampled
+        decided pods through the oracle filter/score chain against the
+        decision-time cache state and count per-plugin drift.
+
+        Runs on the completion worker BEFORE this batch's assumes land,
+        so the cache holds exactly what the device carry held when the
+        batch dispatched (modulo informer events that raced the flight —
+        a documented false-positive source; the frozen repro bundle and
+        scripts/replay_drift.py adjudicate). Pod i of the batch decided
+        against the carry plus pods 0..i-1 of its own batch, so each
+        sampled pod gets a private Snapshot with those prefix decisions
+        cloned in — the shared cache NodeInfos are never touched.
+
+        Drift = the device's node is infeasible per the oracle, or scores
+        strictly below the oracle's max total; with an explain payload on
+        the handle, ANY per-plugin mask/score mismatch counts even when
+        the decision agrees (attribution_diff — the early-warning case).
+        Each drift bumps scheduler_parity_drift_total{plugin}, dumps the
+        flight-recorder ring through the shadow-drift seam, and freezes a
+        replayable repro bundle."""
+        from . import explain as explain_mod
+        from .tpu_backend import RETRY_NODE
+
+        rate = self.tpu.shadow_sample
+        sampled = [
+            i for i, (_, node) in enumerate(results)
+            if node is not None and node != RETRY_NODE
+            and self.rng.random() < rate
+        ]
+        if not sampled:
+            return
+        # decision-time cluster objects, once per audited batch — a raw
+        # dump, NOT update_snapshot: the incremental snapshot's generation
+        # bookkeeping lives in the cache, and consuming it here would
+        # starve the scheduling thread's own snapshot refreshes
+        base_nodes, base_pods = self.cache.dump()
+        node_names = handle.node_names or []
+        for i in sampled:
+            pod, node = results[i]
+            metrics.shadow_samples.inc()
+            prefix = []
+            for p, n in results[:i]:
+                if n is None or n == RETRY_NODE:
+                    continue
+                clone = serde.from_dict(v1.Pod, serde.to_dict(p))
+                clone.spec.node_name = n
+                prefix.append(clone)
+            shadow_pods = base_pods + prefix
+            shadow_snap = Snapshot.from_objects(shadow_pods, base_nodes)
+            oracle_bd = explain_mod.oracle_breakdown(shadow_snap, pod)
+            device_bd = None
+            if handle.explain is not None and i < len(handle.explain) \
+                    and node_names:
+                device_bd = explain_mod.payload_breakdown(
+                    handle.explain[i], node_names)
+            if explain_mod.decision_drifts(oracle_bd, node):
+                plugins = explain_mod.drift_plugins(
+                    oracle_bd, device_bd, node)
+            elif device_bd is not None:
+                plugins = explain_mod.attribution_diff(oracle_bd, device_bd)
+            else:
+                plugins = []
+            if not plugins:
+                continue
+            key = v1.pod_key(pod)
+            for plugin in plugins:
+                metrics.parity_drift.inc(plugin=plugin)
+            metrics.dump_seam(
+                "shadow-drift", pod=key, node=node,
+                plugins=",".join(plugins),
+            )
+            try:
+                bundle = explain_mod.write_bundle(
+                    pod, base_nodes, shadow_pods, node, plugins,
+                    oracle_bd, device_bd, weights=self.tpu.weights,
+                )
+            except Exception:  # noqa: BLE001 — an unwritable bundle dir
+                # must not swallow the drift signal itself
+                traceback.print_exc()
+                bundle = "<bundle write failed>"
+            logger.warning(
+                "shadow parity drift: pod %s on %s disagrees with the "
+                "oracle replay (plugins: %s); repro bundle: %s",
+                key, node, ",".join(plugins), bundle,
+            )
+            self._health_event(
+                "Warning", "ShadowParityDrift",
+                f"device decision for {key} diverged from the oracle "
+                f"replay ({','.join(plugins)})",
+            )
 
     def _handle_failure_wave(self, failed: List, cycle: int) -> None:
         """Failure handling for a whole batch at once. Preemption can

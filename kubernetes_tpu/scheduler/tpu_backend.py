@@ -71,6 +71,19 @@ MASK_PLUGINS = (
 )
 
 
+def _explain_topk(payload: Dict, node_names: List[str]) -> List[Tuple[str, int]]:
+    """Level-2 provenance rendering of one pod's explain payload: the
+    top-k candidates as (node, weighted total), best first. The full
+    per-plugin masks/scores stay on the batch handle for the sentinel and
+    the explain CLI — the flight-recorder record carries the ranking."""
+    out: List[Tuple[str, int]] = []
+    for idx, total in zip(payload["topk_idx"], payload["topk_total"]):
+        idx, total = int(idx), int(total)
+        if 0 <= idx < len(node_names) and total >= 0:
+            out.append((node_names[idx], total))
+    return out
+
+
 class _BatchHandle:
     """One dispatched batch: device outputs + how to decode them. The
     decode fn is captured at dispatch time because the session may be
@@ -79,7 +92,7 @@ class _BatchHandle:
 
     __slots__ = ("group", "ys", "decide", "node_names", "results",
                  "deadline", "bucket", "timed_out", "speculative",
-                 "conflicts", "prov")
+                 "conflicts", "prov", "explain")
 
     def __init__(self, group: List[v1.Pod]):
         self.group = group
@@ -111,6 +124,11 @@ class _BatchHandle:
         # — the disabled path must not allocate per batch beyond the
         # handle itself (pinned by the overhead test)
         self.prov: Optional[Dict] = None
+        # KTPU_EXPLAIN: the decoded per-pod explain payloads (packed
+        # filter-mask bits + top-k totals/score stacks), index-aligned
+        # with `group`. None with explain off — same allocation contract
+        # as prov — and None on sessions without explain support
+        self.explain: Optional[List[Dict]] = None
 
 
 class TPUBackend(CacheListener):
@@ -250,6 +268,23 @@ class TPUBackend(CacheListener):
         # metrics). Signature: (event_type, reason, message). Must never
         # raise into the dispatch path — _notify_health guards it.
         self.health_cb = None
+        # decision explainability (ISSUE 10): KTPU_EXPLAIN makes every
+        # hoisted harvest carry per-plugin filter-mask verdicts and
+        # weighted score splits for the top-k candidate nodes
+        # (ops/hoisted.py explain mode; decisions stay bit-identical).
+        # KTPU_SHADOW_SAMPLE arms the scheduler's shadow parity sentinel
+        # — and needs the explain payload to attribute drift per plugin,
+        # so any sample rate > 0 turns explain on. Explain rides the
+        # hoisted session only: pallas/sharded sessions demote (loudly,
+        # session_builds{reason="explain"}) while it is armed.
+        self.shadow_sample = min(1.0, max(0.0, float(
+            os.environ.get("KTPU_SHADOW_SAMPLE", "0") or 0)))
+        self.explain = (
+            os.environ.get("KTPU_EXPLAIN", "0") == "1"
+            or self.shadow_sample > 0
+        )
+        self.explain_topk = max(1, int(
+            os.environ.get("KTPU_EXPLAIN_TOPK", "3")))
         # flight-recorder provenance context: the last session build
         # ("kind/reason") and the last teardown reason — what the
         # per-pod provenance records (KTPU_TRACE=2) report as the
@@ -275,6 +310,9 @@ class TPUBackend(CacheListener):
             demote_threshold=self.ladder.threshold,
             trace_level=tracing.level(),
             trace_capacity=tracing.RECORDER.capacity,
+            explain=self.explain,
+            explain_topk=self.explain_topk,
+            shadow_sample=self.shadow_sample,
         )
 
     def _notify_health(self, event_type: str, reason: str,
@@ -289,6 +327,28 @@ class TPUBackend(CacheListener):
             cb(event_type, reason, message)
         except Exception:  # noqa: BLE001 — observability is best-effort
             logger.warning("backend health event failed", exc_info=True)
+
+    def set_shadow_sample(self, rate: float) -> None:
+        """Arm (or disarm) the shadow parity sentinel at runtime — the
+        bench/harness knob (Workload.shadow_sample rides the row, not the
+        process env). Arming forces explain mode on so drift can be
+        attributed per plugin; a live non-explain session is torn down
+        and the next dispatch rebuilds with explain outputs."""
+        from ..utils import configz
+
+        with self._lock:
+            self.shadow_sample = min(1.0, max(0.0, float(rate)))
+            explain = (
+                os.environ.get("KTPU_EXPLAIN", "0") == "1"
+                or self.shadow_sample > 0
+            )
+            if explain != self.explain:
+                self.explain = explain
+                self._invalidate_session("explain-toggle")
+            configz.install_knobs(
+                "ktpu", explain=self.explain,
+                shadow_sample=self.shadow_sample,
+            )
 
     def set_volume_resolver(self, resolver) -> None:
         """Enable the volume device path: bound-PVC pods encode their PV
@@ -1356,16 +1416,19 @@ class TPUBackend(CacheListener):
     def _apply_decisions_locked(
         self, pods: List[v1.Pod], decisions: List[int],
         node_names: List[str], prov: Optional[Dict] = None,
+        explain: Optional[List[Dict]] = None,
     ) -> List[Tuple[v1.Pod, Optional[str]]]:
         """Land a batch's harvested decisions in the host encoding (the
         host half of the assume; the device carry already holds them).
         `prov` carries the dispatch-time provenance for KTPU_TRACE=2
         per-pod records (rung, session kind, build reason, bucket,
-        speculation) — None below level 2 keeps this loop allocation-free."""
+        speculation) — None below level 2 keeps this loop allocation-free.
+        `explain` (index-aligned with pods) adds the top-k candidate
+        attribution to each pod's provenance record."""
         results: List[Tuple[v1.Pod, Optional[str]]] = []
         rec = tracing.RECORDER
         pod_level = rec.pod_level()
-        for g, best in zip(pods, decisions):
+        for i, (g, best) in enumerate(zip(pods, decisions)):
             if best < 0:
                 results.append((g, None))
                 node = None
@@ -1378,9 +1441,16 @@ class TPUBackend(CacheListener):
                 self.enc.add_pod(g, node)
                 results.append((g, node))
             if pod_level:
-                rec.provenance(
-                    v1.pod_key(g), node=node, **(prov or {}),
-                )
+                if explain is not None and i < len(explain):
+                    rec.provenance(
+                        v1.pod_key(g), node=node,
+                        explain_topk=_explain_topk(explain[i], node_names),
+                        **(prov or {}),
+                    )
+                else:
+                    rec.provenance(
+                        v1.pod_key(g), node=node, **(prov or {}),
+                    )
         return results
 
     def _miss_speculative(self, handles) -> None:
@@ -1435,6 +1505,16 @@ class TPUBackend(CacheListener):
             # the bucket proved itself (through jit while quarantined):
             # future session rebuilds may AOT it again
             self._suspect_buckets.discard(h.bucket)
+        if self.explain and isinstance(ys, dict) and "expl_bits" in ys:
+            try:
+                h.explain = HoistedSession.explain_payload(ys)
+            except Exception:  # noqa: BLE001 — attribution must never
+                # fail a harvest that already produced valid decisions
+                logger.warning("explain decode failed", exc_info=True)
+            else:
+                from .metrics import explain_harvests
+
+                explain_harvests.inc()
         from .metrics import (
             conflict_replays,
             multipod_conflicts,
@@ -1457,7 +1537,8 @@ class TPUBackend(CacheListener):
                 # (exact); decisions below are final
                 conflict_replays.inc(n_conf)
             h.results = self._apply_decisions_locked(
-                h.group, decisions, h.node_names, prov=h.prov)
+                h.group, decisions, h.node_names, prov=h.prov,
+                explain=h.explain)
             return
         # conflict SUFFIX (pallas/sharded multipod): pods [suffix:] were
         # left UNCOMMITTED by the kernel — the carry holds exactly the
@@ -1726,6 +1807,27 @@ class TPUBackend(CacheListener):
 
         templates = list(self._known_templates.values())
         cluster = self.enc.device_state()
+        # KTPU_EXPLAIN (or an armed shadow sentinel): per-plugin
+        # attribution exists only on the hoisted session's scan outputs
+        # — pallas/sharded builds demote, loudly, for as long as the
+        # knob is on (the decisions themselves stay bit-identical; the
+        # throughput cost is the explain mode's price)
+        explain_k = self.explain_topk if self.explain else 0
+        if explain_k:
+            if self.mesh is not None:
+                from ..parallel import sharded
+
+                session_builds.inc(kind="hoisted", reason="explain")
+                return HoistedSession(
+                    sharded.shard_cluster(cluster, self.mesh),
+                    templates, self.weights, explain_k=explain_k,
+                )
+            if self.use_pallas:
+                logger.warning(
+                    "explain mode: hoisted session instead of pallas")
+            session_builds.inc(kind="hoisted", reason="explain")
+            return HoistedSession(
+                cluster, templates, self.weights, explain_k=explain_k)
         # degradation ladder: a DEMOTED backend (rung below the
         # platform's top — NOT merely a platform whose top is hoisted)
         # builds the hoisted session even on a TPU; the probe loop

@@ -61,3 +61,14 @@ def snapshot() -> Dict[str, Any]:
 
 def handler_body() -> str:
     return json.dumps(snapshot(), indent=2, sort_keys=True)
+
+
+def metricsz_body() -> str:
+    """Prometheus text exposition of every registered scheduler_* metric
+    (the /metricsz body). Served from the same debug HTTP surface as
+    /configz so the drift/explain counters are scrapeable without a
+    separate metrics server; the import is deferred because configz is
+    otherwise metrics-free."""
+    from . import metrics as metrics_mod
+
+    return metrics_mod.legacy_registry.expose()

@@ -1,0 +1,63 @@
+"""Render a pod's scheduling decision as the oracle would log it.
+
+Input is a self-contained cluster file: either a shadow-drift repro
+bundle written by the parity sentinel (scheduler/explain.py
+write_bundle) or any JSON with ``pod`` / ``nodes`` / ``clusterPods`` in
+serde dict form. The CLI replays the decision through the requested
+path and prints the per-plugin attribution: which plugin filtered each
+rejected node, and the weighted score split of the winner vs the
+runners-up.
+
+    JAX_PLATFORMS=cpu python scripts/explain_decision.py BUNDLE.json
+    python scripts/explain_decision.py BUNDLE.json --source oracle --top 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+from kubernetes_tpu.api.types import pod_key  # noqa: E402
+from kubernetes_tpu.scheduler import explain  # noqa: E402
+from kubernetes_tpu.scheduler.framework.snapshot import Snapshot  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("bundle", help="repro bundle or pod/nodes/clusterPods JSON")
+    ap.add_argument("--source", choices=("device", "oracle"), default="device",
+                    help="which path computes the attribution: the fused "
+                         "kernel (standalone dispatch) or the oracle "
+                         "filter/score chain (default: device)")
+    ap.add_argument("--node", default="",
+                    help="render this node as the decision instead of the "
+                         "replayed winner (e.g. the bundle's recorded bind)")
+    ap.add_argument("--top", type=int, default=3,
+                    help="runner-up candidates in the score table")
+    args = ap.parse_args()
+
+    b = explain.load_bundle(args.bundle)
+    pod, nodes, cluster_pods = b["pod"], b["nodes"], b["clusterPods"]
+    if args.source == "oracle":
+        snap = Snapshot.from_objects(list(cluster_pods), list(nodes))
+        bd = explain.oracle_breakdown(snap, pod)
+    else:
+        bd = explain.device_breakdown(nodes, cluster_pods, pod,
+                                      weights=b.get("weights"))
+    node = args.node or b.get("node") or None
+    print(explain.render_decision(bd, pod_key(pod), node=node, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

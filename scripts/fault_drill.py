@@ -100,6 +100,7 @@ def main() -> int:
     faults0 = {k: val for k, val in metrics.device_faults.items()}
     dumps0 = counter_total(metrics.trace_dumps)
     ndumps0 = len(tracing.RECORDER.dump_history)
+    drift0 = counter_total(metrics.parity_drift)
 
     with Cluster(
         n_nodes=args.nodes,
@@ -203,6 +204,16 @@ def main() -> int:
                 failures.append(
                     f"{n_faults:.0f} device faults recorded but no "
                     f"flight-recorder dump fired")
+            # the shadow parity sentinel is a fault seam too: every
+            # drift it counts must leave a paired shadow-drift ring
+            # dump, or the drift is untriageable
+            n_drift = counter_total(metrics.parity_drift) - drift0
+            n_drift_dumps = sum(
+                1 for d in seam_dumps if d["reason"] == "shadow-drift")
+            if n_drift > 0 and n_drift_dumps == 0:
+                failures.append(
+                    f"{n_drift:.0f} parity drifts counted but no "
+                    f"shadow-drift seam dump fired")
             tracing.dump("fault-drill-final", path=args.dump_trace,
                          faults=dict(inj.injected))
             sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,82 @@
+"""Replay a shadow parity drift bundle and report whether it reproduces.
+
+Loads a repro bundle written by the parity sentinel (scheduler/
+explain.py write_bundle: decision-time cluster objects + pod + the
+device's score weights), re-runs BOTH paths from scratch — the device
+explain path (fused kernel, standalone dispatch) and the oracle
+filter/score chain — and prints the per-plugin diff table at the
+decision node. Exits nonzero iff the drift reproduces from the frozen
+state; exit 0 means the frozen objects agree (the original drift was
+transient: an informer race, a since-fixed kernel, a corrupted session).
+
+    JAX_PLATFORMS=cpu python scripts/replay_drift.py \
+        /tmp/ktpu-shadow-bundles/shadow-drift-default-web-1-*.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+from kubernetes_tpu.api.types import pod_key  # noqa: E402
+from kubernetes_tpu.scheduler import explain  # noqa: E402
+from kubernetes_tpu.scheduler.framework.snapshot import Snapshot  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("bundle", help="shadow-drift repro bundle (JSON)")
+    ap.add_argument("--top", type=int, default=3,
+                    help="runner-up candidates in the rendered decision")
+    args = ap.parse_args()
+
+    b = explain.load_bundle(args.bundle)
+    pod, nodes, cluster_pods = b["pod"], b["nodes"], b["clusterPods"]
+    key = pod_key(pod)
+    print(f"bundle: {args.bundle}")
+    print(f"  recorded: node={b.get('node')} plugins={b.get('plugins')}")
+
+    snap = Snapshot.from_objects(list(cluster_pods), list(nodes))
+    oracle_bd = explain.oracle_breakdown(snap, pod)
+    device_bd = explain.device_breakdown(nodes, cluster_pods, pod,
+                                         weights=b.get("weights"))
+    decision = device_bd.get("decision")
+
+    drifted = explain.decision_drifts(oracle_bd, decision)
+    plugins = explain.attribution_diff(oracle_bd, device_bd)
+    if drifted and not plugins:
+        plugins = explain.drift_plugins(oracle_bd, device_bd, decision)
+
+    print()
+    print("device replay:")
+    print(explain.render_decision(device_bd, key, node=decision, top=args.top))
+    print()
+    print("oracle replay:")
+    print(explain.render_decision(oracle_bd, key, top=args.top))
+    print()
+    at = decision or (oracle_bd["best"][0] if oracle_bd["best"] else None)
+    if at is not None:
+        print(explain.diff_table(oracle_bd, device_bd, at))
+        print()
+    if drifted or plugins:
+        print(f"DRIFT REPRODUCES: pod {key} "
+              f"(device={decision}, oracle best={oracle_bd['best']}, "
+              f"plugins: {', '.join(plugins) or 'decision'})")
+        return 1
+    print(f"no drift: device and oracle agree on the frozen objects "
+          f"(decision={decision})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,406 @@
+"""Decision explainability + shadow parity sentinel tests.
+
+Covers scheduler/explain.py and the KTPU_EXPLAIN / KTPU_SHADOW_SAMPLE
+surfaces end to end: randomized explain-vs-oracle attribution parity on
+the hoisted session (per-plugin filter masks and weighted score
+components must bit-match the framework's plugin outputs on CPU), the
+off-switch overhead pin (explain-off / sample=0 is decision-inert and
+launch-free, mirroring the KTPU_TRACE=0 pin), a sentinel drill that
+injects a score-weight perturbation and asserts drift is counted by
+plugin + ring-dumped + bundled + replayable, the triage CLIs, and the
+/metricsz Prometheus exposition on the apiserver debug surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import re
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import Clientset, SharedInformerFactory
+from kubernetes_tpu.ops.hoisted import HoistedSession
+from kubernetes_tpu.scheduler import explain, metrics
+from kubernetes_tpu.scheduler.framework.snapshot import Snapshot
+from kubernetes_tpu.scheduler.internal.cache import SchedulerCache
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.scheduler.tpu_backend import DEFAULT_WEIGHTS, TPUBackend
+from kubernetes_tpu.utils import tracing
+
+from .test_kernel_parity import random_cluster, random_pending
+from .util import make_node, make_pod, spread_constraint
+
+SCRIPTS_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+
+def _counter_total(counter) -> float:
+    return sum(val for _, val in counter.items())
+
+
+def _label_counts(counter):
+    out = {}
+    for key, val in counter.items():
+        slug = key[0] if key else "-"
+        out[slug] = out.get(slug, 0) + int(val)
+    return out
+
+
+def _prefilter_rejected(oracle_bd) -> bool:
+    """True when the oracle breakdown carries the PreFilter-rejection
+    shape (one failing plugin per node instead of full verdict rows)."""
+    return any(len(v) == 1 for v in oracle_bd["filters"].values())
+
+
+# -- explain-vs-oracle attribution parity -----------------------------------
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_attribution_parity(seed):
+    """device_breakdown (fused kernel, per-plugin mask/score decode) must
+    bit-match oracle_breakdown (every filter plugin run on every node, the
+    real score runners) on randomized clusters: same per-plugin verdicts
+    on the shared plugins, identical weighted score components and totals
+    for every feasible node."""
+    rng = random.Random(seed)
+    nodes, pods = random_cluster(rng)
+    for trial in range(2):
+        pending = random_pending(rng)
+        snap = Snapshot.from_objects(list(pods), list(nodes))
+        oracle_bd = explain.oracle_breakdown(snap, pending)
+        device_bd = explain.device_breakdown(nodes, pods, pending)
+        ctx = f"seed={seed} trial={trial}"
+        if _prefilter_rejected(oracle_bd):
+            assert device_bd["totals"] == {}, (
+                f"{ctx}: oracle PreFilter rejected the pod but the device "
+                f"found feasible nodes {device_bd['totals']}")
+            continue
+        diff = explain.attribution_diff(oracle_bd, device_bd)
+        assert diff == [], (
+            f"{ctx}: per-plugin attribution drifted: {diff}\n"
+            + (explain.diff_table(oracle_bd, device_bd,
+                                  device_bd["decision"])
+               if device_bd["decision"] else ""))
+        # totals carry the weighted sum: keyset equality pins that the
+        # oracle-only volume plugins (no device names) were all neutral
+        # on these volume-free pods
+        assert oracle_bd["totals"] == device_bd["totals"], ctx
+        assert sorted(oracle_bd["best"]) == sorted(device_bd["best"]), ctx
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_session_explain_payload_matches_oracle(seed):
+    """The HOISTED SESSION's explain payload (packed mask bits + top-k
+    score stacks harvested from the device) must decode to the same
+    per-plugin attribution the oracle computes — this is the production
+    harvest path, not the standalone replay kernel."""
+    rng = random.Random(seed + 40)
+    nodes, pods = random_cluster(rng)
+    cache = SchedulerCache()
+    be = TPUBackend()
+    cache.add_listener(be)
+    for node in nodes:
+        cache.add_node(node)
+    for p in pods:
+        cache.add_pod(p)
+    be.enc.reserve(pods=256)
+    be.enc.device_state()  # build vocabs before encoding pending pods
+    for trial in range(2):
+        pending = random_pending(rng)
+        arrays = {k: val for k, val in be.pe.encode(pending).items()
+                  if not k.startswith("_")}
+        cluster = be.enc.device_state()
+        sess = HoistedSession(cluster, [arrays], be.weights, explain_k=3)
+        assert sess.supports_explain and sess.explain_k == 3
+        ys = sess.schedule([arrays])
+        payloads = HoistedSession.explain_payload(ys)
+        assert payloads is not None and len(payloads) == 1
+        names = [None] * len(be.enc.node_index)
+        for name, idx in be.enc.node_index.items():
+            names[idx] = name
+        device_bd = explain.payload_breakdown(payloads[0], names)
+        oracle_bd = explain.oracle_breakdown(
+            Snapshot.from_objects(list(pods), list(nodes)), pending)
+        ctx = f"seed={seed} trial={trial}"
+        if _prefilter_rejected(oracle_bd):
+            assert device_bd["totals"] == {}, ctx
+            continue
+        # masks cover every node; scores cover the top-k the device
+        # shipped — attribution_diff restricts to exactly that
+        assert explain.attribution_diff(oracle_bd, device_bd) == [], ctx
+        for name, total in device_bd["totals"].items():
+            assert oracle_bd["totals"].get(name) == total, ctx
+        if device_bd["totals"]:
+            assert max(device_bd["totals"].values()) == \
+                max(oracle_bd["totals"].values()), ctx
+
+
+# -- overhead pin: explain-off / sample=0 is inert --------------------------
+
+
+def _mini_backend(n_nodes=5):
+    cache = SchedulerCache()
+    be = TPUBackend()
+    cache.add_listener(be)
+    for i in range(n_nodes):
+        cache.add_node(make_node(
+            f"node-{i}", cpu=str(4 + (i % 3) * 2), memory="16Gi", pods=64,
+            labels={v1.LABEL_HOSTNAME: f"node-{i}", "zone": f"z{i % 3}"},
+        ))
+    be.enc.reserve(pods=256)
+    return cache, be
+
+
+def _stream(n):
+    return [
+        make_pod(f"p-{i}", namespace="default", cpu="200m", memory="128Mi",
+                 labels={"app": "spread"},
+                 constraints=[spread_constraint(
+                     1, "zone", "ScheduleAnyway", {"app": "spread"})])
+        for i in range(n)
+    ]
+
+
+def test_explain_off_is_decision_inert_and_launch_free(monkeypatch):
+    """Mirrors the KTPU_TRACE=0 pin: with KTPU_EXPLAIN unset and
+    KTPU_SHADOW_SAMPLE=0 the session carries no explain arms (no expl
+    keys in ys, no per-pod payload allocation, explain/shadow counters
+    untouched) and turning explain ON changes no decision."""
+    monkeypatch.delenv("KTPU_EXPLAIN", raising=False)
+    monkeypatch.delenv("KTPU_SHADOW_SAMPLE", raising=False)
+    harvests0 = _counter_total(metrics.explain_harvests)
+    samples0 = _counter_total(metrics.shadow_samples)
+    drift0 = _counter_total(metrics.parity_drift)
+
+    _, off = _mini_backend()
+    assert off.explain is False and off.shadow_sample == 0.0
+    warm = off.schedule_many(_stream(4))
+    assert off._session is not None
+    assert off._session.explain_k == 0
+    h = off.dispatch_many(_stream(3)[:3])
+    assert h.ys is not None, "batch did not ride the session path"
+    assert not any(k.startswith("expl") for k in h.ys), (
+        f"explain-off session shipped explain arrays: "
+        f"{[k for k in h.ys if k.startswith('expl')]}")
+    off_results = off.harvest(h)
+    assert h.explain is None, "explain-off harvest allocated a payload"
+    assert _counter_total(metrics.explain_harvests) == harvests0
+    assert _counter_total(metrics.shadow_samples) == samples0
+    assert _counter_total(metrics.parity_drift) == drift0
+
+    monkeypatch.setenv("KTPU_EXPLAIN", "1")
+    _, on = _mini_backend()
+    assert on.explain is True
+    warm_on = on.schedule_many(_stream(4))
+    assert on._session is not None and on._session.explain_k >= 1
+    h2 = on.dispatch_many(_stream(3)[:3])
+    assert h2.ys is not None and "expl_bits" in h2.ys
+    on_results = on.harvest(h2)
+    assert h2.explain is not None and len(h2.explain) == 3
+    assert _counter_total(metrics.explain_harvests) > harvests0
+
+    def nodes_of(results):
+        return [node for _, node in results]
+
+    assert nodes_of(warm) == nodes_of(warm_on)
+    assert nodes_of(off_results) == nodes_of(on_results), (
+        "explain mode changed scheduling decisions")
+
+
+# -- sentinel drill: injected divergence -> counted, dumped, replayable -----
+
+
+def _cluster(n_nodes):
+    api = APIServer()
+    cs = Clientset(api)
+    for i in range(n_nodes):
+        cs.nodes.create(make_node(
+            f"node-{i}", cpu=str(4 + (i % 3) * 2), memory="16Gi", pods=64,
+            labels={v1.LABEL_HOSTNAME: f"node-{i}", "zone": f"z{i % 3}"},
+        ))
+    return api, cs
+
+
+def _drive(sched, cs, pods, batch=4):
+    for p in pods:
+        cs.pods.create(p)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if sched.queue.num_active() >= len(pods):
+            break
+        time.sleep(0.02)
+    while True:
+        info = sched.queue.pop(timeout=0.2)
+        if info is None:
+            break
+        infos = [info]
+        while len(infos) < batch:
+            nxt = sched.queue.pop(timeout=0)
+            if nxt is None:
+                break
+            infos.append(nxt)
+        sched._schedule_batch_tpu(infos)
+    assert sched._drain_pipeline(timeout=30)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with sched._inflight_lock:
+            if sched._inflight == 0:
+                return
+        time.sleep(0.02)
+    raise AssertionError("binder pool did not drain")
+
+
+def _run_script(monkeypatch, name, argv):
+    """Import a scripts/ CLI in-process and run its main() (a subprocess
+    would pay the full jax import again)."""
+    monkeypatch.syspath_prepend(SCRIPTS_DIR)
+    mod = __import__(name)
+    monkeypatch.setattr(sys, "argv", [f"{name}.py"] + list(argv))
+    return mod.main()
+
+
+def test_shadow_sentinel_drill(monkeypatch, tmp_path):
+    """Inject a score-weight perturbation into a throwaway session and
+    assert the full sentinel chain: drift counted per plugin, the flight
+    recorder ring dumped through the shadow-drift seam, a repro bundle
+    written — and the bundle replays to nonzero exit under
+    scripts/replay_drift.py while scripts/explain_decision.py renders the
+    decision end to end."""
+    monkeypatch.setenv("KTPU_SHADOW_BUNDLE_DIR", str(tmp_path))
+    old_level = tracing.set_level(max(tracing.level(), 1))
+    _, cs = _cluster(5)
+    factory = SharedInformerFactory(cs)
+    sched = Scheduler(cs, factory, backend="tpu", pipeline_depth=2)
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    sched.tpu.set_shadow_sample(1.0)
+    assert sched.tpu.shadow_sample == 1.0 and sched.tpu.explain
+    samples0 = _counter_total(metrics.shadow_samples)
+    drift_by_plugin0 = _label_counts(metrics.parity_drift)
+    dumps0 = _counter_total(metrics.trace_dumps)
+    ndumps0 = len(tracing.RECORDER.dump_history)
+    try:
+        # clean warm-up: sentinel samples everything, zero drift
+        _drive(sched, cs, [
+            make_pod(f"w-{i}", namespace="default", cpu="300m",
+                     memory="128Mi", labels={"app": "x"})
+            for i in range(4)
+        ])
+        assert _counter_total(metrics.shadow_samples) > samples0
+        assert _label_counts(metrics.parity_drift) == drift_by_plugin0, (
+            "clean warm-up produced parity drift")
+        # inject: rebuild the session with a perturbed balanced-allocation
+        # weight (rebind, never mutate — DEFAULT_WEIGHTS is shared)
+        perturbed = dict(DEFAULT_WEIGHTS)
+        perturbed["balanced"] = perturbed.get("balanced", 1) * 7
+        sched.tpu.weights = perturbed
+        sched.tpu._invalidate_session("drill-weights")
+        _drive(sched, cs, [
+            make_pod(f"d-{i}", namespace="default", cpu="300m",
+                     memory="128Mi", labels={"app": "x"})
+            for i in range(8)
+        ])
+    finally:
+        sched.stop()
+        factory.stop()
+        tracing.set_level(old_level)
+
+    drift = {
+        k: val - drift_by_plugin0.get(k, 0)
+        for k, val in _label_counts(metrics.parity_drift).items()
+        if val - drift_by_plugin0.get(k, 0)
+    }
+    assert drift.get("NodeResourcesBalancedAllocation", 0) >= 1, (
+        f"weight perturbation not attributed to the plugin: {drift}")
+    assert _counter_total(metrics.trace_dumps) > dumps0
+    seam_dumps = tracing.RECORDER.dump_history[ndumps0:]
+    assert any(d["reason"] == "shadow-drift" for d in seam_dumps), (
+        f"no shadow-drift ring dump: {[d['reason'] for d in seam_dumps]}")
+
+    bundles = sorted(str(p) for p in tmp_path.glob("shadow-drift-*.json"))
+    assert bundles, "sentinel wrote no repro bundle"
+    b = explain.load_bundle(bundles[0])
+    assert b["plugins"] and b["weights"]["balanced"] == perturbed["balanced"]
+    # the bundle must REPRODUCE: replay_drift exits nonzero on it
+    assert _run_script(monkeypatch, "replay_drift", [bundles[0]]) == 1
+    # and the explain CLI renders the decision as the oracle would log it
+    assert _run_script(monkeypatch, "explain_decision", [bundles[0]]) == 0
+
+
+def test_explain_decision_renders_oracle_style(monkeypatch, tmp_path, capsys):
+    """scripts/explain_decision.py end to end on a directed bundle: the
+    render names the winner, the per-plugin score split, and who filtered
+    the rejected node."""
+    nodes = [
+        make_node("big", cpu="8", memory="32Gi", pods=64,
+                  labels={v1.LABEL_HOSTNAME: "big", "zone": "z0"}),
+        make_node("small", cpu="2", memory="4Gi", pods=64,
+                  labels={v1.LABEL_HOSTNAME: "small", "zone": "z1"}),
+        make_node("cordoned", cpu="8", memory="32Gi", pods=64,
+                  labels={v1.LABEL_HOSTNAME: "cordoned", "zone": "z2"},
+                  unschedulable=True),
+    ]
+    filler = make_pod("filler", namespace="default", cpu="1500m",
+                      memory="1Gi", labels={"app": "f"}, node_name="small")
+    pending = make_pod("web", namespace="default", cpu="1", memory="1Gi",
+                       labels={"app": "web"})
+    snap = Snapshot.from_objects([filler], nodes)
+    oracle_bd = explain.oracle_breakdown(snap, pending)
+    path = explain.write_bundle(
+        pending, nodes, [filler], oracle_bd["best"][0],
+        [], oracle_bd, dir_path=str(tmp_path))
+    assert _run_script(monkeypatch, "explain_decision", [path]) == 0
+    out = capsys.readouterr().out
+    assert 'pod "default/web": scheduled on' in out
+    assert "cordoned: rejected by" in out
+    assert "NodeUnschedulable" in out
+    assert "NodeResourcesBalancedAllocation" in out and "total" in out
+
+
+# -- /metricsz Prometheus exposition ----------------------------------------
+
+
+def test_metricsz_exposition_over_http():
+    """/metricsz on the apiserver debug surface serves the process-wide
+    registry in Prometheus text format: HELP/TYPE headers for every
+    scheduler_* metric (drift + explain counters included) and
+    well-formed sample lines; /configz serves JSON beside it."""
+    from kubernetes_tpu.apiserver.http import HTTPAPIServer
+
+    # touch the labeled counters so sample lines (not just headers) exist
+    metrics.parity_drift.inc(0, plugin="ExpositionSelfTest")
+    metrics.shadow_samples.inc(0)
+    metrics.explain_harvests.inc(0)
+    srv = HTTPAPIServer(api=APIServer()).start()
+    try:
+        with urllib.request.urlopen(srv.address + "/metricsz") as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        for name in ("scheduler_shadow_samples_total",
+                     "scheduler_parity_drift_total",
+                     "scheduler_explain_harvests_total",
+                     "scheduler_schedule_attempts_total",
+                     "scheduler_trace_dumps_total"):
+            assert f"# TYPE {name} counter" in body, name
+            assert f"# HELP {name} " in body, name
+        assert 'scheduler_parity_drift_total{plugin="ExpositionSelfTest"}' \
+            in body
+        sample = re.compile(
+            r"^[A-Za-z_:][A-Za-z0-9_:]*(\{[^}]*\})? -?[0-9.e+-]+(inf)?$")
+        for line in body.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            assert sample.match(line), f"malformed exposition line: {line}"
+        with urllib.request.urlopen(srv.address + "/configz") as resp:
+            assert resp.headers["Content-Type"].startswith("application/json")
+            json.loads(resp.read().decode())
+    finally:
+        srv.stop()
