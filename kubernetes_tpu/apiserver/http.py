@@ -30,8 +30,10 @@ over.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from queue import Empty, Queue
 from typing import Any, Dict, List, Optional, Tuple
@@ -40,7 +42,33 @@ from urllib.parse import parse_qs, urlsplit
 from ..api import types as v1
 from ..store import kv
 from ..utils import serde
+from ..utils.metrics import Counter, Gauge, legacy_registry
 from .server import APIError, APIServer, NotFound, ResourceInfo, WatchEvent
+
+watch_evictions = legacy_registry.register(
+    Counter(
+        "apiserver_watch_evictions_total",
+        "Watch streams force-closed because the client could not drain "
+        "its bounded send buffer (bytes over KTPU_WATCH_BUFFER, or no "
+        "socket-write progress for KTPU_WATCH_EVICT_AFTER seconds with "
+        "frames queued). Slow-consumer backpressure: one wedged reader "
+        "must not block the hub's event fan-out, and the hard close is "
+        "safe — the client's reflector sees EOF (RemoteWatch.closed) and "
+        "recovers via re-list+re-watch. A sustained rate here names a "
+        "consumer that cannot keep up with the event volume.",
+        (),
+    )
+)
+watchers_gauge = legacy_registry.register(
+    Gauge(
+        "apiserver_watchers",
+        "Chunked watch streams currently being served across this "
+        "process's HTTP apiservers (per-hub counts are on "
+        "HTTPAPIServer.watcher_count). The endurance soak's leak "
+        "invariant expects this to return to baseline after chaos.",
+        (),
+    )
+)
 
 
 def _status_body(code: int, message: str, reason: str = "") -> bytes:
@@ -298,7 +326,19 @@ class _Handler(BaseHTTPRequestHandler):
         overhead per event — at a 10k-pod bind wave with several
         informers watching pods, the dominant wire-tax term. The encoded
         frame is also memoized across watchers by (key, revision, type):
-        every watcher of the same prefix streams identical bytes."""
+        every watcher of the same prefix streams identical bytes.
+
+        Slow-consumer backpressure: the blocking socket writes happen on
+        a dedicated writer thread behind a BOUNDED frame buffer, so this
+        (producer) thread never blocks on a wedged peer. A watcher that
+        cannot drain — buffer past hub.watch_buffer_bytes, or no write
+        progress for hub.watch_evict_after seconds with frames queued —
+        is EVICTED: counted (apiserver_watch_evictions_total) and
+        hard-closed. Eviction is safe by the existing contract: the
+        client's RemoteWatch sees EOF, sets `closed`, and its reflector
+        recovers via re-list+re-watch; the alternative (one stalled
+        reader backpressuring the store's event hub) wedges every other
+        consumer."""
         since = params.get("resourceVersion")
         w = client.watch(
             namespace=ns or None,
@@ -309,10 +349,6 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
-
-        def chunk(data: bytes) -> None:
-            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
-            self.wfile.flush()
 
         if raw is not None:
             w = raw
@@ -325,8 +361,65 @@ class _Handler(BaseHTTPRequestHandler):
                     "object": serde.to_dict(ev.object),
                 }).encode() + b"\n"
 
+        hub = self.hub
+        max_bytes = max(1, int(getattr(hub, "watch_buffer_bytes",
+                                       256 * 1024)))
+        evict_after = float(getattr(hub, "watch_evict_after", 10.0))
+        cv = threading.Condition()
+        buf: _collections.deque = _collections.deque()
+        state = {"bytes": 0, "done": False, "dead": False,
+                 "evicted": False, "last_drain": time.monotonic()}
+
+        def writer() -> None:
+            try:
+                while True:
+                    with cv:
+                        while (not buf and not state["done"]
+                               and not state["dead"]):
+                            cv.wait(0.2)
+                        if state["dead"] or (state["done"] and not buf):
+                            return
+                        data = buf.popleft()
+                        state["bytes"] -= len(data)
+                    # a slow reader blocks HERE, on this thread — never
+                    # the producer loop feeding from the store's hub
+                    self.wfile.write(
+                        f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                    self.wfile.flush()
+                    with cv:
+                        state["last_drain"] = time.monotonic()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+            finally:
+                with cv:
+                    state["dead"] = True
+                    cv.notify_all()
+
+        wt = threading.Thread(target=writer, name="watch-writer",
+                              daemon=True)
+        wt.start()
+        hub.watcher_started()
+
+        def enqueue(data: bytes) -> bool:
+            """False = this watcher is dead or just got evicted; the
+            producer loop stops."""
+            with cv:
+                if state["dead"]:
+                    return False
+                stalled = bool(buf) and (
+                    time.monotonic() - state["last_drain"] > evict_after)
+                if state["bytes"] + len(data) > max_bytes or stalled:
+                    state["evicted"] = True
+                    state["dead"] = True
+                    cv.notify_all()
+                    return False
+                buf.append(data)
+                state["bytes"] += len(data)
+                cv.notify_all()
+                return True
+
         try:
-            while self.hub.running:
+            while hub.running:
                 ev = w.poll(timeout=0.5)
                 if ev is None:
                     if getattr(w, "closed", False):
@@ -335,29 +428,51 @@ class _Handler(BaseHTTPRequestHandler):
                         # so the remote reflector re-lists instead of
                         # heartbeating against a dead watch forever
                         break
-                    chunk(b" \n")  # heartbeat keeps dead peers detectable
+                    # heartbeat keeps dead peers detectable — and runs
+                    # the stall clock against a blocked reader even on
+                    # an idle watch
+                    if not enqueue(b" \n"):
+                        break
                     continue
                 # drain everything already queued into ONE chunk: a
                 # 2048-pod bind wave is 2048 MODIFIED events, and one
                 # frame+flush per event made the watch stream the wire
                 # path's throughput ceiling (the client's readline loop
                 # splits lines, so framing is free to batch)
-                buf = [encode(ev)]
-                while len(buf) < 512:
+                batch = [encode(ev)]
+                nbytes = len(batch[0])
+                # byte-bounded too: one joined chunk past the watcher's
+                # whole budget would evict even a fast consumer
+                while len(batch) < 512 and nbytes < max_bytes // 4:
                     ev = w.poll(timeout=0)
                     if ev is None:
                         break
-                    buf.append(encode(ev))
-                chunk(b"".join(buf))
-        except (BrokenPipeError, ConnectionResetError, OSError):
-            pass
+                    batch.append(encode(ev))
+                    nbytes += len(batch[-1])
+                if not enqueue(b"".join(batch)):
+                    break
         finally:
             w.stop()
-            try:
-                self.wfile.write(b"0\r\n\r\n")
-            except OSError:
-                pass
+            with cv:
+                state["done"] = True
+                cv.notify_all()
+            if state["evicted"]:
+                watch_evictions.inc()
+                # the writer may be wedged inside a socket write: a
+                # clean chunked trailer is impossible, and closing the
+                # socket is both the unblock and the re-list signal
+                try:
+                    self.connection.close()
+                except OSError:
+                    pass
+            wt.join(timeout=5)
+            if not state["evicted"]:
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
             self.close_connection = True
+            hub.watcher_finished()
 
     def _verb_post(self, resource, ns, name, sub, params) -> None:
         api = self._client_api()
@@ -498,6 +613,32 @@ class HTTPAPIServer:
         self.running = False
         # per-hub: (key, revision, type) is unique only within one store
         self.raw_event_memo = _RawEventMemo()
+        # slow-consumer backpressure knobs (_stream_watch): bounded
+        # per-watcher send buffer + max stall before eviction. Tests
+        # shrink these per-hub; production tunes via env.
+        self.watch_buffer_bytes = int(
+            os.environ.get("KTPU_WATCH_BUFFER", "") or 256 * 1024)
+        self.watch_evict_after = float(
+            os.environ.get("KTPU_WATCH_EVICT_AFTER", "") or 10.0)
+        self._watch_lock = threading.Lock()
+        self.watcher_count = 0  # live streams on THIS hub
+        from ..utils import configz
+
+        configz.install_knobs(
+            "apiserver",
+            watch_buffer_bytes=self.watch_buffer_bytes,
+            watch_evict_after=self.watch_evict_after,
+        )
+
+    def watcher_started(self) -> None:
+        with self._watch_lock:
+            self.watcher_count += 1
+        watchers_gauge.inc()
+
+    def watcher_finished(self) -> None:
+        with self._watch_lock:
+            self.watcher_count -= 1
+        watchers_gauge.dec()
 
     @property
     def address(self) -> str:

@@ -31,7 +31,12 @@ import time
 from typing import List, Optional, Tuple
 
 from ..utils import tracing
-from .metrics import backend_mode
+from .metrics import (
+    backend_mode,
+    overload_level,
+    overload_restores,
+    overload_sheds,
+)
 
 # ladder rungs, ordered: demotion decrements, promotion increments
 RUNG_ORACLE = 0  # host Go-semantics path; no device dispatch at all
@@ -173,3 +178,178 @@ class DegradationLadder:
         del self.transitions[:-64]  # bounded
         tracing.event(f"ladder-{kind}", "fault",
                       rung=RUNG_NAMES[self._rung])
+
+
+class OverloadMonitor:
+    """Host-side overload detection + adaptive shedding — the HOST dual
+    of the device-fault ladder above.
+
+    The ladder handles a sick DEVICE; this handles a drowning HOST: the
+    PR-8 stage attribution showed completion (assume/bind, plus the
+    optional audit work riding it) is the largest stage, so when the
+    host falls behind the completion FIFO ages and queue depth climbs
+    with no device fault in sight. The monitor watches those signals
+    once per completed batch and, under SUSTAINED pressure, sheds
+    strictly OPTIONAL work in a fixed order:
+
+        explain-harvest -> shadow-sample -> trace -> speculation
+
+    Decision correctness is never shed — every lever changes how much
+    observability/overlap the host pays for, never which node a pod
+    lands on. Restore is hysteretic and LIFO (last shed, first
+    restored): shedding needs `shed_dwell` consecutive hot ticks,
+    restoring needs `restore_dwell` consecutive calm ticks, a tick in
+    the dead band between the high and low water marks resets both
+    streaks, and `cooldown` seconds must separate any two transitions —
+    so a load level that hovers at the threshold cannot flap a lever.
+
+    Levers are (name, shed_fn, restore_fn) closures supplied by the
+    scheduler; the monitor owns only the policy. Thread-safety: `observe`
+    is called from the completion worker, but everything is locked so
+    drills/tests can poke it from other threads.
+    """
+
+    def __init__(
+        self,
+        levers,
+        *,
+        high_fifo_age: float = 0.5,
+        low_fifo_age: float = 0.1,
+        high_queue_depth: int = 512,
+        low_queue_depth: int = 128,
+        high_stage_p99: float = 0.0,
+        low_stage_p99: float = 0.0,
+        shed_dwell: int = 3,
+        restore_dwell: int = 8,
+        cooldown: float = 1.0,
+        now=time.monotonic,
+        on_shed=None,
+        on_restore=None,
+    ):
+        self.levers = list(levers)
+        self.high_fifo_age = high_fifo_age
+        self.low_fifo_age = low_fifo_age
+        self.high_queue_depth = high_queue_depth
+        self.low_queue_depth = low_queue_depth
+        # stage-p99 signal is opt-in (0 = disabled): per-stage latency is
+        # workload-shaped, so the deployment picks the water marks
+        self.high_stage_p99 = high_stage_p99
+        self.low_stage_p99 = (
+            low_stage_p99 if low_stage_p99 > 0 else high_stage_p99 / 2
+        )
+        self.shed_dwell = max(1, shed_dwell)
+        self.restore_dwell = max(1, restore_dwell)
+        self.cooldown = cooldown
+        self._now = now
+        self._on_shed = on_shed
+        self._on_restore = on_restore
+        self._lock = threading.Lock()
+        self._hot_streak = 0
+        self._calm_streak = 0
+        self._level = 0  # levers currently shed (prefix of self.levers)
+        self._last_transition = -float("inf")
+        self.triggered = False  # any shed ever fired this run
+        self.cycles = 0  # completed shed->...->fully-restored cycles
+        # bounded ledger: (monotonic time, "shed"|"restore", lever name,
+        # {signal: value}) — the soak report prints it
+        self.history: List[Tuple[float, str, str, dict]] = []
+        overload_level.set(0)
+
+    # -- state -------------------------------------------------------------
+
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def shed_names(self) -> List[str]:
+        with self._lock:
+            return [name for name, _, _ in self.levers[: self._level]]
+
+    # -- the per-completion tick -------------------------------------------
+
+    def observe(
+        self,
+        fifo_depth: int = 0,
+        fifo_age: float = 0.0,
+        queue_depth: int = 0,
+        stage_p99: float = 0.0,
+    ) -> Optional[str]:
+        """One sample of the host-pressure signals; returns the lever
+        name if THIS tick shed or restored one (else None)."""
+        hot = (
+            fifo_age >= self.high_fifo_age
+            or queue_depth >= self.high_queue_depth
+            or (self.high_stage_p99 > 0 and stage_p99 >= self.high_stage_p99)
+        )
+        calm = (
+            fifo_age <= self.low_fifo_age
+            and queue_depth <= self.low_queue_depth
+            and (self.high_stage_p99 <= 0 or stage_p99 <= self.low_stage_p99)
+        )
+        signals = {
+            "fifo_depth": fifo_depth,
+            "fifo_age": round(fifo_age, 4),
+            "queue_depth": queue_depth,
+            "stage_p99": round(stage_p99, 4),
+        }
+        with self._lock:
+            now = self._now()
+            if hot:
+                self._hot_streak += 1
+                self._calm_streak = 0
+                if (
+                    self._hot_streak >= self.shed_dwell
+                    and self._level < len(self.levers)
+                    and now - self._last_transition >= self.cooldown
+                ):
+                    return self._shed_locked(now, signals)
+            elif calm:
+                self._calm_streak += 1
+                self._hot_streak = 0
+                if (
+                    self._calm_streak >= self.restore_dwell
+                    and self._level > 0
+                    and now - self._last_transition >= self.cooldown
+                ):
+                    return self._restore_locked(now, signals)
+            else:
+                # dead band between the water marks: hysteresis — neither
+                # streak accumulates, so hovering load cannot flap
+                self._hot_streak = 0
+                self._calm_streak = 0
+            return None
+
+    def _shed_locked(self, now: float, signals: dict) -> str:
+        name, shed_fn, _ = self.levers[self._level]
+        self._level += 1
+        self._hot_streak = 0
+        self._calm_streak = 0
+        self._last_transition = now
+        self.triggered = True
+        self.history.append((now, "shed", name, signals))
+        del self.history[:-128]  # bounded
+        overload_sheds.inc(what=name)
+        overload_level.set(self._level)
+        tracing.event("overload-shed", "fault", what=name, **signals)
+        shed_fn()
+        if self._on_shed is not None:
+            self._on_shed(name, signals)
+        return name
+
+    def _restore_locked(self, now: float, signals: dict) -> str:
+        self._level -= 1
+        name, _, restore_fn = self.levers[self._level]
+        self._hot_streak = 0
+        self._calm_streak = 0
+        self._last_transition = now
+        self.history.append((now, "restore", name, signals))
+        del self.history[:-128]  # bounded
+        overload_restores.inc(what=name)
+        overload_level.set(self._level)
+        tracing.event("overload-restore", "fault", what=name, **signals)
+        if self._level == 0:
+            self.cycles += 1
+        restore_fn()
+        if self._on_restore is not None:
+            self._on_restore(name, signals)
+        return name

@@ -60,6 +60,18 @@ class SchedulerCache:
         self._listeners: List[CacheListener] = []
         # snapshot bookkeeping
         self._last_snapshot_generation: Dict[str, int] = {}
+        # foreign-mutation generation: bumped by every state change that
+        # did NOT originate from this scheduler's own assume protocol —
+        # informer adds/updates/removes, node events, TTL expiry, forget.
+        # The shadow parity sentinel compares the value it latched at
+        # dispatch against the value at completion: any advance means the
+        # completion-time cache is no longer the decision-time state and
+        # the oracle replay would adjudicate against a world the device
+        # never saw (audit skipped, counted). Own-batch assumes and bind
+        # confirmations on the assumed node deliberately do NOT bump:
+        # they are exactly the deltas FIFO completion already accounts
+        # for.
+        self._foreign_mutations = 0
 
     def add_listener(self, listener: CacheListener) -> None:
         with self._lock:
@@ -153,6 +165,10 @@ class SchedulerCache:
                 self._remove_pod_locked(ps.pod, ps.pod.spec.node_name)
                 del self._pod_states[key]
                 del self._assumed_pods[key]
+                # a retracted assume breaks the FIFO accounting the
+                # sentinel relies on — later in-flight batches decided
+                # WITH this placement
+                self._foreign_mutations += 1
             else:
                 raise ValueError(f"pod {key} wasn't assumed so cannot be forgotten")
 
@@ -189,12 +205,15 @@ class SchedulerCache:
                     # scheduler sent it elsewhere; informer wins (cache.go:455)
                     self._remove_pod_locked(ps.pod, ps.pod.spec.node_name)
                     self._add_pod_locked(pod, pod.spec.node_name)
+                    self._foreign_mutations += 1
+                # confirm on the assumed node: no state change, no bump
                 self._assumed_pods.pop(key, None)
                 ps.deadline = None
                 ps.pod = pod
             elif ps is None:
                 self._add_pod_locked(pod, pod.spec.node_name)
                 self._pod_states[key] = _PodState(pod)
+                self._foreign_mutations += 1
             # else: duplicate add; ignore
 
     def update_pod(self, old: v1.Pod, new: v1.Pod) -> None:
@@ -206,6 +225,7 @@ class SchedulerCache:
             self._remove_pod_locked(ps.pod, ps.pod.spec.node_name)
             self._add_pod_locked(new, new.spec.node_name)
             ps.pod = new
+            self._foreign_mutations += 1
 
     def remove_pod(self, pod: v1.Pod) -> None:
         key = v1.pod_key(pod)
@@ -216,11 +236,25 @@ class SchedulerCache:
             self._remove_pod_locked(ps.pod, ps.pod.spec.node_name)
             del self._pod_states[key]
             self._assumed_pods.pop(key, None)
+            self._foreign_mutations += 1
 
-    def cleanup_expired_assumed_pods(self) -> None:
+    def cleanup_expired_assumed_pods(self) -> int:
         """cache.go:734 cleanupAssumedPods: expire assumed pods whose
-        binding finished but confirmation never arrived."""
+        binding finished but confirmation never arrived. Expiry routes
+        through _remove_pod_locked like any other remove, so every
+        CacheListener sees it — a live device session absorbs it as a
+        carry-delta remove instead of drifting from the cache
+        (tests/test_session_deltas.py pins expiry bit-identical to a
+        rebuild). Returns the number expired; each one is a bind that
+        was sent and never informer-confirmed, so the counter
+        (scheduler_cache_expired_assumes_total) is a lost-bind signal,
+        not bookkeeping. Also refreshes the assumed-pod gauges the
+        endurance soak's TTL invariant reads."""
+        from ..metrics import assumed_pods, expired_assumes, oldest_assume_age
+
         now = self._now()
+        expired = 0
+        oldest_age = 0.0
         with self._lock:
             for key in list(self._assumed_pods):
                 ps = self._pod_states[key]
@@ -228,6 +262,19 @@ class SchedulerCache:
                     self._remove_pod_locked(ps.pod, ps.pod.spec.node_name)
                     del self._pod_states[key]
                     del self._assumed_pods[key]
+                    self._foreign_mutations += 1
+                    expired += 1
+                elif ps.binding_finished and ps.deadline is not None:
+                    # age past bind-finish of the oldest survivor: if
+                    # this ever exceeds ttl + a few sweep periods, the
+                    # sweep itself is stalled
+                    oldest_age = max(
+                        oldest_age, now - (ps.deadline - self._ttl))
+            assumed_pods.set(len(self._assumed_pods))
+        oldest_assume_age.set(oldest_age)
+        if expired:
+            expired_assumes.inc(expired)
+        return expired
 
     # -- nodes (cache.go:562-650) ------------------------------------------
 
@@ -236,6 +283,7 @@ class SchedulerCache:
             ni = self._node_info(node.metadata.name)
             ni.set_node(node)
             self._touch(node.metadata.name)
+            self._foreign_mutations += 1
             for l in self._listeners:
                 l.on_add_node(node)
 
@@ -244,6 +292,7 @@ class SchedulerCache:
             ni = self._node_info(node.metadata.name)
             ni.set_node(node)
             self._touch(node.metadata.name)
+            self._foreign_mutations += 1
             for l in self._listeners:
                 l.on_update_node(node)
 
@@ -253,8 +302,16 @@ class SchedulerCache:
             if ni is None:
                 return
             self._last_snapshot_generation.pop(node_name, None)
+            self._foreign_mutations += 1
             for l in self._listeners:
                 l.on_remove_node(node_name)
+
+    def foreign_mutations(self) -> int:
+        """Current foreign-mutation generation (see __init__). Latched at
+        dispatch onto the batch handle; the shadow sentinel audits only
+        when it is unchanged at completion."""
+        with self._lock:
+            return self._foreign_mutations
 
     def node_count(self) -> int:
         with self._lock:

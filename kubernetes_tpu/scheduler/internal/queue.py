@@ -329,3 +329,15 @@ class PriorityQueue:
     def num_active(self) -> int:
         with self._lock:
             return len(self._active)
+
+    def depths(self) -> Tuple[int, int, int]:
+        """(active, backoff, unschedulable) counts — the cheap form of
+        pending_pods() for per-tick consumers (the overload monitor and
+        the scheduler_pending_pods gauges) that must not copy the queue
+        contents on every completed batch."""
+        with self._lock:
+            return (
+                len(self._active),
+                len(self._backoff),
+                len(self._unschedulable),
+            )

@@ -296,6 +296,19 @@ shadow_samples = legacy_registry.register(
         (),
     )
 )
+shadow_skips = legacy_registry.register(
+    Counter(
+        "scheduler_shadow_skips_total",
+        "Shadow audits voided by the stale-basis gate: the cache's "
+        "foreign-mutation generation advanced between dispatch and "
+        "completion (informer add/update/remove, node event, TTL "
+        "expiry, forget), so the oracle replay would adjudicate against "
+        "state the device never decided on. A skip is lost sentinel "
+        "COVERAGE, never a drift signal — sustained high skip:sample "
+        "ratios mean completions lag events (see the overload monitor).",
+        ("reason",),
+    )
+)
 parity_drift = legacy_registry.register(
     Counter(
         "scheduler_parity_drift_total",
@@ -338,5 +351,96 @@ speculative_dispatches = legacy_registry.register(
         "crash abandon). KTPU_SPECULATION=0 serializes dispatch on "
         "harvest and zeroes this counter.",
         ("outcome",),
+    )
+)
+overload_sheds = legacy_registry.register(
+    Counter(
+        "scheduler_overload_sheds_total",
+        "Optional work SHED by the host overload monitor under sustained "
+        "pressure (completion-FIFO age / queue depth / stage latency past "
+        "their high-water marks for the dwell window), by lever: "
+        "what=explain-harvest (host skips attribution decode), "
+        "what=shadow-sample (parity-sentinel rate to 0), what=trace "
+        "(flight recorder off), what=speculation (dispatch serializes on "
+        "harvest). Levers shed in that fixed order and restore LIFO after "
+        "a sustained-calm window — decision correctness is never shed, so "
+        "this counter moving changes observability coverage, not "
+        "placements. Sustained nonzero rate = the host is the "
+        "bottleneck; see the paired OverloadShed k8s Events for the "
+        "triggering signal values.",
+        ("what",),
+    )
+)
+overload_restores = legacy_registry.register(
+    Counter(
+        "scheduler_overload_restores_total",
+        "Shed levers restored by the overload monitor after the calm "
+        "dwell window (LIFO: last lever shed is first restored). "
+        "sheds_total - restores_total = levers currently shed (also on "
+        "scheduler_overload_level).",
+        ("what",),
+    )
+)
+overload_level = legacy_registry.register(
+    Gauge(
+        "scheduler_overload_level",
+        "Number of overload-shed levers currently engaged (0 = full "
+        "observability, 4 = maximally shed: explain+shadow+trace+"
+        "speculation). Alert on this sitting above 0 — the host cannot "
+        "keep up with the configured audit load.",
+        (),
+    )
+)
+expired_assumes = legacy_registry.register(
+    Counter(
+        "scheduler_cache_expired_assumes_total",
+        "Assumed pods expired by the cache TTL sweep because no bind "
+        "confirmation (informer add) arrived within the assume TTL. "
+        "Expiry routes through the cache listeners like any other "
+        "remove (live device sessions absorb it as a carry delta), but "
+        "each expiry means a bind was sent and never observed — lost "
+        "bind, apiserver lag, or informer stall. Production rate should "
+        "be ~0; the endurance soak asserts it.",
+        (),
+    )
+)
+assumed_pods = legacy_registry.register(
+    Gauge(
+        "scheduler_cache_assumed_pods",
+        "Pods currently in the assumed (optimistically bound, awaiting "
+        "informer confirmation) state in the scheduler cache.",
+        (),
+    )
+)
+oldest_assume_age = legacy_registry.register(
+    Gauge(
+        "scheduler_cache_oldest_assume_seconds",
+        "Age past bind-finish of the OLDEST still-assumed pod at the "
+        "last TTL sweep (0 when none are overdue-tracked). The sweep "
+        "runs every ~1 s, so this exceeding assume TTL + a couple of "
+        "sweep periods means the expiry sweep itself is stalled — the "
+        "soak's no-pod-outlives-its-TTL invariant reads this gauge.",
+        (),
+    )
+)
+completion_fifo_depth = legacy_registry.register(
+    Gauge(
+        "scheduler_completion_fifo_depth",
+        "In-flight dispatched batches awaiting completion (the pipeline "
+        "FIFO between the scheduler thread and the completion worker). "
+        "Bounded by pipeline_depth; pinned at the bound = dispatch is "
+        "waiting on host completion.",
+        (),
+    )
+)
+completion_fifo_age = legacy_registry.register(
+    Gauge(
+        "scheduler_completion_fifo_age_seconds",
+        "Queue-to-completion age of the batch most recently completed: "
+        "time from dispatch enqueue to completion finish. The overload "
+        "monitor's primary hot signal — sustained age above the "
+        "high-water mark sheds optional work "
+        "(scheduler_overload_sheds_total).",
+        (),
     )
 )

@@ -169,6 +169,16 @@ class Scheduler:
         self._completions: deque = deque()
         self._completion_cv = threading.Condition()
         self._completion_thread: Optional[threading.Thread] = None
+        # decided placements that never landed in the cache (assume lost
+        # to an informer race, RETRY re-gates, recovery abandons): while
+        # the dropping batch was in flight, LATER in-flight batches
+        # chained on a carry containing the dropped placement — a basis
+        # the cache never held. Latched onto each handle at dispatch
+        # (with the cache's foreign-mutation generation) so the shadow
+        # sentinel voids audits whose flight overlapped a drop. Plain
+        # int under the GIL: written by the completion worker, read at
+        # dispatch.
+        self._dropped_decisions = 0
         # exact per-pod scheduling latencies (seconds) for the perf
         # harness: (queue-admission->bind-sent, pop->bind-sent, attempts).
         # The histograms carry the same data bucket-quantized; the harness
@@ -252,7 +262,118 @@ class Scheduler:
             ),
             backend=self.backend,
         )
+        # host-overload monitor (degradation.OverloadMonitor): watches
+        # completion-FIFO age, queue depth and completion-stage latency
+        # once per completed batch; under sustained pressure sheds
+        # optional work in a fixed order with hysteretic LIFO restore.
+        # Decision-inert by construction (tests/test_overload.py pins a
+        # never-triggered run bit-identical) — levers only change how
+        # much audit/overlap work the host pays for.
+        self._shed_saved: Dict[str, object] = {}
+        self._completion_durations: deque = deque(maxlen=64)
+        self.overload = None
+        if self.tpu is not None and os.environ.get(
+                "KTPU_OVERLOAD", "1") != "0":
+            from .degradation import OverloadMonitor
+
+            def _env_f(name: str, default: float) -> float:
+                return float(os.environ.get(name, "") or default)
+
+            high_age = _env_f("KTPU_OVERLOAD_FIFO_AGE", 0.5)
+            high_q = int(_env_f("KTPU_OVERLOAD_QUEUE_DEPTH",
+                                max(256, 4 * self.max_batch)))
+            self.overload = OverloadMonitor(
+                self._overload_levers(),
+                high_fifo_age=high_age,
+                low_fifo_age=_env_f(
+                    "KTPU_OVERLOAD_FIFO_AGE_LOW", high_age * 0.2),
+                high_queue_depth=high_q,
+                low_queue_depth=int(_env_f(
+                    "KTPU_OVERLOAD_QUEUE_DEPTH_LOW", high_q // 4)),
+                # stage-latency signal is opt-in: per-stage p99 is
+                # workload-shaped, the deployment sets the water mark
+                high_stage_p99=_env_f("KTPU_OVERLOAD_STAGE_P99", 0.0),
+                shed_dwell=int(_env_f("KTPU_OVERLOAD_SHED_DWELL", 3)),
+                restore_dwell=int(_env_f(
+                    "KTPU_OVERLOAD_RESTORE_DWELL", 8)),
+                cooldown=_env_f("KTPU_OVERLOAD_COOLDOWN", 1.0),
+                on_shed=lambda what, sig: self._health_event(
+                    "Warning", "OverloadShed",
+                    f"host overload: shed {what} ({sig})"),
+                on_restore=lambda what, sig: self._health_event(
+                    "Normal", "OverloadRestore",
+                    f"host pressure cleared: restored {what}"),
+            )
+            configz.install_knobs(
+                "ktpu",
+                overload=True,
+                overload_fifo_age=self.overload.high_fifo_age,
+                overload_fifo_age_low=self.overload.low_fifo_age,
+                overload_queue_depth=self.overload.high_queue_depth,
+                overload_queue_depth_low=self.overload.low_queue_depth,
+                overload_stage_p99=self.overload.high_stage_p99,
+                overload_shed_dwell=self.overload.shed_dwell,
+                overload_restore_dwell=self.overload.restore_dwell,
+                overload_cooldown=self.overload.cooldown,
+                overload_levers=[
+                    name for name, _, _ in self.overload.levers],
+            )
+        else:
+            configz.install_knobs("ktpu", overload=False)
         self._add_event_handlers()
+
+    def _overload_levers(self) -> List[Tuple]:
+        """The fixed shed order, cheapest-loss first: each lever is
+        (name, shed, restore) and touches only OPTIONAL work — the
+        explain decode, the parity sentinel's sample rate, the flight
+        recorder, dispatch speculation. None of them can change a
+        placement; none tears down the live device session (that is the
+        point: shedding must cost ~nothing, see
+        TPUBackend.set_shadow_rate_only)."""
+        from ..utils import configz
+
+        tpu = self.tpu
+        saved = self._shed_saved
+
+        def shed_explain():
+            tpu.explain_harvest = False
+
+        def restore_explain():
+            tpu.explain_harvest = True
+
+        def shed_shadow():
+            saved["shadow"] = tpu.shadow_sample
+            tpu.set_shadow_rate_only(0.0)
+
+        def restore_shadow():
+            tpu.set_shadow_rate_only(saved.pop("shadow", 0.0))
+
+        def shed_trace():
+            saved["trace"] = tracing.level()
+            tracing.set_level(0)
+            configz.install_knobs("ktpu", trace_level=0)
+
+        def restore_trace():
+            lvl = saved.pop("trace", 0)
+            tracing.set_level(lvl)
+            configz.install_knobs("ktpu", trace_level=lvl)
+
+        def shed_speculation():
+            saved["speculation"] = tpu.speculation
+            tpu.speculation = False
+            configz.install_knobs("ktpu", speculation=False)
+
+        def restore_speculation():
+            spec = saved.pop("speculation", True)
+            tpu.speculation = spec
+            configz.install_knobs("ktpu", speculation=spec)
+
+        return [
+            ("explain-harvest", shed_explain, restore_explain),
+            ("shadow-sample", shed_shadow, restore_shadow),
+            ("trace", shed_trace, restore_trace),
+            ("speculation", shed_speculation, restore_speculation),
+        ]
 
     def _health_event(self, event_type: str, reason: str,
                       message: str) -> None:
@@ -511,6 +632,11 @@ class Scheduler:
                 if now - last_cleanup >= 1.0:  # cache.go:125 1s cleanup ticker
                     last_cleanup = now
                     self.cache.cleanup_expired_assumed_pods()
+                    active, backoff, unsched = self.queue.depths()
+                    metrics.pending_pods.set(active, queue="active")
+                    metrics.pending_pods.set(backoff, queue="backoff")
+                    metrics.pending_pods.set(
+                        unsched, queue="unschedulable")
             except Exception:  # keep the loop alive; scheduleOne logs errors
                 traceback.print_exc()
 
@@ -555,9 +681,18 @@ class Scheduler:
         return True
 
     def _skip(self, pod: v1.Pod) -> bool:
-        """scheduler.go:620 skipPodSchedule: deleted or already assumed."""
+        """scheduler.go:620 skipPodSchedule: deleted or already assumed.
+        A pod ABSENT from the informer cache is deleted too: its delete
+        event raced the pod's in-flight window (popped at delete time,
+        so queue.delete was a no-op) and a failed bind re-queued it
+        afterwards — scheduling it again would 404-bind and re-queue
+        forever, a ghost entry cycling the queue (the reference's
+        MakeDefaultErrorFunc drops exactly this case; surfaced by the
+        soak's queue-returns-to-baseline invariant under delete churn)."""
         current = self.informers.pods().get(meta_namespace_key(pod))
-        if current is not None and current.metadata.deletion_timestamp is not None:
+        if current is None:
+            return True
+        if current.metadata.deletion_timestamp is not None:
             return True
         return self.cache.is_assumed_pod(pod)
 
@@ -640,6 +775,12 @@ class Scheduler:
         # The device double-buffers (tpu.max_pending); the worker
         # preserves dispatch order. Depth 0 completes inline — the
         # sequential reference path the parity gate compares against.
+        # latch the basis BEFORE dispatch: a foreign event landing
+        # between the latch and the session's delta fold is in the carry
+        # but reads as "advanced" at completion — a conservative audit
+        # skip. Latching after would invert that into false drift.
+        basis_gen = (self.cache.foreign_mutations(),
+                     self._dropped_decisions)
         try:
             handle = self.tpu.dispatch_many([i.pod for i in todo])
         except Exception:  # noqa: BLE001 — the backend recovers its own
@@ -649,8 +790,9 @@ class Scheduler:
             for info in todo:
                 self.queue.add(info.pod)
             return
+        handle.basis_mutations = basis_gen
         if self.pipeline_depth <= 0:
-            self._complete_batch(todo, handle, cycle)
+            self._complete_batch(todo, handle, cycle, _time.monotonic())
             return
         with self._completion_cv:
             if self._completion_thread is None:
@@ -661,7 +803,10 @@ class Scheduler:
                     name="batch-completions", daemon=True,
                 )
                 self._completion_thread.start()
-            self._completions.append((todo, handle, cycle))
+            # the enqueue timestamp rides the FIFO item: queue-to-
+            # completion age is the overload monitor's primary signal
+            self._completions.append((todo, handle, cycle,
+                                      _time.monotonic()))
             self._completion_cv.notify_all()
             # backpressure: the assume/bind lag stays bounded by the
             # pipeline depth (an unbounded queue would let the cache
@@ -796,7 +941,38 @@ class Scheduler:
                 self.queue.add(info.pod)
             return False
 
-    def _complete_batch(self, todo: List, handle, cycle: int) -> None:
+    def _complete_batch(self, todo: List, handle, cycle: int,
+                        enq_ts: Optional[float] = None) -> None:
+        # overload injection seam (ChaosMonkey kind="overload"): a
+        # transient completion-worker stall, the synthetic form of the
+        # host falling behind. Before harvest so the whole batch ages.
+        if self.faults is not None:
+            self.faults.on_completion()
+        t0 = _time.monotonic()
+        try:
+            self._complete_batch_inner(todo, handle, cycle)
+        finally:
+            now = _time.monotonic()
+            self._completion_durations.append(now - t0)
+            age = (now - enq_ts) if enq_ts is not None else 0.0
+            depth = len(self._completions)
+            metrics.completion_fifo_depth.set(depth)
+            metrics.completion_fifo_age.set(age)
+            if self.overload is not None:
+                # completion-stage p99 over the recent window — the
+                # same seam the PR-8 recorder spans as stage=complete
+                durs = sorted(self._completion_durations)
+                p99 = durs[int(0.99 * (len(durs) - 1))] if durs else 0.0
+                active, backoff, unsched = self.queue.depths()
+                self.overload.observe(
+                    fifo_depth=depth,
+                    fifo_age=age,
+                    queue_depth=active + backoff,
+                    stage_p99=p99,
+                )
+
+    def _complete_batch_inner(self, todo: List, handle,
+                              cycle: int) -> None:
         results = self.tpu.harvest(handle)
         by_key = {v1.pod_key(p): node for p, node in results}
         from .tpu_backend import RETRY_NODE
@@ -818,7 +994,11 @@ class Scheduler:
             node = by_key.get(v1.pod_key(info.pod))
             if node == RETRY_NODE:
                 # volume gate/encode race: not unschedulable — re-gate
-                # on the next pop instead of parking for the flusher
+                # on the next pop instead of parking for the flusher.
+                # Counts as a dropped decision for the sentinel's basis
+                # gate: a recovery-abandoned batch resolves RETRY while
+                # overlapping flights chained on its carry.
+                self._dropped_decisions += 1
                 self.queue.add(info.pod)
             elif node is None:
                 failed.append(info)
@@ -836,9 +1016,14 @@ class Scheduler:
 
         Runs on the completion worker BEFORE this batch's assumes land,
         so the cache holds exactly what the device carry held when the
-        batch dispatched (modulo informer events that raced the flight —
-        a documented false-positive source; the frozen repro bundle and
-        scripts/replay_drift.py adjudicate). Pod i of the batch decided
+        batch dispatched. Informer events that raced the flight would
+        break that equality — the stale-basis gate (the handle's
+        dispatch-latched foreign-mutation generation vs the cache's now)
+        voids those audits (scheduler_shadow_skips_total{reason=
+        "stale-basis"}) instead of reporting drift the device never
+        caused; under completion lag (overload stalls, crash recovery)
+        coverage drops but the zero-drift invariant stays meaningful.
+        Pod i of the batch decided
         against the carry plus pods 0..i-1 of its own batch, so each
         sampled pod gets a private Snapshot with those prefix decisions
         cloned in — the shared cache NodeInfos are never touched.
@@ -866,6 +1051,18 @@ class Scheduler:
         # bookkeeping lives in the cache, and consuming it here would
         # starve the scheduling thread's own snapshot refreshes
         base_nodes, base_pods = self.cache.dump()
+        basis = getattr(handle, "basis_mutations", None)
+        if basis is not None and (self.cache.foreign_mutations(),
+                                  self._dropped_decisions) != basis:
+            # stale-basis gate, checked AFTER the dump so nothing can
+            # land between the check and the read: either the cluster
+            # moved under this flight (foreign event, expiry, forget) or
+            # an overlapping in-flight batch dropped a decided placement
+            # the chained carry had — in both cases the dump is not the
+            # decision-time state. Void the audit, keep the drift
+            # counter honest.
+            metrics.shadow_skips.inc(len(sampled), reason="stale-basis")
+            return
         node_names = handle.node_names or []
         for i in sampled:
             pod, node = results[i]
@@ -1339,7 +1536,11 @@ class Scheduler:
             for (info, node), assumed, assumed_ok in zip(
                     bound, assumed_list, ok):
                 if not assumed_ok:
-                    continue  # already in cache (informer raced us)
+                    # already in cache (informer raced us): the device
+                    # carry keeps this placement, the cache never takes
+                    # it — void overlapping shadow audits
+                    self._dropped_decisions += 1
+                    continue
                 state = CycleState()
                 if self._reserve_and_permit(
                         state, assumed, node, info) == "bind":

@@ -92,7 +92,7 @@ class _BatchHandle:
 
     __slots__ = ("group", "ys", "decide", "node_names", "results",
                  "deadline", "bucket", "timed_out", "speculative",
-                 "conflicts", "prov", "explain")
+                 "conflicts", "prov", "explain", "basis_mutations")
 
     def __init__(self, group: List[v1.Pod]):
         self.group = group
@@ -129,6 +129,13 @@ class _BatchHandle:
         # with `group`. None with explain off — same allocation contract
         # as prov — and None on sessions without explain support
         self.explain: Optional[List[Dict]] = None
+        # (cache foreign-mutation generation, scheduler dropped-decision
+        # count) latched just before dispatch: the shadow sentinel's
+        # stale-basis gate — if either advanced by completion time, the
+        # oracle replay would run against a cluster the device never
+        # decided on, so the audit is skipped (counted) instead of
+        # reporting false drift
+        self.basis_mutations: Optional[Tuple[int, int]] = None
 
 
 class TPUBackend(CacheListener):
@@ -285,6 +292,12 @@ class TPUBackend(CacheListener):
         )
         self.explain_topk = max(1, int(
             os.environ.get("KTPU_EXPLAIN_TOPK", "3")))
+        # overload-shed lever (scheduler/degradation.OverloadMonitor):
+        # False = the device still computes explain outputs (the session
+        # shape is untouched — no teardown) but the host SKIPS the
+        # attribution decode at harvest, shedding the decode cost while
+        # overloaded. Decision columns are decoded either way.
+        self.explain_harvest = True
         # flight-recorder provenance context: the last session build
         # ("kind/reason") and the last teardown reason — what the
         # per-pod provenance records (KTPU_TRACE=2) report as the
@@ -349,6 +362,21 @@ class TPUBackend(CacheListener):
                 "ktpu", explain=self.explain,
                 shadow_sample=self.shadow_sample,
             )
+
+    def set_shadow_rate_only(self, rate: float) -> None:
+        """Overload-shed path for the sentinel: change the sample rate
+        WITHOUT re-deriving explain mode. set_shadow_sample tears down a
+        live session when the rate transition flips explain ("explain-
+        toggle" rebuild) — exactly wrong under overload, where the point
+        of shedding is to spend LESS. Leaving `explain` as resolved at
+        arm time keeps the session shape (and therefore decisions)
+        bit-identical; the completion worker just stops drawing samples
+        while the rate is 0."""
+        from ..utils import configz
+
+        with self._lock:
+            self.shadow_sample = min(1.0, max(0.0, float(rate)))
+            configz.install_knobs("ktpu", shadow_sample=self.shadow_sample)
 
     def set_volume_resolver(self, resolver) -> None:
         """Enable the volume device path: bound-PVC pods encode their PV
@@ -1505,7 +1533,8 @@ class TPUBackend(CacheListener):
             # the bucket proved itself (through jit while quarantined):
             # future session rebuilds may AOT it again
             self._suspect_buckets.discard(h.bucket)
-        if self.explain and isinstance(ys, dict) and "expl_bits" in ys:
+        if (self.explain and self.explain_harvest
+                and isinstance(ys, dict) and "expl_bits" in ys):
             try:
                 h.explain = HoistedSession.explain_payload(ys)
             except Exception:  # noqa: BLE001 — attribution must never

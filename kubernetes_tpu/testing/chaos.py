@@ -37,9 +37,12 @@ CRASH_KINDS = ("crash-apiserver", "crash-controller")
 #: the device/scheduler fault kinds (opt-in): `wedge-device` arms one
 #: dispatch-level fault (raise / NaN harvest / wedged wait) on the TPU
 #: backend's FaultInjector; `crash-scheduler` kills one pipeline worker
-#: thread (scheduling loop or completion worker). Both no-op on clusters
-#: without a TPU-backed scheduler.
-FAULT_KINDS = ("wedge-device", "crash-scheduler")
+#: thread (scheduling loop or completion worker); `overload` makes the
+#: host transiently SLOW — a completion-worker stall wave or a synthetic
+#: event burst — so the overload monitor's shed→restore cycle gets
+#: exercised (the endurance soak's signature disruption). All no-op on
+#: clusters without a TPU-backed scheduler.
+FAULT_KINDS = ("wedge-device", "crash-scheduler", "overload")
 
 
 class ChaosMonkey:
@@ -88,6 +91,7 @@ class ChaosMonkey:
             "crash-controller": self._crash_controller,
             "wedge-device": self._wedge_device,
             "crash-scheduler": self._crash_scheduler,
+            "overload": self._overload,
         }[kind]
         d = fn()
         if d is not None:
@@ -203,13 +207,50 @@ class ChaosMonkey:
         inj.arm(kind, shots=1)
         return Disruption("crash-scheduler", kind)
 
+    def _overload(self) -> Optional[Disruption]:
+        """Make the host transiently SLOW (not dead): either arm a wave
+        of completion-worker stalls — the FIFO ages, the overload
+        monitor must shed optional work and restore once the wave passes
+        — or fire a synthetic event burst (no-op annotation bumps on a
+        slab of pods) that floods every informer/watcher with MODIFIED
+        events, exercising queue depth and the wire's slow-consumer
+        path. Placements must be untouched either way."""
+        inj = self._fault_injector()
+        if inj is None:
+            return None
+        if self.rng.random() < 0.7:
+            # a wave of stalled batches, long enough to out-dwell the
+            # monitor's shed threshold
+            inj.arm("stall-completion", shots=6)
+            return Disruption("overload", "stall-completion")
+        pods, _ = self.cluster.client.pods.list(namespace="default")
+        victims = [p for p in pods if p.metadata.deletion_timestamp is None]
+        self.rng.shuffle(victims)
+        burst = 0
+        for p in victims[:50]:
+            ann = dict(p.metadata.annotations or {})
+            ann["chaos/overload-burst"] = str(time.time())
+            p.metadata.annotations = ann
+            try:
+                self.cluster.client.pods.update(p)
+                burst += 1
+            except Exception:  # noqa: BLE001 — racing deletes are fine
+                pass
+        return Disruption("overload", f"event-burst:{burst}")
+
     # -- assertions ---------------------------------------------------------
 
     def restart_all_dead(self, timeout: float = 30.0) -> None:
         """End the experiment with every component back: kubelets
         restarted (fresh process over the same node), crashed controller
-        loops re-running under their supervisor, and the apiserver store
-        healthy (crash() recovers in place, so it already is)."""
+        loops re-running under their supervisor, the apiserver store
+        healthy (crash() recovers in place, so it already is), and any
+        still-armed overload stall wave disarmed so the monitor's
+        restore path can run."""
+        sched = getattr(self.cluster, "scheduler", None)
+        inj = getattr(sched, "faults", None) if sched is not None else None
+        if inj is not None:
+            inj.disarm("stall-completion")
         while self._dead:
             self._restart_kubelet()
         sup = getattr(getattr(self.cluster, "kcm", None), "supervisor", None)

@@ -22,6 +22,12 @@ Kinds:
                    watchdog fires (hung collective / preempted chip)
   kill-scheduler   the scheduling loop thread dies at its next iteration
   kill-completion  the completion worker dies before its next batch
+  stall-completion the completion worker sleeps `stall_delay` seconds
+                   before its next batch — a transient SLOW host (GC
+                   pause, noisy neighbor, audit tax), not a dead one.
+                   The overload monitor must see the FIFO age climb,
+                   shed optional work, and restore once shots run out
+                   (the ChaosMonkey "overload" disruption's engine)
 
 Faults are armed with a shot count (`-1` = until disarm) and optionally a
 `min_rung` (scheduler/degradation.py rung constants): a pallas-only
@@ -46,6 +52,7 @@ KINDS = (
     "wedge-wait",
     "kill-scheduler",
     "kill-completion",
+    "stall-completion",
 )
 
 
@@ -67,6 +74,8 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._armed: Dict[str, _Armed] = {}
         self.injected: Dict[str, int] = {}
+        # per-batch sleep while stall-completion is armed (seconds)
+        self.stall_delay = 0.25
 
     # -- arming ------------------------------------------------------------
 
@@ -166,6 +175,17 @@ class FaultInjector:
         """worker = "scheduler" | "completion"; True means the caller
         must die now (it raises scheduler.WorkerKilled)."""
         return self._take(f"kill-{worker}")
+
+    def on_completion(self) -> None:
+        """Called at the top of every batch completion. While
+        stall-completion is armed the worker sleeps stall_delay per
+        batch (one shot = one stalled batch) — the synthetic form of a
+        host that is ALIVE but too slow, which is what the overload
+        monitor sheds against."""
+        if self._take("stall-completion"):
+            import time
+
+            time.sleep(self.stall_delay)
 
 
 class BindIntegrityChecker:

@@ -70,5 +70,9 @@ def metricsz_body() -> str:
     separate metrics server; the import is deferred because configz is
     otherwise metrics-free."""
     from . import metrics as metrics_mod
+    from . import selfstats
 
+    # process self-telemetry (RSS/fds/threads) refreshes at scrape time:
+    # always-current gauges with no background sampler thread
+    selfstats.refresh()
     return metrics_mod.legacy_registry.expose()

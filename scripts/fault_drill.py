@@ -99,6 +99,8 @@ def main() -> int:
     restarts0 = counter_total(metrics.worker_restarts)
     faults0 = {k: val for k, val in metrics.device_faults.items()}
     dumps0 = counter_total(metrics.trace_dumps)
+    sheds0 = counter_total(metrics.overload_sheds)
+    restores0 = counter_total(metrics.overload_restores)
     ndumps0 = len(tracing.RECORDER.dump_history)
     drift0 = counter_total(metrics.parity_drift)
 
@@ -137,7 +139,7 @@ def main() -> int:
         monkey = ChaosMonkey(
             c, period=args.period, rng=rng,
             disruptions=[
-                "wedge-device", "crash-scheduler",
+                "wedge-device", "crash-scheduler", "overload",
                 "kill-kubelet", "restart-kubelet", "delete-pod",
             ],
         )
@@ -188,6 +190,12 @@ def main() -> int:
         print(f"ladder:           demotions={tpu.ladder.demotions} "
               f"re-promotions={tpu.ladder.promotions} "
               f"final={tpu.ladder.mode()}")
+        ov = c.scheduler.overload
+        print(f"overload:         "
+              f"sheds={counter_total(metrics.overload_sheds) - sheds0:.0f} "
+              f"restores="
+              f"{counter_total(metrics.overload_restores) - restores0:.0f} "
+              f"level={ov.level() if ov is not None else 'off'}")
         print(f"final bind count: {bound}/{args.replicas}")
 
         if args.dump_trace:

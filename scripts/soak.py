@@ -1,0 +1,327 @@
+"""Endurance soak: sustained-churn ChaosMonkey run in the PRODUCTION
+shape, gated by invariant monitors.
+
+This is the "run it as it would be in production, not as a drill"
+harness (ROADMAP wire/soak item): shadow parity sentinel sampling at the
+production rate (KTPU_SHADOW_SAMPLE=0.01), flight recorder ON
+(KTPU_TRACE=1), pipeline depth 2, a ReplicaSet keeping the workload
+churning, and a ChaosMonkey mixing workload churn with the `overload`
+disruption (completion-worker stall waves / synthetic event bursts) so
+the host-overload monitor's shed→restore cycle is exercised for real.
+
+The invariant monitors (kubernetes_tpu/testing/invariants.py) read
+/metricsz — the operator surface, not scheduler internals — and assert:
+
+  zero shadow drift            scheduler_parity_drift_total flat
+  zero expired assumes         scheduler_cache_expired_assumes_total flat
+  zero lost / double binds     BindIntegrityChecker + final convergence
+  stage p99 flatness           windowed p99 of the scheduling-attempt
+                               histogram, first third vs last third
+  bounded RSS/fd/thread growth process_* gauges, first vs last third
+  queue returns to baseline    scheduler_pending_pods after the chaos
+  no assume outlives its TTL   scheduler_cache_oldest_assume_seconds
+
+Any violation exits nonzero and writes a triage bundle (trace-ring dump
++ metrics snapshots + report.json). The run must also show at least one
+FULL shed→restore cycle under the injected overload (pass
+--allow-no-shed to waive, e.g. on hardware fast enough to never shed).
+
+CI/chip gate contract:  python scripts/soak.py --seconds 60
+exits 0 iff every invariant held AND a full shed→restore cycle ran.
+"""
+
+import argparse
+import os
+import random
+import sys
+import time
+
+# the PRODUCTION shape, resolved before kubernetes_tpu imports: shadow
+# sentinel at the production sample rate, flight recorder on, CPU lane
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+os.environ.setdefault("KTPU_SHADOW_SAMPLE", "0.01")
+os.environ.setdefault("KTPU_TRACE", "1")
+# overload water marks scaled to a CPU soak: the stall wave (0.6 s per
+# batch) must out-age the high mark for the shed dwell, and calm churn
+# must restore within one inter-wave gap
+os.environ.setdefault("KTPU_OVERLOAD_FIFO_AGE", "0.3")
+os.environ.setdefault("KTPU_OVERLOAD_SHED_DWELL", "2")
+os.environ.setdefault("KTPU_OVERLOAD_RESTORE_DWELL", "4")
+os.environ.setdefault("KTPU_OVERLOAD_COOLDOWN", "0.5")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.api import apps, types as v1  # noqa: E402
+from kubernetes_tpu.cluster import Cluster  # noqa: E402
+from kubernetes_tpu.scheduler import metrics  # noqa: E402
+from kubernetes_tpu.testing import invariants as inv  # noqa: E402
+from kubernetes_tpu.testing.chaos import ChaosMonkey  # noqa: E402
+from kubernetes_tpu.testing.faults import (  # noqa: E402
+    BindIntegrityChecker,
+    FaultInjector,
+)
+
+
+def wait_until(fn, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def deployment(name: str, replicas: int) -> apps.Deployment:
+    return apps.Deployment(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        spec=apps.DeploymentSpec(
+            replicas=replicas,
+            selector=v1.LabelSelector(match_labels={"app": name}),
+            template=apps.PodTemplateSpec(
+                metadata=v1.ObjectMeta(labels={"app": name}),
+                spec=v1.PodSpec(containers=[v1.Container(
+                    name="c", image="img:1",
+                    resources=v1.ResourceRequirements(requests={"cpu": "20m"}),
+                )]),
+            ),
+        ),
+    )
+
+
+def build_suite(checker: BindIntegrityChecker, assume_ttl: float):
+    return inv.InvariantSuite([
+        inv.CounterFlat("scheduler_parity_drift_total",
+                        label="zero-shadow-drift"),
+        inv.CounterFlat("scheduler_cache_expired_assumes_total",
+                        label="zero-expired-assumes"),
+        inv.Callback("zero-double-binds",
+                     lambda: list(checker.violations)),
+        inv.HistogramP99Flat(
+            "scheduler_pod_scheduling_attempt_duration_seconds",
+            ratio=8.0, floor=0.02, label="stage-p99-flat"),
+        inv.BoundedGrowth("process_resident_memory_bytes",
+                          max_frac=0.35, label="rss-growth"),
+        inv.BoundedGrowth("process_open_fds", max_abs=32,
+                          label="fd-growth"),
+        inv.BoundedGrowth("process_threads", max_abs=16,
+                          label="thread-growth"),
+        inv.GaugeBaseline("scheduler_pending_pods", slack=4,
+                          label="queue-returns-to-baseline"),
+        inv.GaugeBaseline("apiserver_watchers", slack=0,
+                          label="watchers-return-to-baseline"),
+        inv.GaugeCeiling("scheduler_cache_oldest_assume_seconds",
+                         ceiling=assume_ttl + 5.0,
+                         label="no-assume-outlives-ttl"),
+    ])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seconds", type=float, default=60.0,
+                    help="chaos window duration (hours-capable)")
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--replicas", type=int, default=12)
+    ap.add_argument("--period", type=float, default=0.25,
+                    help="disruption period")
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--sample-every", type=float, default=0.5,
+                    help="invariant /metricsz sample cadence")
+    ap.add_argument("--bundle-dir", default="soak_failure_bundle",
+                    help="where the triage bundle lands on failure")
+    ap.add_argument("--allow-no-shed", action="store_true",
+                    help="do not require a full shed->restore cycle "
+                         "(hardware fast enough to never overload)")
+    args = ap.parse_args()
+
+    rng = random.Random(args.seed)
+    inj = FaultInjector()
+    inj.stall_delay = 0.6  # one stalled batch must out-age the high mark
+    failures = []
+
+    with Cluster(
+        n_nodes=args.nodes,
+        controllers=["replicaset", "deployment", "nodelifecycle"],
+        controller_opts={
+            "node_monitor_period": 0.3,
+            "node_monitor_grace_period": 2.0,
+        },
+        fault_injector=inj,
+    ) as c:
+        sched = c.scheduler
+        tpu = sched.tpu
+        if tpu is None or sched.overload is None:
+            print("FAIL: soak needs the TPU scheduler backend with the "
+                  "overload monitor enabled")
+            return 1
+        tpu.watchdog_timeout = 0.5
+        tpu.retry_base = 0.01
+        tpu.ladder._probe_interval = 0.1
+        tpu.ladder._probe_delay = 0.1
+        checker = BindIntegrityChecker().attach(c.kcm.informers.pods())
+        c.client.resource("deployments").create(
+            deployment("soak", args.replicas))
+
+        def n_running():
+            pods, _ = c.client.pods.list(namespace="default")
+            return sum(1 for p in pods if p.status.phase == "Running")
+
+        if not wait_until(lambda: n_running() == args.replicas, timeout=60):
+            print(f"FAIL: initial convergence "
+                  f"({n_running()}/{args.replicas})")
+            return 1
+        print(f"seeded: {args.replicas} replicas on {args.nodes} nodes, "
+              f"shadow_sample={tpu.shadow_sample}, depth="
+              f"{sched.pipeline_depth}, rung={tpu.ladder.mode()}")
+
+        suite = build_suite(checker, assume_ttl=sched.cache._ttl)
+        suite.sample()  # baseline BEFORE the chaos window
+
+        # churn-heavy mix (delete-pod thrice-weighted keeps batches
+        # flowing so the monitor always has completion ticks to
+        # observe), overload every ~6 disruptions on average
+        monkey = ChaosMonkey(
+            c, period=args.period, rng=rng,
+            disruptions=[
+                "delete-pod", "delete-pod", "delete-pod",
+                "overload", "wedge-device", "crash-scheduler",
+            ],
+        )
+        monkey.run()
+        deadline = time.monotonic() + args.seconds
+        while time.monotonic() < deadline:
+            time.sleep(args.sample_every)
+            suite.sample()
+        monkey.stop()
+        inj.disarm()
+        monkey.restart_all_dead(timeout=30)
+
+        ov = sched.overload
+        if ov.cycles < 1 and not args.allow_no_shed:
+            # the random mix never completed a full cycle inside the
+            # window: run one DIRECTED wave so the report always shows
+            # the machinery working end-to-end (stall until shed, clear,
+            # churn until restored)
+            print("no full shed->restore cycle in the random window; "
+                  "running a directed overload wave")
+            inj.arm("stall-completion", shots=50)
+
+            def churn_tick():
+                pods, _ = c.client.pods.list(namespace="default")
+                live = [p for p in pods
+                        if p.metadata.deletion_timestamp is None]
+                if live:
+                    p = rng.choice(live)
+                    c.client.pods.delete(
+                        p.metadata.name, p.metadata.namespace)
+
+            deadline = time.monotonic() + 30
+            while ov.level() == 0 and time.monotonic() < deadline:
+                churn_tick()
+                time.sleep(0.3)
+                suite.sample()
+            inj.disarm("stall-completion")
+            deadline = time.monotonic() + 30
+            while ov.level() > 0 and time.monotonic() < deadline:
+                churn_tick()
+                time.sleep(0.3)
+                suite.sample()
+
+        if not wait_until(lambda: tpu.ladder.rung() >= tpu.ladder.top,
+                          timeout=30):
+            failures.append(
+                f"ladder stuck at {tpu.ladder.mode()} after faults cleared")
+
+        def converged():
+            pods, _ = c.client.pods.list(namespace="default")
+            running = [p for p in pods if p.status.phase == "Running"]
+            return (len(running) == args.replicas
+                    and len(pods) == args.replicas)
+
+        if not wait_until(converged, timeout=90):
+            failures.append(
+                f"lost pods: {args.replicas - n_running()} replicas "
+                f"missing after recovery")
+        # settle, then close the invariant window (queue/watcher
+        # baselines are judged on the LAST sample)
+        time.sleep(2.0)
+        violations = suite.finish()
+        failures.extend(violations)
+
+        if ov.cycles < 1 and not args.allow_no_shed:
+            failures.append(
+                "no full shed->restore cycle ran (overload never "
+                "triggered; tune KTPU_OVERLOAD_* or --allow-no-shed)")
+        if ov.level() > 0:
+            failures.append(
+                f"levers still shed at soak end: {ov.shed_names()}")
+
+        by_kind = {}
+        for d in monkey.history:
+            by_kind[d.kind] = by_kind.get(d.kind, 0) + 1
+        print("--- soak report ---")
+        print(f"window:            {args.seconds:.0f}s chaos, "
+              f"{len(suite.samples)} invariant samples")
+        print(f"disruptions:       {by_kind}")
+        print(f"faults injected:   {dict(inj.injected)}")
+        print(f"overload cycles:   {ov.cycles} full shed->restore "
+              f"(final level {ov.level()})")
+        for t, action, what, sig in ov.history:
+            print(f"  {action:7s} {what:16s} fifo_age={sig['fifo_age']} "
+                  f"queue={sig['queue_depth']}")
+        shadow = inv.total(suite.samples[-1][1],
+                           "scheduler_shadow_samples_total")
+        skips = inv.total(suite.samples[-1][1],
+                          "scheduler_shadow_skips_total")
+        print(f"shadow samples:    {shadow:.0f} audited, {skips:.0f} "
+              f"voided stale-basis (drift must be 0: see invariants)")
+        print("invariants:        "
+              + ("ALL HELD" if not violations else "VIOLATED"))
+        for v in violations:
+            print(f"  VIOLATION: {v}")
+
+        if failures:
+            # queue post-mortem: for every entry still parked in the
+            # scheduling queue, what does the apiserver think that pod
+            # IS right now? (a Running/absent pod here = a stale entry)
+            live = {}
+            for p in c.client.pods.list(namespace="default")[0]:
+                live[f"{p.metadata.namespace}/{p.metadata.name}"] = {
+                    "phase": p.status.phase,
+                    "node": p.spec.node_name,
+                    "deleting": p.metadata.deletion_timestamp is not None,
+                }
+            active, backoff, unsched = sched.queue.depths()
+            queue_dump = {
+                "depths": {"active": active, "backoff": backoff,
+                           "unschedulable": unsched},
+                "entries": [
+                    {"key": f"{p.metadata.namespace}/{p.metadata.name}",
+                     "live": live.get(
+                         f"{p.metadata.namespace}/{p.metadata.name}",
+                         "ABSENT")}
+                    for p in sched.queue.pending_pods()
+                ],
+            }
+            bundle = suite.bundle(
+                args.bundle_dir, extra={
+                    "failures": failures,
+                    "disruptions": by_kind,
+                    "queue": queue_dump,
+                    "overload_history": [
+                        (a, w, s) for _, a, w, s in ov.history],
+                })
+            print(f"triage bundle:     {bundle}/ (trace.json, "
+                  f"metrics_first/last.json, report.json)")
+
+    if failures:
+        print("FAIL:\n  " + "\n  ".join(failures))
+        return 1
+    print("PASS: production-shape soak held every invariant "
+          "(zero drift, zero lost binds, flat p99s, no leaks) "
+          f"with {metrics.overload_level.value():.0f} levers shed at exit")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,255 @@
+"""Deterministic endurance slice: the soak's machinery in tier-1 time.
+
+scripts/soak.py is the hours-capable harness; this is its CI-sized
+deterministic core (~10s): a live cluster in the production shape,
+directed `overload` waves (completion-worker stall) driving the
+host-overload monitor through at least one FULL shed->restore cycle,
+workload churn throughout, and the same invariant library
+(testing/invariants.py) reading /metricsz over the run — zero shadow
+drift, zero expired assumes, zero double binds, bounded thread/fd
+growth, queue back to baseline, no assume outliving its TTL.
+
+The `slow` variant runs the same body under a randomized ChaosMonkey
+mix for a longer window (the soak's shape, pytest-managed):
+
+    pytest tests/test_endurance.py -m slow
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from kubernetes_tpu.api import apps, types as v1
+from kubernetes_tpu.cluster import Cluster
+from kubernetes_tpu.testing import invariants as inv
+from kubernetes_tpu.testing.chaos import ChaosMonkey
+from kubernetes_tpu.testing.faults import BindIntegrityChecker, FaultInjector
+
+
+def _wait(fn, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _deployment(name: str, replicas: int) -> apps.Deployment:
+    return apps.Deployment(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        spec=apps.DeploymentSpec(
+            replicas=replicas,
+            selector=v1.LabelSelector(match_labels={"app": name}),
+            template=apps.PodTemplateSpec(
+                metadata=v1.ObjectMeta(labels={"app": name}),
+                spec=v1.PodSpec(containers=[v1.Container(
+                    name="c", image="img:1",
+                    resources=v1.ResourceRequirements(
+                        requests={"cpu": "20m"}),
+                )]),
+            ),
+        ),
+    )
+
+
+def _suite(checker, assume_ttl):
+    """The soak's invariant set minus the long-window-only monitors
+    (RSS and p99 flatness need a window this slice doesn't have)."""
+    return inv.InvariantSuite([
+        inv.CounterFlat("scheduler_parity_drift_total",
+                        label="zero-shadow-drift"),
+        inv.CounterFlat("scheduler_cache_expired_assumes_total",
+                        label="zero-expired-assumes"),
+        inv.Callback("zero-double-binds",
+                     lambda: list(checker.violations)),
+        inv.BoundedGrowth("process_open_fds", max_abs=32,
+                          label="fd-growth"),
+        inv.BoundedGrowth("process_threads", max_abs=16,
+                          label="thread-growth"),
+        inv.GaugeBaseline("scheduler_pending_pods", slack=4,
+                          label="queue-returns-to-baseline"),
+        inv.GaugeCeiling("scheduler_cache_oldest_assume_seconds",
+                         ceiling=assume_ttl + 5.0,
+                         label="no-assume-outlives-ttl"),
+    ])
+
+
+def _endurance_body(seconds: float, directed: bool, seed: int = 11):
+    rng = random.Random(seed)
+    inj = FaultInjector()
+    inj.stall_delay = 0.3
+    replicas = 8
+    with Cluster(
+        n_nodes=3,
+        controllers=["replicaset", "deployment", "nodelifecycle"],
+        controller_opts={
+            "node_monitor_period": 0.3,
+            "node_monitor_grace_period": 2.0,
+        },
+        fault_injector=inj,
+    ) as c:
+        sched = c.scheduler
+        tpu = sched.tpu
+        assert tpu is not None and sched.overload is not None
+        tpu.watchdog_timeout = 0.5
+        tpu.retry_base = 0.01
+        tpu.ladder._probe_interval = 0.1
+        tpu.ladder._probe_delay = 0.1
+        ov = sched.overload
+        # CI-speed water marks: one stalled batch (0.3s) out-ages the
+        # high mark; two clean batches restore a lever
+        ov.high_fifo_age = 0.15
+        ov.low_fifo_age = 0.05
+        ov.shed_dwell = 2
+        ov.restore_dwell = 2
+        ov.cooldown = 0.05
+        checker = BindIntegrityChecker().attach(c.kcm.informers.pods())
+        c.client.resource("deployments").create(
+            _deployment("soak", replicas))
+
+        def n_running():
+            pods, _ = c.client.pods.list(namespace="default")
+            return sum(1 for p in pods if p.status.phase == "Running")
+
+        assert _wait(lambda: n_running() == replicas, timeout=60), (
+            f"initial convergence: {n_running()}/{replicas}"
+        )
+        suite = _suite(checker, assume_ttl=sched.cache._ttl)
+        suite.sample()  # baseline
+
+        def churn_tick():
+            pods, _ = c.client.pods.list(namespace="default")
+            live = [p for p in pods
+                    if p.metadata.deletion_timestamp is None]
+            if live:
+                p = rng.choice(live)
+                c.client.pods.delete(p.metadata.name, p.metadata.namespace)
+
+        monkey = None
+        if directed:
+            # one directed wave: stall until shed, clear, churn until
+            # fully restored — a guaranteed full cycle, deterministically
+            inj.arm("stall-completion", shots=12)
+            deadline = time.monotonic() + 20
+            while ov.level() == 0 and time.monotonic() < deadline:
+                churn_tick()
+                time.sleep(0.15)
+                suite.sample()
+            assert ov.level() > 0, "stall wave never tripped a shed"
+            inj.disarm("stall-completion")
+            deadline = time.monotonic() + 25
+            while ov.level() > 0 and time.monotonic() < deadline:
+                churn_tick()
+                time.sleep(0.15)
+                suite.sample()
+            assert ov.level() == 0, (
+                f"levers never restored: {ov.shed_names()}"
+            )
+        else:
+            monkey = ChaosMonkey(
+                c, period=0.25, rng=rng,
+                disruptions=[
+                    "delete-pod", "delete-pod", "delete-pod",
+                    "overload", "wedge-device", "crash-scheduler",
+                ],
+            )
+            monkey.run()
+            deadline = time.monotonic() + seconds
+            while time.monotonic() < deadline:
+                time.sleep(0.5)
+                suite.sample()
+            monkey.stop()
+            inj.disarm()
+            monkey.restart_all_dead(timeout=30)
+            # guarantee the full cycle even if the random mix missed it
+            if ov.cycles < 1:
+                inj.arm("stall-completion", shots=20)
+                deadline = time.monotonic() + 20
+                while ov.level() == 0 and time.monotonic() < deadline:
+                    churn_tick()
+                    time.sleep(0.15)
+                    suite.sample()
+                inj.disarm("stall-completion")
+                deadline = time.monotonic() + 25
+                while ov.level() > 0 and time.monotonic() < deadline:
+                    churn_tick()
+                    time.sleep(0.15)
+                    suite.sample()
+
+        assert _wait(lambda: tpu.ladder.rung() >= tpu.ladder.top,
+                     timeout=30), "ladder stuck after faults cleared"
+
+        def converged():
+            pods, _ = c.client.pods.list(namespace="default")
+            running = [p for p in pods if p.status.phase == "Running"]
+            return (len(running) == replicas
+                    and len(pods) == replicas)
+
+        assert _wait(converged, timeout=60), (
+            f"lost pods: {n_running()}/{replicas} after recovery"
+        )
+        time.sleep(1.0)
+        violations = suite.finish()
+        assert not violations, f"invariants violated: {violations}"
+        assert ov.triggered and ov.cycles >= 1, (
+            f"no full shed->restore cycle (cycles={ov.cycles}, "
+            f"history={[(a, w) for _, a, w, _ in ov.history]})"
+        )
+        assert ov.level() == 0 and not checker.violations
+
+
+def test_ghost_queue_entry_is_dropped():
+    """The stale-queue-entry race the soak's queue-returns-to-baseline
+    invariant surfaced: a pod deleted during its in-flight window
+    (popped, so the delete event's queue.delete was a no-op) and then
+    re-queued by a failed bind must be DROPPED at the next pop — before
+    the _skip fix it was rescheduled, 404-bound, forgotten and
+    re-queued forever, a ghost cycling the queue and pinning
+    scheduler_pending_pods above baseline."""
+    from .test_pipeline_parity import _cluster, _mk_scheduler
+    from .util import make_pod
+
+    api, cs = _cluster(n_nodes=2)
+    sched = _mk_scheduler(cs, depth=0)
+    try:
+        cs.pods.create(make_pod("ghost", namespace="default", cpu="100m"))
+        assert _wait(lambda: sched.queue.num_active() == 1)
+        info = sched.queue.pop(timeout=5)
+        assert info is not None
+        # the delete lands while the pod is in flight: nothing queued,
+        # so the event handler's queue.delete removes nothing
+        cs.pods.delete("ghost", "default")
+        assert _wait(
+            lambda: sched.informers.pods().get("default/ghost") is None)
+        # absent from the informer cache == deleted, even though the
+        # stale pod object carries no deletion_timestamp
+        assert sched._skip(info.pod)
+        # the failed-bind path re-queues it; the next cycle must drop
+        # it on the floor — no dispatch, no assume, queue drained
+        sched.queue.add(info.pod)
+        ghost = sched.queue.pop(timeout=5)
+        assert ghost is not None
+        sched._schedule_batch_tpu([ghost])
+        assert sched._drain_pipeline(timeout=10)
+        assert sched.queue.depths() == (0, 0, 0)
+        assert sched.cache.pod_count() == 0
+    finally:
+        sched.stop()
+        sched.informers.stop()
+
+
+def test_endurance_directed_cycle():
+    """Tier-1: a directed overload wave through a churning cluster —
+    one full shed->restore cycle, every invariant held."""
+    _endurance_body(seconds=0.0, directed=True)
+
+
+@pytest.mark.slow
+def test_endurance_random_mix_long():
+    """The soak's shape under pytest: randomized ChaosMonkey mix for a
+    longer window (still bounded), same invariants, same cycle gate."""
+    _endurance_body(seconds=20.0, directed=False, seed=23)

@@ -1,0 +1,388 @@
+"""Host-overload monitor: hysteresis, lever wiring, decision inertness.
+
+The tentpole contract (ISSUE 11): under sustained host pressure the
+scheduler sheds OPTIONAL work in a fixed order (explain harvest ->
+shadow sample -> trace -> speculation) with hysteretic LIFO restore,
+and none of it can ever change a placement. Pinned here:
+
+  * OverloadMonitor state machine on a fake clock: fixed shed order,
+    LIFO restore, dwell thresholds, the dead band (no flapping),
+    cooldown between transitions, counters/gauge/history bookkeeping;
+  * the real levers on a live scheduler round-trip every knob back to
+    its pre-shed value;
+  * decision inertness: a monitor-on-but-never-triggered run and a
+    monitor-forced-to-full-shed run both produce BIT-IDENTICAL bindings
+    to a KTPU_OVERLOAD=0 control over randomized churn.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from kubernetes_tpu.scheduler import metrics
+from kubernetes_tpu.scheduler.degradation import OverloadMonitor
+from kubernetes_tpu.utils import tracing
+
+from .test_pipeline_parity import (
+    _bound_map,
+    _cluster,
+    _drive,
+    _mk_scheduler,
+    _pod_stream,
+)
+from .util import make_pod
+
+
+def _label_counts(counter):
+    out = {}
+    for key, val in counter.items():
+        slug = key[0] if key else "-"
+        out[slug] = out.get(slug, 0) + int(val)
+    return out
+
+
+def _mk_monitor(events, n_levers=4, **kw):
+    names = ["a", "b", "c", "d"][:n_levers]
+    levers = [
+        (
+            name,
+            (lambda n=name: events.append(("shed", n))),
+            (lambda n=name: events.append(("restore", n))),
+        )
+        for name in names
+    ]
+    t = [0.0]
+    kw.setdefault("high_fifo_age", 1.0)
+    kw.setdefault("low_fifo_age", 0.2)
+    kw.setdefault("high_queue_depth", 100)
+    kw.setdefault("low_queue_depth", 10)
+    kw.setdefault("shed_dwell", 2)
+    kw.setdefault("restore_dwell", 2)
+    kw.setdefault("cooldown", 0.0)
+    mon = OverloadMonitor(levers, now=lambda: t[0], **kw)
+    return mon, t
+
+
+def _hot(mon, t, n=1, **kw):
+    out = []
+    for _ in range(n):
+        t[0] += 0.1
+        out.append(mon.observe(fifo_age=5.0, **kw))
+    return out
+
+
+def _calm(mon, t, n=1):
+    out = []
+    for _ in range(n):
+        t[0] += 0.1
+        out.append(mon.observe(fifo_age=0.0, queue_depth=0))
+    return out
+
+
+def _mid(mon, t, n=1):
+    """Between the water marks: neither hot nor calm (the dead band)."""
+    out = []
+    for _ in range(n):
+        t[0] += 0.1
+        out.append(mon.observe(fifo_age=0.5, queue_depth=50))
+    return out
+
+
+class TestOverloadMonitor:
+    def test_fixed_shed_order_and_lifo_restore(self):
+        events = []
+        mon, t = _mk_monitor(events)
+        _hot(mon, t, 8)
+        assert [e for e in events if e[0] == "shed"] == [
+            ("shed", "a"), ("shed", "b"), ("shed", "c"), ("shed", "d")]
+        assert mon.level() == 4
+        assert mon.shed_names() == ["a", "b", "c", "d"]
+        assert mon.triggered and mon.cycles == 0
+        _calm(mon, t, 8)
+        assert events[4:] == [
+            ("restore", "d"), ("restore", "c"),
+            ("restore", "b"), ("restore", "a")]
+        assert mon.level() == 0 and mon.shed_names() == []
+        assert mon.cycles == 1
+
+    def test_dwell_blocks_single_tick_shed(self):
+        events = []
+        mon, t = _mk_monitor(events, shed_dwell=3)
+        assert _hot(mon, t, 2) == [None, None]
+        assert not events
+        assert _hot(mon, t, 1) == ["a"]
+        assert mon.level() == 1
+
+    def test_dead_band_resets_both_streaks(self):
+        """Hovering between the water marks must never flap: a hot tick
+        alternating with a dead-band tick never accumulates dwell."""
+        events = []
+        mon, t = _mk_monitor(events, shed_dwell=2)
+        for _ in range(10):
+            _hot(mon, t, 1)
+            _mid(mon, t, 1)
+        assert not events and mon.level() == 0 and not mon.triggered
+        # ... and on the way down too
+        _hot(mon, t, 2)
+        assert mon.level() == 1
+        for _ in range(10):
+            _calm(mon, t, 1)
+            _mid(mon, t, 1)
+        assert mon.level() == 1  # restore_dwell=2 never reached
+
+    def test_calm_resets_hot_streak(self):
+        events = []
+        mon, t = _mk_monitor(events, shed_dwell=3)
+        _hot(mon, t, 2)
+        _calm(mon, t, 1)
+        _hot(mon, t, 2)
+        assert mon.level() == 0
+        _hot(mon, t, 1)
+        assert mon.level() == 1
+
+    def test_cooldown_spaces_transitions(self):
+        events = []
+        mon, t = _mk_monitor(events, shed_dwell=1, cooldown=10.0)
+        _hot(mon, t, 5)  # 0.1s apart: only the first shed clears cooldown
+        assert mon.level() == 1
+        t[0] += 20.0
+        _hot(mon, t, 1)
+        assert mon.level() == 2
+
+    def test_queue_depth_signal_alone_triggers(self):
+        events = []
+        mon, t = _mk_monitor(events)
+        for _ in range(3):
+            t[0] += 0.1
+            mon.observe(fifo_age=0.0, queue_depth=500)
+        assert mon.level() >= 1
+
+    def test_stage_p99_signal_opt_in(self):
+        """high_stage_p99=0 disables the latency signal entirely: an
+        enormous p99 alone neither heats nor blocks calm."""
+        events = []
+        mon, t = _mk_monitor(events)
+        for _ in range(6):
+            t[0] += 0.1
+            mon.observe(fifo_age=0.0, queue_depth=0, stage_p99=1e9)
+        assert mon.level() == 0 and not mon.triggered
+        # opted in: the same ticks shed
+        mon2, t2 = _mk_monitor([], high_stage_p99=1.0)
+        for _ in range(3):
+            t2[0] += 0.1
+            mon2.observe(fifo_age=0.0, queue_depth=0, stage_p99=1e9)
+        assert mon2.level() >= 1
+
+    def test_counters_gauge_and_history(self):
+        sheds0 = _label_counts(metrics.overload_sheds)
+        restores0 = _label_counts(metrics.overload_restores)
+        events = []
+        mon, t = _mk_monitor(events, n_levers=2)
+        _hot(mon, t, 4)
+        assert metrics.overload_level.value() == 2
+        _calm(mon, t, 4)
+        assert metrics.overload_level.value() == 0
+        sheds = _label_counts(metrics.overload_sheds)
+        restores = _label_counts(metrics.overload_restores)
+        for name in ("a", "b"):
+            assert sheds.get(name, 0) - sheds0.get(name, 0) == 1
+            assert restores.get(name, 0) - restores0.get(name, 0) == 1
+        kinds = [(action, what) for _, action, what, _ in mon.history]
+        assert kinds == [("shed", "a"), ("shed", "b"),
+                         ("restore", "b"), ("restore", "a")]
+        # each entry carries the triggering signals
+        assert all(set(sig) >= {"fifo_age", "queue_depth"}
+                   for _, _, _, sig in mon.history)
+
+    def test_history_stays_bounded(self):
+        events = []
+        mon, t = _mk_monitor(events, n_levers=1, restore_dwell=1,
+                             shed_dwell=1)
+        for _ in range(200):
+            _hot(mon, t, 1)
+            _calm(mon, t, 1)
+        assert len(mon.history) <= 128
+        assert mon.cycles > 50
+
+    def test_callbacks_fire_per_transition(self):
+        calls = []
+        mon, t = _mk_monitor(
+            [], n_levers=1,
+            on_shed=lambda what, sig: calls.append(("shed", what)),
+            on_restore=lambda what, sig: calls.append(("restore", what)),
+        )
+        _hot(mon, t, 3)
+        _calm(mon, t, 3)
+        assert calls == [("shed", "a"), ("restore", "a")]
+
+    def test_calm_at_level_zero_is_a_noop(self):
+        events = []
+        mon, t = _mk_monitor(events)
+        assert _calm(mon, t, 10) == [None] * 10
+        assert not events and mon.cycles == 0
+
+
+# ---------------------------------------------------------------------------
+# the real levers on a live scheduler
+
+
+def test_levers_round_trip_every_knob(monkeypatch):
+    """Shed all four levers in order, restore LIFO: every knob returns
+    to its pre-shed value, and no lever tears the session down."""
+    _, cs = _cluster()
+    sched = _mk_scheduler(cs, 2)
+    tpu = sched.tpu
+    trace0 = tracing.level()
+    try:
+        tracing.set_level(2)
+        tpu.shadow_sample = 0.25
+        assert sched.overload is not None
+        levers = sched.overload.levers
+        assert [name for name, _, _ in levers] == [
+            "explain-harvest", "shadow-sample", "trace", "speculation"]
+        # warm a session so "no teardown" is observable
+        pods = [
+            make_pod(f"p-{i}", namespace="default", cpu="100m",
+                     labels={"app": "plain"})
+            for i in range(6)
+        ]
+        _drive(sched, cs, pods, [3, 3])
+        sess = tpu._session
+        assert sess is not None
+        for _, shed, _ in levers:
+            shed()
+        assert tpu.explain_harvest is False
+        assert tpu.shadow_sample == 0.0
+        assert tracing.level() == 0
+        assert tpu.speculation is False
+        assert tpu._session is sess, "a shed lever tore the session down"
+        for _, _, restore in reversed(levers):
+            restore()
+        assert tpu.explain_harvest is True
+        assert tpu.shadow_sample == 0.25
+        assert tracing.level() == 2
+        assert tpu.speculation is True
+        assert tpu._session is sess
+    finally:
+        tracing.set_level(trace0)
+        sched.stop()
+        sched.informers.stop()
+
+
+def test_overload_kill_switch(monkeypatch):
+    monkeypatch.setenv("KTPU_OVERLOAD", "0")
+    _, cs = _cluster()
+    sched = _mk_scheduler(cs, 2)
+    try:
+        assert sched.overload is None
+    finally:
+        sched.stop()
+        sched.informers.stop()
+
+
+def test_env_water_marks_reach_the_monitor(monkeypatch):
+    monkeypatch.setenv("KTPU_OVERLOAD_FIFO_AGE", "2.5")
+    monkeypatch.setenv("KTPU_OVERLOAD_QUEUE_DEPTH", "77")
+    monkeypatch.setenv("KTPU_OVERLOAD_SHED_DWELL", "5")
+    monkeypatch.setenv("KTPU_OVERLOAD_COOLDOWN", "0.25")
+    _, cs = _cluster()
+    sched = _mk_scheduler(cs, 0)
+    try:
+        ov = sched.overload
+        assert ov is not None
+        assert ov.high_fifo_age == 2.5
+        assert ov.low_fifo_age == 0.5  # 0.2x the high mark
+        assert ov.high_queue_depth == 77
+        assert ov.shed_dwell == 5
+        assert ov.cooldown == 0.25
+    finally:
+        sched.stop()
+        sched.informers.stop()
+
+
+# ---------------------------------------------------------------------------
+# decision inertness (THE acceptance pin)
+
+
+@pytest.mark.parametrize("seed", [0, 2])
+def test_monitor_on_but_idle_is_bit_identical(seed, monkeypatch):
+    """The monitor observing every completed batch but never shedding
+    must be invisible: identical pod->node maps to KTPU_OVERLOAD=0."""
+    rng = random.Random(seed)
+    n = rng.randint(24, 40)
+    batch_sizes = [rng.choice([1, 2, 3, 5, 8]) for _ in range(64)]
+    maps = {}
+    for mode in ("off", "on"):
+        if mode == "off":
+            monkeypatch.setenv("KTPU_OVERLOAD", "0")
+        else:
+            monkeypatch.delenv("KTPU_OVERLOAD", raising=False)
+            # water marks pinned unreachable: the monitor RUNS on every
+            # completion but provably never transitions
+            monkeypatch.setenv("KTPU_OVERLOAD_FIFO_AGE", "1e9")
+            monkeypatch.setenv("KTPU_OVERLOAD_QUEUE_DEPTH", "1000000000")
+        _, cs = _cluster()
+        sched = _mk_scheduler(cs, 2)
+        try:
+            pods = _pod_stream(random.Random(seed), n)
+            _drive(sched, cs, pods, batch_sizes)
+            if mode == "on":
+                assert sched.overload is not None
+                assert not sched.overload.triggered
+            else:
+                assert sched.overload is None
+            maps[mode] = _bound_map(cs)
+        finally:
+            sched.stop()
+            sched.informers.stop()
+    assert maps["on"] == maps["off"], (
+        "an idle overload monitor changed scheduling decisions"
+    )
+    assert any(maps["off"].values())
+
+
+def test_full_shed_run_is_bit_identical(monkeypatch):
+    """Every lever forced shed mid-run (water marks below zero: every
+    tick is hot) — placements must STILL match the monitor-off control.
+    This is the 'sheds only optional work' contract end to end."""
+    seed = 5
+    rng = random.Random(seed)
+    batch_sizes = [rng.choice([2, 3, 5]) for _ in range(32)]
+    maps = {}
+    trace0 = tracing.level()
+    try:
+        for mode in ("off", "shed"):
+            if mode == "off":
+                monkeypatch.setenv("KTPU_OVERLOAD", "0")
+            else:
+                monkeypatch.delenv("KTPU_OVERLOAD", raising=False)
+            _, cs = _cluster()
+            sched = _mk_scheduler(cs, 2)
+            try:
+                if mode == "shed":
+                    ov = sched.overload
+                    assert ov is not None
+                    # every observe tick is hot; dwell 1, no cooldown:
+                    # all four levers shed within the first batches
+                    ov.high_fifo_age = -1.0
+                    ov.shed_dwell = 1
+                    ov.cooldown = 0.0
+                pods = _pod_stream(random.Random(seed), 32)
+                _drive(sched, cs, pods, batch_sizes)
+                if mode == "shed":
+                    assert sched.overload.triggered
+                    assert sched.overload.level() == 4, (
+                        "forced-hot run did not shed every lever"
+                    )
+                maps[mode] = _bound_map(cs)
+            finally:
+                sched.stop()
+                sched.informers.stop()
+    finally:
+        tracing.set_level(trace0)
+    assert maps["shed"] == maps["off"], (
+        "shedding changed scheduling decisions — a lever is not inert"
+    )
+    assert any(maps["off"].values())

@@ -336,6 +336,68 @@ def test_rebuild_storm_regression():
     # live in test_batchable_events_keep_session_alive above.
 
 
+def test_assume_expiry_is_a_listener_event():
+    """Assume-TTL expiry (cleanup_expired_assumed_pods) must route
+    through the cache listeners like any other remove: the live session
+    SURVIVES, the expiries ride the carry-delta queue, the expired
+    counter and assumed-pod gauges move, and post-expiry decisions are
+    bit-identical to a fresh rebuild from the same cache state."""
+    t = [0.0]
+    cache = SchedulerCache(ttl=5.0, now=lambda: t[0])
+    be = TPUBackend()
+    cache.add_listener(be)
+    for i in range(6):
+        cache.add_node(make_node(
+            f"node-{i}", cpu=str(4 + (i % 2) * 2), memory="16Gi", pods=64,
+            labels={v1.LABEL_HOSTNAME: f"node-{i}", "zone": f"z{i % 3}"},
+        ))
+    res = be.schedule_many([_spread_pod(f"w{i}") for i in range(3)])
+    assert all(node for _, node in res)
+    sess = be._session
+    assert sess is not None
+    for p, node in res:
+        assumed = _spread_pod(p.metadata.name, node=node)
+        cache.assume_pod(assumed)
+        cache.finish_binding(assumed)
+    # mid-TTL sweep: nothing expires, the age gauge tracks the oldest
+    t[0] = 2.0
+    assert cache.cleanup_expired_assumed_pods() == 0
+    assert metrics.assumed_pods.value() == 3
+    assert abs(metrics.oldest_assume_age.value() - 2.0) < 1e-6
+    # past the TTL: every assume expires THROUGH the listener
+    exp0 = metrics.expired_assumes.value()
+    t[0] = 10.0
+    assert cache.cleanup_expired_assumed_pods() == 3
+    assert metrics.expired_assumes.value() - exp0 == 3
+    assert metrics.assumed_pods.value() == 0
+    assert metrics.oldest_assume_age.value() == 0.0
+    assert be._session is sess, "expiry tore the live session down"
+    assert len(be._deltas) == 3, "expiries did not ride the delta queue"
+    # parity: the delta-patched session vs a fresh rebuild over the
+    # post-expiry cache state must decide identically
+    live = {
+        p.metadata.name: node
+        for p, node in be.schedule_many(
+            [_spread_pod(f"probe{i}") for i in range(4)])
+    }
+    assert be._session is sess
+    cache2 = SchedulerCache()
+    be2 = TPUBackend()
+    cache2.add_listener(be2)
+    for i in range(6):
+        cache2.add_node(make_node(
+            f"node-{i}", cpu=str(4 + (i % 2) * 2), memory="16Gi", pods=64,
+            labels={v1.LABEL_HOSTNAME: f"node-{i}", "zone": f"z{i % 3}"},
+        ))
+    want = {
+        p.metadata.name: node
+        for p, node in be2.schedule_many(
+            [_spread_pod(f"probe{i}") for i in range(4)])
+    }
+    assert live == want, "post-expiry decisions diverged from rebuild"
+    assert any(live.values())
+
+
 # ---------------------------------------------------------------------------
 # pallas carry-layout parity (CPU-verifiable without running the kernel)
 

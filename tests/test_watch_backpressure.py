@@ -1,0 +1,210 @@
+"""Slow-consumer backpressure on the HTTP watch wire (ISSUE 11 tentpole).
+
+A watcher that cannot drain its bounded send buffer must be EVICTED —
+counted (apiserver_watch_evictions_total) and hard-closed — while every
+other watcher of the same hub keeps streaming untouched. Eviction is
+safe by the existing contract: the client sees EOF, RemoteWatch sets
+`closed`, and its reflector recovers via re-list+re-watch.
+
+Exercised over REAL sockets (HTTPAPIServer): the stalled reader is a raw
+socket that never reads, with the kernel buffers pinned small (listener
+SO_SNDBUF + client SO_RCVBUF) so the writer thread wedges after a few
+KiB instead of megabytes.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver.http import (
+    HTTPAPIServer,
+    RemoteAPIServer,
+    watch_evictions,
+)
+from kubernetes_tpu.client import Clientset, SharedInformerFactory
+
+from .util import make_pod
+
+
+def _wait(fn, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def hub():
+    api = APIServer()
+    server = HTTPAPIServer(api)
+    # pin the kernel buffers SMALL so a non-reading peer wedges the
+    # writer thread within a few KiB: accepted sockets inherit SNDBUF
+    # from the listener; the client side caps RCVBUF before connect
+    server._httpd.socket.setsockopt(
+        socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+    server.start()
+    try:
+        yield server
+    finally:
+        server.stop()
+
+
+def _stalled_watcher(hub):
+    """A raw-socket pod watcher that NEVER reads its response."""
+    host, port = hub._httpd.server_address[:2]
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    s.connect((host, port))
+    s.sendall(
+        b"GET /api/v1/namespaces/default/pods?watch=true HTTP/1.1\r\n"
+        b"Host: x\r\n\r\n"
+    )
+    return s
+
+
+def _pump(api, pod, n, payload_kib=2):
+    """n MODIFIED events of ~payload_kib KiB each through the store."""
+    blob = "x" * (payload_kib * 1024)
+    for i in range(n):
+        pod.metadata.annotations = {"seq": str(i), "blob": blob}
+        pod = api.update("pods", pod)
+    return pod
+
+
+def _socket_saw_eof(s, timeout=10.0):
+    """Drain until EOF/RST: either is the close the reflector acts on."""
+    s.settimeout(timeout)
+    try:
+        while True:
+            if not s.recv(65536):
+                return True
+    except (ConnectionResetError, OSError):
+        return True
+    finally:
+        s.close()
+
+
+def test_byte_budget_eviction_over_real_http(hub):
+    """Overflow the bounded send buffer of a never-reading watcher: it
+    is evicted and hard-closed; a fast RemoteWatch on the same hub
+    streams through the whole storm and keeps receiving afterwards."""
+    hub.watch_buffer_bytes = 32 * 1024
+    api = hub.api
+    pod = api.create("pods", make_pod("victim", namespace="default",
+                                      cpu="100m"))
+    ev0 = watch_evictions.value()
+    remote = RemoteAPIServer(hub.address)
+    fast = remote.watch("pods", namespace="default")
+    fast_seen = []
+    stop = threading.Event()
+
+    def drain():
+        while not stop.is_set():
+            ev = fast.poll(timeout=0.1)
+            if ev is not None:
+                fast_seen.append(ev)
+
+    dt = threading.Thread(target=drain, daemon=True)
+    dt.start()
+    slow = _stalled_watcher(hub)
+    assert _wait(lambda: hub.watcher_count == 2)
+
+    # pump in waves with drain gaps: the wedged watcher's buffer only
+    # grows until it bursts its budget, while the fast consumer keeps
+    # emptying its own between waves
+    for _ in range(100):
+        pod = _pump(api, pod, 10)
+        time.sleep(0.02)
+        if watch_evictions.value() > ev0:
+            break
+    assert watch_evictions.value() - ev0 == 1, (
+        "expected exactly the stalled watcher evicted"
+    )
+    # the evicted stream is hard-closed: EOF/RST at the client = the
+    # re-list signal (RemoteWatch.closed fires on exactly this)
+    assert _socket_saw_eof(slow)
+    assert _wait(lambda: hub.watcher_count == 1), (
+        "evicted stream never released its watcher slot"
+    )
+
+    # the fast consumer lived through the storm AND still receives
+    pod.metadata.annotations = {"after": "eviction"}
+    pod = api.update("pods", pod)
+    assert _wait(lambda: any(
+        (e.object.metadata.annotations or {}).get("after") == "eviction"
+        for e in fast_seen))
+    stop.set()
+    dt.join(timeout=2)
+    fast.stop()
+    assert _wait(lambda: hub.watcher_count == 0)
+
+
+def test_no_drain_stall_eviction(hub):
+    """The stall clock: a watcher with frames queued and NO socket-write
+    progress for watch_evict_after seconds is evicted even far below the
+    byte budget (heartbeats run the clock on an otherwise idle watch)."""
+    hub.watch_buffer_bytes = 64 * 1024 * 1024  # byte budget out of play
+    hub.watch_evict_after = 0.5
+    api = hub.api
+    pod = api.create("pods", make_pod("victim", namespace="default",
+                                      cpu="100m"))
+    ev0 = watch_evictions.value()
+    slow = _stalled_watcher(hub)
+    assert _wait(lambda: hub.watcher_count == 1)
+    # enough volume to wedge the writer mid-write (kernel buffers are
+    # pinned to a few KiB), then go IDLE: the heartbeat path must still
+    # notice the stall and evict
+    for _ in range(50):
+        pod = _pump(api, pod, 5)
+        if watch_evictions.value() > ev0:
+            break
+        time.sleep(0.1)
+    assert _wait(lambda: watch_evictions.value() > ev0, timeout=15), (
+        "stalled watcher with queued frames was never evicted"
+    )
+    assert _socket_saw_eof(slow)
+    assert _wait(lambda: hub.watcher_count == 0)
+
+
+def test_informer_survives_a_neighboring_eviction(hub):
+    """A full reflector/informer stack on the same hub keeps its cache
+    in sync while a stalled neighbor is evicted — the hub's fan-out is
+    never blocked by the wedged peer."""
+    hub.watch_buffer_bytes = 16 * 1024
+    api = hub.api
+    pod = api.create("pods", make_pod("victim", namespace="default",
+                                      cpu="100m"))
+    cs = Clientset(RemoteAPIServer(hub.address))
+    factory = SharedInformerFactory(cs)
+    informer = factory.pods()
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    ev0 = watch_evictions.value()
+    slow = _stalled_watcher(hub)
+    assert _wait(lambda: hub.watcher_count >= 2)
+    for _ in range(100):
+        pod = _pump(api, pod, 10)
+        if watch_evictions.value() > ev0:
+            break
+    assert watch_evictions.value() > ev0
+    assert _socket_saw_eof(slow)
+    # the informer's cache converges on the post-storm state
+    pod.metadata.annotations = {"final": "1"}
+    api.update("pods", pod)
+    def cache_final():
+        got = informer.get("default/victim")
+        return (got is not None
+                and (got.metadata.annotations or {}).get("final") == "1")
+
+    assert _wait(cache_final, timeout=10), (
+        "informer cache fell behind after a neighbor eviction"
+    )
+    factory.stop()
