@@ -144,12 +144,31 @@ class Quantity:
         return f"{float(r):g}"
 
 
+@lru_cache(maxsize=8192)
+def milli_value_of(q: Union[str, int, float, "Quantity"]) -> int:
+    """MilliValue of a quantity literal, memoized by the literal.
+
+    The string parse is already cached (_parse_quantity_str), but the
+    Fraction multiply + ceil per MilliValue call was not — and it
+    dominated calculate_resource in the completion worker's assume
+    profile (workloads repeat a handful of request literals across
+    every pod). Hashable literals only, which is what serde yields.
+    """
+    return _ceil_int64(parse_quantity(q) * 1000)
+
+
+@lru_cache(maxsize=8192)
+def value_of(q: Union[str, int, float, "Quantity"]) -> int:
+    """Value (ceil to integer) of a quantity literal, memoized."""
+    return _ceil_int64(parse_quantity(q))
+
+
 def cpu_milli(requests: dict, key: str = "cpu") -> int:
     """CPU request in milli-cores from a resource map of quantity strings."""
     q = requests.get(key)
-    return Quantity(q).milli_value() if q is not None else 0
+    return milli_value_of(q) if q is not None else 0
 
 
 def mem_bytes(requests: dict, key: str = "memory") -> int:
     q = requests.get(key)
-    return Quantity(q).value() if q is not None else 0
+    return value_of(q) if q is not None else 0

@@ -337,6 +337,30 @@ class ClusterEncoding:
         if not self._try_add_pod_arrays(pod, key, nidx):
             self._rebuild_needed = True
 
+    def swap_pod_object(self, key: str, pod: v1.Pod,
+                        node_name: str) -> bool:
+        """Replace the stored pod OBJECT for an already-encoded placement
+        without touching any array state — the assume-echo fast path. The
+        cache's batched assume hands the backend the same (pod, node)
+        placements the device session already encoded via
+        _apply_decisions_locked; routing the echo through add_pod would
+        net a full remove_pod + re-add (two row encodes, two volume
+        refcount round-trips) for an array-identical result, since the
+        only object difference (spec.node_name) is not encoded. Volume
+        hook exactness: the remove+add path round-trips each (ns, claim)
+        refcount to net zero and recomputes _pod_extras[key] from the
+        same spec+node to the identical value, so skipping both here is
+        state-exact. Bumps version exactly like add_pod would, so
+        planner _books_version pins behave identically. Returns False
+        (caller falls back to add_pod) when the key isn't present or is
+        recorded on a different node."""
+        entry = self._pods.get(key)
+        if entry is None or entry[1] != node_name:
+            return False
+        self.version += 1
+        self._pods[key] = (pod, node_name)
+        return True
+
     def remove_pod(self, pod: v1.Pod) -> None:
         self.version += 1
         key = v1.pod_key(pod)

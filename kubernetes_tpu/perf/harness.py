@@ -14,6 +14,7 @@ mustSetupScheduler (util.go:61) with a real apiserver+etcd and no kubelet.
 from __future__ import annotations
 
 import math
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -239,6 +240,10 @@ class Workload:
     # default) is decision-inert and launch-free — benchmark rows only
     # pay the audit when they opt in.
     shadow_sample: float = 0.0
+    # columnar scheduler cache (KTPU_COLUMNAR_CACHE): False pins the
+    # per-pod object writeback path for A/B rows (scripts/probe_assume.py
+    # and the completion-tax adjudication in bench_configs.py)
+    columnar: bool = True
 
 
 @dataclass
@@ -468,6 +473,10 @@ def _kernel_direct_rate(sched, w: "Workload", reps: int = 3) -> float:
 
 
 def run_workload(w: Workload, quiet: bool = True) -> Result:
+    if not w.columnar:
+        os.environ["KTPU_COLUMNAR_CACHE"] = "0"
+    else:
+        os.environ.pop("KTPU_COLUMNAR_CACHE", None)
     api = APIServer()
     http_srv = None
     if w.wire:

@@ -15,7 +15,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ...api import types as v1
 from ...api.labels import Selector
-from ...api.quantity import Quantity
+from ...api.quantity import milli_value_of, value_of
 
 # Non-zero request defaults (reference: pkg/scheduler/util/non_zero.go:33-38)
 DEFAULT_MILLI_CPU_REQUEST = 100
@@ -58,33 +58,31 @@ class Resource:
     def add(self, resource_list: Optional[Dict[str, str]]) -> None:
         """Resource.Add (types.go:345)."""
         for name, q in (resource_list or {}).items():
-            quant = Quantity(q)
             if name == v1.RESOURCE_CPU:
-                self.milli_cpu += quant.milli_value()
+                self.milli_cpu += milli_value_of(q)
             elif name == v1.RESOURCE_MEMORY:
-                self.memory += quant.value()
+                self.memory += value_of(q)
             elif name == v1.RESOURCE_PODS:
-                self.allowed_pod_number += quant.value()
+                self.allowed_pod_number += value_of(q)
             elif name == v1.RESOURCE_EPHEMERAL_STORAGE:
-                self.ephemeral_storage += quant.value()
+                self.ephemeral_storage += value_of(q)
             elif is_scalar_resource_name(name):
                 self.scalar_resources[name] = (
-                    self.scalar_resources.get(name, 0) + quant.value()
+                    self.scalar_resources.get(name, 0) + value_of(q)
                 )
 
     def set_max(self, resource_list: Optional[Dict[str, str]]) -> None:
         """Resource.SetMaxResource (types.go:393) — per-dimension max."""
         for name, q in (resource_list or {}).items():
-            quant = Quantity(q)
             if name == v1.RESOURCE_CPU:
-                self.milli_cpu = max(self.milli_cpu, quant.milli_value())
+                self.milli_cpu = max(self.milli_cpu, milli_value_of(q))
             elif name == v1.RESOURCE_MEMORY:
-                self.memory = max(self.memory, quant.value())
+                self.memory = max(self.memory, value_of(q))
             elif name == v1.RESOURCE_EPHEMERAL_STORAGE:
-                self.ephemeral_storage = max(self.ephemeral_storage, quant.value())
+                self.ephemeral_storage = max(self.ephemeral_storage, value_of(q))
             elif is_scalar_resource_name(name):
                 self.scalar_resources[name] = max(
-                    self.scalar_resources.get(name, 0), quant.value()
+                    self.scalar_resources.get(name, 0), value_of(q)
                 )
 
     def clone(self) -> "Resource":
@@ -108,11 +106,11 @@ def _nonzero_requests(requests: Optional[Dict[str, str]]) -> Tuple[int, int]:
     """GetNonzeroRequests (util/non_zero.go:42): defaults for unset cpu/mem."""
     requests = requests or {}
     if v1.RESOURCE_CPU in requests:
-        cpu = Quantity(requests[v1.RESOURCE_CPU]).milli_value()
+        cpu = milli_value_of(requests[v1.RESOURCE_CPU])
     else:
         cpu = DEFAULT_MILLI_CPU_REQUEST
     if v1.RESOURCE_MEMORY in requests:
-        mem = Quantity(requests[v1.RESOURCE_MEMORY]).value()
+        mem = value_of(requests[v1.RESOURCE_MEMORY])
     else:
         mem = DEFAULT_MEMORY_REQUEST
     return cpu, mem
@@ -137,9 +135,9 @@ def calculate_resource(pod: v1.Pod) -> Tuple[Resource, int, int]:
     if pod.spec.overhead:
         res.add(pod.spec.overhead)
         if v1.RESOURCE_CPU in pod.spec.overhead:
-            non0_cpu += Quantity(pod.spec.overhead[v1.RESOURCE_CPU]).milli_value()
+            non0_cpu += milli_value_of(pod.spec.overhead[v1.RESOURCE_CPU])
         if v1.RESOURCE_MEMORY in pod.spec.overhead:
-            non0_mem += Quantity(pod.spec.overhead[v1.RESOURCE_MEMORY]).value()
+            non0_mem += value_of(pod.spec.overhead[v1.RESOURCE_MEMORY])
     return res, non0_cpu, non0_mem
 
 
@@ -382,10 +380,14 @@ class NodeInfo:
         """types.go:489 AddPod."""
         self.add_pod_info(PodInfo(pod))
 
-    def add_pod_info(self, pod_info: PodInfo) -> None:
-        """Shares an already-parsed PodInfo (the reference's AddPod path)."""
+    def add_pod_info(self, pod_info: PodInfo, res3=None) -> None:
+        """Shares an already-parsed PodInfo (the reference's AddPod path).
+        `res3` optionally carries a precomputed calculate_resource(pod)
+        triple so batch callers (SchedulerCache.assume_pods) parse each
+        pod's Quantity strings exactly once."""
         pod = pod_info.pod
-        res, non0_cpu, non0_mem = calculate_resource(pod)
+        res, non0_cpu, non0_mem = res3 if res3 is not None \
+            else calculate_resource(pod)
         self.requested.milli_cpu += res.milli_cpu
         self.requested.memory += res.memory
         self.requested.ephemeral_storage += res.ephemeral_storage
@@ -403,8 +405,9 @@ class NodeInfo:
         self._update_used_ports(pod, add=True)
         self.generation = next_generation()
 
-    def remove_pod(self, pod: v1.Pod) -> None:
-        """types.go:517 RemovePod."""
+    def remove_pod(self, pod: v1.Pod, res3=None) -> None:
+        """types.go:517 RemovePod. `res3` optionally carries a
+        precomputed calculate_resource(pod) triple (see add_pod_info)."""
         key = v1.pod_key(pod)
 
         def _strip(lst: List[PodInfo]) -> None:
@@ -420,7 +423,8 @@ class NodeInfo:
             if v1.pod_key(pi.pod) == key:
                 self.pods[i] = self.pods[-1]
                 self.pods.pop()
-                res, non0_cpu, non0_mem = calculate_resource(pod)
+                res, non0_cpu, non0_mem = res3 if res3 is not None \
+                    else calculate_resource(pod)
                 self.requested.milli_cpu -= res.milli_cpu
                 self.requested.memory -= res.memory
                 self.requested.ephemeral_storage -= res.ephemeral_storage

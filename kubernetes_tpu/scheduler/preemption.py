@@ -229,8 +229,23 @@ class FastPreemptionPlanner:
         self._max_pods = np.zeros(N, dtype=np.int64)
 
         wave_prios = sorted({_prio(p) for p in wave})
+        cols = getattr(self.snapshot, "columnar_util", None)
+        col_base = (
+            cols is not None
+            and [ni.node.metadata.name for ni in self.nodes] == cols["names"]
+        )
+        if col_base:
+            # the base dims (cpu/memory/ephemeral — the columnar cache's
+            # fixed row layout) land as one transposed array copy off
+            # the snapshot's utilization gather instead of a per-node
+            # Python attribute walk; scalar dims (wave-discovered, not
+            # columnar) still walk below
+            self._alloc[0:3, :] = cols["alloc"].T
+            self._used[0:3, :] = cols["requested"].T
         for d in range(D):
             name = self._dims[d]
+            if col_base and d < 3:
+                continue
             for i, ni in enumerate(self.nodes):
                 if name == "cpu":
                     self._alloc[d, i] = ni.allocatable.milli_cpu

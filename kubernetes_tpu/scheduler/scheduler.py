@@ -1046,36 +1046,71 @@ class Scheduler:
         ]
         if not sampled:
             return
-        # decision-time cluster objects, once per audited batch — a raw
-        # dump, NOT update_snapshot: the incremental snapshot's generation
-        # bookkeeping lives in the cache, and consuming it here would
-        # starve the scheduling thread's own snapshot refreshes
-        base_nodes, base_pods = self.cache.dump()
+        # decision-time cluster state, once per audited batch. Columnar
+        # mode: an O(changed) clone view off the cache's generation-keyed
+        # audit cache — no per-audit NodeInfo reconstruction from raw
+        # objects, no Quantity re-parse (the reason production shadow
+        # sample rates were capped). Object mode (KTPU_COLUMNAR_CACHE=0):
+        # the raw dump + Snapshot.from_objects rebuild. Neither touches
+        # update_snapshot's generation bookkeeping — a throwaway audit
+        # must not starve the scheduling thread's incremental refreshes.
+        base_infos = self.cache.audit_view()
+        base_nodes = base_pods = None
+        if base_infos is None:
+            base_nodes, base_pods = self.cache.dump()
         basis = getattr(handle, "basis_mutations", None)
         if basis is not None and (self.cache.foreign_mutations(),
                                   self._dropped_decisions) != basis:
-            # stale-basis gate, checked AFTER the dump so nothing can
-            # land between the check and the read: either the cluster
+            # stale-basis gate, checked AFTER the state read so nothing
+            # can land between the check and the read: either the cluster
             # moved under this flight (foreign event, expiry, forget) or
             # an overlapping in-flight batch dropped a decided placement
-            # the chained carry had — in both cases the dump is not the
+            # the chained carry had — in both cases the read is not the
             # decision-time state. Void the audit, keep the drift
             # counter honest.
             metrics.shadow_skips.inc(len(sampled), reason="stale-basis")
             return
         node_names = handle.node_names or []
+        if base_infos is not None:
+            # prefix decisions land incrementally across ascending
+            # samples: each touched node is copy-on-write cloned once
+            # (the audit_view clones are shared and must stay pristine),
+            # then pod i's snapshot is just the current overlay state
+            by_name = {
+                ni.node.metadata.name: ni for ni in base_infos
+            }
+            overlaid: set = set()
+            applied = 0
         for i in sampled:
             pod, node = results[i]
             metrics.shadow_samples.inc()
-            prefix = []
-            for p, n in results[:i]:
-                if n is None or n == RETRY_NODE:
-                    continue
-                clone = serde.from_dict(v1.Pod, serde.to_dict(p))
-                clone.spec.node_name = n
-                prefix.append(clone)
-            shadow_pods = base_pods + prefix
-            shadow_snap = Snapshot.from_objects(shadow_pods, base_nodes)
+            if base_infos is not None:
+                for p, n in results[applied:i]:
+                    if n is None or n == RETRY_NODE:
+                        continue
+                    clone = copy.copy(p)
+                    clone.spec = copy.copy(p.spec)
+                    clone.spec.node_name = n
+                    tgt = by_name.get(n)
+                    if tgt is None:
+                        continue  # from_objects also drops unknown nodes
+                    if n not in overlaid:
+                        tgt = tgt.clone()
+                        by_name[n] = tgt
+                        overlaid.add(n)
+                    tgt.add_pod(clone)
+                applied = i
+                shadow_snap = Snapshot(list(by_name.values()))
+            else:
+                prefix = []
+                for p, n in results[:i]:
+                    if n is None or n == RETRY_NODE:
+                        continue
+                    clone = serde.from_dict(v1.Pod, serde.to_dict(p))
+                    clone.spec.node_name = n
+                    prefix.append(clone)
+                shadow_pods = base_pods + prefix
+                shadow_snap = Snapshot.from_objects(shadow_pods, base_nodes)
             oracle_bd = explain_mod.oracle_breakdown(shadow_snap, pod)
             device_bd = None
             if handle.explain is not None and i < len(handle.explain) \
@@ -1098,9 +1133,18 @@ class Scheduler:
                 "shadow-drift", pod=key, node=node,
                 plugins=",".join(plugins),
             )
+            if base_infos is not None:
+                # bundle inputs only materialize on drift (the rare
+                # case) — never on the clean-audit hot path
+                bundle_nodes = [ni.node for ni in by_name.values()]
+                bundle_pods = [
+                    pi.pod for ni in by_name.values() for pi in ni.pods
+                ]
+            else:
+                bundle_nodes, bundle_pods = base_nodes, shadow_pods
             try:
                 bundle = explain_mod.write_bundle(
-                    pod, base_nodes, shadow_pods, node, plugins,
+                    pod, bundle_nodes, bundle_pods, node, plugins,
                     oracle_bd, device_bd, weights=self.tpu.weights,
                 )
             except Exception:  # noqa: BLE001 — an unwritable bundle dir
@@ -1531,6 +1575,14 @@ class Scheduler:
         with tracing.span("assume", "assume", n=len(assumed_list)):
             ok = self.cache.assume_pods(assumed_list)
         batch_items: List[Tuple] = []  # (assumed, node, state, info)
+        # one check per harvest, not per pod: with no Reserve and no
+        # Permit plugins registered (the common profile), the entire
+        # _reserve_and_permit call is a guaranteed "bind" — skip the
+        # per-pod framework dispatch. CycleState is still minted per pod
+        # (PreBind/PostBind read it in the binding cycle).
+        fwk = self.framework
+        plugins_engaged = fwk is not None and (
+            fwk.reserve_plugins or fwk.permit_plugins)
         with tracing.span("reserve-permit", "reserve-permit",
                           n=len(assumed_list)):
             for (info, node), assumed, assumed_ok in zip(
@@ -1542,7 +1594,7 @@ class Scheduler:
                     self._dropped_decisions += 1
                     continue
                 state = CycleState()
-                if self._reserve_and_permit(
+                if not plugins_engaged or self._reserve_and_permit(
                         state, assumed, node, info) == "bind":
                     batch_items.append((assumed, node, state, info))
         if batch_items:

@@ -902,6 +902,35 @@ class TPUBackend(CacheListener):
             ):
                 self._invalidate_session("foreign-pod-add")
 
+    def on_assume_pods(self, items) -> None:
+        """Batched assume-echo from the cache's columnar assume_pods: one
+        listener call per harvest instead of N on_add_pod events. For
+        placements this backend itself applied on-device
+        (_apply_decisions_locked recorded them in _session_assumed), the
+        echo's remove+re-add through enc.add_pod would be array-identical
+        — the only object difference vs the decision-time pod is
+        spec.node_name, which is not encoded — so the echo collapses to a
+        pure stored-object swap (enc.swap_pod_object): no row encode, no
+        volume refcount round-trip, no Quantity re-parse. Anything else
+        (nominated placements, swap misses) falls through to the per-pod
+        on_add_pod path, preserving object-path semantics exactly."""
+        leftovers = None
+        with self._lock:
+            assumed = self._session_assumed
+            enc = self.enc
+            swap = enc.swap_pod_object
+            for pod, node_name in items:
+                key = (pod.metadata.namespace, pod.metadata.name, node_name)
+                if key in assumed and swap(v1.pod_key(pod), pod, node_name):
+                    assumed.discard(key)
+                    continue
+                if leftovers is None:
+                    leftovers = []
+                leftovers.append((pod, node_name))
+            if leftovers:
+                for pod, node_name in leftovers:
+                    self.on_add_pod(pod, node_name)  # RLock: nested is fine
+
     def on_remove_pod(self, pod: v1.Pod, node_name: str) -> None:
         with self._lock:
             # mirror of the add path's assume-echo gate: removing a pod
@@ -1456,18 +1485,22 @@ class TPUBackend(CacheListener):
         results: List[Tuple[v1.Pod, Optional[str]]] = []
         rec = tracing.RECORDER
         pod_level = rec.pod_level()
+        live = self._session is not None
+        record_assume = self._session_assumed.add
+        enc_add = self.enc.add_pod
+        append = results.append
         for i, (g, best) in enumerate(zip(pods, decisions)):
             if best < 0:
-                results.append((g, None))
+                append((g, None))
                 node = None
             else:
                 node = node_names[best]
-                if self._session is not None:
-                    self._session_assumed.add(
+                if live:
+                    record_assume(
                         (g.metadata.namespace, g.metadata.name, node)
                     )
-                self.enc.add_pod(g, node)
-                results.append((g, node))
+                enc_add(g, node)
+                append((g, node))
             if pod_level:
                 if explain is not None and i < len(explain):
                     rec.provenance(

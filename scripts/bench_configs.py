@@ -375,6 +375,17 @@ def main() -> None:
         line["stage_latency_runs"] = [
             r.get("stage_latency") for r in runs
         ]
+        # per-rep completion-tax attribution (round 14): the assume
+        # (cache writeback) and bind stages pulled out of each rep's
+        # stage_latency so the chip rerun adjudicates the columnar
+        # batched delta-apply directly, without unpacking the full
+        # stage dict per rep (None with tracing off)
+        line["assume_stage_runs"] = [
+            (r.get("stage_latency") or {}).get("assume") for r in runs
+        ]
+        line["bind_stage_runs"] = [
+            (r.get("stage_latency") or {}).get("bind") for r in runs
+        ]
         # per-rep shadow parity accounting (round 12): at sample>0 the
         # chip rerun adjudicates drift from THESE counters — a drift
         # burst in one rep must not hide behind the median rep's dict

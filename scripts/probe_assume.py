@@ -1,0 +1,215 @@
+"""Adjudicate the completion tax: batched columnar delta-apply vs the
+per-pod object writeback, across harvest batch sizes.
+
+Each trial replays the production assume stage end to end: a fresh
+SchedulerCache (columnar on or off) with a TPUBackend-shaped echo
+listener over a real ClusterEncoding, landing PODS pods in
+assume_pods() harvests of size B. The per-pod object path pays the
+round-11 triple tax — NodeInfo writeback, then the listener assume-echo
+routing through enc.add_pod, which for an already-encoded key nets a
+FULL remove_pod + re-add (two row encodes, two volume refcount
+round-trips). The columnar path lands the NodeInfo writebacks plus ONE
+vectorized columnar delta and ONE batched on_assume_pods whose echo
+collapses to a stored-object swap. The decision-time enc.add_pod (the
+harvest's device-side apply) happens OUTSIDE the timer in both modes —
+only the assume stage is measured.
+
+Parity is asserted per run: both modes must end with identical dump()
+contents, per-node NodeInfo aggregates, encoding pod placements, and
+(columnar mode) columnar rows that recompute exactly from the NodeInfo
+aggregates.
+
+Chip-runnable but device-free (cache + encoding are pure host state):
+the same numbers adjudicate on a TPU host and on CPU CI.
+
+Usage: python scripts/probe_assume.py
+Env: PROBE_NODES (1000), PROBE_PODS (3000), PROBE_BATCHES
+     (comma list, default 1,32,128,512,1024), PROBE_REPS (3).
+
+Output: one JSON line per (mode, batch-size) with wall seconds and
+pods/s, then a summary speedup table on stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from kubernetes_tpu.api import types as v1  # noqa: E402
+from kubernetes_tpu.models.encoding import ClusterEncoding  # noqa: E402
+from kubernetes_tpu.scheduler.internal.cache import (  # noqa: E402
+    CacheListener,
+    SchedulerCache,
+)
+from kubernetes_tpu.testing.synth import make_node, make_pod  # noqa: E402
+
+
+class EchoListener(CacheListener):
+    """The TPUBackend's assume-echo shape, minus the device: every
+    placement was already applied to the encoding at harvest time
+    (_apply_decisions_locked) and recorded in session_assumed; the
+    cache's assume then echoes back. Per-pod path: on_add_pod ->
+    enc.add_pod (remove + re-add for the already-held key). Batched
+    path: on_assume_pods -> enc.swap_pod_object."""
+
+    def __init__(self, enc: ClusterEncoding):
+        self.enc = enc
+        self.session_assumed = set()
+
+    def on_add_pod(self, pod, node_name):
+        key = (pod.metadata.namespace, pod.metadata.name, node_name)
+        if key in self.session_assumed:
+            self.session_assumed.discard(key)
+            self.enc.add_pod(pod, node_name)
+
+    def on_assume_pods(self, items):
+        assumed = self.session_assumed
+        swap = self.enc.swap_pod_object
+        for pod, node_name in items:
+            key = (pod.metadata.namespace, pod.metadata.name, node_name)
+            if key in assumed and swap(v1.pod_key(pod), pod, node_name):
+                assumed.discard(key)
+            else:
+                self.on_add_pod(pod, node_name)
+
+
+def _mk_pods(n_pods: int, n_nodes: int):
+    pods = []
+    for i in range(n_pods):
+        p = make_pod(f"probe-{i}", cpu="100m", memory="128Mi",
+                     node_name=f"node-{i % n_nodes}")
+        pods.append(p)
+    return pods
+
+
+def _node_aggregates(cache):
+    out = {}
+    for name in sorted(n.metadata.name for n in cache.dump()[0]):
+        ni = cache._nodes[name]
+        out[name] = (
+            ni.requested.milli_cpu, ni.requested.memory,
+            ni.requested.ephemeral_storage,
+            ni.non_zero_requested.milli_cpu,
+            ni.non_zero_requested.memory,
+            len(ni.pods),
+        )
+    return out
+
+
+def _assert_columnar_rows(cache):
+    """Columnar rows must recompute exactly from the object NodeInfos."""
+    for name, (cpu, mem, eph, nz_cpu, nz_mem, npods) in \
+            _node_aggregates(cache).items():
+        i = cache._col_index[name]
+        row = (
+            int(cache._col_req[i, 0]), int(cache._col_req[i, 1]),
+            int(cache._col_req[i, 2]), int(cache._col_nz[i, 0]),
+            int(cache._col_nz[i, 1]), int(cache._col_counts[i, 0]),
+        )
+        assert row == (cpu, mem, eph, nz_cpu, nz_mem, npods), (
+            f"columnar row for {name} diverged: {row} != "
+            f"{(cpu, mem, eph, nz_cpu, nz_mem, npods)}"
+        )
+
+
+def _setup(columnar: bool, nodes, n_pods: int):
+    cache = SchedulerCache(columnar=columnar)
+    enc = ClusterEncoding()
+    # pre-size like the harness: without this the pod table overflows
+    # into _rebuild_needed and every echo add_pod degrades to a cheap
+    # dict update — hiding exactly the row-encode tax being probed
+    enc.reserve(pods=int(n_pods * 2))
+    enc.set_cluster(nodes, [])
+    enc.rebuild()  # live arrays: the echo must hit the row-encode path
+    listener = EchoListener(enc)
+    cache.add_listener(listener)
+    for n in nodes:
+        cache.add_node(n)
+    return cache, enc, listener
+
+
+def _trial(columnar: bool, nodes, pods, batch: int) -> float:
+    cache, enc, listener = _setup(columnar, nodes, len(pods))
+    wall = 0.0
+    for off in range(0, len(pods), batch):
+        harvest = pods[off:off + batch]
+        # harvest-time device apply — NOT the measured stage
+        for p in harvest:
+            listener.session_assumed.add(
+                (p.metadata.namespace, p.metadata.name, p.spec.node_name))
+            enc.add_pod(p, p.spec.node_name)
+        t0 = time.perf_counter()
+        ok = cache.assume_pods(harvest)
+        wall += time.perf_counter() - t0
+        assert all(ok)
+    assert not listener.session_assumed, "unechoed assumes left behind"
+    if columnar:
+        _assert_columnar_rows(cache)
+    return wall
+
+
+def main() -> None:
+    n_nodes = int(os.environ.get("PROBE_NODES", "1000"))
+    n_pods = int(os.environ.get("PROBE_PODS", "3000"))
+    batches = [
+        int(b) for b in os.environ.get(
+            "PROBE_BATCHES", "1,32,128,512,1024").split(",")
+    ]
+    reps = int(os.environ.get("PROBE_REPS", "3"))
+    nodes = [make_node(f"node-{i}") for i in range(n_nodes)]
+    pods = _mk_pods(n_pods, n_nodes)
+
+    # cross-mode parity once up front: same pod stream, both modes,
+    # identical end state (cache AND encoding placements)
+    ref, ref_enc, ref_l = _setup(False, nodes, n_pods)
+    col, col_enc, col_l = _setup(True, nodes, n_pods)
+    for c, e, l in ((ref, ref_enc, ref_l), (col, col_enc, col_l)):
+        for p in pods:
+            l.session_assumed.add(
+                (p.metadata.namespace, p.metadata.name, p.spec.node_name))
+            e.add_pod(p, p.spec.node_name)
+        assert all(c.assume_pods(list(pods)))
+    assert {k: ent[1] for k, ent in ref_enc._pods.items()} == \
+        {k: ent[1] for k, ent in col_enc._pods.items()}, \
+        "encoding placements diverged between modes"
+    ref_nodes, ref_pods = ref.dump()
+    col_nodes, col_pods = col.dump()
+    assert [n.metadata.name for n in ref_nodes] == \
+        [n.metadata.name for n in col_nodes], "dump node order diverged"
+    assert [v1.pod_key(p) for p in ref_pods] == \
+        [v1.pod_key(p) for p in col_pods], "dump pod set diverged"
+    assert _node_aggregates(ref) == _node_aggregates(col), \
+        "NodeInfo aggregates diverged between modes"
+    assert ref.foreign_mutations() == col.foreign_mutations()
+    _assert_columnar_rows(col)
+    print("parity: ok (dump, aggregates, foreign_mutations, "
+          "columnar rows)", file=sys.stderr)
+
+    speedups = {}
+    for batch in batches:
+        walls = {}
+        for mode, columnar in (("object", False), ("columnar", True)):
+            best = min(
+                _trial(columnar, nodes, pods, batch) for _ in range(reps)
+            )
+            walls[mode] = best
+            print(json.dumps({
+                "mode": mode, "batch": batch, "nodes": n_nodes,
+                "pods": n_pods, "wall_s": round(best, 5),
+                "pods_per_sec": round(n_pods / best, 1),
+            }), flush=True)
+        speedups[batch] = walls["object"] / walls["columnar"]
+    print("\nbatched columnar speedup over per-pod object writeback:",
+          file=sys.stderr)
+    for batch, s in speedups.items():
+        print(f"  B={batch:>5}: {s:.2f}x", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

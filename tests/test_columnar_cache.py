@@ -1,0 +1,359 @@
+"""Columnar-vs-object SchedulerCache bit-parity (ISSUE 12 tentpole).
+
+Two caches — columnar arrays on (KTPU_COLUMNAR_CACHE default) and the
+per-pod object path (the =0 kill switch) — are driven through identical
+randomized interleavings of the full mutation surface: batched assumes,
+informer confirms (same node and relocations), foreign adds, updates,
+removes, forgets, TTL expiry sweeps on a fake clock, and node
+add/update/remove churn. After every step the externally observable
+state must be identical: dump() sequences, foreign_mutations(),
+min_pod_priority(), per-node NodeInfo aggregates, TTL expiry counts.
+The columnar arrays themselves must recompute exactly from the object
+NodeInfos at every step — the lock-step invariant.
+
+Also pinned here: the incremental image-spread index against an in-test
+full rebuild (satellite), the min_pod_priority multiset against the
+O(n) scan under churn (satellite), and the batched on_assume_pods
+listener default emitting the per-pod event stream unchanged.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.scheduler.internal.cache import (
+    CacheListener,
+    SchedulerCache,
+)
+from kubernetes_tpu.testing.synth import make_node, make_pod
+
+
+def _mk_pod(i: int, node: str, prio=None, cpu="100m", memory="64Mi"):
+    return make_pod(f"p-{i}", cpu=cpu, memory=memory, node_name=node,
+                    priority=prio)
+
+
+def _aggregates(cache: SchedulerCache) -> Dict[str, tuple]:
+    out = {}
+    for name, ni in cache._nodes.items():
+        out[name] = (
+            ni.node is not None,
+            ni.requested.milli_cpu, ni.requested.memory,
+            ni.requested.ephemeral_storage,
+            ni.non_zero_requested.milli_cpu,
+            ni.non_zero_requested.memory,
+            sorted(v1.pod_key(pi.pod) for pi in ni.pods),
+        )
+    return out
+
+
+def _assert_same_external_state(a: SchedulerCache, b: SchedulerCache):
+    an, ap = a.dump()
+    bn, bp = b.dump()
+    assert [n.metadata.name for n in an] == [n.metadata.name for n in bn]
+    assert [v1.pod_key(p) for p in ap] == [v1.pod_key(p) for p in bp]
+    assert [p.spec.node_name for p in ap] == [p.spec.node_name for p in bp]
+    assert a.foreign_mutations() == b.foreign_mutations()
+    assert a.min_pod_priority() == b.min_pod_priority()
+    assert a.pod_count() == b.pod_count()
+    assert a.node_count() == b.node_count()
+    assert sorted(a._assumed_pods) == sorted(b._assumed_pods)
+    assert _aggregates(a) == _aggregates(b)
+
+
+def _assert_columnar_lockstep(cache: SchedulerCache, check_assumed=True):
+    """Columnar rows recompute exactly from the object NodeInfos."""
+    assert cache._col_len == len(cache._nodes)
+    assumed_by_node: Dict[str, int] = {}
+    for key in cache._assumed_pods:
+        ps = cache._pod_states[key]
+        n = ps.pod.spec.node_name
+        assumed_by_node[n] = assumed_by_node.get(n, 0) + 1
+    for name, ni in cache._nodes.items():
+        i = cache._col_index[name]
+        assert int(cache._col_req[i, 0]) == ni.requested.milli_cpu
+        assert int(cache._col_req[i, 1]) == ni.requested.memory
+        assert int(cache._col_req[i, 2]) == ni.requested.ephemeral_storage
+        assert int(cache._col_nz[i, 0]) == ni.non_zero_requested.milli_cpu
+        assert int(cache._col_nz[i, 1]) == ni.non_zero_requested.memory
+        assert int(cache._col_counts[i, 0]) == len(ni.pods)
+        if ni.node is not None:
+            assert int(cache._col_alloc[i, 0]) == ni.allocatable.milli_cpu
+            assert int(cache._col_alloc[i, 3]) == \
+                ni.allocatable.allowed_pod_number
+        if check_assumed:
+            assert int(cache._col_counts[i, 1]) == \
+                assumed_by_node.get(name, 0)
+    # freed/unused rows stay zeroed (swap-compaction hygiene)
+    assert not cache._col_req[cache._col_len:].any()
+    assert not cache._col_counts[cache._col_len:].any()
+
+
+def _scan_min_priority(cache: SchedulerCache) -> int:
+    return min(
+        (ps.pod.spec.priority or 0 for ps in cache._pod_states.values()),
+        default=0,
+    )
+
+
+@pytest.mark.parametrize("seed", [1, 7, 42])
+@pytest.mark.parametrize("node_churn", [False, True])
+def test_columnar_object_equivalence(seed, node_churn):
+    """The tentpole property test: identical op interleavings produce
+    identical external state in both modes, and the columnar arrays
+    stay in lock-step with the object NodeInfos throughout. node_churn
+    adds node remove/re-add under live pods; the assumed-count column
+    is exempt there (a freed row forgets flags for pods that outlive
+    their node — the object path has no analogous state at all)."""
+    rng = random.Random(seed)
+    clock = [0.0]
+    obj = SchedulerCache(ttl=10.0, now=lambda: clock[0], columnar=False)
+    col = SchedulerCache(ttl=10.0, now=lambda: clock[0], columnar=True)
+    caches = (obj, col)
+
+    node_names = [f"node-{i}" for i in range(6)]
+    for n in node_names:
+        node = make_node(n)
+        for c in caches:
+            c.add_node(node)
+
+    next_id = [0]
+    assumed: List[v1.Pod] = []       # assumed, unconfirmed
+    confirmed: List[v1.Pod] = []     # informer-confirmed
+
+    def mk(node):
+        next_id[0] += 1
+        return _mk_pod(next_id[0], node,
+                       prio=rng.choice([None, -5, 0, 3, 100]))
+
+    for step in range(250):
+        op = rng.randrange(10)
+        if op <= 2:  # batched assume harvest
+            pods = [mk(rng.choice(node_names))
+                    for _ in range(rng.randrange(1, 9))]
+            res_o = obj.assume_pods(list(pods))
+            res_c = col.assume_pods(list(pods))
+            assert res_o == res_c
+            for c in caches:
+                c.finish_binding_many(pods)
+            assumed.extend(pods)
+        elif op == 3 and assumed:  # informer confirm (maybe relocated)
+            p = assumed.pop(rng.randrange(len(assumed)))
+            confirm = v1.Pod(
+                metadata=p.metadata,
+                spec=v1.PodSpec(
+                    node_name=(rng.choice(node_names) if rng.random() < 0.2
+                               else p.spec.node_name),
+                    priority=p.spec.priority,
+                    containers=p.spec.containers,
+                ),
+            )
+            for c in caches:
+                c.add_pod(confirm)
+            confirmed.append(confirm)
+        elif op == 4 and assumed:  # forget (failed bind)
+            p = assumed.pop(rng.randrange(len(assumed)))
+            for c in caches:
+                c.forget_pod(p)
+        elif op == 5 and confirmed:  # informer update
+            p = confirmed[rng.randrange(len(confirmed))]
+            for c in caches:
+                c.update_pod(p, p)
+        elif op == 6 and confirmed:  # informer remove
+            p = confirmed.pop(rng.randrange(len(confirmed)))
+            for c in caches:
+                c.remove_pod(p)
+        elif op == 7:  # clock advance + TTL sweep
+            clock[0] += rng.choice([1.0, 6.0, 11.0])
+            n_o = obj.cleanup_expired_assumed_pods()
+            n_c = col.cleanup_expired_assumed_pods()
+            assert n_o == n_c
+            if n_o:
+                # expired pods left both caches; prune the mirror
+                live = set(obj._pod_states)
+                assumed[:] = [p for p in assumed
+                              if v1.pod_key(p) in live]
+        elif op == 8:  # node heartbeat/update
+            node = make_node(rng.choice(node_names))
+            for c in caches:
+                c.update_node(node)
+        elif op == 9 and node_churn:  # remove + re-add a node
+            name = rng.choice(node_names)
+            for c in caches:
+                c.remove_node(name)
+            # pods bound there survive in _pod_states (informer truth);
+            # drop them from our mirror lists only when later ops would
+            # trip NodeInfo.remove_pod on the fresh empty node
+            confirmed[:] = [p for p in confirmed
+                            if p.spec.node_name != name]
+            assumed[:] = [p for p in assumed
+                          if p.spec.node_name != name]
+            for key in [k for k, ps in obj._pod_states.items()
+                        if ps.pod.spec.node_name == name]:
+                for c in caches:
+                    ps = c._pod_states.get(key)
+                    if ps is not None:
+                        c.remove_pod(ps.pod)
+            node = make_node(name)
+            for c in caches:
+                c.add_node(node)
+        _assert_same_external_state(obj, col)
+        _assert_columnar_lockstep(col, check_assumed=not node_churn)
+        assert obj.min_pod_priority() == _scan_min_priority(obj)
+        assert col.min_pod_priority() == _scan_min_priority(col)
+
+
+def test_min_pod_priority_multiset_under_churn():
+    """Satellite regression: the incremental multiset tracks the O(n)
+    scan through every add/confirm/update/remove/forget/expiry
+    transition, including duplicate priorities and the empty-cache
+    default of 0."""
+    rng = random.Random(99)
+    clock = [0.0]
+    cache = SchedulerCache(ttl=5.0, now=lambda: clock[0])
+    assert cache.min_pod_priority() == 0
+    cache.add_node(make_node("n0"))
+    live = []
+    for i in range(400):
+        r = rng.random()
+        if r < 0.5 or not live:
+            p = _mk_pod(1000 + i, "n0",
+                        prio=rng.choice([None, -3, -3, 0, 2, 2, 50]))
+            assert cache.assume_pods([p]) == [True]
+            cache.finish_binding_many([p])
+            live.append(p)
+        elif r < 0.7:
+            p = live.pop(rng.randrange(len(live)))
+            cache.forget_pod(p)
+        elif r < 0.9:
+            p = live.pop(rng.randrange(len(live)))
+            cache.add_pod(p)     # confirm
+            cache.remove_pod(p)  # then informer delete
+        else:
+            clock[0] += 6.0
+            cache.cleanup_expired_assumed_pods()
+            keys = set(cache._pod_states)
+            live[:] = [p for p in live if v1.pod_key(p) in keys]
+        assert cache.min_pod_priority() == _scan_min_priority(cache)
+    for p in list(live):
+        cache.forget_pod(p)
+    assert cache.min_pod_priority() == 0
+    assert cache._prio_counts == {}
+
+
+def _full_rebuild_image_states(cache: SchedulerCache):
+    """The pre-satellite algorithm, verbatim: index over ALL nodes."""
+    names_with_node = [
+        n for n, ni in cache._nodes.items() if ni.node is not None
+    ]
+    image_nodes: Dict[str, set] = {}
+    for name in names_with_node:
+        node = cache._nodes[name].node
+        for image in node.status.images or []:
+            for nm in image.names or []:
+                image_nodes.setdefault(nm, set()).add(name)
+    out = {}
+    for name in names_with_node:
+        ni = cache._nodes[name]
+        states = {}
+        for image in ni.node.status.images or []:
+            for nm in image.names or []:
+                states[nm] = (image.size_bytes, len(image_nodes[nm]))
+        out[name] = states
+    return out
+
+
+def _node_with_images(name: str, images: Dict[str, int]) -> v1.Node:
+    node = make_node(name)
+    node.status.images = [
+        v1.ContainerImage(names=[nm], size_bytes=sz)
+        for nm, sz in images.items()
+    ]
+    return node
+
+
+def test_incremental_image_index_matches_full_rebuild():
+    """Satellite: ImageStateSummary equivalence against the full
+    rebuild through add/update/remove node churn — including the
+    spread-count (num_nodes) updates on OTHER holders when one node
+    gains or loses an image."""
+    from kubernetes_tpu.scheduler.framework.snapshot import Snapshot
+
+    rng = random.Random(5)
+    cache = SchedulerCache()
+    image_pool = [f"registry.example/img-{i}:v1" for i in range(7)]
+    snap = Snapshot([])
+    current: Dict[str, Dict[str, int]] = {}
+
+    def check():
+        nonlocal snap
+        snap = cache.update_snapshot(snap)
+        expected = _full_rebuild_image_states(cache)
+        actual = {}
+        for ni in snap.list():
+            actual[ni.node.metadata.name] = {
+                nm: (st.size, st.num_nodes)
+                for nm, st in ni.image_states.items()
+            }
+        assert actual == expected
+
+    for step in range(60):
+        op = rng.randrange(4)
+        name = f"inode-{rng.randrange(5)}"
+        if op <= 1:  # add/update with a random image subset
+            imgs = {nm: (i + 1) * 1000
+                    for i, nm in enumerate(image_pool)
+                    if rng.random() < 0.4}
+            current[name] = imgs
+            cache.add_node(_node_with_images(name, imgs))
+        elif op == 2 and name in current:  # mutate one image in/out
+            imgs = dict(current[name])
+            nm = rng.choice(image_pool)
+            if nm in imgs:
+                del imgs[nm]
+            else:
+                imgs[nm] = 12345
+            current[name] = imgs
+            cache.update_node(_node_with_images(name, imgs))
+        elif op == 3 and name in current:
+            del current[name]
+            cache.remove_node(name)
+        check()
+
+
+class _RecordingListener(CacheListener):
+    def __init__(self):
+        self.events = []
+
+    def on_add_pod(self, pod, node_name):
+        self.events.append(("add", v1.pod_key(pod), node_name))
+
+    def on_remove_pod(self, pod, node_name):
+        self.events.append(("remove", v1.pod_key(pod), node_name))
+
+
+def test_on_assume_pods_default_preserves_per_pod_stream():
+    """A listener that only implements the per-pod hooks must observe
+    the exact same event stream from the batched columnar assume as
+    from the object path — the CacheListener.on_assume_pods default."""
+    streams = {}
+    for columnar in (False, True):
+        cache = SchedulerCache(columnar=columnar)
+        rec = _RecordingListener()
+        cache.add_listener(rec)
+        cache.add_node(make_node("n0"))
+        cache.add_node(make_node("n1"))
+        pods = [_mk_pod(i, f"n{i % 2}") for i in range(10)]
+        assert all(cache.assume_pods(pods))
+        cache.forget_pod(pods[0])
+        streams[columnar] = rec.events
+    assert streams[False] == streams[True]
+    assert streams[True][:3] == [
+        ("add", "default/p-0", "n0"),
+        ("add", "default/p-1", "n1"),
+        ("add", "default/p-2", "n0"),
+    ]
