@@ -58,7 +58,7 @@ from .selectors import (
     compile_pod_node_constraints,
     compile_selector,
 )
-from .vocab import Interner, bucket_capacity
+from .vocab import Interner, bucket_capacity, node_headroom
 
 # Taint effect codes (device-side)
 EFFECT_NONE = 0
@@ -190,15 +190,32 @@ class ClusterEncoding:
         self._dirty_pods: Set[int] = set()
         self._dirty_terms: bool = False
         self.node_index: Dict[str, int] = {}
-        self.node_names: List[str] = []
+        # lane -> name; None marks a tombstone lane (incrementally
+        # removed node awaiting reuse). len(node_names) is the lane
+        # high-water mark (n_lanes), NOT the live node count (n_nodes).
+        self.node_names: List[Optional[str]] = []
         self.pod_index: Dict[str, int] = {}
         self._pod_free: List[int] = []
+        # tombstone lanes available for incremental node adds
+        self._node_free: List[int] = []
+        # node names referenced by pods that have NO encoded row (their
+        # node was deleted; rebuild skipped them). Re-adding such a name
+        # incrementally would miss re-encoding those pods — structural.
+        self._ghost_nodes: Set[str] = set()
+        # device-side n_nodes / img_nodes pending sync (incremental node
+        # adds/removes; the dirty-row scatter doesn't cover them)
+        self._dirty_meta: bool = False
         self._anti_terms: Optional[_TermRows] = None
         self._score_terms: Optional[_TermRows] = None
         # capacity floors (reserve()): rebuilds size rows to at least these
         self._pod_reserve = 0
         self._anti_reserve = 0
         self._score_reserve = 0
+        self._node_reserve = 0
+        # node-lane capacity quantum: the mesh backend sets this to the
+        # shard count so padded capacity divides the mesh evenly and the
+        # session's lane space aligns with the encoding's
+        self.node_quantum = 1
         # volume hook (scheduler/volume_device.py VolumeDeviceResolver):
         # contributes attach-limit scalars to pod requests and node
         # allocatable, and tracks PVC reference counts. None = volumes
@@ -215,7 +232,7 @@ class ClusterEncoding:
         self.version = 0
 
     def reserve(self, pods: int = 0, anti_terms: int = 0,
-                score_terms: int = 0) -> None:
+                score_terms: int = 0, nodes: int = 0) -> None:
         """Pre-size row capacities for a workload of known scale.
 
         Without a reserve, a workload that grows from 1k to 20k pods walks
@@ -228,10 +245,12 @@ class ClusterEncoding:
         self._pod_reserve = max(self._pod_reserve, pods)
         self._anti_reserve = max(self._anti_reserve, anti_terms)
         self._score_reserve = max(self._score_reserve, score_terms)
+        self._node_reserve = max(self._node_reserve, nodes)
         A = self._arrays
         if (
             not A
             or self._pod_reserve > A["pvalid"].shape[0]
+            or self._node_reserve > A["valid"].shape[0]
             or (self._anti_terms is not None
                 and self._anti_reserve > self._anti_terms.valid.shape[0])
             or (self._score_terms is not None
@@ -252,12 +271,66 @@ class ClusterEncoding:
                 self._pods[v1.pod_key(p)] = (p, p.spec.node_name)
         self._rebuild_needed = True
 
-    def add_node(self, node: v1.Node) -> None:
+    def add_node(self, node: v1.Node) -> Optional[int]:
+        """Add (or update) a node. A brand-new node whose vocab needs fit
+        the current capacity buckets lands INCREMENTALLY in a free lane
+        (a tombstone from a prior remove, or a pre-padded tail lane from
+        the headroom/reserve sizing): the row is encoded in place, the
+        n_nodes/img_nodes meta marked for device sync, and the lane
+        index returned so session-level node deltas can ride along.
+        Updates of existing nodes and anything that would grow a vocab
+        bucket or the lane space stay structural (returns None, rebuild
+        flagged) — at 100k nodes the headroom knob is what keeps churn
+        on the incremental path."""
         self.version += 1
-        if node.metadata.name not in self._nodes:
-            self._node_order.append(node.metadata.name)
-        self._nodes[node.metadata.name] = node
-        self._rebuild_needed = True
+        name = node.metadata.name
+        fresh = name not in self._nodes
+        if fresh:
+            self._node_order.append(name)
+        self._nodes[name] = node
+        lane = self._try_add_node_arrays(node) if fresh else None
+        if lane is None:
+            self._rebuild_needed = True
+        return lane
+
+    def _try_add_node_arrays(self, node: v1.Node) -> Optional[int]:
+        A = self._arrays
+        name = node.metadata.name
+        # a name with ghost pods (rows skipped because this node was
+        # gone at the last rebuild) must re-encode those pods — rebuild
+        if self._rebuild_needed or not A or name in self._ghost_nodes:
+            return None
+        # vocab growth guard: crossing a capacity bucket changes row
+        # WIDTHS; a new taint id (even inside its bucket) would miss its
+        # effect code in the taint_effect row — both structural
+        before = (
+            self.node_key_vocab.capacity, self.node_pair_vocab.capacity,
+            len(self.taint_vocab), self.scalar_vocab.capacity,
+            self.image_vocab.capacity, self.avoid_vocab.capacity,
+        )
+        self._intern_node_vocabs(node)
+        after = (
+            self.node_key_vocab.capacity, self.node_pair_vocab.capacity,
+            len(self.taint_vocab), self.scalar_vocab.capacity,
+            self.image_vocab.capacity, self.avoid_vocab.capacity,
+        )
+        if before != after:
+            return None
+        if self._node_free:
+            lane = self._node_free.pop()
+        elif len(self.node_names) < A["valid"].shape[0]:
+            lane = len(self.node_names)
+            self.node_names.append(None)
+        else:
+            return None  # lane space exhausted: capacity ladder decides
+        self._encode_node_row(lane, node)
+        self.node_names[lane] = name
+        self.node_index[name] = lane
+        for iid in self._node_image_ids(node):
+            A["img_nodes"][iid] += 1
+        self._dirty_nodes.add(lane)
+        self._dirty_meta = True
+        return lane
 
     def update_node(self, node: v1.Node) -> None:
         self.add_node(node)
@@ -305,11 +378,45 @@ class ClusterEncoding:
         self._dirty_nodes.add(i)
         return dalloc, dallowed
 
-    def remove_node(self, node_name: str) -> None:
+    def remove_node(self, node_name: str) -> Optional[int]:
+        """Remove a node. A pod-free node leaves INCREMENTALLY: its row
+        is zeroed (valid=0 makes the lane infeasible, id columns hit the
+        vocab null sentinel), the lane becomes a tombstone reused by the
+        next add, and the lane index is returned for session node
+        deltas. A node still carrying pods stays structural — its pods'
+        rows must be dropped too, which only rebuild does."""
         self.version += 1
-        self._nodes.pop(node_name, None)
+        node = self._nodes.pop(node_name, None)
         self._node_order = [n for n in self._node_order if n != node_name]
-        self._rebuild_needed = True
+        lane = (
+            self._try_remove_node_arrays(node_name, node)
+            if node is not None else None
+        )
+        if lane is None:
+            self._rebuild_needed = True
+        return lane
+
+    def _try_remove_node_arrays(self, node_name: str,
+                                node: v1.Node) -> Optional[int]:
+        A = self._arrays
+        if self._rebuild_needed or not A:
+            return None
+        lane = self.node_index.get(node_name)
+        if lane is None:
+            return None
+        if int(A["pod_count"][lane]) != 0:
+            return None  # bound pods: their rows die only at rebuild
+        for iid in self._node_image_ids(node):
+            if A["img_nodes"][iid] > 0:
+                A["img_nodes"][iid] -= 1
+        for k in self._NODE_ROW_KEYS:
+            A[k][lane] = 0
+        self.node_index.pop(node_name, None)
+        self.node_names[lane] = None
+        self._node_free.append(lane)
+        self._dirty_nodes.add(lane)
+        self._dirty_meta = True
+        return lane
 
     def add_pod(self, pod: v1.Pod, node_name: Optional[str] = None) -> None:
         """Assume/confirm a pod onto a node (cache AssumePod analog,
@@ -384,7 +491,29 @@ class ClusterEncoding:
 
     @property
     def n_nodes(self) -> int:
+        """LIVE node count — the kernel-image denominator and every
+        "how many nodes exist" consumer. Under incremental node churn
+        this diverges from the LANE high-water mark (tombstoned rows
+        keep their lane); use `n_lanes` to slice kernel outputs."""
         return len(self._node_order)
+
+    @property
+    def n_lanes(self) -> int:
+        """Node-LANE high-water mark: live rows + tombstones. Kernel
+        outputs are indexed by lane, so `[:n]` slices and node_names
+        lookups must use this, not n_nodes."""
+        return len(self.node_names) if self._arrays else self.n_nodes
+
+    def _node_image_ids(self, node: v1.Node) -> set:
+        """Interned ids of this node's images (deduped across tags) —
+        the rows of A["img_nodes"] the node contributes to."""
+        ids = set()
+        for image in node.status.images or []:
+            for n in image.names or []:
+                iid = self.image_vocab.get(normalized_image_name(n))
+                if iid:
+                    ids.add(iid)
+        return ids
 
     @staticmethod
     def node_fingerprint(node: v1.Node) -> tuple:
@@ -544,7 +673,18 @@ class ClusterEncoding:
             pod_infos[key] = PodInfo(pod)
 
         n = len(self._node_order)
-        ncap = bucket_capacity(max(n, 1))
+        # node-lane capacity: reserve floor + growth headroom
+        # (KTPU_NODE_HEADROOM), rounded up to the mesh quantum so the
+        # padded axis divides the shard count evenly — node adds then
+        # land in pre-padded tail lanes (add_node's incremental path)
+        # instead of walking the capacity ladder through rebuilds
+        want = max(n, self._node_reserve, 1)
+        h = node_headroom()
+        if h:
+            want = max(want, int(-(-n * (1.0 + h) // 1)))
+        ncap = bucket_capacity(want)
+        q = max(1, int(self.node_quantum))
+        ncap = -(-ncap // q) * q
         pcap = bucket_capacity(
             max(len(self._pods), self._pod_reserve, 1), minimum=64
         )
@@ -596,6 +736,7 @@ class ClusterEncoding:
 
         self.node_index = {}
         self.node_names = []
+        self._node_free = []
         for i, node_name in enumerate(self._node_order):
             self.node_index[node_name] = i
             self.node_names.append(node_name)
@@ -647,9 +788,11 @@ class ClusterEncoding:
 
         self.pod_index = {}
         self._pod_free = list(range(pcap - 1, -1, -1))
+        self._ghost_nodes = set()
         for key, (pod, node_name) in self._pods.items():
             nidx = self.node_index.get(node_name)
             if nidx is None:
+                self._ghost_nodes.add(node_name)
                 # pod bound to a DELETED node (node remove raced bound
                 # pods — the reference's cache keeps such pods on a ghost
                 # nodeInfo until they drain, cache.go removeNode). No row:
@@ -666,6 +809,7 @@ class ClusterEncoding:
         self._dirty_nodes = set()
         self._dirty_pods = set()
         self._dirty_terms = False
+        self._dirty_meta = False
 
     def _encode_node_row(self, i: int, node: v1.Node) -> None:
         A = self._arrays
@@ -902,6 +1046,7 @@ class ClusterEncoding:
             self._dirty_nodes = set()
             self._dirty_pods = set()
             self._dirty_terms = False
+            self._dirty_meta = False
             return self._device
         dev = self._device
         if self._dirty_nodes:
@@ -914,8 +1059,13 @@ class ClusterEncoding:
             for k, a in self._term_arrays().items():
                 dev[k] = jnp.asarray(a)
             self._dirty_terms = False
-        # n_nodes/img_nodes only change via node mutations, which force a
-        # rebuild (full re-upload above) — nothing further to sync here.
+        if self._dirty_meta:
+            # incremental node add/remove changes the live count (kernel
+            # image-spread denominator) and the per-image node spread —
+            # neither lives in a scattered row group
+            dev["n_nodes"] = jnp.asarray(np.array(self.n_nodes, np.int32))
+            dev["img_nodes"] = jnp.asarray(self._arrays["img_nodes"])
+            self._dirty_meta = False
         return dev
 
     def host_snapshot(self) -> dict:
@@ -931,6 +1081,29 @@ class ClusterEncoding:
         host.update(self._term_arrays())
         out = {k: np.array(a, copy=True) for k, a in host.items()}
         out["n_nodes"] = np.array(self.n_nodes, np.int32)
+        return out
+
+    def node_slice_cluster(self, lane: int) -> dict:
+        """One-lane cluster view for session node-join deltas: node rows
+        sliced to `[lane:lane+1]` (copies), pod rows zeroed (a fresh
+        node carries no pods), term tables zeroed, vocab-space arrays
+        (taint_effect, img_nodes) copied so the slice session's
+        prologue resolves ids identically to a full rebuild. A
+        PallasSession built on this has exactly the full rebuild's
+        column `lane` in its per-node statics — the node-delta envelope
+        checks (ops/sharded_scan.py node_join_delta) reject the cases
+        where that equivalence would break."""
+        A = self._arrays
+        out = {}
+        for k in self._NODE_ROW_KEYS:
+            out[k] = np.array(A[k][lane:lane + 1], copy=True)
+        for k in self._POD_ROW_KEYS:
+            out[k] = np.zeros_like(A[k])
+        for k in ("taint_effect", "img_nodes", "hard_pod_affinity_weight"):
+            out[k] = np.array(A[k], copy=True)
+        for k, a in self._term_arrays().items():
+            out[k] = np.zeros_like(a)
+        out["n_nodes"] = np.array(1, np.int32)
         return out
 
     def scratch_state(self) -> dict:

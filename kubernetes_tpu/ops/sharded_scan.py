@@ -57,6 +57,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..parallel.partition import (
+    SESSION_PARTITION_RULES,
+    session_specs,
+    shard_map_compat,
+    shard_tree,
+)
 from ..parallel.sharded import NODE_AXIS
 from . import kernel as K_ops
 from .hoisted import template_fingerprint
@@ -72,15 +78,6 @@ from .pallas_scan import (
     batch_prologue,
 )
 
-# node-sharded statics: key -> node axis position
-_NODE_DIM = {
-    "alloc": 1, "stat": 2, "regrow_f": 1, "zvalid_node_s": 1,
-    "konn_f": 1, "konn_s": 1, "shasall": 1, "valid_n": 1,
-    "prow_f": 1, "prow_s": 1, "onehot": 1,
-    # IPA term machinery (dyn_ipa sessions only)
-    "ipa_stat": 2, "anti_static": 2, "anti_konn": 2, "aff_static": 2,
-    "prow_ipa": 1,
-}
 _CARRY_KEYS = ("requested", "nzpc", "cnt_fn", "cnt_sn")
 
 
@@ -603,24 +600,16 @@ def _step_multi_fn(cfg, statics, tables, k, carry, xk, seen_in):
     return carry_i, {kk: jnp.stack(v) for kk, v in ys.items()}, conf_seen
 
 
-def _node_spec(k, ndim):
-    nd = _NODE_DIM[k]
-    return P(*[NODE_AXIS if i == nd else None for i in range(ndim)])
-
-
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "mesh", "k"),
     donate_argnames=("carry",),
 )
 def _sharded_scan(cfg, mesh, statics, tables, carry, xs, k: int = 1):
-    statics_spec = {
-        kk: _node_spec(kk, np.ndim(v)) if kk in _NODE_DIM else P()
-        for kk, v in statics.items()
-    }
-    carry_spec = {kk: P(None, NODE_AXIS) for kk in carry}
-    tables_spec = {kk: P() for kk in tables}
-    xs_spec = {kk: P() for kk in xs}
+    # placements are DECLARED, not wired: the same rule table that placed
+    # the session state at build time (parallel/partition.py
+    # SESSION_PARTITION_RULES) yields the shard_map in/out specs, so a
+    # new carry or static either matches a rule or fails at trace time
     ys_spec = {"best": P(), "score": P(), "n_feasible": P()}
     if k > 1:
         ys_spec["conflicts"] = P()
@@ -630,6 +619,10 @@ def _sharded_scan(cfg, mesh, statics, tables, carry, xs, k: int = 1):
         bp = int(np.shape(xs["tmpl"])[0])
         xs = {kk: v.reshape((bp // k, k) + v.shape[1:])
               for kk, v in xs.items()}
+    statics_spec = session_specs("statics", statics)
+    tables_spec = session_specs("tables", tables)
+    carry_spec = session_specs("carry", carry)
+    xs_spec = session_specs("xs", xs)
 
     def body(statics, tables, carry, xs):
         if k > 1:
@@ -645,15 +638,33 @@ def _sharded_scan(cfg, mesh, statics, tables, carry, xs, k: int = 1):
         step = functools.partial(_step_fn, cfg, statics, tables)
         return jax.lax.scan(step, carry, xs)
 
-    carry, ys = jax.shard_map(
-        body, mesh=mesh,
+    carry, ys = shard_map_compat(
+        body, mesh,
         in_specs=(statics_spec, tables_spec, carry_spec, xs_spec),
         out_specs=(carry_spec, ys_spec),
-        check_vma=False,
     )(statics, tables, carry, xs)
     if k > 1:
         ys = {kk: v.reshape((-1,) + v.shape[2:]) for kk, v in ys.items()}
     return carry, ys
+
+
+@functools.partial(
+    jax.jit, donate_argnames=("statics", "delta", "carry"))
+def _node_col_apply(statics, delta, carry, lane, cols):
+    """Write one node lane's columns into the sharded session state in a
+    single fused launch. The node-axis position of every leaf comes from
+    the same rule table that placed it, so the scatter follows whatever
+    sharding the rules declared."""
+    out = {"statics": dict(statics), "delta": dict(delta),
+           "carry": dict(carry)}
+    for group, g_cols in cols.items():
+        specs = session_specs(group, out[group])
+        for k2, colv in g_cols.items():
+            arr = out[group][k2]
+            axis = list(specs[k2]).index(NODE_AXIS)
+            out[group][k2] = jax.lax.dynamic_update_slice_in_dim(
+                arr, jnp.asarray(colv).astype(arr.dtype), lane, axis=axis)
+    return out["statics"], out["delta"], out["carry"]
 
 
 class ShardedPallasSession:
@@ -801,51 +812,68 @@ class ShardedPallasSession:
             tables["aff_total"] = ipa["aff_total"].astype(np.int32)
             tables["anti_valid"] = ipa["anti_valid"].astype(np.int32)
             tables["aff_valid"] = ipa["aff_valid"].astype(np.int32)
-        # device placement: node-sharded statics split over the mesh,
-        # tables replicated — collectives then ride ICI, not DCN
-        self._statics = {}
-        for k, v in statics.items():
-            if k in _NODE_DIM:
-                nd = _NODE_DIM[k]
-                ndim = np.ndim(v)
-                spec = P(*([None] * nd + [NODE_AXIS]
-                           + [None] * (ndim - nd - 1)))
-            else:
-                spec = P()
-            self._statics[k] = jax.device_put(
-                jnp.asarray(v), NamedSharding(mesh, spec))
-        repl = NamedSharding(mesh, P())
-        self._tables = {k: jax.device_put(jnp.asarray(v), repl)
-                        for k, v in tables.items()}
         # session-delta statics (apply_deltas): the same-pair masks read
-        # prow_f/prow_s (already node-sharded above); the cnt_sn factor
-        # needs the row-expanded s_src (node-sharded) + perno flags
-        self._delta_statics = {
-            "src_rows": jax.device_put(
-                jnp.asarray(padn(inner._src_rows, 1)),
-                NamedSharding(mesh, P(None, NODE_AXIS))),
-            "perno_rows": jax.device_put(
-                jnp.asarray(inner._perno_rows), repl),
+        # prow_f/prow_s (node-sharded statics); the cnt_sn factor needs
+        # the row-expanded s_src (node-sharded) + perno flags
+        delta_statics = {
+            "src_rows": padn(inner._src_rows, 1),
+            "perno_rows": inner._perno_rows,
         }
-        shard = NamedSharding(mesh, P(None, NODE_AXIS))
-        self._carry = {
-            "requested": jax.device_put(
-                jnp.asarray(padn(inner._requested0, 1)), shard),
-            "nzpc": jax.device_put(
-                jnp.asarray(padn(inner._nzpc0, 1)), shard),
-            "cnt_fn": jax.device_put(
-                jnp.asarray(padn(inner._cnt_fn0, 1)), shard),
-            "cnt_sn": jax.device_put(
-                jnp.asarray(padn(inner._cnt_sn0, 1)), shard),
+        carry0 = {
+            "requested": padn(inner._requested0, 1),
+            "nzpc": padn(inner._nzpc0, 1),
+            "cnt_fn": padn(inner._cnt_fn0, 1),
+            "cnt_sn": padn(inner._cnt_sn0, 1),
         }
         if self.UR:
             # session starts with zero ASSUMED pods (existing pods live
             # in the static tables); kcnt is PER-SHARD partial totals —
             # one column per shard, psum'd at read
-            self._carry["ucnt"] = jax.device_put(
-                jnp.zeros((self.UR, self.Nps), jnp.int32), shard)
-            self._carry["kcnt"] = jax.device_put(
-                jnp.zeros((self.UR, nsh), jnp.int32), shard)
+            carry0["ucnt"] = np.zeros((self.UR, self.Nps), np.int32)
+            carry0["kcnt"] = np.zeros((self.UR, nsh), np.int32)
+        # device placement is DECLARED by the session rule table
+        # (parallel/partition.py SESSION_PARTITION_RULES): node-sharded
+        # leaves split over the mesh so collectives ride ICI, tables
+        # replicate, and a leaf no rule covers fails construction loudly
+        placed = shard_tree(
+            {"statics": statics, "tables": tables,
+             "delta": delta_statics, "carry": carry0},
+            SESSION_PARTITION_RULES, mesh)
+        self._statics = placed["statics"]
+        self._tables = placed["tables"]
+        self._delta_statics = placed["delta"]
+        self._carry = placed["carry"]
+
+        # ---- node-delta envelope (node_join_delta / node_leave_delta) --
+        # Node add/remove stays a per-lane column write when NOTHING
+        # cross-node can change: no assumed-term machinery (UR), no
+        # existing-pod affinity terms, no image-locality scores (they
+        # embed the global node count), and hostname-only score
+        # topologies (zone one-hots embed a global value vocab). Within
+        # that envelope a 1-node slice session reproduces the full
+        # rebuild's column exactly (see node_join_delta).
+        self._templates = list(template_arrays_list)
+        f_valid_b = np.asarray(tb["f_valid"], bool)
+        s_valid_b = np.asarray(tb["s_valid"], bool)
+        rows_f = np.zeros(TCp, bool)
+        rows_s = np.zeros(TCp, bool)
+        for t in range(T):
+            rows_f[t * CP:t * CP + self.C] = f_valid_b[t]
+            rows_s[t * CP:t * CP + self.C] = s_valid_b[t]
+        self._rows_f_valid, self._rows_s_valid = rows_f, rows_s
+        # host mirrors of the sharded pair rows: the fresh-pair /
+        # pair-distinct envelope checks run against these (kept in sync
+        # by the node deltas themselves)
+        self._prow_f_np = padn(inner._prow_f, 1, fill=-1)
+        self._prow_s_np = padn(inner._prow_s, 1, fill=-1)
+        cluster_terms = bool(
+            np.asarray(cluster["at_valid"]).any()
+            or np.asarray(cluster["st_valid"]).any())
+        img_rows = inner._stat[:T * SR].reshape(T, SR, -1)[:, 4, :]
+        self._node_delta_ok = (
+            self.UR == 0 and not cluster_terms
+            and not img_rows.any()
+            and bool(np.all(inner._s_perno[s_valid_b])))
 
     def schedule(self, pod_arrays_list: List[Dict]) -> Dict:
         """Enqueue one batch (async); decisions(ys) blocks. KeyError on
@@ -906,12 +934,34 @@ class ShardedPallasSession:
     delta_compatible = PallasSession.delta_compatible
 
     def apply_deltas(self, deltas: List[Dict]) -> None:
-        """Sharded face of the session-delta contract (see
-        HoistedSession.apply_deltas): per-shard counts patch through the
-        SAME fused _carry_delta_scan — the node-sharded carry and the
-        sharded prow/src statics flow through GSPMD, so each shard
-        updates only its node slice and the per-shard kcnt partials are
-        untouched (batchable pods never enter the assumed-term counts)."""
+        """Sharded face of the session-delta contract, extended with the
+        node-axis deltas (node-join / node-leave): pod/alloc deltas batch
+        through the fused _carry_delta_scan in runs, node deltas apply as
+        per-lane column writes BETWEEN those runs — ordering matters,
+        because a pod delta may reference a lane a node-join in the same
+        flush introduced."""
+        run: List[Dict] = []
+        for d in deltas:
+            if d["kind"] in ("node-join", "node-leave"):
+                if run:
+                    self._apply_pod_deltas(run)
+                    run = []
+                self._statics, self._delta_statics, self._carry = \
+                    _node_col_apply(
+                        self._statics, self._delta_statics, self._carry,
+                        jnp.int32(d["lane"]), d["cols"])
+            else:
+                run.append(d)
+        if run:
+            self._apply_pod_deltas(run)
+
+    def _apply_pod_deltas(self, deltas: List[Dict]) -> None:
+        """Per-shard counts patch through the SAME fused
+        _carry_delta_scan as HoistedSession.apply_deltas — the
+        node-sharded carry and the sharded prow/src statics flow through
+        GSPMD, so each shard updates only its node slice and the
+        per-shard kcnt partials are untouched (batchable pods never
+        enter the assumed-term counts)."""
         rp = int(self._carry["requested"].shape[0])
         rows = []
         for d in deltas:
@@ -971,6 +1021,140 @@ class ShardedPallasSession:
             self._delta_statics["perno_rows"],
             {k: jnp.asarray(v) for k, v in xs.items()},
         )
+
+    # -- node-axis deltas --------------------------------------------------
+
+    def _pair_rows_shared(self, pf: np.ndarray, ps: np.ndarray,
+                          lane: int) -> bool:
+        """True when any pair id in (pf, ps) also appears at ANOTHER lane
+        of the same valid constraint row. A shared pair couples lanes
+        through the registration rows (f_reg_real in the prologue): the
+        node event would change columns other than `lane`, so it must go
+        structural. Pair id 0 (node lacks the key) is exempt — konn==0
+        gates those lanes dead for the row."""
+        for rows_valid, col, mirror in (
+                (self._rows_f_valid, pf, self._prow_f_np),
+                (self._rows_s_valid, ps, self._prow_s_np)):
+            hit = ((mirror == col[:, None]) & (col[:, None] > 0)
+                   & rows_valid[:, None])
+            hit[:, lane] = False
+            if hit.any():
+                return True
+        return False
+
+    def node_join_delta(self, slice_cluster: Dict,
+                        lane: int) -> Optional[Dict]:
+        """Column-write delta for a node ADD at `lane`, or None when the
+        add falls outside the delta envelope (caller rebuilds).
+
+        The column comes from a 1-node PallasSession built on the node's
+        own slice of the encoding (pod rows and term tables zeroed, see
+        ClusterEncoding.node_slice_cluster). Inside the envelope —
+        _node_delta_ok, fresh pair ids, a pod-free node — that slice's
+        lane 0 IS what a full rebuild would put at `lane`: every
+        surviving static is per-node, pair ids are global encoding vocab
+        ids, and a fresh pair's registration equals the node's own
+        eligibility. The alloc column is rescaled by the LIVE session's
+        per-dimension GCD from the raw encoding values (the slice
+        derives its own, coarser GCD)."""
+        if not self._node_delta_ok or not (0 <= lane < self.Nps):
+            return None
+        try:
+            s1 = PallasSession(slice_cluster, self._templates, self.weights)
+        except (PallasUnsupported, KeyError):
+            return None
+        T, SR, TCp = self.T, self.SR, self.TCp
+        if (s1.T, s1.C, s1.CP, s1.SR, s1.R) != (
+                T, self.C, self.CP, SR, self.R):
+            return None
+        raw = np.asarray(slice_cluster["alloc"], np.int64)[0]     # [R]
+        if np.any(raw % self._gcd[: self.R]):
+            return None
+        scaled = raw // self._gcd[: self.R]
+        if int(np.abs(scaled).max(initial=0)) * (MAX_NODE_SCORE + 1) \
+                >= 2 ** 31:
+            return None
+        # a fresh node carries no pods: its utilization columns are zero
+        # apart from the allowed-pods budget (nzpc row 3)
+        if s1._requested0[:, 0].any() or s1._nzpc0[:3, 0].any():
+            return None
+        pf = s1._prow_f[: TCp, 0].copy()
+        ps = s1._prow_s[: TCp, 0].copy()
+        if int(max(pf.max(initial=0), ps.max(initial=0))) >= 2 ** 24:
+            return None
+        if self._pair_rows_shared(pf, ps, lane):
+            return None
+        stat_col = s1._stat[: T * SR].reshape(T, SR, -1)[:, :, 0]
+        if stat_col[:, 1].any() or stat_col[:, 4].any():
+            # the slice disagrees with the live envelope (terms / image
+            # scores at the joining node) — structural
+            return None
+        alloc_col = np.zeros(self._alloc.shape[0], np.int32)
+        alloc_col[: self.R] = scaled.astype(np.int32)
+        cols = {
+            "statics": {
+                "alloc": alloc_col[:, None],
+                "stat": stat_col[:, :, None],
+                "regrow_f": s1._regrow_f[: TCp, 0:1],
+                "konn_f": s1._konn_f[: TCp, 0:1],
+                "konn_s": s1._konn_s[: TCp, 0:1],
+                "shasall": s1._shasall[: T, 0:1],
+                "valid_n": np.ones((1, 1), np.int32),
+                "prow_f": pf[:, None],
+                "prow_s": ps[:, None],
+            },
+            "delta": {"src_rows": s1._src_rows[: TCp, 0:1]},
+            "carry": {
+                "requested": np.zeros(
+                    (int(self._carry["requested"].shape[0]), 1), np.int32),
+                "nzpc": s1._nzpc0[:, 0:1],
+                "cnt_fn": s1._cnt_fn0[: TCp, 0:1],
+                "cnt_sn": s1._cnt_sn0[: TCp, 0:1],
+            },
+        }
+        # host mirrors move at QUEUE time so later joins/leaves in the
+        # same flush check against the post-queue state
+        self._prow_f_np[:, lane] = pf
+        self._prow_s_np[:, lane] = ps
+        self._alloc[:, lane] = alloc_col
+        return {"kind": "node-join", "lane": lane, "cols": cols}
+
+    def node_leave_delta(self, lane: int) -> Optional[Dict]:
+        """Column-clear delta for a node REMOVE at `lane` (the lane
+        reverts to padding form: invalid, zero statics and counts, -1
+        pair rows), or None outside the envelope. The caller guarantees
+        the node hosts no pods; shared pair ids go structural for the
+        same registration reason as joins."""
+        if not self._node_delta_ok or not (0 <= lane < self.Nps):
+            return None
+        if self._pair_rows_shared(self._prow_f_np[:, lane],
+                                  self._prow_s_np[:, lane], lane):
+            return None
+        T, SR, TCp = self.T, self.SR, self.TCp
+        z = np.zeros((TCp, 1), np.int32)
+        cols = {
+            "statics": {
+                "alloc": np.zeros((self._alloc.shape[0], 1), np.int32),
+                "stat": np.zeros((T, SR, 1), np.int32),
+                "regrow_f": z, "konn_f": z, "konn_s": z,
+                "shasall": np.zeros((T, 1), np.int32),
+                "valid_n": np.zeros((1, 1), np.int32),
+                "prow_f": np.full((TCp, 1), -1, np.int32),
+                "prow_s": np.full((TCp, 1), -1, np.int32),
+            },
+            "delta": {"src_rows": z},
+            "carry": {
+                "requested": np.zeros(
+                    (int(self._carry["requested"].shape[0]), 1), np.int32),
+                "nzpc": np.zeros(
+                    (int(self._carry["nzpc"].shape[0]), 1), np.int32),
+                "cnt_fn": z, "cnt_sn": z,
+            },
+        }
+        self._prow_f_np[:, lane] = -1
+        self._prow_s_np[:, lane] = -1
+        self._alloc[:, lane] = 0
+        return {"kind": "node-leave", "lane": lane, "cols": cols}
 
 
 def _perno_rows(s_perno: np.ndarray, T: int, C: int, CP: int) -> np.ndarray:

@@ -383,14 +383,24 @@ class WhatifContext:
 
     @classmethod
     def from_host_snapshot(cls, host: Dict, node_names,
-                           pod_arrays: Dict) -> "WhatifContext":
+                           pod_arrays: Dict, mesh=None) -> "WhatifContext":
         """Throwaway single-template hoisted view over a host-array
         snapshot (ClusterEncoding.host_snapshot). The snapshot is
         already a consistent copy, so the EXPENSIVE part — the device
         upload and the prologue build — runs outside the encoding
         owner's lock. Never touches the encoder's cached device dict
-        (no donation) and never counts as a session build."""
-        cluster = {k: jnp.asarray(a) for k, a in host.items()}
+        (no donation) and never counts as a session build. With `mesh`,
+        the snapshot is node-sharded first (parallel/sharded
+        shard_cluster) so the scratch view's statics and carry inherit
+        the mesh placement through GSPMD — at 100k nodes an unsharded
+        what-if copy would replicate the full cluster on every host."""
+        if mesh is not None:
+            from ..parallel.sharded import shard_cluster
+
+            cluster = shard_cluster(
+                {k: np.asarray(a) for k, a in host.items()}, mesh)
+        else:
+            cluster = {k: jnp.asarray(a) for k, a in host.items()}
         sess = HoistedSession(cluster, [pod_arrays], multipod_k=1)
         return cls(sess, sess._carry, node_names)
 

@@ -26,6 +26,7 @@ ops/hoisted.py) and by gang scheduling (scheduler/plugins/coscheduling.py).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, Optional
 
 import jax
@@ -33,9 +34,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..models.vocab import node_headroom
 from ..ops.kernel import DEFAULT_WEIGHTS, schedule_pod
+from .partition import CLUSTER_PARTITION_RULES, NODE_AXIS, shard_tree
 
-NODE_AXIS = "nodes"
+__all__ = [
+    "NODE_AXIS", "NODE_DIM0_KEYS", "make_mesh", "node_capacity_multiple",
+    "node_headroom", "pad_node_axis", "shard_cluster", "replicate_pod",
+    "select",
+    "ShardedScheduler",
+]
 
 # Cluster-dict arrays whose dim 0 is the node axis (ClusterEncoding node
 # rows). Everything else — pod rows, term tables, vocab-indexed vectors,
@@ -51,8 +59,18 @@ NODE_DIM0_KEYS = frozenset(
 
 
 def make_mesh(devices=None, n_devices: Optional[int] = None) -> Mesh:
-    """1-D device mesh over the node axis."""
+    """1-D device mesh over the node axis.
+
+    With no explicit count, `KTPU_MESH_DEVICES` picks how many local
+    devices to span (0/unset = all). On a CPU host, export
+    `XLA_FLAGS=--xla_force_host_platform_device_count=8` before jax
+    imports to simulate an 8-device mesh (tests/conftest.py forces this
+    for tier-1).
+    """
     if devices is None:
+        if n_devices is None:
+            n_devices = int(os.environ.get("KTPU_MESH_DEVICES", "0") or 0) \
+                or None
         devices = jax.devices()
         if n_devices is not None:
             devices = devices[:n_devices]
@@ -63,14 +81,21 @@ def node_capacity_multiple(mesh: Mesh) -> int:
     return int(mesh.devices.size)
 
 
-def pad_node_axis(cluster: Dict, multiple: int) -> Dict:
-    """Pad node-axis arrays so dim 0 divides the shard count.
+def pad_node_axis(cluster: Dict, multiple: int,
+                  headroom: Optional[float] = None) -> Dict:
+    """Pad node-axis arrays so dim 0 divides the shard count, with
+    growth headroom quantized to shard multiples.
 
     Padding rows are all-zero: `valid` stays False so padded nodes are
     infeasible, and id columns hit the vocab null sentinel (id 0).
+    `headroom` (default `KTPU_NODE_HEADROOM`) over-pads by a fraction of
+    the live node count so later node adds stay inside the same padded
+    shape — the delta-class envelope at 100k nodes.
     """
     n = cluster["valid"].shape[0]
-    target = -(-n // multiple) * multiple
+    h = node_headroom() if headroom is None else max(0.0, headroom)
+    want = max(n, int(-(-n * (1.0 + h) // 1)))
+    target = -(-want // multiple) * multiple
     if target == n:
         return cluster
     out = dict(cluster)
@@ -82,16 +107,11 @@ def pad_node_axis(cluster: Dict, multiple: int) -> Dict:
 
 
 def shard_cluster(cluster: Dict, mesh: Mesh) -> Dict:
-    """Place the cluster dict: node rows split over the mesh, rest replicated."""
+    """Place the cluster dict: node rows split over the mesh, rest
+    replicated — placements declared by CLUSTER_PARTITION_RULES
+    (parallel/partition.py), not per-key wiring."""
     cluster = pad_node_axis(cluster, node_capacity_multiple(mesh))
-    out = {}
-    for k, v in cluster.items():
-        if k in NODE_DIM0_KEYS:
-            spec = P(NODE_AXIS, *([None] * (np.ndim(v) - 1)))
-        else:
-            spec = P()
-        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
-    return out
+    return shard_tree(dict(cluster), CLUSTER_PARTITION_RULES, mesh)
 
 
 def replicate_pod(pod_arrays: Dict, mesh: Mesh) -> Dict:

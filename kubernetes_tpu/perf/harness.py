@@ -244,6 +244,12 @@ class Workload:
     # per-pod object writeback path for A/B rows (scripts/probe_assume.py
     # and the completion-tax adjudication in bench_configs.py)
     columnar: bool = True
+    # multi-host mesh scale-out: shard the node axis over this many
+    # devices (parallel/sharded.make_mesh; 0 = single-device backend).
+    # On CPU the devices are simulated — export
+    # XLA_FLAGS=--xla_force_host_platform_device_count=8 before jax
+    # imports (scripts/bench_configs.py and tests/conftest.py do)
+    mesh_devices: int = 0
 
 
 @dataclass
@@ -347,6 +353,12 @@ class Result:
     # shadow_sample=0, where the sentinel never runs)
     shadow_samples: int = 0
     shadow_drift: Optional[Dict[str, int]] = None
+    # node-axis shard count the row rode (scheduler_mesh_shards; 0 =
+    # single-device). Mesh rows' session_builds slugs carry the same
+    # number ("sharded@8/-") so per-rep build accounting in
+    # bench_configs.py stays per-shard-count when a rep falls off the
+    # mesh path
+    mesh_shards: int = 0
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -392,11 +404,25 @@ def _label_counts(counter, default: str = "-") -> Dict[str, int]:
     return out
 
 
+def _shard_suffix(key) -> str:
+    """"@<shards>" for builds that rode a mesh, "" for single-device —
+    mesh rows keep per-shard-count accounting without changing the
+    slugs every existing single-device row records."""
+    shards = key[2] if len(key) > 2 and key[2] else ""
+    return f"@{shards}" if shards else ""
+
+
 def _session_build_counts() -> Dict[str, int]:
-    """scheduler_tpu_session_builds_total by kind, from the live registry."""
+    """scheduler_tpu_session_builds_total by kind (plus "@<shards>" for
+    mesh builds), from the live registry."""
     from ..scheduler.metrics import session_builds
 
-    return _label_counts(session_builds, default="unknown")
+    out: Dict[str, int] = {}
+    for key, val in session_builds.items():
+        kind = key[0] if key else "unknown"
+        slug = f"{kind}{_shard_suffix(key)}"
+        out[slug] = out.get(slug, 0) + int(val)
+    return out
 
 
 def _session_build_reasons() -> Dict[str, int]:
@@ -408,7 +434,7 @@ def _session_build_reasons() -> Dict[str, int]:
     for key, val in session_builds.items():
         kind = key[0] if key else "unknown"
         reason = key[1] if len(key) > 1 and key[1] else "-"
-        slug = f"{kind}/{reason}"
+        slug = f"{kind}{_shard_suffix(key)}/{reason}"
         out[slug] = out.get(slug, 0) + int(val)
     return out
 
@@ -526,7 +552,23 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
                 disruptions_allowed=w.pdb_disruptions_allowed),
         ))
     factory = SharedInformerFactory(cs)
-    sched = Scheduler(cs, factory, backend=w.backend, max_batch=w.max_batch)
+    tpu_backend = None
+    if w.backend == "tpu" and w.mesh_devices:
+        import jax
+
+        from ..parallel.sharded import make_mesh
+        from ..scheduler.tpu_backend import TPUBackend
+
+        if len(jax.devices()) < w.mesh_devices:
+            raise RuntimeError(
+                f"mesh_devices={w.mesh_devices} but only "
+                f"{len(jax.devices())} devices; export XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={w.mesh_devices} "
+                f"before jax imports to simulate the mesh on CPU"
+            )
+        tpu_backend = TPUBackend(mesh=make_mesh(n_devices=w.mesh_devices))
+    sched = Scheduler(cs, factory, backend=w.backend, max_batch=w.max_batch,
+                      tpu_backend=tpu_backend)
     if w.backend == "tpu":
         # pre-size the encoding for the whole workload: without this the
         # pod/term tables walk the 1.5x capacity ladder and every step is
@@ -912,6 +954,11 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             trace_level=tracing.level(),
             shadow_samples=n_shadow,
             shadow_drift=shadow_drift,
+            mesh_shards=(
+                int(sched.tpu.mesh.devices.size)
+                if sched.tpu is not None and sched.tpu.mesh is not None
+                else 0
+            ),
         )
     finally:
         sched.stop()

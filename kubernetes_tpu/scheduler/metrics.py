@@ -156,8 +156,11 @@ session_rebuilds = legacy_registry.register(
         "(foreign-pod-add, pod-remove) should be near zero now that "
         "batchable pod events apply as carry deltas "
         "(scheduler_session_delta_applies_total) — a sustained rate "
-        "there means events are falling off the delta fast path.",
-        ("reason",),
+        "there means events are falling off the delta fast path. "
+        "shards = mesh shard count at teardown time ('' off-mesh): at "
+        "100k nodes a rebuild storm is a per-HOST cost, so alerts key "
+        "on the sharded series.",
+        ("reason", "shards"),
     )
 )
 session_delta_applies = legacy_registry.register(
@@ -179,8 +182,22 @@ session_builds = legacy_registry.register(
         "kind=pallas is the single-launch fast path; kind=hoisted is the "
         "jnp lax.scan fallback. A pallas->hoisted downgrade on a workload "
         "that previously rode pallas is a ~2.4x throughput cliff — alert "
-        "on it; the build also logs the downgrade reason.",
-        ("kind", "reason"),
+        "on it; the build also logs the downgrade reason. shards = mesh "
+        "shard count the session spans ('' off-mesh), so per-shard build "
+        "rates separate mesh rebuild storms from single-chip ones.",
+        ("kind", "reason", "shards"),
+    )
+)
+mesh_shards = legacy_registry.register(
+    Gauge(
+        "scheduler_mesh_shards",
+        "Devices in the node-axis scoring mesh (TPU-build metric): 0 = "
+        "single-device dispatch (no mesh), N = every per-node array is "
+        "split N ways and each host holds 1/N of the cluster encoding. "
+        "Changes only at backend construction — a drop to 0 in a fleet "
+        "that should be meshed means the mesh env (KTPU_MESH_DEVICES / "
+        "megascale topology) regressed.",
+        (),
     )
 )
 multipod_conflicts = legacy_registry.register(

@@ -158,6 +158,13 @@ class TPUBackend(CacheListener):
         # collectives. Decisions are bit-identical to single-device
         # (tests/test_sharded.py through the Scheduler loop).
         self.mesh = mesh
+        if mesh is not None:
+            # rebuild-time node capacity lands on a shard multiple, so
+            # the mesh path never re-pads (shape-stable across rebuilds)
+            # and incremental node adds stay inside the session's lanes
+            from ..parallel.sharded import node_capacity_multiple
+
+            self.enc.node_quantum = node_capacity_multiple(mesh)
         self._lock = threading.RLock()
         # cross-cycle hoisted session (ops/hoisted.py HoistedSession): the
         # device-resident carry survives between schedule_many calls as
@@ -307,12 +314,19 @@ class TPUBackend(CacheListener):
         # runtime-effective KTPU_* knob surface (utils/configz.py):
         # today the env vars are invisible at runtime; /configz shows
         # the values this backend actually resolved
+        from ..models.vocab import node_headroom as _nh
         from ..ops.kernel import multipod_k as _mk
         from ..utils import configz
+        from .metrics import mesh_shards
 
+        mesh_shards.set(
+            float(self.mesh.devices.size) if self.mesh is not None else 0.0)
         configz.install_knobs(
             "ktpu",
             multipod_k=_mk(platform=jax.devices()[0].platform),
+            mesh_devices=(
+                int(self.mesh.devices.size) if self.mesh is not None else 0),
+            node_headroom=_nh(),
             speculation=self.speculation,
             whatif=self.whatif,
             session_deltas=self.delta_patching,
@@ -442,6 +456,12 @@ class TPUBackend(CacheListener):
             return True
         return True
 
+    def _shards_label(self) -> str:
+        """`shards` metric label: mesh device count, '' off-mesh —
+        appended LAST at every inc site (label order is declared)."""
+        return str(int(self.mesh.devices.size)) if self.mesh is not None \
+            else ""
+
     def _invalidate_session(self, reason: str = "unspecified") -> None:
         # _session_assumed survives invalidation deliberately: an assume
         # echo (cache confirming a pod the torn-down session scheduled)
@@ -456,7 +476,7 @@ class TPUBackend(CacheListener):
             return
         from .metrics import session_rebuilds
 
-        session_rebuilds.inc(reason=reason)
+        session_rebuilds.inc(reason=reason, shards=self._shards_label())
         self._last_invalidate = reason
         tracing.event("session-teardown", "session", reason=reason)
         if _os.environ.get("KTPU_DEBUG_INVALIDATE"):
@@ -622,7 +642,7 @@ class TPUBackend(CacheListener):
         def attempt():
             self._check_dispatch_fault()
             decisions = self._session_schedule(arrays)
-            self._validate_decisions(decisions, self.enc.n_nodes)
+            self._validate_decisions(decisions, self.enc.n_lanes)
             return decisions
 
         try:
@@ -744,7 +764,8 @@ class TPUBackend(CacheListener):
             host = self.enc.host_snapshot()
             node_names = list(self.enc.node_names)
             version = self.enc.version
-        ctx = WhatifContext.from_host_snapshot(host, node_names, pod_arrays)
+        ctx = WhatifContext.from_host_snapshot(host, node_names, pod_arrays,
+                                               mesh=self.mesh)
         with self._lock:
             if (self._whatif_cache_version == version
                     and self.enc.version == version):
@@ -949,8 +970,9 @@ class TPUBackend(CacheListener):
     def on_add_node(self, node: v1.Node) -> None:
         with self._lock:
             self._node_fps[node.metadata.name] = ClusterEncoding.node_fingerprint(node)
-            self._invalidate_session("node-add")
-            self.enc.add_node(node)
+            lane = self.enc.add_node(node)
+            if not self._queue_node_delta(lane, "node-join"):
+                self._invalidate_session("node-add")
 
     def on_update_node(self, node: v1.Node) -> None:
         with self._lock:
@@ -1011,8 +1033,44 @@ class TPUBackend(CacheListener):
     def on_remove_node(self, node_name: str) -> None:
         with self._lock:
             self._node_fps.pop(node_name, None)
-            self._invalidate_session("node-remove")
-            self.enc.remove_node(node_name)
+            lane = self.enc.remove_node(node_name)
+            if not self._queue_node_delta(lane, "node-leave"):
+                self._invalidate_session("node-remove")
+
+    def _queue_node_delta(self, lane: Optional[int], kind: str) -> bool:
+        """Absorb a node add/remove into the LIVE session as a lane-column
+        delta. The encoding has already decided the host half: `lane` is
+        None when the event was structural there (vocab bucket growth,
+        lane space exhausted, node still carrying pods). The session half
+        gates itself (node_join_delta / node_leave_delta return None
+        outside their exactness envelope — shared topology pairs, term
+        templates, image-locality mass, conflict mode). True -> the event
+        is fully reconciled; False -> the caller tears the session down
+        (rebuild from the already-mutated encoding is always correct)."""
+        if lane is None or not self.delta_patching:
+            return False
+        sess = self._session
+        if sess is None:
+            return True  # nothing device-resident; next build sees it
+        if (
+            not hasattr(sess, "node_join_delta")
+            or len(self._deltas) >= self.max_queued_deltas
+        ):
+            return False
+        try:
+            if kind == "node-join":
+                d = sess.node_join_delta(
+                    self.enc.node_slice_cluster(lane), lane)
+            else:
+                d = sess.node_leave_delta(lane)
+        except Exception:  # noqa: BLE001 — rebuild is always correct
+            logger.warning("node delta classification failed; rebuilding",
+                           exc_info=True)
+            return False
+        if d is None:
+            return False
+        self._deltas.append(d)
+        return True
 
     # -- session-delta classification + apply ------------------------------
 
@@ -1208,7 +1266,10 @@ class TPUBackend(CacheListener):
             n_nodes = self.enc.n_nodes
             n_feasible = int(feasible.sum())
             if n_feasible == 0:
-                raise FitError(pod, n_nodes, self._statuses(out, n_nodes))
+                # statuses walk the LANE space (kernel outputs are
+                # lane-indexed); the FitError count stays the live count
+                raise FitError(
+                    pod, n_nodes, self._statuses(out, self.enc.n_lanes))
             best = self._select_host(total, feasible)
             return ScheduleResult(self.enc.node_names[best], n_nodes, n_feasible)
 
@@ -1238,7 +1299,7 @@ class TPUBackend(CacheListener):
                 from ..parallel import sharded
 
                 c = sharded.shard_cluster(c, self.mesh)
-            n_nodes = self.enc.n_nodes
+            n_nodes = self.enc.n_lanes  # kernel outputs are lane-indexed
             encoded = []
             skipped = set()
             for idx, p in enumerate(pods):
@@ -1867,6 +1928,7 @@ class TPUBackend(CacheListener):
         and downgrades are logged."""
         from .metrics import session_builds
 
+        sh = self._shards_label()
         templates = list(self._known_templates.values())
         cluster = self.enc.device_state()
         # KTPU_EXPLAIN (or an armed shadow sentinel): per-plugin
@@ -1879,7 +1941,7 @@ class TPUBackend(CacheListener):
             if self.mesh is not None:
                 from ..parallel import sharded
 
-                session_builds.inc(kind="hoisted", reason="explain")
+                session_builds.inc(kind="hoisted", reason="explain", shards=sh)
                 return HoistedSession(
                     sharded.shard_cluster(cluster, self.mesh),
                     templates, self.weights, explain_k=explain_k,
@@ -1887,7 +1949,7 @@ class TPUBackend(CacheListener):
             if self.use_pallas:
                 logger.warning(
                     "explain mode: hoisted session instead of pallas")
-            session_builds.inc(kind="hoisted", reason="explain")
+            session_builds.inc(kind="hoisted", reason="explain", shards=sh)
             return HoistedSession(
                 cluster, templates, self.weights, explain_k=explain_k)
         # degradation ladder: a DEMOTED backend (rung below the
@@ -1896,7 +1958,8 @@ class TPUBackend(CacheListener):
         # re-promotes and invalidates, so the NEXT build climbs back
         demoted = self.ladder.rung() < self.ladder.top
         if self.mesh is not None and demoted:
-            session_builds.inc(kind="hoisted", reason="mesh-ladder-demoted")
+            session_builds.inc(kind="hoisted", reason="mesh-ladder-demoted",
+                               shards=sh)
             from ..parallel import sharded
 
             return HoistedSession(
@@ -1914,7 +1977,7 @@ class TPUBackend(CacheListener):
             try:
                 s = ShardedPallasSession(
                     cluster, templates, self.weights, mesh=self.mesh)
-                session_builds.inc(kind="pallas", reason="mesh-sharded")
+                session_builds.inc(kind="pallas", reason="mesh-sharded", shards=sh)
                 return s
             except PallasUnsupported as e:
                 logger.warning(
@@ -1926,7 +1989,7 @@ class TPUBackend(CacheListener):
                 # throughput cliff than a single-chip one — alerting must
                 # tell them apart; slugs stay bounded
                 session_builds.inc(kind="hoisted",
-                                   reason=f"mesh-{e.reason}")
+                                   reason=f"mesh-{e.reason}", shards=sh)
             from ..parallel import sharded
 
             return HoistedSession(
@@ -1938,7 +2001,7 @@ class TPUBackend(CacheListener):
                 "ladder-demoted session build: %s instead of pallas",
                 self.ladder.mode(),
             )
-            session_builds.inc(kind="hoisted", reason="ladder-demoted")
+            session_builds.inc(kind="hoisted", reason="ladder-demoted", shards=sh)
         elif self.use_pallas:
             from ..ops.pallas_scan import PallasSession, PallasUnsupported
 
@@ -1949,7 +2012,7 @@ class TPUBackend(CacheListener):
                 # cleanly again
                 for b in self._suspect_buckets:
                     s.retire_exec(bucket=b)
-                session_builds.inc(kind="pallas", reason="")
+                session_builds.inc(kind="pallas", reason="", shards=sh)
                 # AOT-warm the ragged-tail batch buckets OFF the serving
                 # path: a daemon thread populates the (persistent)
                 # compile caches so a mid-window first-tail batch never
@@ -1964,9 +2027,10 @@ class TPUBackend(CacheListener):
                     "pallas scan unsupported for this workload shape (%s); "
                     "downgrading to the jnp hoisted session (~2.4x slower)", e,
                 )
-                session_builds.inc(kind="hoisted", reason=e.reason)
+                session_builds.inc(kind="hoisted", reason=e.reason, shards=sh)
         else:
-            session_builds.inc(kind="hoisted", reason="platform is not tpu")
+            session_builds.inc(kind="hoisted", reason="platform is not tpu",
+                               shards=sh)
         return HoistedSession(cluster, templates, self.weights)
 
     # -- helpers -----------------------------------------------------------
@@ -2002,7 +2066,10 @@ class TPUBackend(CacheListener):
         masks = {k: arr(k) for k, _ in MASK_PLUGINS}
         pts_unres = arr("pts_unresolvable")
         ipa_unres = arr("ipa_unresolvable")
+        names = self.enc.node_names
         for i in range(n_nodes):
+            if i >= len(names) or names[i] is None:
+                continue  # tombstoned lane: no node to report on
             failed = [name for key, name in MASK_PLUGINS if not masks[key][i]]
             if not failed:
                 continue
@@ -2013,7 +2080,7 @@ class TPUBackend(CacheListener):
                 or "NodeAffinity" in failed
             )
             reasons = [f"{name}" for name in failed]
-            statuses[self.enc.node_names[i]] = (
+            statuses[names[i]] = (
                 Status.unschedulable_and_unresolvable(*reasons)
                 if unresolvable
                 else Status.unschedulable(*reasons)

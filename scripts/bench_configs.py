@@ -21,6 +21,15 @@ import sys
 import time
 
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+# the mesh rows (mesh20k/50k/100k) shard the node axis over 8 devices;
+# on a CPU host the devices are simulated (harmless on real chips: the
+# flag only multiplies the HOST platform). Must land before jax imports.
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -259,6 +268,27 @@ CONFIGS = {
         num_init_pods=2048, num_pods=5000,
         template=PodTemplate(node_affinity_zones=["zone-0", "zone-1"]),
         max_batch=2048, timeout=900.0,
+    ),
+    # -- multi-host mesh scale-out (round 15): the node axis sharded
+    #    over an 8-device mesh (simulated on CPU via the XLA_FLAGS set
+    #    above; real ICI on a pod slice). Rows prove the 50k-100k-node
+    #    regime is survivable host-side — per-host session arrays are
+    #    bounded to Nps/8 rows — and that throughput holds while the
+    #    encoding/cache layers carry 20x the node count of the
+    #    single-device headline rows. Pod counts stay moderate: these
+    #    rows measure node-axis scale, not pod backlog (the 5000n rows
+    #    own that axis).
+    "mesh20k": Workload(
+        "Mesh-20000n-8sh", num_nodes=20000, num_init_pods=1024,
+        num_pods=4096, mesh_devices=8, max_batch=1024, timeout=1800.0,
+    ),
+    "mesh50k": Workload(
+        "Mesh-50000n-8sh", num_nodes=50000, num_init_pods=512,
+        num_pods=2048, mesh_devices=8, max_batch=512, timeout=2400.0,
+    ),
+    "mesh100k": Workload(
+        "Mesh-100000n-8sh", num_nodes=100000, num_init_pods=256,
+        num_pods=1024, mesh_devices=8, max_batch=256, timeout=3600.0,
     ),
 }
 

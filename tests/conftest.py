@@ -23,8 +23,25 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 
+import pytest  # noqa: E402
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: long-running soak tests, excluded from tier-1 (-m 'not slow')",
     )
+
+
+@pytest.fixture
+def sim_mesh():
+    """8-device simulated CPU mesh over the node axis — the tier-1 stand-in
+    for a real multi-host topology (the module docstring's XLA_FLAGS recipe
+    provides the virtual devices). Parametrize shard counts by slicing:
+    `Mesh(np.asarray(jax.devices()[:n]), ("nodes",))` or
+    `make_mesh(n_devices=n)`."""
+    from kubernetes_tpu.parallel.sharded import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_mesh(n_devices=8)

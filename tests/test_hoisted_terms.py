@@ -434,12 +434,12 @@ class TestBackendRouting:
             for i in range(3)
         ]
         before = sched_metrics.session_builds.value(
-            kind="hoisted", reason="host-ports"
+            kind="hoisted", reason="host-ports", shards=""
         )
         with caplog.at_level(logging.WARNING):
             backend.schedule_many(pending)
         after = sched_metrics.session_builds.value(
-            kind="hoisted", reason="host-ports"
+            kind="hoisted", reason="host-ports", shards=""
         )
         assert after == before + 1
         assert any("downgrading" in r.message for r in caplog.records)
