@@ -42,7 +42,7 @@ from urllib.parse import parse_qs, urlsplit
 from ..api import types as v1
 from ..store import kv
 from ..utils import serde
-from ..utils.metrics import Counter, Gauge, legacy_registry
+from ..utils.metrics import Counter, Gauge, Histogram, legacy_registry
 from .server import APIError, APIServer, NotFound, ResourceInfo, WatchEvent
 
 watch_evictions = legacy_registry.register(
@@ -69,6 +69,30 @@ watchers_gauge = legacy_registry.register(
         (),
     )
 )
+watch_delivery = legacy_registry.register(
+    Histogram(
+        "apiserver_watch_delivery_seconds",
+        "Event-ready to socket-write latency per watch frame: stamped "
+        "when the producer loop pulls the event batch off the store "
+        "hub, observed on the writer thread AFTER the chunked write "
+        "flushes. Heartbeats are excluded — this is the event SLI the "
+        "wire open item needs a p99 for, and a rising tail here (with "
+        "apiserver_watch_buffer_depth climbing) names a consumer "
+        "drifting toward eviction before it crosses the threshold.",
+        (),
+        buckets=tuple(0.0001 * 2 ** i for i in range(20)),
+    )
+)
+watch_buffer_depth = legacy_registry.register(
+    Gauge(
+        "apiserver_watch_buffer_depth",
+        "Frames queued in one watcher's bounded send buffer, keyed by a "
+        "per-stream id. Updated on every enqueue and drain; the series "
+        "is removed when the watcher finishes, so the exposition only "
+        "ever lists live streams.",
+        ("watcher",),
+    )
+)
 
 
 def _status_body(code: int, message: str, reason: str = "") -> bytes:
@@ -82,6 +106,9 @@ def _status_body(code: int, message: str, reason: str = "") -> bytes:
 
 
 import collections as _collections
+import itertools as _itertools
+
+_watch_ids = _itertools.count(1)
 
 _RAW_EVENT_CAP = 8192
 
@@ -369,6 +396,7 @@ class _Handler(BaseHTTPRequestHandler):
         buf: _collections.deque = _collections.deque()
         state = {"bytes": 0, "done": False, "dead": False,
                  "evicted": False, "last_drain": time.monotonic()}
+        wid = f"w{next(_watch_ids)}"
 
         def writer() -> None:
             try:
@@ -379,13 +407,18 @@ class _Handler(BaseHTTPRequestHandler):
                             cv.wait(0.2)
                         if state["dead"] or (state["done"] and not buf):
                             return
-                        data = buf.popleft()
+                        data, ready = buf.popleft()
                         state["bytes"] -= len(data)
+                        watch_buffer_depth.set(len(buf), watcher=wid)
                     # a slow reader blocks HERE, on this thread — never
                     # the producer loop feeding from the store's hub
                     self.wfile.write(
                         f"{len(data):x}\r\n".encode() + data + b"\r\n")
                     self.wfile.flush()
+                    if ready is not None:
+                        # event-ready -> socket-write SLI, observed only
+                        # AFTER the flush (heartbeats carry ready=None)
+                        watch_delivery.observe(time.monotonic() - ready)
                     with cv:
                         state["last_drain"] = time.monotonic()
             except (BrokenPipeError, ConnectionResetError, OSError):
@@ -400,9 +433,10 @@ class _Handler(BaseHTTPRequestHandler):
         wt.start()
         hub.watcher_started()
 
-        def enqueue(data: bytes) -> bool:
+        def enqueue(data: bytes, ready: Optional[float] = None) -> bool:
             """False = this watcher is dead or just got evicted; the
-            producer loop stops."""
+            producer loop stops. `ready` stamps when the frame's events
+            came off the hub (None for heartbeats) for the delivery SLI."""
             with cv:
                 if state["dead"]:
                     return False
@@ -413,8 +447,9 @@ class _Handler(BaseHTTPRequestHandler):
                     state["dead"] = True
                     cv.notify_all()
                     return False
-                buf.append(data)
+                buf.append((data, ready))
                 state["bytes"] += len(data)
+                watch_buffer_depth.set(len(buf), watcher=wid)
                 cv.notify_all()
                 return True
 
@@ -439,6 +474,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # frame+flush per event made the watch stream the wire
                 # path's throughput ceiling (the client's readline loop
                 # splits lines, so framing is free to batch)
+                ready_ts = time.monotonic()
                 batch = [encode(ev)]
                 nbytes = len(batch[0])
                 # byte-bounded too: one joined chunk past the watcher's
@@ -449,7 +485,7 @@ class _Handler(BaseHTTPRequestHandler):
                         break
                     batch.append(encode(ev))
                     nbytes += len(batch[-1])
-                if not enqueue(b"".join(batch)):
+                if not enqueue(b"".join(batch), ready=ready_ts):
                     break
         finally:
             w.stop()
@@ -472,6 +508,7 @@ class _Handler(BaseHTTPRequestHandler):
                 except OSError:
                     pass
             self.close_connection = True
+            watch_buffer_depth.remove(watcher=wid)
             hub.watcher_finished()
 
     def _verb_post(self, resource, ns, name, sub, params) -> None:

@@ -42,6 +42,7 @@ from __future__ import annotations
 import functools
 import math
 import os as _os
+import time as _time
 from typing import Dict, List, NamedTuple, Optional
 
 import jax
@@ -1127,11 +1128,20 @@ class PallasSession:
         if _os.environ.get("KTPU_PALLAS_AOT", "1") != "1":
             fn = None  # kill switch wins even over warm-installed execs
         elif fn is _MISSING:
+            # Counted miss path: a dispatch-time compile is a stall the
+            # device timeline must attribute (warm_buckets prefills are
+            # deliberate and uncounted).
+            from ..utils import devtime
+            t0 = _time.perf_counter()
             try:
                 fn = self._compile_exec(Bp, mode)
             except Exception:  # noqa: BLE001 — jit path still works
                 fn = None
             self._exec[key] = fn
+            if devtime.enabled():
+                devtime.TIMELINE.compile_event(
+                    "pallas-bucket", t0, _time.perf_counter() - t0,
+                    bucket=Bp, mode=mode, ok=fn is not None)
         if fn is not None:
             args = [meta, self._carry, match]
             if mode == "apply":

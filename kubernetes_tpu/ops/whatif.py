@@ -455,7 +455,23 @@ class WhatifContext:
         """Launch the fused what-if program; returns device arrays
         (caller bounds the wait and decodes). v/nom/pre are dicts of
         numpy tensors shaped as _whatif_run documents."""
+        from ..utils import devtime
         sess = self._sess
+        if devtime.enabled():
+            # Measured path: the launch is synchronous (block_until_ready
+            # inside the record window) so submit→ready is device time,
+            # not host wall-clock to the first decode. Decision-inert:
+            # the caller's watchdog wait then sees an already-ready tree.
+            lt = devtime.launch(
+                "kernel", "whatif", tj=tj,
+                h2d_bytes=devtime.payload_bytes((v, nom, pre)))
+            ys = self._run_impl(tj, v, nom, pre, sess)
+            jax.block_until_ready(ys)
+            lt.done(d2h_bytes=devtime.payload_bytes(ys))
+            return ys
+        return self._run_impl(tj, v, nom, pre, sess)
+
+    def _run_impl(self, tj: int, v, nom, pre, sess):
         return _whatif_run(
             sess._S, sess._c_static, self.carry,
             jnp.asarray(v["valid"]), jnp.asarray(v["req"]),

@@ -102,6 +102,61 @@ scheduling_attempt_duration = legacy_registry.register(
         buckets=tuple(0.001 * 2**i for i in range(20)),
     )
 )
+e2e_duration = legacy_registry.register(
+    Histogram(
+        "scheduler_e2e_duration_seconds",
+        "Kube-style e2e scheduling SLO histogram: queue admission "
+        "(first attempt) to bind sent, per pod — the distribution "
+        "behind the harness's pod_scheduling_p50/90/99 extracts, "
+        "exposed on /metricsz so an SLO reader needs no harness. Fed "
+        "from the same bind timestamps the latency sample ring uses.",
+        (),
+        buckets=tuple(0.001 * 2**i for i in range(20)),
+    )
+)
+attempt_duration = legacy_registry.register(
+    Histogram(
+        "scheduler_attempt_duration_seconds",
+        "Per-stage scheduling SLO histogram (kube's "
+        "scheduling_attempt_duration sliced by pipeline stage): "
+        "stage=attempt is one attempt queue-pop->bind-sent (per pod); "
+        "stage=bind is the batched bind POST (per batch); "
+        "stage=complete is the completion worker's harvest+assume+bind "
+        "pass (per batch); stage=fifo-wait is dispatch-enqueue->"
+        "completion-finish age (per batch; the overload monitor's "
+        "primary signal, as a distribution instead of a last-value "
+        "gauge).",
+        ("stage",),
+        buckets=tuple(0.001 * 2**i for i in range(20)),
+    )
+)
+queue_wait = legacy_registry.register(
+    Histogram(
+        "scheduler_queue_wait_seconds",
+        "Queue wait per scheduled pod: queue admission (first attempt "
+        "timestamp) to the pop that led to its bind — e2e minus the "
+        "attempt, as its own SLO distribution (kube's "
+        "pod_scheduling_sli_duration decomposition).",
+        (),
+        buckets=tuple(0.001 * 2**i for i in range(20)),
+    )
+)
+device_time = legacy_registry.register(
+    Counter(
+        "scheduler_device_time_seconds_total",
+        "Accumulated device time by kind and session slug (TPU-build "
+        "metric; KTPU_DEVTIME >= 1, zero-cost and absent at 0): "
+        "kind=kernel is scheduling-scan submit->ready time, "
+        "kind=transfer is session-build cluster upload, kind=compile "
+        "is AOT executable-cache misses. slug carries the session kind "
+        "and mesh shard count ('pallas@8', 'hoisted') in the "
+        "session_builds slug convention, so the mesh bench rows read "
+        "collective/transfer cost PER SHARD COUNT. Rate(kernel) vs "
+        "wall-clock is the device-utilization half of the overlap "
+        "accounting in utils/devtime.py.",
+        ("slug", "kind"),
+    )
+)
 backend_mode = legacy_registry.register(
     Gauge(
         "scheduler_backend_mode",
@@ -290,15 +345,18 @@ def dump_seam(seam: str, **attrs) -> None:
     Every fault seam goes through here so the counter and the dump can
     never drift apart — fault_drill's --dump-trace integrity check
     counts faults against dumps, and a seam that bumps without dumping
-    (or vice versa) would silently break that accounting. No-op with
-    tracing off (the ring is empty there and the fault path stays
-    cheap)."""
-    from ..utils import tracing
+    (or vice versa) would silently break that accounting. The device
+    timeline dumps HERE too (utils/devtime.py): a device fault leaves
+    both the host span trail and the launch timeline, each gated on its
+    own level. No-op with both recorders off (the rings are empty there
+    and the fault path stays cheap)."""
+    from ..utils import devtime, tracing
 
-    if not tracing.enabled():
-        return
-    trace_dumps.inc(seam=seam)
-    tracing.dump(seam, **attrs)
+    if tracing.enabled():
+        trace_dumps.inc(seam=seam)
+        tracing.dump(seam, **attrs)
+    if devtime.enabled():
+        devtime.dump(seam, **attrs)
 
 
 shadow_samples = legacy_registry.register(
@@ -377,8 +435,9 @@ overload_sheds = legacy_registry.register(
         "pressure (completion-FIFO age / queue depth / stage latency past "
         "their high-water marks for the dwell window), by lever: "
         "what=explain-harvest (host skips attribution decode), "
-        "what=shadow-sample (parity-sentinel rate to 0), what=trace "
-        "(flight recorder off), what=speculation (dispatch serializes on "
+        "what=shadow-sample (parity-sentinel rate to 0), what=devtime "
+        "(device timeline off), what=trace (flight recorder off), "
+        "what=speculation (dispatch serializes on "
         "harvest). Levers shed in that fixed order and restore LIFO after "
         "a sustained-calm window — decision correctness is never shed, so "
         "this counter moving changes observability coverage, not "
@@ -402,8 +461,9 @@ overload_level = legacy_registry.register(
     Gauge(
         "scheduler_overload_level",
         "Number of overload-shed levers currently engaged (0 = full "
-        "observability, 4 = maximally shed: explain+shadow+trace+"
-        "speculation). Alert on this sitting above 0 — the host cannot "
+        "observability, 5 = maximally shed: explain+shadow+devtime+"
+        "trace+speculation). Alert on this sitting above 0 — the host "
+        "cannot "
         "keep up with the configured audit load.",
         (),
     )

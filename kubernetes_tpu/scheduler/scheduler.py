@@ -43,7 +43,7 @@ from ..apiserver.server import APIError
 from ..client.clientset import Clientset
 from ..client.events import EventRecorder
 from ..client.informer import EventHandler, SharedInformerFactory, meta_namespace_key
-from ..utils import serde, tracing
+from ..utils import devtime, serde, tracing
 from . import metrics
 from .core import GenericScheduler, ScheduleResult
 from .framework.interface import Code, CycleState, FitError
@@ -348,6 +348,16 @@ class Scheduler:
         def restore_shadow():
             tpu.set_shadow_rate_only(saved.pop("shadow", 0.0))
 
+        def shed_devtime():
+            saved["devtime"] = devtime.level()
+            devtime.set_level(0)
+            configz.install_knobs("ktpu", devtime_level=0)
+
+        def restore_devtime():
+            lvl = saved.pop("devtime", 0)
+            devtime.set_level(lvl)
+            configz.install_knobs("ktpu", devtime_level=lvl)
+
         def shed_trace():
             saved["trace"] = tracing.level()
             tracing.set_level(0)
@@ -371,6 +381,7 @@ class Scheduler:
         return [
             ("explain-harvest", shed_explain, restore_explain),
             ("shadow-sample", shed_shadow, restore_shadow),
+            ("devtime", shed_devtime, restore_devtime),
             ("trace", shed_trace, restore_trace),
             ("speculation", shed_speculation, restore_speculation),
         ]
@@ -958,6 +969,8 @@ class Scheduler:
             depth = len(self._completions)
             metrics.completion_fifo_depth.set(depth)
             metrics.completion_fifo_age.set(age)
+            metrics.attempt_duration.observe(now - t0, stage="complete")
+            metrics.attempt_duration.observe(age, stage="fifo-wait")
             if self.overload is not None:
                 # completion-stage p99 over the recent window — the
                 # same seam the PR-8 recorder spans as stage=complete
@@ -1760,6 +1773,7 @@ class Scheduler:
         FINISHED — an assumed pod that never reaches finish_binding has
         no expiry)."""
         unsettled = {id(assumed): assumed for assumed, _, _, _ in items}
+        bind_t0 = _time.monotonic()
         bind_sp = tracing.span("bind", "bind", n=len(items))
         bind_sp.__enter__()
         try:
@@ -1818,6 +1832,8 @@ class Scheduler:
                     traceback.print_exc()
         finally:
             bind_sp.__exit__(None, None, None)
+            metrics.attempt_duration.observe(
+                _time.monotonic() - bind_t0, stage="bind")
             with self._inflight_lock:
                 self._inflight -= 1
 
@@ -1838,6 +1854,12 @@ class Scheduler:
         attempt = now - (info.pop_timestamp or info.initial_attempt_timestamp)
         metrics.pod_scheduling_duration.observe(e2e, attempts=str(info.attempts))
         metrics.scheduling_attempt_duration.observe(attempt)
+        # kube-style SLO histograms (scheduler_perf SLIs): e2e from the
+        # FIRST attempt stamp, attempt from the LAST queue pop, queue
+        # wait as the difference — all from stamps that already exist.
+        metrics.e2e_duration.observe(e2e)
+        metrics.attempt_duration.observe(attempt, stage="attempt")
+        metrics.queue_wait.observe(max(0.0, e2e - attempt))
         self.latency_samples.append((e2e, attempt, info.attempts))
         self.bind_timestamps.append(now)
 

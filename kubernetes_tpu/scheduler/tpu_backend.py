@@ -37,7 +37,7 @@ from ..ops.hoisted import (
     match_matrices_np,
     template_fingerprint,
 )
-from ..utils import tracing
+from ..utils import devtime, tracing
 from .degradation import (
     RUNG_HOISTED,
     RUNG_ORACLE,
@@ -92,7 +92,7 @@ class _BatchHandle:
 
     __slots__ = ("group", "ys", "decide", "node_names", "results",
                  "deadline", "bucket", "timed_out", "speculative",
-                 "conflicts", "prov", "explain", "basis_mutations")
+                 "conflicts", "prov", "explain", "basis_mutations", "dt")
 
     def __init__(self, group: List[v1.Pod]):
         self.group = group
@@ -136,6 +136,11 @@ class _BatchHandle:
         # decided on, so the audit is skipped (counted) instead of
         # reporting false drift
         self.basis_mutations: Optional[Tuple[int, int]] = None
+        # device-timeline launch token (utils/devtime.py): submit
+        # stamped at dispatch enqueue, ready at harvest — None below
+        # KTPU_DEVTIME=1 (the disabled path allocates nothing per
+        # batch; pinned with prov/explain by the overhead test)
+        self.dt = None
 
 
 class TPUBackend(CacheListener):
@@ -311,6 +316,11 @@ class TPUBackend(CacheListener):
         # session half of "where did this pod's time go"
         self._last_build = ""
         self._last_invalidate = ""
+        # device-timeline hand-off: _build_session_impl measures the
+        # cluster upload (kind=transfer) before the session kind is
+        # known; the _build_session wrapper reads this and feeds the
+        # per-shard slug counter once the built session names the slug
+        self._upload_seconds = 0.0
         # runtime-effective KTPU_* knob surface (utils/configz.py):
         # today the env vars are invisible at runtime; /configz shows
         # the values this backend actually resolved
@@ -337,6 +347,8 @@ class TPUBackend(CacheListener):
             demote_threshold=self.ladder.threshold,
             trace_level=tracing.level(),
             trace_capacity=tracing.RECORDER.capacity,
+            devtime_level=devtime.level(),
+            devtime_capacity=devtime.TIMELINE.capacity,
             explain=self.explain,
             explain_topk=self.explain_topk,
             shadow_sample=self.shadow_sample,
@@ -461,6 +473,27 @@ class TPUBackend(CacheListener):
         appended LAST at every inc site (label order is declared)."""
         return str(int(self.mesh.devices.size)) if self.mesh is not None \
             else ""
+
+    def _devtime_slug(self, session=None) -> str:
+        """Per-shard device-time slug ('pallas@8', 'hoisted', '-' with
+        no live session): the session_builds kind@shards convention, so
+        scheduler_device_time_seconds_total reads per shard count."""
+        s = session if session is not None else self._session
+        if s is None:
+            return "-"
+        kind = "pallas" if "Pallas" in type(s).__name__ else "hoisted"
+        sh = self._shards_label()
+        return f"{kind}@{sh}" if sh else kind
+
+    def _feed_device_time(self, kind: str, seconds: float,
+                          session=None) -> None:
+        """Accumulate one launch's device seconds into the per-shard
+        slug counter (KTPU_DEVTIME >= 1 only — callers gate)."""
+        from .metrics import device_time
+
+        if seconds > 0:
+            device_time.inc(
+                seconds, slug=self._devtime_slug(session), kind=kind)
 
     def _invalidate_session(self, reason: str = "unspecified") -> None:
         # _session_assumed survives invalidation deliberately: an assume
@@ -1205,7 +1238,25 @@ class TPUBackend(CacheListener):
         try:
             with tracing.span("queued-delta-apply", "delta-apply",
                               n=len(deltas)):
-                self._session.apply_deltas(deltas)
+                if devtime.enabled():
+                    # measured delta apply: the fused patch launch gets
+                    # its own submit->ready interval via an explicit
+                    # block (decision-inert; the block is the
+                    # documented KTPU_DEVTIME=1 measurement cost — the
+                    # next dispatch would synchronize on the carry
+                    # anyway)
+                    import jax
+
+                    lt = devtime.launch("kernel", "delta-apply",
+                                        n=len(deltas))
+                    self._session.apply_deltas(deltas)
+                    jax.block_until_ready(
+                        getattr(self._session, "_carry", None))
+                    lt.done()
+                    self._feed_device_time(
+                        "kernel", _time.perf_counter() - lt.submit)
+                else:
+                    self._session.apply_deltas(deltas)
         except Exception:  # noqa: BLE001 — rebuild is always correct
             logger.warning(
                 "session delta apply failed; falling back to a rebuild",
@@ -1466,8 +1517,19 @@ class TPUBackend(CacheListener):
                             pipelined=True,
                             group_pos=len(self._pending),
                         ) if tracing.enabled() else tracing.NOOP_SPAN
-                        with sp:
+                        with sp, devtime.TIMELINE.maybe_profile(
+                                "dispatch"):
                             ys = self._session.schedule(clean)  # async
+                        if devtime.enabled():
+                            # submit stamps at the enqueue; harvest
+                            # stamps ready after the pipeline's own
+                            # wait — no extra synchronization on the
+                            # dispatch path
+                            h.dt = devtime.launch(
+                                "kernel", "dispatch",
+                                h2d_bytes=devtime.payload_bytes(clean),
+                                n=len(pods),
+                            )
                     except Exception:  # noqa: BLE001 — dispatch-time fault:
                         # the enqueue failed BEFORE the scan chained onto
                         # the carry, so earlier pending batches stay
@@ -1594,6 +1656,28 @@ class TPUBackend(CacheListener):
                     "chained on was invalidated",
                 )
 
+    def _close_launch_devtime(self, h, ys) -> None:
+        """Commit a dispatched batch's device-timeline record: ready is
+        stamped when the pipeline's own watchdog-bounded wait returned
+        (no extra synchronization — the pipeline already paid it), D2H
+        bytes are the harvest outputs' array sizes (readable without
+        forcing a transfer). Faulted batches never commit: their launch
+        never became ready, and the fault seam dumps the timeline
+        instead."""
+        lt = h.dt
+        if lt is None:
+            return
+        h.dt = None
+        if not devtime.enabled():
+            return  # shed mid-flight: drop, don't record a torn window
+        ready = _time.perf_counter()
+        lt.done(
+            d2h_bytes=devtime.payload_bytes(ys) if isinstance(ys, dict)
+            else 0,
+            bucket=h.bucket, speculative=h.speculative,
+        )
+        self._feed_device_time("kernel", ready - lt.submit)
+
     def _harvest_locked(self) -> None:
         h = self._pending.popleft()
         self._pending_cv.notify_all()  # back-pressured dispatchers
@@ -1622,6 +1706,7 @@ class TPUBackend(CacheListener):
             logger.warning("harvest decode failed", exc_info=True)
             self._recover_dispatches_locked("invalid", h)
             return
+        self._close_launch_devtime(h, ys)
         self.ladder.record_success()
         if h.bucket is not None:
             # the bucket proved itself (through jit while quarantined):
@@ -1917,6 +2002,12 @@ class TPUBackend(CacheListener):
                 f"{type(s).__name__}/{self._last_invalidate or 'initial'}"
             )
             sp.set(kind=type(s).__name__)
+            up, self._upload_seconds = self._upload_seconds, 0.0
+            if up:
+                # the impl measured the cluster upload before the
+                # session kind existed; the slug comes from the session
+                # it became
+                self._feed_device_time("transfer", up, session=s)
             return s
 
     def _build_session_impl(self):
@@ -1930,7 +2021,21 @@ class TPUBackend(CacheListener):
 
         sh = self._shards_label()
         templates = list(self._known_templates.values())
-        cluster = self.enc.device_state()
+        if devtime.enabled():
+            # the cluster upload is the H2D transfer the mesh rows care
+            # about: measured with an explicit block (decision-inert —
+            # the session constructor would synchronize on these arrays
+            # anyway), byte count from the uploaded leaves
+            import jax
+
+            lt = devtime.launch("transfer", "session-upload")
+            cluster = self.enc.device_state()
+            jax.block_until_ready(cluster)
+            lt.h2d_bytes = devtime.payload_bytes(cluster)
+            lt.done()
+            self._upload_seconds = _time.perf_counter() - lt.submit
+        else:
+            cluster = self.enc.device_state()
         # KTPU_EXPLAIN (or an armed shadow sentinel): per-plugin
         # attribution exists only on the hoisted session's scan outputs
         # — pallas/sharded builds demote, loudly, for as long as the

@@ -91,6 +91,12 @@ class Gauge(_Metric):
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
+    def remove(self, **labels) -> None:
+        """Drop one label series (per-entity gauges — e.g. a per-watcher
+        buffer depth — must not leak series after the entity is gone)."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
     collect = Counter.collect
 
 
@@ -103,6 +109,16 @@ class Histogram(_Metric):
         self._counts: Dict[Tuple[str, ...], List[int]] = {}
         self._sums: Dict[Tuple[str, ...], float] = {}
         self._totals: Dict[Tuple[str, ...], int] = {}
+        if not label_names:
+            # Prometheus convention: an unlabeled histogram exports at
+            # zero from birth, so a reader can tell "no observations
+            # yet" from "metric missing" — an SLI that only appears
+            # under traffic is invisible exactly when its absence is
+            # the signal (labeled series still appear on first use).
+            key = self._key({})
+            self._counts[key] = [0] * len(self.buckets)
+            self._sums[key] = 0.0
+            self._totals[key] = 0
 
     def observe(self, value: float, **labels) -> None:
         key = self._key(labels)

@@ -416,6 +416,23 @@ def main() -> None:
         line["bind_stage_runs"] = [
             (r.get("stage_latency") or {}).get("bind") for r in runs
         ]
+        # per-rep device-timeline attribution (round 16): with
+        # KTPU_DEVTIME on, each rep's host<->device overlap ratio, its
+        # kernel/transfer/compile device-seconds split, and its
+        # dispatch-path recompile count survive — the chip rerun reads
+        # where device time went PER REP (a compile storm in rep 0 must
+        # not hide behind the median rep's dict). Always present:
+        # 0.0/None/0 per rep with devtime off, mirroring
+        # stage_latency_runs, so the schema is stable across knob sets.
+        line["overlap_ratio_runs"] = [
+            r.get("overlap_ratio") for r in runs
+        ]
+        line["device_time_runs"] = [
+            r.get("device_time") for r in runs
+        ]
+        line["recompiles_runs"] = [
+            r.get("recompiles") for r in runs
+        ]
         # per-rep shadow parity accounting (round 12): at sample>0 the
         # chip rerun adjudicates drift from THESE counters — a drift
         # burst in one rep must not hide behind the median rep's dict

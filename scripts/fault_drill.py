@@ -84,14 +84,23 @@ def main() -> int:
                          "scripts/trace_report.py, and fail the drill if "
                          "any fault seam fired WITHOUT dumping or the "
                          "dump does not render")
+    ap.add_argument("--devtime", nargs="?", const="fault_drill_devtime.json",
+                    default="", metavar="PATH",
+                    help="run with KTPU_DEVTIME>=1, assert every device "
+                         "fault dumps the device timeline ALONGSIDE the "
+                         "span ring, write the end-of-drill timeline to "
+                         "PATH, and (with --dump-trace) gate the "
+                         "trace_report timeline/span reconciliation")
     args = ap.parse_args()
 
-    from kubernetes_tpu.utils import tracing
+    from kubernetes_tpu.utils import devtime, tracing
 
     if args.dump_trace:
         # per-pod provenance on: the drill's dump must name the faulted
         # batch's bucket, rung and speculation state
         tracing.set_level(max(tracing.level(), 2))
+    if args.devtime:
+        devtime.set_level(max(devtime.level(), 1))
     rng = random.Random(args.seed)
     inj = FaultInjector()
     failures = []
@@ -102,6 +111,7 @@ def main() -> int:
     sheds0 = counter_total(metrics.overload_sheds)
     restores0 = counter_total(metrics.overload_restores)
     ndumps0 = len(tracing.RECORDER.dump_history)
+    dt_dumps0 = len(devtime.TIMELINE.dump_history)
     drift0 = counter_total(metrics.parity_drift)
 
     with Cluster(
@@ -230,6 +240,32 @@ def main() -> int:
             if trace_report.render(args.dump_trace) != 0:
                 failures.append(
                     f"trace_report could not render {args.dump_trace}")
+
+        if args.devtime:
+            # device-timeline integrity: a device fault must leave BOTH
+            # halves of the story — dump_seam pairs the span-ring dump
+            # with a timeline dump, so a fault with only one half is a
+            # broken seam, not a rendering nit
+            n_faults = sum(fault_delta.values())
+            dt_seam_dumps = devtime.TIMELINE.dump_history[dt_dumps0:]
+            print(f"devtime dumps:    {len(dt_seam_dumps)} "
+                  f"({sorted({d['reason'] for d in dt_seam_dumps})})")
+            if n_faults > 0 and not dt_seam_dumps:
+                failures.append(
+                    f"{n_faults:.0f} device faults recorded but no "
+                    f"device-timeline dump fired")
+            devtime.dump("fault-drill-final", path=args.devtime,
+                         faults=dict(inj.injected))
+            if args.dump_trace:
+                sys.path.insert(0, os.path.dirname(
+                    os.path.abspath(__file__)))
+                import trace_report
+
+                if trace_report.render(args.dump_trace,
+                                       devtime_path=args.devtime) != 0:
+                    failures.append(
+                        f"trace_report timeline/span reconciliation "
+                        f"failed for {args.devtime}")
 
     if failures:
         print("FAIL:\n  " + "\n  ".join(failures))

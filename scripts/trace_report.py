@@ -15,7 +15,19 @@ Exits nonzero on an unreadable/empty dump — the fault drill runs this
 renderer as one of its integrity checks, so a fault seam that emitted a
 record nothing can render fails the drill, not just the retro.
 
+With --devtime DEVTIME.json (a utils/devtime.py DeviceTimeline.dump
+file — the seams write one beside every ring dump) the device timeline
+merges into the chrome export as a separate track (pid=1, one tid per
+kind: kernel/transfer/compile) aligned to the host spans in the shared
+perf_counter timebase, the overlap summary prints, and the exit code
+additionally gates on timeline<->span reconciliation: every record's
+ready >= submit, device_busy <= window, host_busy <= window, and
+overlapped <= min(host_busy, device_busy). A mismatch means the two
+recorders disagree about the same wall-clock — a triage artifact nobody
+should trust — so the drill fails loudly instead.
+
 Usage: python scripts/trace_report.py DUMP.json [--chrome OUT.json]
+                                      [--devtime DEVTIME.json]
 """
 
 from __future__ import annotations
@@ -27,10 +39,38 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from kubernetes_tpu.utils import tracing  # noqa: E402
+from kubernetes_tpu.utils import devtime, tracing  # noqa: E402
+
+# reconciliation slack: both recorders round their dump floats through
+# JSON; a few µs of slack keeps the gate about real disagreement
+_RECON_EPS = 1e-4
 
 
-def render(dump_path: str, chrome_path: str = "") -> int:
+def _reconcile(dt_records, ov) -> int:
+    """Timeline<->span reconciliation gate; returns the number of
+    violated invariants (0 = clean)."""
+    bad = 0
+    for d in dt_records:
+        if d["ready"] + _RECON_EPS < d["submit"]:
+            print(f"FAIL: record seq={d['seq']} {d['kind']}:{d['name']} "
+                  f"has ready < submit", file=sys.stderr)
+            bad += 1
+    window = ov["window_s"] + _RECON_EPS
+    for side in ("device_busy_s", "host_busy_s"):
+        if ov[side] > window:
+            print(f"FAIL: {side}={ov[side]} exceeds window_s="
+                  f"{ov['window_s']}", file=sys.stderr)
+            bad += 1
+    floor = min(ov["device_busy_s"], ov["host_busy_s"])
+    if ov["overlapped_s"] > floor + _RECON_EPS:
+        print(f"FAIL: overlapped_s={ov['overlapped_s']} exceeds "
+              f"min(host, device)={floor}", file=sys.stderr)
+        bad += 1
+    return bad
+
+
+def render(dump_path: str, chrome_path: str = "",
+           devtime_path: str = "") -> int:
     """Render one dump file; returns a process exit code."""
     try:
         with open(dump_path) as f:
@@ -44,7 +84,24 @@ def render(dump_path: str, chrome_path: str = "") -> int:
               f"(reason={record.get('reason')!r})", file=sys.stderr)
         return 1
 
+    dt_records = []
+    if devtime_path:
+        try:
+            with open(devtime_path) as f:
+                dt_dump = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"FAIL: unreadable devtime dump {devtime_path}: {e}",
+                  file=sys.stderr)
+            return 1
+        dt_records = dt_dump.get("records") or []
+        if not dt_records:
+            print(f"FAIL: devtime dump {devtime_path} holds no records "
+                  f"(reason={dt_dump.get('reason')!r})", file=sys.stderr)
+            return 1
+
     chrome = tracing.chrome_trace(events)
+    if dt_records:
+        chrome = chrome + devtime.device_track(dt_records)
     out_path = chrome_path or (os.path.splitext(dump_path)[0]
                                + ".chrome.json")
     with open(out_path, "w") as f:
@@ -77,6 +134,27 @@ def render(dump_path: str, chrome_path: str = "") -> int:
     window = tracing.window_span(events)
     print()
     print(f"window: {window:.3f}s covered by recorded spans")
+
+    if dt_records:
+        summary = devtime.device_time_summary(dt_records)
+        ov = devtime.overlap(dt_records, events)
+        print()
+        print(f"device timeline: {len(dt_records)} records "
+              f"(kernel {summary['kernel_s']:.4f}s, "
+              f"transfer {summary['transfer_s']:.4f}s, "
+              f"compile {summary['compile_s']:.4f}s; "
+              f"H2D {summary['h2d_bytes']} B, "
+              f"D2H {summary['d2h_bytes']} B)")
+        print(f"overlap: window {ov['window_s']:.3f}s  "
+              f"device_busy {ov['device_busy_s']:.4f}s  "
+              f"host_busy {ov['host_busy_s']:.4f}s  "
+              f"overlapped {ov['overlapped_s']:.4f}s  "
+              f"ratio {ov['overlap_ratio']}")
+        bad = _reconcile(dt_records, ov)
+        if bad:
+            print(f"FAIL: {bad} timeline/span reconciliation "
+                  f"mismatch(es)", file=sys.stderr)
+            return 1
     return 0
 
 
@@ -86,8 +164,12 @@ def main() -> int:
     ap.add_argument("--chrome", default="",
                     help="chrome-trace output path "
                          "(default: <dump>.chrome.json)")
+    ap.add_argument("--devtime", default="",
+                    help="device-timeline dump JSON to merge as a "
+                         "separate track (+ overlap summary + "
+                         "reconciliation gate)")
     args = ap.parse_args()
-    return render(args.dump, args.chrome)
+    return render(args.dump, args.chrome, args.devtime)
 
 
 if __name__ == "__main__":

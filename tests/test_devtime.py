@@ -1,0 +1,301 @@
+"""Device-timeline attribution: zero-overhead off, decision inertness,
+timeline<->stage-span reconciliation, SLO histograms, recompile events.
+
+The tentpole contract (ISSUE 14): per-launch device timing + overlap
+accounting + kube-style SLO histograms, decision-inert by construction.
+Pinned here:
+
+  * KTPU_DEVTIME=0 is the no-op singleton fast path: launch() returns
+    the shared NOOP_LAUNCH (zero per-launch allocation), record() drops,
+    the timeline stays empty;
+  * decisions are BIT-IDENTICAL with the timeline on vs off over
+    randomized churn (the overload lever can flip the level mid-run, so
+    inertness is load-bearing, not cosmetic);
+  * a live run's device records reconcile with the flight-recorder
+    spans: ready >= submit per record, device_busy <= window,
+    overlapped <= min(host_busy, device_busy) — the same gate
+    scripts/trace_report.py --devtime enforces on dump files;
+  * the SLO histograms (scheduler_e2e_duration_seconds /
+    scheduler_attempt_duration_seconds{stage} /
+    scheduler_queue_wait_seconds) bucket synthetic bind timestamps
+    correctly, read through the invariant library's /metricsz parser —
+    the exact surface an operator's SLO reader uses;
+  * the AOT executable-cache miss path records a compile event exactly
+    when a bucket is force-evicted, never on a cache hit.
+"""
+
+from __future__ import annotations
+
+import random
+from types import SimpleNamespace
+
+import pytest
+
+from kubernetes_tpu.scheduler import metrics
+from kubernetes_tpu.testing import invariants
+from kubernetes_tpu.utils import configz, devtime, tracing
+
+from .test_pipeline_parity import (
+    _bound_map,
+    _cluster,
+    _drive,
+    _mk_scheduler,
+    _pod_stream,
+)
+
+
+@pytest.fixture(autouse=True)
+def _devtime_off_after():
+    lvl0 = devtime.level()
+    yield
+    devtime.set_level(lvl0)
+
+
+# ---------------------------------------------------------------------------
+# level 0: zero overhead, empty timeline
+
+
+def test_level0_launch_is_the_noop_singleton():
+    devtime.set_level(0)
+    lt = devtime.launch("kernel", "dispatch", h2d_bytes=123, n=7)
+    assert lt is devtime.NOOP_LAUNCH
+    # done()/set() chain on the singleton without allocating
+    assert lt.done(d2h_bytes=5, bucket=64) is devtime.NOOP_LAUNCH
+    assert lt.set(extra=1) is devtime.NOOP_LAUNCH
+    mark = devtime.TIMELINE.mark()
+    devtime.TIMELINE.record("kernel", "x", 0.0, 1.0)
+    devtime.TIMELINE.compile_event("x", 0.0, 0.5)
+    assert devtime.TIMELINE.snapshot(since=mark) == []
+    assert devtime.dump("level0") == []
+
+
+def test_level1_records_and_level_roundtrip():
+    devtime.set_level(1)
+    mark = devtime.TIMELINE.mark()
+    lt = devtime.launch("kernel", "dispatch", h2d_bytes=10, bucket=64)
+    assert lt is not devtime.NOOP_LAUNCH
+    lt.done(d2h_bytes=3, speculative=False)
+    lt.done(d2h_bytes=999)  # idempotent: second done is a no-op
+    recs = devtime.TIMELINE.snapshot(since=mark)
+    assert len(recs) == 1
+    seq, kind, name, submit, ready, h2d, d2h, tid, attrs = recs[0]
+    assert (kind, name, h2d, d2h) == ("kernel", "dispatch", 10, 3)
+    assert ready >= submit
+    assert attrs["bucket"] == 64 and attrs["speculative"] is False
+
+
+# ---------------------------------------------------------------------------
+# decision inertness: off vs on, bit-identical bindings
+
+
+def test_devtime_on_is_bit_identical_to_off():
+    seed = 9
+    rng = random.Random(seed)
+    batch_sizes = [rng.choice([2, 3, 5]) for _ in range(24)]
+    maps = {}
+    for mode, lvl in (("off", 0), ("on", 1)):
+        devtime.set_level(lvl)
+        mark = devtime.TIMELINE.mark()
+        _, cs = _cluster()
+        sched = _mk_scheduler(cs, 2)
+        try:
+            pods = _pod_stream(random.Random(seed), 24)
+            _drive(sched, cs, pods, batch_sizes)
+            maps[mode] = _bound_map(cs)
+            recs = devtime.TIMELINE.snapshot(since=mark)
+            if mode == "on":
+                assert recs, "level 1 run recorded no device launches"
+            else:
+                assert recs == [], "level 0 run wrote timeline records"
+        finally:
+            sched.stop()
+            sched.informers.stop()
+    assert maps["on"] == maps["off"], (
+        "device timeline changed scheduling decisions"
+    )
+    assert any(maps["off"].values())
+
+
+# ---------------------------------------------------------------------------
+# timeline <-> stage-span reconciliation on a live run
+
+
+def test_timeline_reconciles_with_stage_spans():
+    trace0 = tracing.level()
+    devtime.set_level(1)
+    try:
+        tracing.set_level(1)
+        dt_mark = devtime.TIMELINE.mark()
+        tr_mark = tracing.RECORDER.mark()
+        _, cs = _cluster()
+        sched = _mk_scheduler(cs, 2)
+        try:
+            pods = _pod_stream(random.Random(11), 18)
+            _drive(sched, cs, pods, [3] * 6)
+        finally:
+            sched.stop()
+            sched.informers.stop()
+        records = devtime.TIMELINE.snapshot(since=dt_mark)
+        events = tracing.RECORDER.snapshot(since=tr_mark)
+        assert records and events
+        for r in records:
+            assert r[4] >= r[3], "record with ready < submit"
+        ov = devtime.overlap(records, events)
+        eps = 1e-6
+        assert ov["device_busy_s"] <= ov["window_s"] + eps
+        assert ov["host_busy_s"] <= ov["window_s"] + eps
+        assert ov["overlapped_s"] <= min(
+            ov["device_busy_s"], ov["host_busy_s"]) + eps
+        summary = devtime.device_time_summary(records)
+        assert summary["launches"] == len(records)
+        assert summary["kernel_s"] > 0.0
+        # the dispatch path stamps H2D bytes from the encoding payloads
+        assert summary["h2d_bytes"] > 0
+        # per-shard device-time slug fed by the backend
+        kinds = {k[1] for k, _ in metrics.device_time.items()}
+        assert "kernel" in kinds
+    finally:
+        tracing.set_level(trace0)
+
+
+def test_overlap_synthetic_invariants():
+    # device: [0,2) and [3,4); host spans: [1,3.5) work + excluded wait
+    records = [
+        (0, "kernel", "a", 0.0, 2.0, 0, 0, 1, None),
+        (1, "kernel", "b", 3.0, 4.0, 0, 0, 1, None),
+    ]
+    host = [
+        (0, "encode", "encode", 1.0, 1.5, 1, None),  # [1.0, 2.5)
+        (1, "wait", "wait", 0.0, 4.0, 1, None),  # excluded stage
+    ]
+    ov = devtime.overlap(records, host)
+    assert ov["window_s"] == pytest.approx(4.0)
+    assert ov["device_busy_s"] == pytest.approx(3.0)
+    assert ov["host_busy_s"] == pytest.approx(1.5)
+    # intersection: host [1,2.5) against device [0,2) -> [1,2) only
+    assert ov["overlapped_s"] == pytest.approx(1.0)
+    assert ov["overlap_ratio"] == pytest.approx(1.0 / 1.5, abs=1e-3)
+    # empty side reports 0, never NaN
+    assert devtime.overlap([], host)["overlap_ratio"] == 0.0
+    assert devtime.overlap(records, [])["overlap_ratio"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# SLO histograms over synthetic bind timestamps, via the invariant reader
+
+
+def test_slo_histograms_bucket_synthetic_bind_timestamps():
+    _, cs = _cluster()
+    sched = _mk_scheduler(cs, 0)
+    try:
+        before = invariants.parse_metrics(configz.metricsz_body())
+        now = 1000.0
+        # (e2e, attempt) pairs: queue_wait = e2e - attempt. Values sit
+        # mid-bucket so float subtraction noise cannot straddle a bound.
+        cases = [(0.003, 0.0015), (0.010, 0.007), (0.300, 0.250)]
+        for e2e, attempt in cases:
+            info = SimpleNamespace(
+                initial_attempt_timestamp=now - e2e,
+                pop_timestamp=now - attempt,
+                attempts=1,
+            )
+            sched._observe_bound(info, now)
+        after = invariants.parse_metrics(configz.metricsz_body())
+
+        def delta(name):
+            a = invariants.bucket_counts(after, name)
+            b = invariants.bucket_counts(before, name)
+            return {le: a[le] - b.get(le, 0.0) for le in a}
+
+        e2e_d = delta("scheduler_e2e_duration_seconds")
+        # cumulative counts: 0.003 -> first bound >= is 0.004; 0.010 ->
+        # 0.016; 0.300 -> 0.512 (exponential 0.001 * 2**i buckets)
+        assert e2e_d[0.002] == 0
+        assert e2e_d[0.004] == 1
+        assert e2e_d[0.016] == 2
+        assert e2e_d[0.512] == 3
+        assert e2e_d[float("inf")] == 3
+        qw_d = delta("scheduler_queue_wait_seconds")
+        # waits: 0.0015, 0.003, 0.05 -> cumulative 1 at 0.002, 2 at
+        # 0.004, 3 at 0.064
+        assert qw_d[0.002] == 1
+        assert qw_d[0.004] == 2
+        assert qw_d[0.064] == 3
+        # attempt histogram is labeled by stage; the synthetic feeds all
+        # land in stage="attempt"
+        att = invariants.total(
+            after, "scheduler_attempt_duration_seconds_count"
+        ) - invariants.total(
+            before, "scheduler_attempt_duration_seconds_count")
+        assert att == 3
+        # the watch-delivery SLI reads through the same parser
+        from kubernetes_tpu.apiserver.http import watch_delivery
+
+        watch_delivery.observe(0.002)
+        final = invariants.parse_metrics(configz.metricsz_body())
+        wd = invariants.bucket_counts(
+            final, "apiserver_watch_delivery_seconds")
+        assert wd, "apiserver_watch_delivery_seconds not exposed"
+        assert invariants.total(
+            final, "apiserver_watch_delivery_seconds_count") >= 1
+    finally:
+        sched.stop()
+        sched.informers.stop()
+
+
+# ---------------------------------------------------------------------------
+# recompile events: exactly on a forced bucket eviction
+
+
+def test_recompile_event_fires_exactly_on_forced_eviction():
+    from kubernetes_tpu.ops.pallas_scan import PallasSession
+
+    from .test_hoisted import _encode_all, _presized_encoding
+    from kubernetes_tpu.testing.synth import synth_cluster, \
+        synth_pending_pods
+
+    nodes, init_pods = synth_cluster(8, pods_per_node=1)
+    pending = synth_pending_pods(6)
+    enc, pe = _presized_encoding(nodes, init_pods, pending)
+    arrays = _encode_all(enc, pe, pending)
+    templates = []
+    seen = set()
+    from kubernetes_tpu.ops.hoisted import template_fingerprint
+
+    for a in arrays:
+        fp = template_fingerprint(a)
+        if fp not in seen:
+            seen.add(fp)
+            templates.append(a)
+    sess = PallasSession(enc.device_state(), templates, interpret=True)
+
+    def dispatch():
+        # The COUNTED MISS fires before any dispatch result is used, so
+        # the accounting contract holds even where this jax build cannot
+        # lower the interpret-mode kernel (the compile event then simply
+        # carries ok=False and the jit fallback's failure is irrelevant
+        # to what this test pins).
+        try:
+            sess.schedule(arrays)
+        except Exception:  # noqa: BLE001
+            pass
+
+    devtime.set_level(1)
+    c0 = devtime.TIMELINE.compiles
+    dispatch()  # first dispatch of this bucket: a counted miss
+    c1 = devtime.TIMELINE.compiles
+    assert c1 == c0 + 1, "bucket miss did not record a compile event"
+    dispatch()  # cache hit (even a pinned failed compile): no new event
+    assert devtime.TIMELINE.compiles == c1
+    # forced eviction: drop the bucket's executables, next dispatch is a
+    # fresh counted miss
+    evicted = [k for k in list(sess._exec)]
+    assert evicted
+    for k in evicted:
+        del sess._exec[k]
+    dispatch()
+    assert devtime.TIMELINE.compiles == c1 + 1, (
+        "forced eviction did not record exactly one compile event"
+    )
+    recs = [r for r in devtime.TIMELINE.snapshot() if r[1] == "compile"]
+    assert recs and recs[-1][2] == "pallas-bucket"

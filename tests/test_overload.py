@@ -229,19 +229,24 @@ class TestOverloadMonitor:
 
 
 def test_levers_round_trip_every_knob(monkeypatch):
-    """Shed all four levers in order, restore LIFO: every knob returns
+    """Shed all five levers in order, restore LIFO: every knob returns
     to its pre-shed value, and no lever tears the session down."""
+    from kubernetes_tpu.utils import devtime
+
     _, cs = _cluster()
     sched = _mk_scheduler(cs, 2)
     tpu = sched.tpu
     trace0 = tracing.level()
+    devtime0 = devtime.level()
     try:
         tracing.set_level(2)
+        devtime.set_level(1)
         tpu.shadow_sample = 0.25
         assert sched.overload is not None
         levers = sched.overload.levers
         assert [name for name, _, _ in levers] == [
-            "explain-harvest", "shadow-sample", "trace", "speculation"]
+            "explain-harvest", "shadow-sample", "devtime", "trace",
+            "speculation"]
         # warm a session so "no teardown" is observable
         pods = [
             make_pod(f"p-{i}", namespace="default", cpu="100m",
@@ -255,6 +260,7 @@ def test_levers_round_trip_every_knob(monkeypatch):
             shed()
         assert tpu.explain_harvest is False
         assert tpu.shadow_sample == 0.0
+        assert devtime.level() == 0
         assert tracing.level() == 0
         assert tpu.speculation is False
         assert tpu._session is sess, "a shed lever tore the session down"
@@ -262,11 +268,13 @@ def test_levers_round_trip_every_knob(monkeypatch):
             restore()
         assert tpu.explain_harvest is True
         assert tpu.shadow_sample == 0.25
+        assert devtime.level() == 1
         assert tracing.level() == 2
         assert tpu.speculation is True
         assert tpu._session is sess
     finally:
         tracing.set_level(trace0)
+        devtime.set_level(devtime0)
         sched.stop()
         sched.informers.stop()
 
@@ -365,7 +373,7 @@ def test_full_shed_run_is_bit_identical(monkeypatch):
                     ov = sched.overload
                     assert ov is not None
                     # every observe tick is hot; dwell 1, no cooldown:
-                    # all four levers shed within the first batches
+                    # all five levers shed within the first batches
                     ov.high_fifo_age = -1.0
                     ov.shed_dwell = 1
                     ov.cooldown = 0.0
@@ -373,7 +381,7 @@ def test_full_shed_run_is_bit_identical(monkeypatch):
                 _drive(sched, cs, pods, batch_sizes)
                 if mode == "shed":
                     assert sched.overload.triggered
-                    assert sched.overload.level() == 4, (
+                    assert sched.overload.level() == 5, (
                         "forced-hot run did not shed every lever"
                     )
                 maps[mode] = _bound_map(cs)
