@@ -1,0 +1,9 @@
+"""ktpu-lint: AST invariant analysis for the hand-enforced contracts.
+
+Stdlib-only (``ast`` + ``tokenize``); never imports the code it checks.
+Entry points: ``scripts/lint.py`` (CLI) and
+``tests/test_static_analysis.py`` (tier-1 gate).
+"""
+
+from .core import (Report, Violation, load_baseline, run,  # noqa: F401
+                   save_baseline, update_baseline)

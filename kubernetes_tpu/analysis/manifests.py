@@ -1,0 +1,117 @@
+"""Declarative manifests for the ktpu-lint checkers.
+
+Everything the checkers treat as policy — which modules are hot, which
+are observability-only, which APIs mutate scheduling state, which
+counters are fault-seam counters — lives HERE as data, so tightening a
+contract is a manifest edit plus a fixture, never a checker rewrite.
+Paths are repo-relative with forward slashes.
+"""
+
+from __future__ import annotations
+
+import re
+
+# ---------------------------------------------------------------------------
+# host-sync-in-hot-path (checker: host-sync)
+#
+# Modules on the dispatch hot path: nothing here may trigger an
+# implicit host<->device sync without a `# ktpu: allow-sync(reason)`
+# pragma. Directory entries (trailing "/") cover every file below them.
+
+HOT_PATHS = (
+    "kubernetes_tpu/ops/",
+    "kubernetes_tpu/scheduler/tpu_backend.py",
+)
+
+# import roots whose call results are device values (taint sources)
+DEVICE_ROOTS = frozenset({"jax", "jnp", "lax", "pl", "pltpu"})
+
+# parameter names that conventionally carry device values (session
+# trees, scan carries, harvested outputs) in the hot modules — a
+# function taking one of these starts with it tainted
+DEVICE_PARAM_NAMES = frozenset({
+    "ys", "carry", "tp", "xs", "S", "tree", "cluster", "meta", "match",
+})
+
+# attribute names that hold device values on session/backend objects
+DEVICE_ATTRS = frozenset({"_carry", "device_state"})
+
+# calls (by terminal name) that produce device values
+DEVICE_PRODUCERS = frozenset({
+    "device_state", "_initial_carry", "apply_deltas_carry", "_run",
+})
+
+# numpy aliases whose asarray/array on a device value is a D2H readback
+NUMPY_ROOTS = frozenset({"np", "numpy", "onp"})
+
+# ---------------------------------------------------------------------------
+# knob-registry (checker: knob-registry)
+
+KNOBS_MODULE = "kubernetes_tpu/utils/knobs.py"
+KNOB_PREFIX = "KTPU_"
+KNOB_TOKEN_RE = re.compile(r"KTPU_[A-Z0-9_]+")
+README = "README.md"
+
+# ---------------------------------------------------------------------------
+# decision-inertness (checker: decision-inert)
+#
+# Observability-only modules: they may read anything, but must never
+# import the scheduling-state surface or call its mutating APIs — a
+# trace/explain/timeline code path that can change a placement is the
+# exact bug class PRs 8/10 promised away.
+
+DECISION_INERT_MODULES = (
+    "kubernetes_tpu/utils/tracing.py",
+    "kubernetes_tpu/utils/devtime.py",
+    "kubernetes_tpu/utils/selfstats.py",
+    "kubernetes_tpu/scheduler/explain.py",
+)
+
+# modules an observability-only module may not import (the mutating
+# scheduling-state surface; dotted-prefix match)
+INERT_DENY_IMPORTS = (
+    "kubernetes_tpu.scheduler.internal.cache",
+    "kubernetes_tpu.scheduler.tpu_backend",
+    "kubernetes_tpu.scheduler.scheduler",
+    "kubernetes_tpu.ops",
+    "kubernetes_tpu.parallel",
+    "kubernetes_tpu.cluster",
+)
+
+# mutating method names of the carry/session/cache surface: calling one
+# from an observability-only module is a violation regardless of how
+# the receiver was obtained
+INERT_DENY_CALLS = frozenset({
+    "assume", "finish_binding", "forget", "expire_assumed",
+    "add_pod", "remove_pod", "update_pod",
+    "add_node", "remove_node", "update_node",
+    "apply_deltas", "dispatch_many", "schedule_many",
+    "set_shadow_sample", "set_shadow_rate_only",
+    "_invalidate_session", "_apply_decisions_locked",
+})
+
+# ---------------------------------------------------------------------------
+# seam-dump pairing (checker: seam-pairing)
+#
+# Fault-seam counters must bump WITH a flight-recorder dump (the PR 8
+# rule): an `.inc()` on one of these is legal only in a function that
+# also calls `dump_seam` (or inside metrics.py, which defines the
+# paired helper itself).
+
+SEAM_COUNTERS = frozenset({
+    "device_faults", "worker_restarts", "parity_drift", "trace_dumps",
+})
+SEAM_PAIR_CALL = "dump_seam"
+SEAM_EXEMPT_MODULES = ("kubernetes_tpu/scheduler/metrics.py",)
+
+# ---------------------------------------------------------------------------
+# lock-order (checker: lock-order)
+
+# a `with <expr>:` context whose terminal name matches this is treated
+# as a lock acquisition
+LOCK_NAME_RE = re.compile(r"(?:^|_)(?:lock|mutex|cv|cond|condition)$|_lock$",
+                          re.IGNORECASE)
+
+# with-contexts that look lock-ish but are not exclusive locks (never
+# graph nodes)
+LOCK_NAME_DENY = frozenset({"self"})

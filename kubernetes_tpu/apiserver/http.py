@@ -41,7 +41,7 @@ from urllib.parse import parse_qs, urlsplit
 
 from ..api import types as v1
 from ..store import kv
-from ..utils import serde
+from ..utils import knobs, serde
 from ..utils.metrics import Counter, Gauge, Histogram, legacy_registry
 from .server import APIError, APIServer, NotFound, ResourceInfo, WatchEvent
 
@@ -653,10 +653,9 @@ class HTTPAPIServer:
         # slow-consumer backpressure knobs (_stream_watch): bounded
         # per-watcher send buffer + max stall before eviction. Tests
         # shrink these per-hub; production tunes via env.
-        self.watch_buffer_bytes = int(
-            os.environ.get("KTPU_WATCH_BUFFER", "") or 256 * 1024)
+        self.watch_buffer_bytes = int(knobs.get_int("KTPU_WATCH_BUFFER"))
         self.watch_evict_after = float(
-            os.environ.get("KTPU_WATCH_EVICT_AFTER", "") or 10.0)
+            knobs.get_float("KTPU_WATCH_EVICT_AFTER"))
         self._watch_lock = threading.Lock()
         self.watcher_count = 0  # live streams on THIS hub
         from ..utils import configz

@@ -20,16 +20,15 @@ from __future__ import annotations
 import os
 from typing import Dict, Hashable, Iterable, List, Optional
 
+from ..utils import knobs
+
 
 def node_headroom() -> float:
     """Growth headroom fraction for the node axis (`KTPU_NODE_HEADROOM`,
     default 0): capacity targets n*(1+headroom) at rebuild time, so node
     adds land in pre-padded tail lanes instead of forcing a rebuild —
     the delta-class envelope for churn at 100k nodes."""
-    try:
-        return max(0.0, float(os.environ.get("KTPU_NODE_HEADROOM", "0") or 0))
-    except ValueError:
-        return 0.0
+    return max(0.0, knobs.get_float("KTPU_NODE_HEADROOM"))
 
 
 def bucket_capacity(n: int, minimum: int = 8) -> int:

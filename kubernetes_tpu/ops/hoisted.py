@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import knobs
 from . import kernel as K
 from .eval import eval_reqs, eval_reqs_single, ns_member
 from .kernel import _CNT, _F64, _I64, DEFAULT_WEIGHTS
@@ -1092,6 +1093,7 @@ def _port_adds_for(templates: List[Dict], cluster: Dict):
     )
 
 
+# ktpu: allow-sync(harvest decode: one-shot API drains decisions to host lists by design)
 def schedule_batch_hoisted(
     cluster: Dict,
     pod_arrays_list: List[Dict],
@@ -1179,7 +1181,7 @@ def _session_scan(S, c_static, tp, carry, batch_self, xs, weights_key,
     # unroll: the tunnel pays a fixed cost per fused-kernel launch, and
     # launches scale with scan iterations; unrolling trades compile time
     # for fewer iterations (semantics identical) — see PERF_NOTES.md
-    unroll = int(os.environ.get("KTPU_SCAN_UNROLL", "1"))
+    unroll = knobs.get_int("KTPU_SCAN_UNROLL")
     if k <= 1 or explain_k > 0:
         # explain rides the one-pod-per-step scan (the session pins
         # multipod_k to 1 in explain mode; decisions are identical)
@@ -1395,6 +1397,7 @@ class HoistedSession:
         return ys
 
     @staticmethod
+    # ktpu: allow-sync(harvest decode: host consumes batch verdicts after the launch completes)
     def decisions(ys: Dict) -> List[int]:
         """Block on a batch's results and return node indices (-1 =
         unschedulable), bucket-padding rows stripped."""
@@ -1402,6 +1405,7 @@ class HoistedSession:
         return [int(v) for v in best[: ys.get("_b_real", best.shape[0])]]
 
     @staticmethod
+    # ktpu: allow-sync(harvest decode: host reads conflict planes after the launch completes)
     def conflict_stats(ys: Dict):
         """(n_conflicts, replay_suffix_start) for one harvested batch.
         The hoisted scan replays conflicted pods IN-DEVICE (_step_multi
@@ -1415,6 +1419,7 @@ class HoistedSession:
         return int(arr[: ys.get("_b_real", arr.shape[0])].sum()), None
 
     @staticmethod
+    # ktpu: allow-sync(harvest decode: explain attribution is read back off the hot path)
     def explain_payload(ys: Dict):
         """Per-pod attribution from an explain-mode batch, or None when
         the batch ran with explain off (any session kind — the keys are

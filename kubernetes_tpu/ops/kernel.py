@@ -698,14 +698,14 @@ def multipod_k(explicit=None, dyn_ports: bool = False,
     nothing, and the parity suites pass k explicitly). The result is
     clamped to a power of two <= 64 so every pow2 batch bucket divides
     into whole steps."""
-    import os as _os
+    from ..utils import knobs
 
     if dyn_ports:
         return 1
     if explicit is not None:
         k = int(explicit)
     else:
-        env = _os.environ.get("KTPU_MULTIPOD_K", "")
+        env = knobs.get_int("KTPU_MULTIPOD_K", default=0)
         if env:
             k = int(env)
         else:

@@ -45,6 +45,8 @@ import os as _os
 import time as _time
 from typing import Dict, List, NamedTuple, Optional
 
+from ..utils import knobs
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -576,6 +578,7 @@ class PallasSession:
         # SMEM scalar table
         self._scalars = self._pack_scalars(S)
 
+    # ktpu: allow-sync(session build: one-time host packing of affinity planes, runs before first dispatch)
     def _build_ipa(self, c: Dict, S: Dict, tp: Dict) -> Dict:
         """InterPodAffinity term machinery for the single-launch kernel.
 
@@ -778,6 +781,7 @@ class PallasSession:
             aff_valid=_pad_tc(a_valid.astype(np.int32), T),
         )
 
+    # ktpu: allow-sync(session build: packs static scalar rows on host before upload)
     def _pack_scalars(self, S) -> np.ndarray:
         T, C, R = self.T, self.C, self.R
         # the sharded two-phase session (ops/sharded_scan.py) reads these
@@ -890,10 +894,12 @@ class PallasSession:
         return {"rows": out, "n": B, "bucket": Bp, "mk": self.multipod_k}
 
     @staticmethod
+    # ktpu: allow-sync(harvest decode: host consumes batch verdicts after the launch completes)
     def decisions(ys) -> List[int]:
         return [int(v) for v in np.asarray(ys["rows"])[0, :ys["n"]]]
 
     @staticmethod
+    # ktpu: allow-sync(harvest decode: host reads conflict planes after the launch completes)
     def conflict_stats(ys):
         """(n_conflicts, replay_suffix_start) from out row 3: the kernel
         leaves the conflicted suffix UNCOMMITTED (flag 1) — the backend
@@ -1125,7 +1131,7 @@ class PallasSession:
         match = jnp.asarray(match)
         key = (Bp, mode)
         fn = self._exec.get(key, _MISSING)
-        if _os.environ.get("KTPU_PALLAS_AOT", "1") != "1":
+        if not knobs.get_bool("KTPU_PALLAS_AOT"):
             fn = None  # kill switch wins even over warm-installed execs
         elif fn is _MISSING:
             # Counted miss path: a dispatch-time compile is a stall the
@@ -1175,7 +1181,7 @@ class PallasSession:
         self._carry (a mid-warm schedule() would have its batch's
         assumes silently zeroed by the overwrite) — all shapes come from
         _carry_struct. Failures are non-fatal (the lazy path works)."""
-        aot = _os.environ.get("KTPU_PALLAS_AOT", "1") == "1"
+        aot = knobs.get_bool("KTPU_PALLAS_AOT")
         for Bp in sizes:
             try:
                 if (Bp, "full") in self._exec:
@@ -1256,10 +1262,10 @@ def _build_kernel(shapes, weights, Bp: int, ur: int = 0,
     row 3 flags them, and the host replays exactly that suffix through
     the session (tpu_backend._harvest_locked) — bit-identical to
     one-pod-per-step either way."""
-    import os as _os
+    from ..utils import knobs as _knobs
 
     skip = frozenset(
-        _os.environ.get("KTPU_PALLAS_SKIP", "").split(","))  # profiling only
+        _knobs.get_str("KTPU_PALLAS_SKIP").split(","))  # profiling only
     T, C, Np, R, SR, TCp, K, CP = shapes
     W = dict(weights)
     dyn_ipa = ur > 0 and "ipa" not in skip
@@ -1864,7 +1870,7 @@ def _build_kernel(shapes, weights, Bp: int, ur: int = 0,
         # per-iteration bookkeeping (the marginal-cost floor; partial
         # `unroll=` is unsupported by the TPU lowering). b >= B_real
         # iterations are no-ops via the ok gate.
-        U = int(_os.environ.get("KTPU_PALLAS_GROUP", "4"))
+        U = int(_knobs.get_int("KTPU_PALLAS_GROUP"))
         while Bp % U:
             U //= 2
 

@@ -689,6 +689,7 @@ class ShardedPallasSession:
     def explain_payload(ys):
         return None
 
+    # ktpu: allow-sync(session build: host mirrors of shard planes built once at construction)
     def __init__(self, cluster: Dict, template_arrays_list: List[Dict],
                  weights: Optional[Dict[str, int]] = None,
                  mesh: Optional[Mesh] = None,
@@ -905,11 +906,13 @@ class ShardedPallasSession:
         return out
 
     @staticmethod
+    # ktpu: allow-sync(harvest decode: host consumes batch verdicts after the launch completes)
     def decisions(ys: Dict) -> List[int]:
         best = np.asarray(ys["best"])
         return [int(v) for v in best[: ys["_b_real"]]]
 
     @staticmethod
+    # ktpu: allow-sync(harvest decode: host reads conflict planes after the launch completes)
     def conflict_stats(ys: Dict):
         """(n_conflicts, replay_suffix_start): the sharded multipod step
         does NOT replay in-device (collectives under lax.cond) — the

@@ -35,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.vocab import node_headroom
+from ..utils import knobs
 from ..ops.kernel import DEFAULT_WEIGHTS, schedule_pod
 from .partition import CLUSTER_PARTITION_RULES, NODE_AXIS, shard_tree
 
@@ -69,8 +70,7 @@ def make_mesh(devices=None, n_devices: Optional[int] = None) -> Mesh:
     """
     if devices is None:
         if n_devices is None:
-            n_devices = int(os.environ.get("KTPU_MESH_DEVICES", "0") or 0) \
-                or None
+            n_devices = knobs.get_int("KTPU_MESH_DEVICES") or None
         devices = jax.devices()
         if n_devices is not None:
             devices = devices[:n_devices]

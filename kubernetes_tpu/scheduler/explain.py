@@ -36,7 +36,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..api import types as v1
 from ..api.types import pod_key
-from ..utils import serde
+from ..utils import knobs, serde
 
 # explain score key (kernel/hoisted stack order) -> oracle plugin name.
 # Must stay in lockstep with ops.hoisted.EXPLAIN_SCORE_KEYS and the score
@@ -58,7 +58,7 @@ BUNDLE_DIR_ENV = "KTPU_SHADOW_BUNDLE_DIR"
 def bundle_dir() -> str:
     import tempfile
 
-    return os.environ.get(BUNDLE_DIR_ENV) or os.path.join(
+    return knobs.get_str(BUNDLE_DIR_ENV) or os.path.join(
         tempfile.gettempdir(), "ktpu-shadow-bundles"
     )
 
@@ -147,7 +147,9 @@ def device_breakdown(
 
     from ..models.encoding import ClusterEncoding
     from ..models.pod_encoder import PodEncoder
+    # ktpu: allow-inert(read-only import: schedule_pod scores a copy for attribution, no state is written)
     from ..ops.kernel import schedule_pod
+    # ktpu: allow-inert(read-only import: plugin mask table consulted, never mutated)
     from .tpu_backend import MASK_PLUGINS
 
     enc = ClusterEncoding()
@@ -188,6 +190,7 @@ def payload_breakdown(payload: Dict, node_names: Sequence[str]) -> Dict:
     ``explain_payload`` entry: packed mask bits + top-k totals/score
     stacks) into the common breakdown shape. Scores cover only the top-k
     candidates — that is what the device shipped back."""
+    # ktpu: allow-inert(read-only import: filter/score key tables consulted, never mutated)
     from ..ops.hoisted import EXPLAIN_FILTER_PLUGINS, EXPLAIN_SCORE_KEYS
 
     bits = payload["bits"]

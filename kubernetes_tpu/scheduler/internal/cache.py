@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ...api import types as v1
+from ...utils import knobs
 from ..framework.snapshot import Snapshot
 from ..framework.types import (
     ImageStateSummary,
@@ -50,7 +51,7 @@ ASSUME_EXPIRATION_SECONDS = 30.0  # cache.go durationToExpireAssumedPod
 
 
 def _columnar_default() -> bool:
-    return os.environ.get("KTPU_COLUMNAR_CACHE", "1") != "0"
+    return knobs.get_bool("KTPU_COLUMNAR_CACHE")
 
 
 class CacheListener:

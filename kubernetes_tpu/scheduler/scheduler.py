@@ -43,7 +43,7 @@ from ..apiserver.server import APIError
 from ..client.clientset import Clientset
 from ..client.events import EventRecorder
 from ..client.informer import EventHandler, SharedInformerFactory, meta_namespace_key
-from ..utils import devtime, serde, tracing
+from ..utils import devtime, knobs, serde, tracing
 from . import metrics
 from .core import GenericScheduler, ScheduleResult
 from .framework.interface import Code, CycleState, FitError
@@ -222,10 +222,8 @@ class Scheduler:
         # dispatch watchdog, which is what actually unsticks a wedged
         # wait; the drain timeout is the second line of defense
         self.faults = None
-        self.drain_timeout = (
-            float(os.environ["KTPU_DRAIN_TIMEOUT"])
-            if "KTPU_DRAIN_TIMEOUT" in os.environ else None
-        )
+        self.drain_timeout = knobs.get_float(
+            "KTPU_DRAIN_TIMEOUT", default=None)
         self._binders = ThreadPoolExecutor(max_workers=8, thread_name_prefix="binder")
         self._inflight = 0  # scheduling batches + binds not yet finished
         self._inflight_lock = threading.Lock()
@@ -272,31 +270,27 @@ class Scheduler:
         self._shed_saved: Dict[str, object] = {}
         self._completion_durations: deque = deque(maxlen=64)
         self.overload = None
-        if self.tpu is not None and os.environ.get(
-                "KTPU_OVERLOAD", "1") != "0":
+        if self.tpu is not None and knobs.get_bool("KTPU_OVERLOAD"):
             from .degradation import OverloadMonitor
 
-            def _env_f(name: str, default: float) -> float:
-                return float(os.environ.get(name, "") or default)
-
-            high_age = _env_f("KTPU_OVERLOAD_FIFO_AGE", 0.5)
-            high_q = int(_env_f("KTPU_OVERLOAD_QUEUE_DEPTH",
-                                max(256, 4 * self.max_batch)))
+            high_age = knobs.get_float("KTPU_OVERLOAD_FIFO_AGE")
+            high_q = knobs.get_int(
+                "KTPU_OVERLOAD_QUEUE_DEPTH",
+                default=max(256, 4 * self.max_batch))
             self.overload = OverloadMonitor(
                 self._overload_levers(),
                 high_fifo_age=high_age,
-                low_fifo_age=_env_f(
-                    "KTPU_OVERLOAD_FIFO_AGE_LOW", high_age * 0.2),
+                low_fifo_age=knobs.get_float(
+                    "KTPU_OVERLOAD_FIFO_AGE_LOW", default=high_age * 0.2),
                 high_queue_depth=high_q,
-                low_queue_depth=int(_env_f(
-                    "KTPU_OVERLOAD_QUEUE_DEPTH_LOW", high_q // 4)),
+                low_queue_depth=knobs.get_int(
+                    "KTPU_OVERLOAD_QUEUE_DEPTH_LOW", default=high_q // 4),
                 # stage-latency signal is opt-in: per-stage p99 is
                 # workload-shaped, the deployment sets the water mark
-                high_stage_p99=_env_f("KTPU_OVERLOAD_STAGE_P99", 0.0),
-                shed_dwell=int(_env_f("KTPU_OVERLOAD_SHED_DWELL", 3)),
-                restore_dwell=int(_env_f(
-                    "KTPU_OVERLOAD_RESTORE_DWELL", 8)),
-                cooldown=_env_f("KTPU_OVERLOAD_COOLDOWN", 1.0),
+                high_stage_p99=knobs.get_float("KTPU_OVERLOAD_STAGE_P99"),
+                shed_dwell=knobs.get_int("KTPU_OVERLOAD_SHED_DWELL"),
+                restore_dwell=knobs.get_int("KTPU_OVERLOAD_RESTORE_DWELL"),
+                cooldown=knobs.get_float("KTPU_OVERLOAD_COOLDOWN"),
                 on_shed=lambda what, sig: self._health_event(
                     "Warning", "OverloadShed",
                     f"host overload: shed {what} ({sig})"),

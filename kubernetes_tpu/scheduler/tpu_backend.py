@@ -37,7 +37,7 @@ from ..ops.hoisted import (
     match_matrices_np,
     template_fingerprint,
 )
-from ..utils import devtime, tracing
+from ..utils import devtime, knobs, tracing
 from .degradation import (
     RUNG_HOISTED,
     RUNG_ORACLE,
@@ -190,14 +190,11 @@ class TPUBackend(CacheListener):
         # terms or host ports, vocab/capacity growth. The kill switch
         # exists for A/B parity runs (tests + probe_session_deltas.py).
         self._deltas: List[Dict] = []
-        self.delta_patching = (
-            os.environ.get("KTPU_SESSION_DELTAS", "1") == "1"
-        )
+        self.delta_patching = knobs.get_bool("KTPU_SESSION_DELTAS")
         # backstop for an idle scheduler accumulating events with no
         # dispatch to flush them: past this the rebuild is cheaper than
         # the queue is worth, and the teardown path absorbs everything
-        self.max_queued_deltas = int(
-            os.environ.get("KTPU_MAX_QUEUED_DELTAS", "4096"))
+        self.max_queued_deltas = knobs.get_int("KTPU_MAX_QUEUED_DELTAS")
         self._node_fps: Dict[str, tuple] = {}  # heartbeat-change gate
         self._known_templates: Dict = {}  # fingerprint -> pod arrays
         # in-flight batches, oldest first. Depth 2 double-buffers the
@@ -221,7 +218,7 @@ class TPUBackend(CacheListener):
         # speculation off, a new scan never chains on a not-yet-
         # harvested carry — dispatch_many flushes the pipeline first
         # (serializing; the A/B lever for the bench matrix)
-        self.speculation = os.environ.get("KTPU_SPECULATION", "1") == "1"
+        self.speculation = knobs.get_bool("KTPU_SPECULATION")
         self.MAX_SESSION_TEMPLATES = 8
         self.volume_resolver = None  # scheduler/volume_device.py
         # pallas rides only on real TPUs: on CPU (tests, dryruns) the
@@ -242,10 +239,10 @@ class TPUBackend(CacheListener):
         # the jnp what-if pays XLA compiles the numpy fast rung + oracle
         # don't (the parity suites and probe enable it explicitly).
         # KTPU_WHATIF=0 is the kill switch / =1 the CPU opt-in.
-        self.whatif = os.environ.get(
+        self.whatif = knobs.get_bool(
             "KTPU_WHATIF",
-            "1" if jax.devices()[0].platform == "tpu" else "0",
-        ) == "1"
+            default=jax.devices()[0].platform == "tpu",
+        )
         # -- device fault tolerance ------------------------------------
         # Optional FaultInjector seam (testing/faults.py, duck-typed):
         # chaos drills arm dispatch raises / NaN harvests / wedged waits
@@ -254,20 +251,19 @@ class TPUBackend(CacheListener):
         # watchdog: no device wait (harvest, flush, probe) may exceed
         # this — past it the dispatch is a fault, the in-flight chain is
         # abandoned, and the batch re-drives synchronously
-        self.watchdog_timeout = float(
-            os.environ.get("KTPU_WATCHDOG_TIMEOUT", "30"))
+        self.watchdog_timeout = knobs.get_float("KTPU_WATCHDOG_TIMEOUT")
         # bounded retry (capped exponential backoff + full jitter — the
         # Supervisor's restart policy at dispatch granularity)
-        self.retry_cap = int(os.environ.get("KTPU_DISPATCH_RETRIES", "2"))
-        self.retry_base = float(os.environ.get("KTPU_RETRY_BASE", "0.05"))
-        self.retry_max = float(os.environ.get("KTPU_RETRY_MAX", "2.0"))
+        self.retry_cap = knobs.get_int("KTPU_DISPATCH_RETRIES")
+        self.retry_base = knobs.get_float("KTPU_RETRY_BASE")
+        self.retry_max = knobs.get_float("KTPU_RETRY_MAX")
         # degradation ladder: consecutive faults demote pallas -> hoisted
         # -> oracle; the probe loop below re-promotes when a canary
         # dispatch answers correctly again
         self.ladder = DegradationLadder(
             top=RUNG_PALLAS if self.use_pallas else RUNG_HOISTED,
-            threshold=int(os.environ.get("KTPU_DEMOTE_THRESHOLD", "3")),
-            probe_interval=float(os.environ.get("KTPU_PROBE_INTERVAL", "1.0")),
+            threshold=knobs.get_int("KTPU_DEMOTE_THRESHOLD"),
+            probe_interval=knobs.get_float("KTPU_PROBE_INTERVAL"),
             rng=self.rng,
         )
         self._probe_thread: Optional[threading.Thread] = None
@@ -296,14 +292,13 @@ class TPUBackend(CacheListener):
         # so any sample rate > 0 turns explain on. Explain rides the
         # hoisted session only: pallas/sharded sessions demote (loudly,
         # session_builds{reason="explain"}) while it is armed.
-        self.shadow_sample = min(1.0, max(0.0, float(
-            os.environ.get("KTPU_SHADOW_SAMPLE", "0") or 0)))
+        self.shadow_sample = min(1.0, max(0.0,
+            knobs.get_float("KTPU_SHADOW_SAMPLE")))
         self.explain = (
-            os.environ.get("KTPU_EXPLAIN", "0") == "1"
+            knobs.get_bool("KTPU_EXPLAIN")
             or self.shadow_sample > 0
         )
-        self.explain_topk = max(1, int(
-            os.environ.get("KTPU_EXPLAIN_TOPK", "3")))
+        self.explain_topk = max(1, knobs.get_int("KTPU_EXPLAIN_TOPK"))
         # overload-shed lever (scheduler/degradation.OverloadMonitor):
         # False = the device still computes explain outputs (the session
         # shape is untouched — no teardown) but the host SKIPS the
@@ -378,7 +373,7 @@ class TPUBackend(CacheListener):
         with self._lock:
             self.shadow_sample = min(1.0, max(0.0, float(rate)))
             explain = (
-                os.environ.get("KTPU_EXPLAIN", "0") == "1"
+                knobs.get_bool("KTPU_EXPLAIN")
                 or self.shadow_sample > 0
             )
             if explain != self.explain:
@@ -512,7 +507,7 @@ class TPUBackend(CacheListener):
         session_rebuilds.inc(reason=reason, shards=self._shards_label())
         self._last_invalidate = reason
         tracing.event("session-teardown", "session", reason=reason)
-        if _os.environ.get("KTPU_DEBUG_INVALIDATE"):
+        if knobs.get_flag("KTPU_DEBUG_INVALIDATE"):
             import traceback as _tb
 
             print(f"SESSION INVALIDATED ({reason}) BY:",
@@ -903,6 +898,7 @@ class TPUBackend(CacheListener):
                 if inj is not None:
                     inj.consume_wedge()
                 return False
+            # ktpu: allow-sync(ladder probe: the 1-element sentinel readback IS the probe)
             return int(np.asarray(y)) == 64 * 63
         except Exception:  # noqa: BLE001 — a raising probe is a failed probe
             return False
@@ -1250,6 +1246,7 @@ class TPUBackend(CacheListener):
                     lt = devtime.launch("kernel", "delta-apply",
                                         n=len(deltas))
                     self._session.apply_deltas(deltas)
+                    # ktpu: allow-sync(devtime fence: delta-apply is timed in-window; the fence is the measurement)
                     jax.block_until_ready(
                         getattr(self._session, "_carry", None))
                     lt.done()
@@ -2030,6 +2027,7 @@ class TPUBackend(CacheListener):
 
             lt = devtime.launch("transfer", "session-upload")
             cluster = self.enc.device_state()
+            # ktpu: allow-sync(devtime fence: session upload timed at build, not on the dispatch path)
             jax.block_until_ready(cluster)
             lt.h2d_bytes = devtime.payload_bytes(cluster)
             lt.done()

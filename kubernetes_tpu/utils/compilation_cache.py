@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import os
 
+from . import knobs
+
 DEFAULT_CACHE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     ".xla_cache",
@@ -27,7 +29,7 @@ def enable_persistent_cache(path: str = "") -> str:
     """Turn on jax's on-disk compilation cache; returns the cache dir.
 
     Honors KTPU_COMPILATION_CACHE (set to "0"/"off" to disable)."""
-    env = os.environ.get("KTPU_COMPILATION_CACHE", "")
+    env = knobs.get_str("KTPU_COMPILATION_CACHE")
     if env.lower() in ("0", "off", "disable"):
         return ""
     cache_dir = path or env or DEFAULT_CACHE_DIR

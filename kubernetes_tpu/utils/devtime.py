@@ -51,6 +51,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from . import knobs
+
 logger = logging.getLogger(__name__)
 
 DEVTIME_OFF = 0
@@ -91,17 +93,6 @@ class _NoopLaunch:
 
 
 NOOP_LAUNCH = _NoopLaunch()
-
-
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name, "")
-    if not raw:
-        return default
-    try:
-        return int(raw)
-    except ValueError:
-        logger.warning("invalid %s=%r; using %d", name, raw, default)
-        return default
 
 
 class _Launch:
@@ -160,9 +151,9 @@ class DeviceTimeline:
         # level TIMELINE) — malformed env degrades to defaults, never
         # fails the import; capacity clamps >= 1
         if capacity is None:
-            capacity = _env_int("KTPU_DEVTIME_CAPACITY", 4096)
+            capacity = knobs.get_int("KTPU_DEVTIME_CAPACITY")
         if level is None:
-            level = _env_int("KTPU_DEVTIME", 0)
+            level = knobs.get_int("KTPU_DEVTIME")
         self.capacity = max(1, int(capacity))
         self.level = max(0, int(level))
         self._buf: List[Optional[Record]] = [None] * self.capacity
@@ -173,13 +164,13 @@ class DeviceTimeline:
         self.compiles = 0
         # level-2 profiler captures remaining (bounded: each capture is
         # a real jax.profiler trace, not a ring write)
-        self.profile_budget = max(0, _env_int("KTPU_DEVTIME_PROFILE_MAX", 4))
+        self.profile_budget = max(0, knobs.get_int("KTPU_DEVTIME_PROFILE_MAX"))
         self._dump_lock = threading.Lock()
         self.dump_history: List[dict] = []
         # timeline dumps land beside the flight-recorder dumps unless
         # pointed elsewhere — one triage directory per incident
-        self.dump_dir = (os.environ.get("KTPU_DEVTIME_DUMP_DIR", "")
-                         or os.environ.get("KTPU_TRACE_DUMP_DIR", ""))
+        self.dump_dir = (knobs.get_str("KTPU_DEVTIME_DUMP_DIR")
+                         or knobs.get_str("KTPU_TRACE_DUMP_DIR"))
 
     # -- write side --------------------------------------------------------
 

@@ -52,6 +52,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from . import knobs
+
 logger = logging.getLogger(__name__)
 
 TRACE_OFF = 0
@@ -101,17 +103,6 @@ class _NoopSpan:
 NOOP_SPAN = _NoopSpan()
 
 
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name, "")
-    if not raw:
-        return default
-    try:
-        return int(raw)
-    except ValueError:
-        logger.warning("invalid %s=%r; using %d", name, raw, default)
-        return default
-
-
 class Span:
     __slots__ = ("_rec", "name", "stage", "attrs", "t0")
 
@@ -157,9 +148,9 @@ class FlightRecorder:
         # fail the scheduler's import; capacity is clamped >= 1 (a
         # zero-size ring would divide by zero on the first record)
         if capacity is None:
-            capacity = _env_int("KTPU_TRACE_CAPACITY", 8192)
+            capacity = knobs.get_int("KTPU_TRACE_CAPACITY")
         if level is None:
-            level = _env_int("KTPU_TRACE", 0)
+            level = knobs.get_int("KTPU_TRACE")
         self.capacity = max(1, int(capacity))
         self.level = max(0, int(level))
         self._buf: List[Optional[Event]] = [None] * self.capacity
@@ -168,7 +159,7 @@ class FlightRecorder:
         # is the observable for the fault-seam acceptance contract)
         self._dump_lock = threading.Lock()
         self.dump_history: List[dict] = []
-        self.dump_dir = os.environ.get("KTPU_TRACE_DUMP_DIR", "")
+        self.dump_dir = knobs.get_str("KTPU_TRACE_DUMP_DIR")
 
     # -- write side --------------------------------------------------------
 

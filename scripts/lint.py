@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""ktpu-lint CLI.
+
+Usage:
+  python scripts/lint.py                 lint the package (exit 1 on
+                                         any non-baselined violation)
+  python scripts/lint.py --explain       also list pragma-waived sites
+                                         with their reasons, and any
+                                         baselined debt
+  python scripts/lint.py --json          machine-readable report on
+                                         stdout (for automation)
+  python scripts/lint.py --update-baseline
+                                         re-record analysis/baseline.json
+                                         to the current violation set
+  python scripts/lint.py --knob-table    print the README KTPU_* knob
+                                         table from the live registry
+  python scripts/lint.py --no-cache      ignore the per-file mtime cache
+
+The checkers and their pragma rules (# ktpu: allow-<rule>(<reason>)):
+  host-sync       sync        decision-inert  inert
+  knob-registry   knob        seam-pairing    seam
+  lock-order      lock
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="ktpu-lint")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    ap.add_argument("--explain", action="store_true",
+                    help="list pragma-waived sites and baselined debt")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="re-record analysis/baseline.json")
+    ap.add_argument("--knob-table", action="store_true",
+                    help="print the README knob table from the registry")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore the per-file mtime cache")
+    args = ap.parse_args()
+
+    if args.knob_table:
+        # the only mode that imports package runtime code (knobs.py is
+        # dependency-free; the checkers themselves never import it)
+        from kubernetes_tpu.utils import knobs
+        print(knobs.markdown_table())
+        return 0
+
+    from kubernetes_tpu.analysis import core
+
+    if args.update_baseline:
+        report = core.update_baseline()
+        n = len(report.baselined)
+        print(f"baseline re-recorded: {n} grandfathered entr"
+              f"{'y' if n == 1 else 'ies'}")
+        return 0 if report.clean else 1
+
+    report = core.run(use_cache=not args.no_cache)
+
+    if args.json:
+        json.dump(report.to_json(), sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return 0 if report.clean else 1
+
+    for v in report.violations:
+        print(f"{v.path}:{v.line}: [{v.checker}/{v.code}] {v.message} "
+              f"(in {v.func})")
+    if args.explain:
+        if report.allowed:
+            print(f"\n-- {len(report.allowed)} pragma-waived site(s):")
+            for a in sorted(report.allowed,
+                            key=lambda a: (a.path, a.line)):
+                print(f"  {a.path}:{a.line} [{a.checker}/{a.code}] "
+                      f"allowed: {a.reason}")
+        if report.baselined:
+            print(f"\n-- {len(report.baselined)} baselined (grandfathered) "
+                  "violation(s):")
+            for v in report.baselined:
+                print(f"  {v.path}:{v.line} [{v.checker}/{v.code}] {v.key}")
+    if report.stale_baseline:
+        print(f"\n-- {len(report.stale_baseline)} stale baseline entr"
+              f"{'y' if len(report.stale_baseline) == 1 else 'ies'} "
+              "(fixed! shrink with --update-baseline):")
+        for k in report.stale_baseline:
+            print(f"  {k}")
+
+    cached = report.files_from_cache
+    print(f"\nktpu-lint: {len(report.violations)} violation(s), "
+          f"{len(report.baselined)} baselined, {len(report.allowed)} "
+          f"allowed by pragma ({report.files_checked} files, "
+          f"{cached} from cache)")
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

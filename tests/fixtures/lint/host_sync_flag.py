@@ -1,0 +1,22 @@
+"""Fixture: every host-sync sink fires (checked as a hot-path file)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def readbacks(ys):
+    total = jnp.sum(ys)
+    n = total.item()               # item-call
+    f = float(total)               # scalar-coerce
+    host = np.asarray(ys)          # numpy-readback
+    g = jax.device_get(total)      # device-get
+    total.block_until_ready()      # block-until-ready
+    return n, f, host, g
+
+
+def propagation(xs):
+    a = jnp.ones(4) + xs
+    b, c = a, a * 2
+    lo = int(b)                    # scalar-coerce through alias b
+    hi = int(c[0])                 # scalar-coerce through alias c
+    return lo + hi

@@ -1,0 +1,25 @@
+"""Fixture: host-only code plus pragma'd intentional syncs -> clean."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_only(rows):
+    arr = np.asarray(rows)              # np-sourced: not a readback
+    n = int(arr.shape[0])               # metadata only
+    return float(arr.sum()) + n
+
+
+def shapes(ys):
+    return int(ys.shape[0]), ys.dtype   # device metadata never syncs
+
+
+# ktpu: allow-sync(fixture: harvest decode reads verdicts by design)
+def pragma_function(ys):
+    return [int(v) for v in np.asarray(ys)]
+
+
+def pragma_line(ys):
+    total = jnp.sum(ys)
+    # ktpu: allow-sync(fixture: measured fence inside a timing window)
+    total.block_until_ready()
+    return total

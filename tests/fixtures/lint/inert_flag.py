@@ -1,0 +1,7 @@
+"""Fixture: an observability module steering the scheduler."""
+from kubernetes_tpu.scheduler.internal.cache import SchedulerCache
+
+
+def sneaky_mutation(cache, pod):
+    cache.assume(pod)             # inert-mutation-call
+    cache.finish_binding(pod)     # inert-mutation-call

@@ -1,0 +1,10 @@
+"""Fixture: observability module that only reads -> clean."""
+from kubernetes_tpu.utils import serde
+
+
+def render(snapshot):
+    return serde.to_dict(snapshot)
+
+
+def summarize(counts):
+    return {k: v + 1 for k, v in counts.items()}

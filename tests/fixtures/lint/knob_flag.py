@@ -1,0 +1,11 @@
+"""Fixture: direct KTPU_* env reads that bypass the registry."""
+import os
+from os import getenv
+
+
+def reads():
+    a = os.environ["KTPU_FIXTURE_SUBSCRIPT"]      # env-read
+    b = os.environ.get("KTPU_FIXTURE_GET", "0")   # env-read
+    c = os.getenv("KTPU_FIXTURE_GETENV")          # env-read
+    d = getenv("KTPU_FIXTURE_BARE")               # env-read
+    return a, b, c, d

@@ -1,0 +1,14 @@
+"""Fixture: accessor reads and env WRITES are legal."""
+import os
+
+from kubernetes_tpu.utils import knobs
+
+
+def accessor_read():
+    return knobs.get_int("KTPU_TRACE")
+
+
+def harness_writes():
+    os.environ["KTPU_FIXTURE_LEVER"] = "1"    # Store context: allowed
+    os.environ.pop("KTPU_FIXTURE_LEVER", None)  # write: allowed
+    return os.environ.get("PATH", "")         # non-KTPU read: allowed

@@ -1,0 +1,21 @@
+"""Fixture: consistent order, including through a helper call."""
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+def _take_b():
+    with b_lock:
+        pass
+
+
+def forward_direct():
+    with a_lock:
+        with b_lock:
+            pass
+
+
+def forward_via_call():
+    with a_lock:
+        _take_b()

@@ -1,0 +1,7 @@
+"""Fixture: seam counter bumped without the paired ring dump."""
+from kubernetes_tpu.scheduler import metrics
+
+
+def silent_fault(kind):
+    metrics.device_faults.inc(kind=kind)   # seam-unpaired
+    return kind

@@ -1,0 +1,12 @@
+"""Fixture: counter and dump travel together -> clean."""
+from kubernetes_tpu.scheduler import metrics
+from kubernetes_tpu.scheduler.metrics import dump_seam
+
+
+def loud_fault(kind):
+    metrics.device_faults.inc(kind=kind)
+    dump_seam(f"device-fault-{kind}")
+
+
+def unrelated_counter(m):
+    m.dispatches.inc()   # not a seam counter

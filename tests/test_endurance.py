@@ -27,6 +27,7 @@ from kubernetes_tpu.cluster import Cluster
 from kubernetes_tpu.testing import invariants as inv
 from kubernetes_tpu.testing.chaos import ChaosMonkey
 from kubernetes_tpu.testing.faults import BindIntegrityChecker, FaultInjector
+from kubernetes_tpu.testing.locks import lock_order_sentinel
 
 
 def _wait(fn, timeout=30.0, interval=0.05):
@@ -79,6 +80,13 @@ def _suite(checker, assume_ttl):
 
 
 def _endurance_body(seconds: float, directed: bool, seed: int = 11):
+    # dynamic lock-order sentinel: the chaos mix must not only avoid
+    # deadlock by timing luck — the acquisition graph itself is checked
+    with lock_order_sentinel():
+        _endurance_impl(seconds, directed, seed)
+
+
+def _endurance_impl(seconds: float, directed: bool, seed: int = 11):
     rng = random.Random(seed)
     inj = FaultInjector()
     inj.stall_delay = 0.3

@@ -23,6 +23,7 @@ from kubernetes_tpu.api import apps, types as v1
 from kubernetes_tpu.cluster import Cluster
 from kubernetes_tpu.testing.chaos import ChaosMonkey
 from kubernetes_tpu.testing.faults import BindIntegrityChecker, FaultInjector
+from kubernetes_tpu.testing.locks import lock_order_sentinel
 
 from .util import wait_until
 
@@ -46,6 +47,14 @@ def _deployment(name: str, replicas: int) -> apps.Deployment:
 
 def _soak(seed: int, duration: float, n_nodes: int, replicas: int,
           period: float = 0.25) -> None:
+    # every lock the cluster creates is order-tracked; teardown asserts
+    # the observed acquisition graph is cycle-free (testing/locks.py)
+    with lock_order_sentinel():
+        _soak_impl(seed, duration, n_nodes, replicas, period)
+
+
+def _soak_impl(seed: int, duration: float, n_nodes: int, replicas: int,
+               period: float = 0.25) -> None:
     inj = FaultInjector()
     rng = random.Random(seed)
     with Cluster(

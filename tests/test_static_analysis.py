@@ -1,0 +1,432 @@
+"""Tier-1 gate for ktpu-lint (kubernetes_tpu/analysis/).
+
+Four layers:
+  * fixture corpus — every checker demonstrably catches its violation
+    class (tests/fixtures/lint/*_flag.py) and stays quiet on the
+    legal twin (*_pass.py), including pragma waivers;
+  * framework — pragma parsing, line-free baseline keys, baseline
+    add/remove round-trip on a synthetic mini-repo, warm-cache reuse;
+  * the repo itself — `test_repo_clean` runs the full suite over the
+    package and fails on any non-baselined violation, and the
+    committed baseline may only shrink;
+  * the dynamic lock-order sentinel — opposite-order acquisition from
+    two threads is detected, consistent order passes, and
+    `threading.Condition` built on a tracked lock still works.
+
+The linter is stdlib-ast only, so this whole module runs in seconds.
+"""
+
+import ast
+import os
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.analysis import (core, decision_inert, host_sync,
+                                     knob_registry, lock_order, seam_pairing)
+from kubernetes_tpu.testing.locks import LockOrderSentinel, lock_order_sentinel
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
+
+# fixture sources are checked AS IF they lived at these in-repo paths,
+# so the checkers' path gates (hot modules, inert modules) apply
+HOT_REL = "kubernetes_tpu/ops/fixture_case.py"
+INERT_REL = "kubernetes_tpu/utils/tracing.py"
+ANY_REL = "kubernetes_tpu/scheduler/fixture_case.py"
+
+
+def read_fixture(name: str) -> str:
+    with open(os.path.join(FIXTURES, name), "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def lint_source(checker, rel: str, src: str):
+    """Run one checker through the full per-file pipeline (pragmas
+    applied, facts collected) — the same flow core.run uses."""
+    tree = ast.parse(src)
+    pragmas = core.Pragmas(src, tree)
+    scope_of = core.enclosing_func(tree)
+    facts = {}
+    found = checker.check_file(rel, tree, src, scope_of, facts)
+    rule = core.CHECKER_TO_RULE[checker.CHECKER]
+    violations, allowed = [], []
+    for v in found:
+        reason = pragmas.waiver(rule, v.line)
+        (allowed if reason is not None else violations).append(v)
+    return violations, allowed, facts
+
+
+# ---------------------------------------------------------------------------
+# fixtures: each checker catches its violation class and passes the twin
+
+
+class TestHostSyncFixtures:
+    def test_flag_corpus_catches_every_sink(self):
+        violations, _, _ = lint_source(
+            host_sync, HOT_REL, read_fixture("host_sync_flag.py"))
+        codes = {v.code for v in violations}
+        assert codes >= {"item-call", "scalar-coerce", "numpy-readback",
+                         "device-get", "block-until-ready"}
+        # aliasing: both tuple-unpacked names stay tainted
+        assert sum(v.code == "scalar-coerce" for v in violations) >= 3
+
+    def test_pass_corpus_is_clean(self):
+        violations, allowed, _ = lint_source(
+            host_sync, HOT_REL, read_fixture("host_sync_pass.py"))
+        assert violations == []
+        # the pragma'd sites are reported as allowed, with reasons
+        assert len(allowed) >= 2
+
+    def test_cold_modules_are_not_checked(self):
+        violations, _, _ = lint_source(
+            host_sync, "kubernetes_tpu/utils/fixture_case.py",
+            read_fixture("host_sync_flag.py"))
+        assert violations == []
+
+
+class TestKnobFixtures:
+    def test_flag_corpus(self):
+        violations, _, _ = lint_source(
+            knob_registry, ANY_REL, read_fixture("knob_flag.py"))
+        assert len(violations) == 4
+        assert {v.code for v in violations} == {"env-read"}
+
+    def test_pass_corpus_writes_and_accessors_legal(self):
+        violations, _, facts = lint_source(
+            knob_registry, ANY_REL, read_fixture("knob_pass.py"))
+        assert violations == []
+        # the accessor read is recorded as a fact for the global phase
+        assert ["KTPU_TRACE"] == [name for name, _, _ in
+                                  facts["knob_reads"]]
+
+    def test_registry_module_itself_exempt(self):
+        violations, _, _ = lint_source(
+            knob_registry, "kubernetes_tpu/utils/knobs.py",
+            read_fixture("knob_flag.py"))
+        assert violations == []
+
+
+class TestInertFixtures:
+    def test_flag_corpus(self):
+        violations, _, _ = lint_source(
+            decision_inert, INERT_REL, read_fixture("inert_flag.py"))
+        codes = [v.code for v in violations]
+        assert "inert-deny-import" in codes
+        assert codes.count("inert-mutation-call") == 2
+
+    def test_pass_corpus(self):
+        violations, _, _ = lint_source(
+            decision_inert, INERT_REL, read_fixture("inert_pass.py"))
+        assert violations == []
+
+    def test_relative_import_resolution(self):
+        src = "from ..scheduler import tpu_backend\n"
+        violations, _, _ = lint_source(decision_inert, INERT_REL, src)
+        assert [v.code for v in violations] == ["inert-deny-import"]
+
+    def test_non_inert_module_unchecked(self):
+        violations, _, _ = lint_source(
+            decision_inert, ANY_REL, read_fixture("inert_flag.py"))
+        assert violations == []
+
+
+class TestSeamFixtures:
+    def test_flag_corpus(self):
+        violations, _, _ = lint_source(
+            seam_pairing, ANY_REL, read_fixture("seam_flag.py"))
+        assert [v.code for v in violations] == ["seam-unpaired"]
+
+    def test_pass_corpus(self):
+        violations, _, _ = lint_source(
+            seam_pairing, ANY_REL, read_fixture("seam_pass.py"))
+        assert violations == []
+
+    def test_metrics_module_exempt(self):
+        violations, _, _ = lint_source(
+            seam_pairing, "kubernetes_tpu/scheduler/metrics.py",
+            read_fixture("seam_flag.py"))
+        assert violations == []
+
+
+class TestLockOrderFixtures:
+    def test_flag_corpus_cycle_detected(self):
+        _, _, facts = lint_source(
+            lock_order, ANY_REL, read_fixture("lock_flag.py"))
+        violations = lock_order.check_global("", {ANY_REL: facts})
+        assert [v.code for v in violations] == ["lock-cycle"]
+        assert "a_lock" in violations[0].message
+        assert "b_lock" in violations[0].message
+
+    def test_pass_corpus_acyclic_including_call_edge(self):
+        _, _, facts = lint_source(
+            lock_order, ANY_REL, read_fixture("lock_pass.py"))
+        # the helper-call edge IS tracked (a_lock -> b_lock via
+        # forward_via_call), but consistent order has no cycle
+        calls = facts["locks"]["forward_via_call"]["calls"]
+        assert any(c[0] == "_take_b" and "a_lock" in c[1] for c in calls)
+        assert lock_order.check_global("", {ANY_REL: facts}) == []
+
+
+# ---------------------------------------------------------------------------
+# framework: pragmas, keys, baseline, cache
+
+
+class TestPragmas:
+    def _pragmas(self, src):
+        return core.Pragmas(src, ast.parse(src))
+
+    def test_line_and_line_above(self):
+        src = ("x = 1  # ktpu: allow-sync(same line)\n"
+               "# ktpu: allow-knob(line above)\n"
+               "y = 2\n")
+        p = self._pragmas(src)
+        assert p.waiver("sync", 1) == "same line"
+        assert p.waiver("knob", 3) == "line above"
+        assert p.waiver("sync", 3) is None      # rule must match
+        assert p.waiver("knob", 1) is None
+
+    def test_function_span(self):
+        src = ("# ktpu: allow-sync(whole body)\n"
+               "def f(ys):\n"
+               "    a = 1\n"
+               "    return a\n"
+               "def g(ys):\n"
+               "    return 2\n")
+        p = self._pragmas(src)
+        assert p.waiver("sync", 3) == "whole body"
+        assert p.waiver("sync", 4) == "whole body"
+        assert p.waiver("sync", 6) is None      # next function not covered
+
+    def test_reason_required_by_grammar(self):
+        # a pragma without parens does not parse -> no waiver
+        src = "# ktpu: allow-sync\nx = 1\n"
+        assert self._pragmas(src).waiver("sync", 2) is None
+
+
+class TestBaselineKeys:
+    def test_keys_are_line_free_and_ordinal_stable(self):
+        mk = lambda line: core.Violation(  # noqa: E731
+            "host-sync", "kubernetes_tpu/ops/x.py", line, "f",
+            "item-call", "m")
+        keyed = core._assign_keys([mk(10), mk(90)])
+        assert [v.key for v in keyed] == [
+            "host-sync:kubernetes_tpu/ops/x.py:f:item-call:0",
+            "host-sync:kubernetes_tpu/ops/x.py:f:item-call:1",
+        ]
+        # shifting every line leaves the keys identical
+        shifted = core._assign_keys([mk(110), mk(190)])
+        assert [v.key for v in shifted] == [v.key for v in keyed]
+
+
+BAD_OPS = '''import jax.numpy as jnp
+
+def hot(ys):
+    return float(jnp.sum(ys))
+'''
+MINI_KNOBS = '''_REGISTRY = {}
+
+def _declare(name, kind, default, description):
+    _REGISTRY[name] = (kind, default, description)
+
+_declare("KTPU_X", "int", 1, "fixture knob")
+'''
+
+
+class TestBaselineRoundTrip:
+    @pytest.fixture
+    def mini_repo(self, tmp_path, monkeypatch):
+        (tmp_path / "kubernetes_tpu" / "ops").mkdir(parents=True)
+        (tmp_path / "kubernetes_tpu" / "utils").mkdir(parents=True)
+        (tmp_path / "kubernetes_tpu" / "ops" / "bad.py").write_text(BAD_OPS)
+        (tmp_path / "kubernetes_tpu" / "utils" / "knobs.py").write_text(
+            MINI_KNOBS)
+        (tmp_path / "README.md").write_text("knob table: KTPU_X\n")
+        monkeypatch.setattr(core, "BASELINE_PATH",
+                            str(tmp_path / "baseline.json"))
+        monkeypatch.setattr(core, "CACHE_PATH",
+                            str(tmp_path / "cache.json"))
+        return str(tmp_path)
+
+    def test_add_remove_round_trip(self, mini_repo):
+        report = core.run(mini_repo, use_cache=False)
+        assert not report.clean
+        keys = [v.key for v in report.violations]
+        assert keys, "mini repo must produce a violation"
+
+        # add: grandfather everything -> clean, counted as baselined
+        core.save_baseline({v.key: v.message for v in report.violations})
+        report2 = core.run(mini_repo, use_cache=False)
+        assert report2.clean
+        assert [v.key for v in report2.baselined] == keys
+        assert report2.stale_baseline == []
+
+        # remove: shrink the baseline -> the violation is live again
+        core.save_baseline({})
+        report3 = core.run(mini_repo, use_cache=False)
+        assert [v.key for v in report3.violations] == keys
+
+        # stale: an entry no live violation matches is surfaced
+        core.save_baseline({"host-sync:gone.py:f:item-call:0": "fixed"})
+        report4 = core.run(mini_repo, use_cache=False)
+        assert report4.stale_baseline == [
+            "host-sync:gone.py:f:item-call:0"]
+
+    def test_warm_cache_reuses_file_results(self, mini_repo):
+        first = core.run(mini_repo, use_cache=True)
+        assert first.files_from_cache == 0
+        second = core.run(mini_repo, use_cache=True)
+        assert second.files_from_cache == second.files_checked
+        assert [v.key for v in second.violations] == \
+            [v.key for v in first.violations]
+
+
+# ---------------------------------------------------------------------------
+# the repo itself
+
+
+class TestRepoClean:
+    def test_repo_clean(self):
+        """The tier-1 gate: zero non-baselined violations, repo-wide."""
+        report = core.run()
+        assert report.clean, (
+            "ktpu-lint violations (fix, pragma with a reason, or — for "
+            "pre-existing debt only — baseline):\n" + "\n".join(
+                f"  {v.path}:{v.line} [{v.checker}/{v.code}] {v.message}"
+                for v in report.violations))
+
+    def test_repo_warm_run_is_fast(self):
+        core.run()  # prime
+        t0 = time.monotonic()
+        report = core.run()
+        elapsed = time.monotonic() - t0
+        assert report.files_from_cache == report.files_checked
+        assert elapsed < 10.0, f"warm lint took {elapsed:.1f}s"
+
+    def test_baseline_only_shrinks(self):
+        """The committed baseline is empty; it may never grow again.
+
+        New exceptions must be annotated in place with
+        `# ktpu: allow-<rule>(<reason>)` — the baseline exists only to
+        grandfather pre-existing debt, and all of it has been triaged.
+        """
+        entries = core.load_baseline()
+        assert entries == {}, (
+            "analysis/baseline.json grew — annotate new exceptions with "
+            "pragmas instead of baselining them")
+
+    def test_no_stale_baseline_entries(self):
+        report = core.run()
+        assert report.stale_baseline == [], (
+            "baseline entries no longer match any violation; shrink with "
+            "scripts/lint.py --update-baseline")
+
+
+class TestConfigzCompleteness:
+    def test_every_declared_knob_on_configz(self):
+        """Runtime half of the knob-registry contract: the live /configz
+        snapshot exposes every declared knob with value+default+source."""
+        from kubernetes_tpu.utils import configz, knobs
+        snap = configz.snapshot()
+        assert "ktpu-env" in snap
+        view = snap["ktpu-env"]
+        for name in knobs.registry():
+            assert name in view, f"{name} missing from /configz"
+            assert {"value", "default", "source"} <= set(view[name])
+
+    def test_env_override_shows_as_env_source(self, monkeypatch):
+        from kubernetes_tpu.utils import configz, knobs
+        monkeypatch.setenv("KTPU_TRACE", "2")
+        view = configz.snapshot()["ktpu-env"]
+        assert view["KTPU_TRACE"]["value"] == "2"
+        assert view["KTPU_TRACE"]["source"] == "env"
+        assert knobs.get_int("KTPU_TRACE") == 2
+
+
+# ---------------------------------------------------------------------------
+# dynamic lock-order sentinel
+
+
+class TestLockSentinel:
+    def test_opposite_order_across_threads_is_a_cycle(self):
+        s = LockOrderSentinel()
+        s.install()
+        try:
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def ab():
+                with a:
+                    with b:
+                        pass
+
+            def ba():
+                with b:
+                    with a:
+                        pass
+
+            t1 = threading.Thread(target=ab)
+            t1.start()
+            t1.join()
+            t2 = threading.Thread(target=ba)
+            t2.start()
+            t2.join()
+        finally:
+            s.uninstall()
+        with pytest.raises(AssertionError, match="lock-order cycle"):
+            s.assert_cycle_free()
+
+    def test_consistent_order_passes(self):
+        with lock_order_sentinel() as s:
+            a = threading.Lock()
+            b = threading.RLock()
+            with a:
+                with b:
+                    pass
+            with a:
+                with b:
+                    pass
+        assert s.edges  # the a->b edge was observed, and no cycle raised
+
+    def test_release_out_of_lifo_order(self):
+        with lock_order_sentinel() as s:
+            a = threading.Lock()
+            b = threading.Lock()
+            a.acquire()
+            b.acquire()
+            a.release()   # not LIFO
+            b.release()
+        assert s._stack() == []
+
+    def test_condition_on_tracked_lock(self):
+        """Condition(tracked Lock) must stay correct: wait() releases
+        through the wrapper, so the held stack balances."""
+        with lock_order_sentinel() as s:
+            lock = threading.Lock()
+            cv = threading.Condition(lock)
+            ready = []
+
+            def waiter():
+                with cv:
+                    while not ready:
+                        cv.wait(timeout=5)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cv:
+                ready.append(1)
+                cv.notify()
+            t.join(timeout=5)
+            assert not t.is_alive()
+            # main thread's stack is balanced after the with-blocks
+            assert s._stack() == []
+
+    def test_untracked_locks_after_uninstall(self):
+        s = LockOrderSentinel()
+        s.install()
+        s.uninstall()
+        lock = threading.Lock()
+        with lock:
+            pass
+        assert s.edges == {}
