@@ -124,10 +124,16 @@ class AggregatedAPIServer:
     def update(self, resource, obj, subresource=""):
         return self._server_for(resource).update(resource, obj, subresource)
 
-    def update_status(self, resource, obj):
+    def update_status(self, resource, obj, fence=None):
+        if fence is not None:
+            return self._server_for(resource).update_status(
+                resource, obj, fence=fence)
         return self._server_for(resource).update_status(resource, obj)
 
-    def delete(self, resource, name, namespace=""):
+    def delete(self, resource, name, namespace="", fence=None):
+        if fence is not None:
+            return self._server_for(resource).delete(
+                resource, name, namespace, fence=fence)
         return self._server_for(resource).delete(resource, name, namespace)
 
     def remove_finalizer(self, resource, name, namespace, finalizer):
@@ -141,10 +147,15 @@ class AggregatedAPIServer:
     def watch(self, resource, namespace=None, since_revision=None):
         return self._server_for(resource).watch(resource, namespace, since_revision)
 
-    def bind_pod(self, namespace, pod_name, node_name):
+    def bind_pod(self, namespace, pod_name, node_name, fence=None):
+        if fence is not None:
+            return self.local.bind_pod(namespace, pod_name, node_name,
+                                       fence=fence)
         return self.local.bind_pod(namespace, pod_name, node_name)
 
-    def bind_pods(self, bindings):
+    def bind_pods(self, bindings, fence=None):
+        if fence is not None:
+            return self.local.bind_pods(bindings, fence=fence)
         return self.local.bind_pods(bindings)
 
     @property

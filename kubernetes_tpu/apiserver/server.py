@@ -55,6 +55,16 @@ class Invalid(APIError):
     code = 422
 
 
+class FenceExpired(APIError):
+    """A write carried a fencing token whose lease no longer matches the
+    stored leader lease (different holder or a newer leaseTransitions
+    epoch): the caller is a deposed leader and must demote, not retry.
+    Deliberately NOT a kv.Conflict subclass — guaranteed_update's
+    optimistic retry loop must not paper over a dead fence."""
+
+    code = 409
+
+
 @dataclass(frozen=True)
 class ResourceInfo:
     name: str  # plural, e.g. "pods"
@@ -395,7 +405,7 @@ class APIServer:
         return updated
 
     def delete(self, resource: str, name: str, namespace: str = "",
-               propagation_policy: Optional[str] = None) -> None:
+               propagation_policy: Optional[str] = None, fence=None) -> None:
         """Delete, honoring finalizers: an object with a non-empty
         metadata.finalizers list is soft-deleted (deletionTimestamp stamped,
         object kept) until the last finalizer is removed by its controller —
@@ -408,6 +418,7 @@ class APIServer:
         or "Orphan" (the GC strips ownerReferences from dependents)."""
         info = self._info(resource)
         key = self._key(info, namespace, name)
+        fence_check = self._fence_precondition(fence, "delete")
         # DELETE admission (validating webhooks guard deletions in the
         # reference dispatcher); the current object is what hooks see
         try:
@@ -439,7 +450,8 @@ class APIServer:
                 return nb
 
             try:
-                self.store.guaranteed_update(key, add_fin)
+                self.store.guaranteed_update(key, add_fin,
+                                             precondition=fence_check)
             except kv.KeyNotFound as e:
                 raise NotFound(str(e))
         # The finalizer check and the write are guarded by the same
@@ -461,10 +473,13 @@ class APIServer:
                     meta = dict(nb.get("metadata", {}))
                     meta["deletionTimestamp"] = time.time()
                     nb["metadata"] = meta
-                    self.store.update(key, nb, expected_mod_revision=kvv.mod_revision)
+                    self.store.update(key, nb,
+                                      expected_mod_revision=kvv.mod_revision,
+                                      precondition=fence_check)
                 else:
                     del_rev = self.store.delete(
-                        key, expected_mod_revision=kvv.mod_revision
+                        key, expected_mod_revision=kvv.mod_revision,
+                        precondition=fence_check
                     )
                     deleted = self._stamp(info, body, del_rev)
                     for hook in self._post_write:
@@ -550,9 +565,51 @@ class APIServer:
         raw = self.store.watch(self._prefix(info, namespace), since_revision)
         return TypedWatch(raw, info.type)
 
+    # -- fencing -----------------------------------------------------------
+
+    def _fence_precondition(self, fence, op: str):
+        """Store-level precondition for a fenced write: the stored leader
+        lease must still show the token's holder at the token's
+        leaseTransitions epoch (the monotonic fencing number — adoption
+        bumps it, so a deposed leader's token can never validate again).
+        Runs atomically with the commit under the store lock; the check is
+        deliberately clock-free — expiry is the elector's own job (it
+        self-fences a margin BEFORE the lease runs out), the server only
+        compares epochs. `fence` is duck-typed (lock_name, lock_namespace,
+        holder_identity, transitions) so the storage layer never imports
+        the client."""
+        if fence is None:
+            return None
+        lease_key = self._key(
+            self._info("leases"), fence.lock_namespace, fence.lock_name
+        )
+
+        def check():
+            try:
+                spec = self.store.get(lease_key).value.get("spec", {})
+            except kv.KeyNotFound:
+                spec = {}
+            if (
+                spec.get("holderIdentity", "") != fence.holder_identity
+                or spec.get("leaseTransitions", 0) != fence.transitions
+            ):
+                from ..scheduler import metrics
+
+                metrics.fencing_rejections.inc(op=op)
+                raise FenceExpired(
+                    f"{op}: fencing token for {fence.holder_identity!r} "
+                    f"(epoch {fence.transitions}) is stale — lease "
+                    f"{lease_key} now held by "
+                    f"{spec.get('holderIdentity', '')!r} "
+                    f"(epoch {spec.get('leaseTransitions', 0)})"
+                )
+
+        return check
+
     # -- subresources ------------------------------------------------------
 
-    def bind_pod(self, namespace: str, pod_name: str, node_name: str) -> None:
+    def bind_pod(self, namespace: str, pod_name: str, node_name: str,
+                 fence=None) -> None:
         """pods/{name}/binding: set spec.nodeName exactly once (reference:
         pkg/registry/core/pod/storage/storage.go BindingREST.Create —
         'pod X is already assigned to node Y' conflict)."""
@@ -571,12 +628,14 @@ class APIServer:
             return new_body
 
         try:
-            self.store.guaranteed_update(key, apply)
+            self.store.guaranteed_update(
+                key, apply, precondition=self._fence_precondition(fence, "bind")
+            )
         except kv.KeyNotFound as e:
             raise NotFound(str(e))
 
     def bind_pods(
-        self, bindings: List[Tuple[str, str, str]]
+        self, bindings: List[Tuple[str, str, str]], fence=None
     ) -> List[Optional[APIError]]:
         """Bulk binding application: N pods/{name}/binding writes in one
         call, per-binding outcomes (None = bound). Semantically identical
@@ -589,13 +648,13 @@ class APIServer:
         results: List[Optional[APIError]] = []
         for namespace, pod_name, node_name in bindings:
             try:
-                self.bind_pod(namespace, pod_name, node_name)
+                self.bind_pod(namespace, pod_name, node_name, fence=fence)
                 results.append(None)
             except APIError as e:
                 results.append(e)
         return results
 
-    def update_status(self, resource: str, obj: Any) -> Any:
+    def update_status(self, resource: str, obj: Any, fence=None) -> Any:
         """status subresource: replaces only .status (handlers for
         pods/status, nodes/status)."""
         info = self._info(resource)
@@ -619,7 +678,10 @@ class APIServer:
             return new_body
 
         try:
-            rev = self.store.guaranteed_update(key, apply)
+            rev = self.store.guaranteed_update(
+                key, apply,
+                precondition=self._fence_precondition(fence, "update_status"),
+            )
         except kv.KeyNotFound as e:
             raise NotFound(str(e))
         return self._stamp(info, final, rev)

@@ -44,11 +44,18 @@ class _ResourceClient:
     def update(self, obj: Any) -> Any:
         return self._api.update(self._resource, obj)
 
-    def update_status(self, obj: Any) -> Any:
+    def update_status(self, obj: Any, fence=None) -> Any:
+        if fence is not None:
+            return self._api.update_status(self._resource, obj, fence=fence)
         return self._api.update_status(self._resource, obj)
 
     def delete(self, name: str, namespace: str = "",
-               propagation_policy: Optional[str] = None) -> None:
+               propagation_policy: Optional[str] = None, fence=None) -> None:
+        if fence is not None:
+            self._api.delete(self._resource, name, namespace,
+                             propagation_policy=propagation_policy,
+                             fence=fence)
+            return
         self._api.delete(self._resource, name, namespace,
                          propagation_policy=propagation_policy)
 
@@ -64,12 +71,20 @@ class _ResourceClient:
 
 
 class _PodClient(_ResourceClient):
-    def bind(self, namespace: str, pod_name: str, node_name: str) -> None:
+    def bind(self, namespace: str, pod_name: str, node_name: str,
+             fence=None) -> None:
+        if fence is not None:
+            self._api.bind_pod(namespace, pod_name, node_name, fence=fence)
+            return
         self._api.bind_pod(namespace, pod_name, node_name)
 
-    def bind_many(self, bindings: List[Tuple[str, str, str]]):
+    def bind_many(self, bindings: List[Tuple[str, str, str]], fence=None):
         """Bulk bindings [(namespace, name, node)]; per-binding outcome
-        list (None = bound, APIError otherwise)."""
+        list (None = bound, APIError otherwise). `fence` (a leader-lease
+        fencing token) makes every write conditional on the lease still
+        naming the caller — see APIServer._fence_precondition."""
+        if fence is not None:
+            return self._api.bind_pods(bindings, fence=fence)
         return self._api.bind_pods(bindings)
 
 

@@ -34,6 +34,27 @@ class LeaderElectionConfig:
     # same beat all CAS the lease in the same instant and all but one
     # conflict, every cycle — jitter de-synchronizes the herd
     retry_jitter: float = 0.2
+    # seconds before lease EXPIRY (not renew_deadline) a leader stops
+    # trusting its own holdership: a GC-paused/partitioned instance whose
+    # renews stall must demote strictly before a peer's adoption window
+    # opens at lease_duration, or the two overlap for up to the clock
+    # skew between them. None resolves KTPU_LEASE_FENCE_MARGIN.
+    fence_margin: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class FencingToken:
+    """The identity + lease epoch a fenced write carries. Validity is
+    clock-free: the apiserver accepts the write iff the stored lease
+    still names `holder_identity` at `transitions` — adoption bumps
+    leaseTransitions, so a deposed leader's token can never validate
+    again no matter whose clock is wrong (the monotonic fencing number
+    from the Chubby/ZooKeeper lock literature)."""
+
+    lock_name: str
+    lock_namespace: str
+    holder_identity: str
+    transitions: int
 
 
 class LeaderElector:
@@ -53,6 +74,18 @@ class LeaderElector:
             raise ValueError("identity is required")
         self._leases = clientset.resource("leases")
         self.cfg = config
+        if config.fence_margin is None:
+            from ..utils import knobs
+
+            # the knob default assumes production lease durations; a
+            # short (test-scale) lease gets a proportional margin rather
+            # than a rejection — only an EXPLICIT margin can be invalid
+            config.fence_margin = min(
+                knobs.get_float("KTPU_LEASE_FENCE_MARGIN"),
+                config.lease_duration / 4.0,
+            )
+        if config.fence_margin >= config.lease_duration:
+            raise ValueError("fence_margin must be less than leaseDuration")
         self._on_started = on_started_leading
         self._on_stopped = on_stopped_leading
         self._now = now
@@ -61,6 +94,16 @@ class LeaderElector:
         self.is_leader = threading.Event()
         self._observed_renew_time: float = 0.0
         self._observed_holder: str = ""
+        # epoch + timestamp of OUR OWN last successful renew (local clock
+        # — the self-fence deadline must not trust the store's clock)
+        self._transitions: int = 0
+        self._last_renew: float = 0.0
+        # chaos hooks (testing/chaos.py): a partitioned elector cannot
+        # reach the store — renews fail, the token freezes, and the
+        # instance must self-fence on the margin like a real netsplit
+        self.partitioned = False
+        self._abdicated = threading.Event()
+        self._backoff_until: float = 0.0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -83,6 +126,9 @@ class LeaderElector:
         the lease record so the successor acquires on its next retry
         instead of waiting out the full lease_duration (graceful handoff;
         an actual crash still pays the expiry wait — that's failover)."""
+        if self.partitioned:
+            self.is_leader.clear()
+            return  # can't reach the store; the lease expires on its own
         try:
             lease = self._leases.get(self.cfg.lock_name, self.cfg.lock_namespace)
         except APIError:
@@ -113,7 +159,7 @@ class LeaderElector:
 
     def _acquire(self) -> bool:
         while not self._stop.is_set():
-            if self._try_acquire_or_renew():
+            if self._now() >= self._backoff_until and self._try_acquire_or_renew():
                 self.is_leader.set()
                 return True
             self._stop.wait(
@@ -124,20 +170,46 @@ class LeaderElector:
 
     def _renew_loop(self) -> None:
         while not self._stop.is_set():
-            deadline = self._now() + self.cfg.renew_deadline
+            if self._abdicated.is_set():
+                self._abdicated.clear()
+                self._release()
+                return
+            # the self-fence deadline: whichever comes FIRST of the renew
+            # deadline and `margin` seconds before our own lease would
+            # expire. Measured on the local clock from our own last
+            # successful renew — a partitioned or GC-paused instance whose
+            # renews stall demotes at lease_duration - margin, strictly
+            # before any peer's adoption window opens at lease_duration.
+            deadline = min(
+                self._now() + self.cfg.renew_deadline,
+                self._last_renew + self.cfg.lease_duration
+                - self.cfg.fence_margin,
+            )
             renewed = False
-            while self._now() < deadline and not self._stop.is_set():
+            while (self._now() < deadline and not self._stop.is_set()
+                   and not self._abdicated.is_set()):
                 if self._try_acquire_or_renew():
                     renewed = True
                     break
                 self._stop.wait(self.cfg.retry_period)
+            if self._abdicated.is_set():
+                self._abdicated.clear()
+                self._release()
+                return
             if not renewed:
-                return  # lost the lease
-            self._stop.wait(self.cfg.retry_period)
+                return  # lost the lease (or self-fenced on the margin)
+            # jittered gap between renews: N leaders across the fleet
+            # renewing on the same beat hammer the store in phase
+            self._stop.wait(
+                self.cfg.retry_period
+                * (1.0 + self.cfg.retry_jitter * random.random())
+            )
 
     # -- the CAS (leaderelection.go:317 tryAcquireOrRenew) -----------------
 
     def _try_acquire_or_renew(self) -> bool:
+        if self.partitioned:
+            return False  # netsplit: the store is unreachable from here
         now = self._now()
         try:
             lease = self._leases.get(self.cfg.lock_name, self.cfg.lock_namespace)
@@ -155,6 +227,8 @@ class LeaderElector:
             )
             try:
                 self._leases.create(lease)
+                self._transitions = 0
+                self._last_renew = now
                 return True
             except APIError:
                 return False
@@ -174,9 +248,35 @@ class LeaderElector:
         spec.renew_time = now
         try:
             self._leases.update(lease)  # resourceVersion-guarded CAS
+            self._transitions = spec.lease_transitions
+            self._last_renew = now
             return True
         except (Conflict, APIError):
             return False
+
+    # -- fencing / chaos hooks ---------------------------------------------
+
+    def fencing_token(self) -> Optional[FencingToken]:
+        """The token fenced writes carry while this instance leads; None
+        when not leading. Latched at promotion (the epoch can't change
+        while we hold the lease — adoption requires expiry first)."""
+        if not self.is_leader.is_set():
+            return None
+        return FencingToken(
+            lock_name=self.cfg.lock_name,
+            lock_namespace=self.cfg.lock_namespace,
+            holder_identity=self.cfg.identity,
+            transitions=self._transitions,
+        )
+
+    def abdicate(self, cooldown: float = 0.0) -> None:
+        """Drill hook: gracefully hand the lease off — vacate the record
+        (the successor adopts on its next retry, bumping the epoch) and
+        stay out of the race for `cooldown` seconds so a warm standby
+        wins deterministically. The renew loop notices within one
+        retry_period."""
+        self._backoff_until = self._now() + cooldown
+        self._abdicated.set()
 
     @property
     def leader_identity(self) -> str:

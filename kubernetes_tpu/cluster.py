@@ -78,6 +78,9 @@ class Cluster:
         node_config: Optional[Dict] = None,
         controller_opts: Optional[Dict] = None,
         fault_injector=None,
+        n_schedulers: int = 1,
+        leader_election: bool = False,
+        election_opts: Optional[Dict] = None,
     ):
         # save the process-global gate overrides so stop() can restore them
         # (gates must not leak across Cluster instances)
@@ -96,6 +99,9 @@ class Cluster:
                 node_config,
                 controller_opts,
                 fault_injector,
+                n_schedulers,
+                leader_election,
+                election_opts,
             )
         except BaseException:
             default_feature_gate.restore(self._fg_saved)
@@ -115,6 +121,9 @@ class Cluster:
         node_config,
         controller_opts,
         fault_injector=None,
+        n_schedulers=1,
+        leader_election=False,
+        election_opts=None,
     ) -> None:
         if feature_gates:
             default_feature_gate.set_from_string(feature_gates)
@@ -155,14 +164,32 @@ class Cluster:
                 self.proxiers.append(
                     Proxier(self.kcm.informers, node_name=kl.config.node_name)
                 )
-        self._sched_factory = SharedInformerFactory(self.client)
         self.scheduler_config = default_configuration()
         if scheduler_backend:
             for profile in self.scheduler_config.profiles:
                 profile.backend = scheduler_backend
-        self.scheduler = create_scheduler(
-            self.client, self._sched_factory, self.scheduler_config
-        )
+        # HA scheduling: n_schedulers instances, each with its OWN
+        # informer factory (independent watch streams — a partition or
+        # crash of one must not stall the others' relists), racing for
+        # one leader lease; only the holder pops pods, and every write
+        # it issues is fenced with the lease epoch
+        elect = leader_election or n_schedulers > 1
+        self._sched_factories: List[SharedInformerFactory] = []
+        self.schedulers: List = []
+        for i in range(max(1, n_schedulers)):
+            factory = SharedInformerFactory(self.client)
+            sched = create_scheduler(self.client, factory, self.scheduler_config)
+            if elect:
+                from .client.leaderelection import LeaderElectionConfig
+
+                cfg = LeaderElectionConfig(**(election_opts or {}))
+                sched.enable_leader_election(
+                    f"{sched.profile_name}-{i}", config=cfg
+                )
+            self._sched_factories.append(factory)
+            self.schedulers.append(sched)
+        self._sched_factory = self._sched_factories[0]
+        self.scheduler = self.schedulers[0]
         if fault_injector is not None:
             # fault drills (scripts/fault_drill.py, ChaosMonkey
             # wedge-device/crash-scheduler) arm device/worker faults here
@@ -181,10 +208,12 @@ class Cluster:
             if self.hollow is not None:
                 self.hollow.start()
             self.kcm.run()
-            self._sched_factory.start()
-            if not self._sched_factory.wait_for_cache_sync():
-                raise RuntimeError("scheduler informers failed to sync")
-            self.scheduler.start()
+            for factory in self._sched_factories:
+                factory.start()
+                if not factory.wait_for_cache_sync():
+                    raise RuntimeError("scheduler informers failed to sync")
+            for sched in self.schedulers:
+                sched.start()
             if self.metrics_server is not None:
                 self.metrics_server.run()
             self._fg_state = default_feature_gate.state()
@@ -210,8 +239,8 @@ class Cluster:
             # shutdown (vs stop) joins the pipeline worker threads and
             # flushes the completion FIFO deterministically — tests must
             # not lean on daemon-thread teardown
-            self.scheduler.shutdown,
-            self._sched_factory.stop,
+            *[s.shutdown for s in self.schedulers],
+            *[f.stop for f in self._sched_factories],
             self.kcm.stop,
             self.hollow.stop if self.hollow is not None else None,
         ):
@@ -242,6 +271,15 @@ class Cluster:
         self.stop()
 
     # -- conveniences -------------------------------------------------------
+
+    @property
+    def active_scheduler(self):
+        """The instance currently holding the leader lease (the only one
+        popping pods); the sole scheduler when election is off."""
+        for s in self.schedulers:
+            if s.elector is not None and s.elector.is_leader.is_set():
+                return s
+        return self.scheduler
 
     def kubectl(self, *argv: str) -> str:
         """Run a kubectl command; returns its output (raises on rc != 0)."""

@@ -36,11 +36,24 @@ UNSCHEDULABLE_Q_TIME_INTERVAL = 60.0  # scheduling_queue.go:60
 
 
 def default_less(a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
-    """PrioritySort.Less (plugins/queuesort/priority_sort.go:45)."""
+    """PrioritySort.Less (plugins/queuesort/priority_sort.go:45).
+
+    Equal priority ties break on pod CREATION time, then queue-entry
+    time. The reference's QueuedPodInfo.Timestamp survives requeues, so
+    its order is first-seen; ours is rebuilt per add, and an informer's
+    initial list delivers in store-key (lexicographic) order — without
+    the creation tie-break a cold-restarted scheduler would pop the
+    same backlog in a different order than the instance that watched
+    the pods arrive, and restart-reconcile parity (bit-identical
+    assignments) breaks."""
     pa = a.pod.spec.priority or 0
     pb = b.pod.spec.priority or 0
     if pa != pb:
         return pa > pb
+    ca = a.pod.metadata.creation_timestamp or a.timestamp
+    cb = b.pod.metadata.creation_timestamp or b.timestamp
+    if ca != cb:
+        return ca < cb
     return a.timestamp < b.timestamp
 
 

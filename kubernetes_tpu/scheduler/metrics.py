@@ -340,6 +340,49 @@ trace_dumps = legacy_registry.register(
         ("seam",),
     )
 )
+fencing_rejections = legacy_registry.register(
+    Counter(
+        "scheduler_fencing_rejections_total",
+        "State-changing writes the apiserver rejected because their "
+        "lease fencing token was stale (different holder or an older "
+        "leaseTransitions epoch than the stored leader lease), by "
+        "op=bind|update_status|delete. Nonzero means a deposed leader "
+        "tried to write after failover and the fence held — the "
+        "split-brain double-bind that write would have been never "
+        "reached the store. The healthy-path count is ZERO: the "
+        "elector self-fences KTPU_LEASE_FENCE_MARGIN seconds before "
+        "its lease expires, so only clock skew, a GC pause outliving "
+        "the margin, or a drill's deliberate stale replay lands here.",
+        ("op",),
+    )
+)
+restart_reconcile = legacy_registry.register(
+    Counter(
+        "scheduler_restart_reconcile_total",
+        "Pods processed by the cold-restart/promotion reconcile "
+        "(authoritative store relist), by outcome: outcome=adopted "
+        "(already bound — folded into the SchedulerCache as its node's "
+        "tenant), outcome=requeued (unbound in-flight pod re-entered "
+        "the active queue, exactly once — dedup against the queue and "
+        "the drained-FIFO set), outcome=cleared (stale "
+        "nominated_node_name from a preemption that never completed "
+        "wiped so the slot isn't double-reserved).",
+        ("outcome",),
+    )
+)
+leader_transitions = legacy_registry.register(
+    Counter(
+        "scheduler_leader_transitions_total",
+        "Times THIS scheduler instance was promoted to leader "
+        "(lease acquired or adopted). Summed across instances it "
+        "counts failovers + initial elections; a climb with no chaos "
+        "running means the lease is flapping (fence margin too tight "
+        "for the renew cadence, or the store is slow).",
+        (),
+    )
+)
+
+
 def dump_seam(seam: str, **attrs) -> None:
     """Flight-recorder dump + scheduler_trace_dumps_total bump, PAIRED.
     Every fault seam goes through here so the counter and the dump can

@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Tuple
 import time as _time
 
 from ..api import types as v1
-from ..apiserver.server import APIError
+from ..apiserver.server import APIError, FenceExpired
 from ..client.clientset import Clientset
 from ..client.events import EventRecorder
 from ..client.informer import EventHandler, SharedInformerFactory, meta_namespace_key
@@ -224,6 +224,22 @@ class Scheduler:
         self.faults = None
         self.drain_timeout = knobs.get_float(
             "KTPU_DRAIN_TIMEOUT", default=None)
+        # leader election / fencing (enable_leader_election): every
+        # state-changing write carries self._fence; the apiserver
+        # rejects a token whose lease epoch has moved on. The token is
+        # LATCHED — demotion deliberately leaves the stale token in
+        # place so straggler binder-thread writes are rejected server-
+        # side instead of going out unfenced; only the next promotion
+        # replaces it.
+        self.elector = None
+        self._fence = None
+        # requeue-exactly-once across the demote -> promote round trip:
+        # pod key -> metadata.generation of every pod the demotion
+        # drain sent back to the queue; the next reconcile_from_store
+        # consults (then clears) it so the relist cannot requeue the
+        # same generation a second time
+        self._drain_requeued: Dict[str, int] = {}
+        self._reconcile_lock = threading.Lock()
         self._binders = ThreadPoolExecutor(max_workers=8, thread_name_prefix="binder")
         self._inflight = 0  # scheduling batches + binds not yet finished
         self._inflight_lock = threading.Lock()
@@ -415,6 +431,14 @@ class Scheduler:
                 else:
                     self.cache.add_pod(new)
                 self.nominator.delete_nominated_pod_if_exists(new)
+                # a pod can BECOME assigned while a queue entry for it
+                # exists (another scheduler instance bound it, or a
+                # relist refresh after restart delivers the bound state
+                # as an update) — retire the entry and any preemption
+                # tracking exactly as the add path does, or the ghost
+                # entry 409s on every future bind attempt
+                self.queue.delete(new)
+                self._clear_preempt_tracking(new)
             elif self._schedulable(new):
                 self.nominator.update_nominated_pod(old, new)
                 self.queue.update(old, new)
@@ -495,6 +519,167 @@ class Scheduler:
         pv_inf.add_event_handler(bump_for("pv"))
         csi_inf.add_event_handler(bump_for("csinode"))
 
+    # -- leader election / split-brain-safe failover -----------------------
+
+    def enable_leader_election(self, identity: str, config=None) -> None:
+        """Arm lease-based leader election (call before start()): the
+        instance then starts PAUSED and only pops pods while it holds
+        the leader lease. Every state-changing write — binds,
+        nominatedNodeName patches, victim deletes — carries the lease
+        fencing token, and the apiserver rejects a deposed epoch's
+        writes with FenceExpired; on fence loss the instance demotes
+        (pause, abandon the device FIFO, flush completions) and rejoins
+        the election."""
+        from ..client.leaderelection import LeaderElectionConfig, LeaderElector
+
+        if config is None:
+            config = LeaderElectionConfig(identity=identity)
+        elif not config.identity:
+            config.identity = identity
+        self.elector = LeaderElector(
+            self.client,
+            config,
+            on_started_leading=self._on_started_leading,
+            on_stopped_leading=self._on_stopped_leading,
+        )
+
+    def _on_started_leading(self) -> None:
+        """Promotion (elector thread): latch the fencing token FIRST —
+        every write from here on carries the new epoch — then reconcile
+        the authoritative store into the caches, then open the pop
+        gate. Order matters: reconcile-before-resume is what makes a
+        restarted leader's decisions bit-identical to a never-crashed
+        one's on the surviving pod set."""
+        self._fence = self.elector.fencing_token()
+        metrics.leader_transitions.inc()
+        logger.info(
+            "%s promoted to leader (epoch %s)",
+            self.profile_name, getattr(self._fence, "transitions", None),
+        )
+        self._health_event(
+            "Normal", "LeaderElected",
+            f"{self.profile_name} acquired the scheduler lease",
+        )
+        try:
+            self.reconcile_from_store()
+        except Exception:  # noqa: BLE001 — the informer relist is the
+            # backstop for anything a failed reconcile missed
+            traceback.print_exc()
+        self.resume()
+
+    def _on_stopped_leading(self) -> None:
+        """Demotion (fence loss, abdication, or stop): close the pop
+        gate, abandon not-yet-harvested device batches and flush the
+        completion FIFO (abandoned batches resolve RETRY_NODE and
+        requeue), and record what the drain requeued so the NEXT
+        promotion's reconcile can't requeue the same generation twice.
+        The stale fencing token is deliberately NOT cleared: straggler
+        writes still in binder threads must be rejected server-side,
+        not escape unfenced."""
+        self.pause()
+        with self._completion_cv:
+            fifo_pods = [
+                info.pod for item in self._completions for info in item[0]
+            ]
+        # the completion worker is STILL RUNNING here (demotion is not
+        # teardown) — it owns the FIFO, so flush through it: abandon the
+        # un-harvested device batches (their results resolve RETRY_NODE)
+        # and wait for the worker to land everything. Popping the FIFO
+        # from this thread (_recover_completions) would race the worker.
+        try:
+            if self.tpu is not None:
+                self.tpu.abandon_pending()
+            self._drain_pipeline()
+        except Exception:  # noqa: BLE001 — demotion must complete
+            traceback.print_exc()
+        pending = {v1.pod_key(p) for p in self.queue.pending_pods()}
+        for pod in fifo_pods:
+            key = v1.pod_key(pod)
+            if key in pending:
+                self._drain_requeued[key] = pod.metadata.generation or 0
+        logger.info("%s demoted: lease lost or released", self.profile_name)
+
+    def reconcile_from_store(self) -> Dict[str, int]:
+        """Cold-restart / promotion reconciliation: relist pods from the
+        authoritative store and repair this instance's view so a
+        restarted (or newly promoted) scheduler treats the surviving pod
+        set exactly as a never-crashed one would.
+
+        - adopted: already-bound pods the cache doesn't know (a prior
+          leader's binds that landed while this instance was down);
+        - cleared: stale nominatedNodeName on unbound pods with no
+          preemption in flight HERE — the old leader died mid-
+          preemption and nobody is freeing that capacity anymore;
+        - requeued: unbound, undeleted, unassumed pods entered into the
+          queue exactly once (deduped by pod key + generation against
+          both the live queue and the demotion drain's requeues).
+        """
+        with self._reconcile_lock:
+            counts = {"adopted": 0, "requeued": 0, "cleared": 0}
+            try:
+                pods, _ = self.client.pods.list()
+            except APIError:
+                traceback.print_exc()
+                return counts
+            queued = {v1.pod_key(p) for p in self.queue.pending_pods()}
+            # the store lists by key (lexicographic); requeue must
+            # replay CREATION order or the restarted queue pops pod-2
+            # after pod-19 and the batch placements diverge from the
+            # never-crashed run's (restart parity is bit-identical
+            # assignments, not just all-bound)
+            pods.sort(key=lambda p: (
+                p.metadata.creation_timestamp or 0.0,
+                int(p.metadata.resource_version or 0),
+            ))
+            for pod in pods:
+                key = v1.pod_key(pod)
+                if pod.spec.node_name:
+                    if not self.cache.has_pod(key):
+                        self.cache.add_pod(pod)
+                        counts["adopted"] += 1
+                    continue
+                if pod.metadata.deletion_timestamp is not None:
+                    continue
+                if (pod.status.nominated_node_name
+                        and not self._preemption_in_flight(pod)):
+                    self._reconcile_clear_nomination(pod)
+                    counts["cleared"] += 1
+                gen = pod.metadata.generation or 0
+                if key in queued or self._drain_requeued.get(key) == gen:
+                    continue  # already pending exactly once
+                if self.cache.is_assumed_pod(pod):
+                    continue  # an in-flight bind of ours owns it
+                self.queue.add(pod)
+                counts["requeued"] += 1
+            self._drain_requeued.clear()
+            for outcome, n in counts.items():
+                if n:
+                    metrics.restart_reconcile.inc(n, outcome=outcome)
+            logger.info(
+                "%s reconciled from store: %d adopted, %d requeued, "
+                "%d nominations cleared", self.profile_name,
+                counts["adopted"], counts["requeued"], counts["cleared"],
+            )
+            return counts
+
+    def _reconcile_clear_nomination(self, pod: v1.Pod) -> None:
+        """A relisted unbound pod carries a nomination from a preemption
+        this instance never started: the victims are gone or will never
+        be deleted — either way the nomination is a lie. Clear it in
+        the nominator, the API object, and the local copy headed for
+        the queue (synchronous, unlike _clear_nomination's binder-pool
+        path: reconcile must finish before the pop gate opens)."""
+        self.nominator.delete_nominated_pod_if_exists(pod)
+        try:
+            fresh = self.client.pods.get(
+                pod.metadata.name, pod.metadata.namespace
+            )
+            fresh.status.nominated_node_name = ""
+            self.client.pods.update_status(fresh, fence=self._fence)
+        except APIError:
+            pass
+        pod.status.nominated_node_name = ""
+
     # -- run loop ----------------------------------------------------------
 
     def install_fault_injector(self, inj) -> None:
@@ -544,6 +729,11 @@ class Scheduler:
 
     def start(self) -> None:
         if self._thread is None:
+            if self.elector is not None:
+                # standby until elected: the loop runs but the pop gate
+                # stays closed — _on_started_leading opens it
+                self.pause()
+                self.elector.start()
             self._thread = threading.Thread(
                 target=self._supervised, args=("scheduler", self._run),
                 name="scheduler-loop", daemon=True,
@@ -570,6 +760,14 @@ class Scheduler:
         test suites' no-leaked-threads contract (daemon-flag teardown is
         the fallback, not the plan)."""
         ok = True
+        if self.elector is not None:
+            # vacate the lease FIRST so a standby takes over on its next
+            # retry instead of waiting out expiry; on_stopped_leading
+            # (pause + FIFO drain) is harmless ahead of full teardown
+            try:
+                self.elector.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                traceback.print_exc()
         self._stop.set()
         self._permit_wake.set()  # let the permit drainer exit
         self.queue.close()
@@ -655,6 +853,13 @@ class Scheduler:
         if info is None:
             if self.backend == "tpu":
                 self._drain_pipeline()  # idle: land the tail batches
+            return False
+        if self._paused.is_set():
+            # pause() landed while this thread was already blocked in
+            # pop: hand the pod back instead of scheduling past the
+            # pause — a demoted leader must not pop work its successor
+            # now owns
+            self.queue.add(info.pod)
             return False
         info.pop_timestamp = _time.monotonic()
         with self._inflight_lock:
@@ -848,9 +1053,12 @@ class Scheduler:
                 traceback.print_exc()
             finally:
                 # remove AFTER completing: an empty deque means every
-                # dispatched batch has fully landed (_drain_pipeline)
+                # dispatched batch has fully landed (_drain_pipeline).
+                # Guarded: a teardown-time _recover_completions flush may
+                # have raced this item out already.
                 with self._completion_cv:
-                    self._completions.popleft()
+                    if self._completions and self._completions[0] is item:
+                        self._completions.popleft()
                     self._completion_cv.notify_all()
 
     def _recover_completions(self) -> None:
@@ -904,15 +1112,38 @@ class Scheduler:
             # retry storm before resolving
             timeout = max(30.0, 3.0 * watchdog)
         deadline = _time.monotonic() + timeout
-        with self._completion_cv:
-            while self._completions:
-                wait = min(0.2, deadline - _time.monotonic())
-                if wait <= 0:
-                    stuck = len(self._completions)
-                    break
-                self._completion_cv.wait(wait)
-            else:
-                return True
+        while True:
+            with self._completion_cv:
+                if not self._completions:
+                    return True
+                # orphaned-batch seam: the dispatching thread can append
+                # a batch AFTER the worker saw (empty deque, _stop set)
+                # and exited — the enqueue path only spawns a worker
+                # when the thread slot is None, so nothing would ever
+                # land it. The worker is dead, so the FIFO has no other
+                # owner: land it from here.
+                worker = self._completion_thread
+                orphan = (
+                    self._stop.is_set()
+                    and (worker is None or not worker.is_alive())
+                )
+                item = self._completions[0] if orphan else None
+                if item is None:
+                    wait = min(0.2, deadline - _time.monotonic())
+                    if wait <= 0:
+                        stuck = len(self._completions)
+                        break
+                    self._completion_cv.wait(wait)
+                    continue
+            try:
+                self._complete_batch(*item)
+            except Exception:  # noqa: BLE001 — keep flushing the FIFO
+                traceback.print_exc()
+            finally:
+                with self._completion_cv:
+                    if self._completions and self._completions[0] is item:
+                        self._completions.popleft()
+                    self._completion_cv.notify_all()
         tracing.event("pipeline-stalled", "fault", stuck=stuck,
                       timeout=timeout)
         metrics.dump_seam("pipeline-stalled", stuck=stuck)
@@ -1414,7 +1645,8 @@ class Scheduler:
                 for victim in cand.victims:
                     try:
                         self.client.pods.delete(
-                            victim.metadata.name, victim.metadata.namespace
+                            victim.metadata.name, victim.metadata.namespace,
+                            fence=self._fence,
                         )
                     except NotFound:
                         # already gone — but ONLY resolve the wave here
@@ -1442,7 +1674,7 @@ class Scheduler:
                         info.pod.metadata.name, info.pod.metadata.namespace
                     )
                     fresh.status.nominated_node_name = cand.node_name
-                    self.client.pods.update_status(fresh)
+                    self.client.pods.update_status(fresh, fence=self._fence)
                 except APIError:
                     pass
 
@@ -1469,7 +1701,7 @@ class Scheduler:
                         pod.metadata.name, pod.metadata.namespace
                     )
                     fresh.status.nominated_node_name = ""
-                    self.client.pods.update_status(fresh)
+                    self.client.pods.update_status(fresh, fence=self._fence)
                 except APIError:
                     pass
             with self._inflight_lock:
@@ -1786,13 +2018,21 @@ class Scheduler:
                 return
             outcomes = self.client.pods.bind_many(
                 [(a.metadata.namespace, a.metadata.name, node)
-                 for a, node, _, _ in ready]
+                 for a, node, _, _ in ready],
+                fence=self._fence,
             )
             now = _time.monotonic()
             done: List[Tuple] = []
             for (assumed, node, state, info), err in zip(ready, outcomes):
                 unsettled.pop(id(assumed), None)
-                if err is not None:
+                if isinstance(err, FenceExpired):
+                    # our lease epoch is dead: the new leader owns this
+                    # pod now. Forget the assumed state but do NOT
+                    # requeue — requeuing here is how a deposed leader
+                    # double-schedules (the successor's reconcile has
+                    # already relisted it).
+                    self.cache.forget_pod(assumed)
+                elif err is not None:
                     self._retry_failed_bind(assumed)
                 else:
                     done.append((assumed, node, state, info))
@@ -1817,6 +2057,11 @@ class Scheduler:
                         fwk.run_post_bind_plugins(state, assumed, node)
                 except Exception:  # noqa: BLE001
                     traceback.print_exc()
+        except FenceExpired:
+            # whole-call fence rejection (a frontend that raises instead
+            # of collecting per-binding outcomes): forget, never requeue
+            for assumed in unsettled.values():
+                self.cache.forget_pod(assumed)
         except Exception:
             traceback.print_exc()
             for assumed in unsettled.values():
@@ -1934,13 +2179,14 @@ class Scheduler:
         try:
             fresh = self.client.pods.get(pod.metadata.name, pod.metadata.namespace)
             fresh.status.nominated_node_name = node_name
-            self.client.pods.update_status(fresh)
+            self.client.pods.update_status(fresh, fence=self._fence)
         except APIError:
             pass
         for victim in result.victims:
             try:
                 self.client.pods.delete(
-                    victim.metadata.name, victim.metadata.namespace
+                    victim.metadata.name, victim.metadata.namespace,
+                    fence=self._fence,
                 )
             except APIError:
                 pass
@@ -2002,7 +2248,8 @@ class Scheduler:
                     self._abort_binding(assumed, f"PreBind: {st.message()}")
                     return
             self.client.pods.bind(
-                assumed.metadata.namespace, assumed.metadata.name, node_name
+                assumed.metadata.namespace, assumed.metadata.name, node_name,
+                fence=self._fence,
             )
             self.cache.finish_binding(assumed)
             metrics.schedule_attempts.inc(
@@ -2016,6 +2263,12 @@ class Scheduler:
             )
             if self.framework is not None:
                 self.framework.run_post_bind_plugins(state, assumed, node_name)
+        except FenceExpired:
+            # deposed mid-bind: forget the assumed pod, do NOT requeue —
+            # the successor relisted it at promotion (before FenceExpired
+            # — a subclass of APIError — the clause below would have
+            # requeued it into a double-schedule)
+            self.cache.forget_pod(assumed)
         except APIError:
             self._retry_failed_bind(assumed)
         except Exception:

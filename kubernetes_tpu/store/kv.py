@@ -116,6 +116,11 @@ class Watch:
 
 
 class KVStore:
+    #: conditional writes accept a `precondition` callable (checked
+    #: atomically under the store lock) — the capability the fencing
+    #: layer probes before trusting guaranteed_update to be race-free
+    supports_precondition = True
+
     def __init__(self, history_limit: int = 100_000):
         self._lock = threading.RLock()
         self._data: Dict[str, KeyValue] = {}
@@ -172,7 +177,13 @@ class KVStore:
             self._emit(Event(ADDED, key, value, self._rev))
             return self._rev
 
-    def update(self, key: str, value: Any, expected_mod_revision: Optional[int] = None) -> int:
+    def update(
+        self,
+        key: str,
+        value: Any,
+        expected_mod_revision: Optional[int] = None,
+        precondition=None,
+    ) -> int:
         with self._lock:
             kv = self._data.get(key)
             if kv is None:
@@ -181,12 +192,22 @@ class KVStore:
                 raise Conflict(
                     f"{key}: mod_revision {kv.mod_revision} != expected {expected_mod_revision}"
                 )
+            if precondition is not None:
+                # under the store RLock (re-entrant: the callable may read
+                # OTHER keys — the fencing check reads the leader lease) so
+                # check + commit are one atomic step
+                precondition()
             self._rev += 1
             self._data[key] = KeyValue(key, value, kv.create_revision, self._rev)
             self._emit(Event(MODIFIED, key, value, self._rev))
             return self._rev
 
-    def delete(self, key: str, expected_mod_revision: Optional[int] = None) -> int:
+    def delete(
+        self,
+        key: str,
+        expected_mod_revision: Optional[int] = None,
+        precondition=None,
+    ) -> int:
         with self._lock:
             kv = self._data.get(key)
             if kv is None:
@@ -195,6 +216,8 @@ class KVStore:
                 raise Conflict(
                     f"{key}: mod_revision {kv.mod_revision} != expected {expected_mod_revision}"
                 )
+            if precondition is not None:
+                precondition()
             self._rev += 1
             del self._data[key]
             i = bisect.bisect_left(self._keys, key)
@@ -202,8 +225,9 @@ class KVStore:
             self._emit(Event(DELETED, key, kv.value, self._rev))
             return self._rev
 
-    def guaranteed_update(self, key: str, fn, max_retries: int = 16) -> int:
-        return guaranteed_update(self, key, fn, max_retries)
+    def guaranteed_update(self, key: str, fn, max_retries: int = 16,
+                          precondition=None) -> int:
+        return guaranteed_update(self, key, fn, max_retries, precondition)
 
     # -- watch -------------------------------------------------------------
 
@@ -276,6 +300,8 @@ class DurableKVStore:
     fsync=False trades the unsynced tail for write latency, exactly the
     etcd `--unsafe-no-fsync` posture.
     """
+
+    supports_precondition = True
 
     def __init__(
         self,
@@ -394,22 +420,36 @@ class DurableKVStore:
             self._log(wal.OP_CREATE, key, value, rev)
             return rev
 
-    def update(self, key: str, value: Any, expected_mod_revision: Optional[int] = None) -> int:
+    def update(
+        self,
+        key: str,
+        value: Any,
+        expected_mod_revision: Optional[int] = None,
+        precondition=None,
+    ) -> int:
         with self._dlock:
-            rev = self._inner.update(key, value, expected_mod_revision)
+            rev = self._inner.update(key, value, expected_mod_revision,
+                                     precondition=precondition)
             self._log(wal.OP_UPDATE, key, value, rev)
             return rev
 
-    def delete(self, key: str, expected_mod_revision: Optional[int] = None) -> int:
+    def delete(
+        self,
+        key: str,
+        expected_mod_revision: Optional[int] = None,
+        precondition=None,
+    ) -> int:
         with self._dlock:
             # the DELETED event (and its WAL record) carries the last value
             prev = self._inner.get(key)
-            rev = self._inner.delete(key, expected_mod_revision)
+            rev = self._inner.delete(key, expected_mod_revision,
+                                     precondition=precondition)
             self._log(wal.OP_DELETE, key, prev.value, rev)
             return rev
 
-    def guaranteed_update(self, key: str, fn, max_retries: int = 16) -> int:
-        return guaranteed_update(self, key, fn, max_retries)
+    def guaranteed_update(self, key: str, fn, max_retries: int = 16,
+                          precondition=None) -> int:
+        return guaranteed_update(self, key, fn, max_retries, precondition)
 
     def compact(self, revision: int) -> None:
         with self._dlock:
@@ -482,14 +522,36 @@ class DurableKVStore:
             w.stop()
 
 
-def guaranteed_update(store, key: str, fn, max_retries: int = 16) -> int:
+def guaranteed_update(store, key: str, fn, max_retries: int = 16,
+                      precondition=None) -> int:
     """Read-modify-write with conflict retry (etcd3 store.go:286
     GuaranteedUpdate's optimistic loop). fn(value) -> new value. Shared by
-    every store backend so retry semantics can't diverge."""
+    every store backend so retry semantics can't diverge.
+
+    `precondition` (zero-arg, raises to veto) is evaluated atomically with
+    the commit on stores that support it (`supports_precondition`); on
+    plain dict-backed stores it degrades to check-then-write — adequate
+    for the fencing layer because a stale fence can only get MORE stale.
+    """
+    if precondition is not None and not getattr(
+            store, "supports_precondition", False):
+        for _ in range(max_retries):
+            kv = store.get(key)
+            new_value = fn(kv.value)
+            precondition()
+            try:
+                return store.update(key, new_value, expected_mod_revision=kv.mod_revision)
+            except Conflict:
+                continue
+        raise Conflict(f"{key}: too many conflicts in guaranteed_update")
     for _ in range(max_retries):
         kv = store.get(key)
         new_value = fn(kv.value)
         try:
+            if precondition is not None:
+                return store.update(key, new_value,
+                                    expected_mod_revision=kv.mod_revision,
+                                    precondition=precondition)
             return store.update(key, new_value, expected_mod_revision=kv.mod_revision)
         except Conflict:
             continue

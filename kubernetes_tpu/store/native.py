@@ -166,6 +166,12 @@ class NativeWatch:
 class NativeKVStore:
     """Drop-in KVStore over the C++ library (same API surface)."""
 
+    #: the C side cannot evaluate a Python precondition inside its write
+    #: lock; callers get check-then-write (see kv.guaranteed_update) —
+    #: adequate for fencing (a stale fence only gets MORE stale) but not
+    #: atomic, so the capability flag stays honest
+    supports_precondition = False
+
     def __init__(self, history_limit: int = 100_000):
         self._lib = load_library()
         self._h = self._lib.kv_new(history_limit)
@@ -231,8 +237,11 @@ class NativeKVStore:
         return rev
 
     def update(
-        self, key: str, value: Any, expected_mod_revision: Optional[int] = None
+        self, key: str, value: Any, expected_mod_revision: Optional[int] = None,
+        precondition=None,
     ) -> int:
+        if precondition is not None:
+            precondition()
         data = json.dumps(value).encode()
         expected = -1 if expected_mod_revision is None else expected_mod_revision
         rev = self._lib.kv_update(self._h, key.encode(), data, len(data), expected)
@@ -244,7 +253,10 @@ class NativeKVStore:
             )
         return rev
 
-    def delete(self, key: str, expected_mod_revision: Optional[int] = None) -> int:
+    def delete(self, key: str, expected_mod_revision: Optional[int] = None,
+               precondition=None) -> int:
+        if precondition is not None:
+            precondition()
         expected = -1 if expected_mod_revision is None else expected_mod_revision
         rev = self._lib.kv_delete(self._h, key.encode(), expected)
         if rev == -1:
@@ -255,10 +267,11 @@ class NativeKVStore:
             )
         return rev
 
-    def guaranteed_update(self, key: str, fn, max_retries: int = 16) -> int:
+    def guaranteed_update(self, key: str, fn, max_retries: int = 16,
+                          precondition=None) -> int:
         from .kv import guaranteed_update
 
-        return guaranteed_update(self, key, fn, max_retries)
+        return guaranteed_update(self, key, fn, max_retries, precondition)
 
     def compact(self, revision: int) -> None:
         """Drop history up to revision (etcd compaction)."""

@@ -44,6 +44,15 @@ CRASH_KINDS = ("crash-apiserver", "crash-controller")
 #: clusters without a TPU-backed scheduler.
 FAULT_KINDS = ("wedge-device", "crash-scheduler", "overload")
 
+#: the scheduler-failover kinds (opt-in): `partition-scheduler` cuts the
+#: current leader off from the store — its lease renews fail, the
+#: self-fence margin demotes it, and a standby adopts the lease while
+#: the zombie's straggler writes bounce off the fencing precondition;
+#: `failover-scheduler` is the graceful form — the leader abdicates
+#: (vacates the lease + cools down) so a warm standby wins
+#: deterministically. Both no-op on clusters without leader election.
+FAILOVER_KINDS = ("partition-scheduler", "failover-scheduler")
+
 
 class ChaosMonkey:
     def __init__(
@@ -60,6 +69,7 @@ class ChaosMonkey:
         self.history: List[Disruption] = []
         self._dead: List = []  # kubelets killed and not yet restarted
         self._crashed_controllers: List[str] = []  # awaiting supervisor
+        self._partitioned: List = []  # electors cut off from the store
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -92,6 +102,8 @@ class ChaosMonkey:
             "wedge-device": self._wedge_device,
             "crash-scheduler": self._crash_scheduler,
             "overload": self._overload,
+            "partition-scheduler": self._partition_scheduler,
+            "failover-scheduler": self._failover_scheduler,
         }[kind]
         d = fn()
         if d is not None:
@@ -238,6 +250,56 @@ class ChaosMonkey:
                 pass
         return Disruption("overload", f"event-burst:{burst}")
 
+    def _electing_schedulers(self) -> List:
+        """Every scheduler instance with leader election armed; supports
+        both the multi-scheduler cluster (`.schedulers`) and a bare
+        single-scheduler one."""
+        scheds = getattr(self.cluster, "schedulers", None)
+        if not scheds:
+            sole = getattr(self.cluster, "scheduler", None)
+            scheds = [sole] if sole is not None else []
+        return [s for s in scheds if getattr(s, "elector", None) is not None]
+
+    def _leader(self):
+        for s in self._electing_schedulers():
+            if s.elector.is_leader.is_set():
+                return s
+        return None
+
+    def _partition_scheduler(self) -> Optional[Disruption]:
+        """Netsplit the current leader from the store: heal any previous
+        partition first (both instances partitioned means nobody can
+        lead), then cut the leader off — its renews fail, the self-fence
+        margin demotes it strictly before a standby's adoption window
+        opens, and any straggler write it still has in flight carries a
+        dead epoch the apiserver rejects (FenceExpired). No-op without
+        at least two electing schedulers."""
+        if len(self._electing_schedulers()) < 2:
+            return None
+        while self._partitioned:
+            self._partitioned.pop().partitioned = False
+        leader = self._leader()
+        if leader is None:
+            return None
+        leader.elector.partitioned = True
+        self._partitioned.append(leader.elector)
+        return Disruption("partition-scheduler", leader.elector.cfg.identity)
+
+    def _failover_scheduler(self) -> Optional[Disruption]:
+        """Graceful leader handoff: the active instance abdicates —
+        vacates the lease record and sits out the next race — so a warm
+        standby adopts (epoch bump, reconcile, resume) while the old
+        leader demotes through the same pause-and-drain path a crash
+        would use. No-op without at least two electing schedulers."""
+        if len(self._electing_schedulers()) < 2:
+            return None
+        leader = self._leader()
+        if leader is None:
+            return None
+        # sit out long enough that the standby reliably wins the race
+        leader.elector.abdicate(cooldown=2.0 * leader.elector.cfg.lease_duration)
+        return Disruption("failover-scheduler", leader.elector.cfg.identity)
+
     # -- assertions ---------------------------------------------------------
 
     def restart_all_dead(self, timeout: float = 30.0) -> None:
@@ -263,3 +325,18 @@ class ChaosMonkey:
                     f"controller {name} not restarted within {timeout}s "
                     f"(restarts={sup.restart_count(name)})"
                 )
+        # heal scheduler netsplits and wait for a leader to re-emerge —
+        # the same no-shrug rule: converging with no active scheduler
+        # would pass every per-pod check on a cluster that schedules
+        # nothing ever again
+        while self._partitioned:
+            self._partitioned.pop().partitioned = False
+        if self._electing_schedulers():
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if self._leader() is not None:
+                    return
+                time.sleep(0.05)
+            raise RuntimeError(
+                f"no scheduler re-acquired the leader lease within {timeout}s"
+            )

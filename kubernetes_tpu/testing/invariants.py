@@ -14,6 +14,9 @@ Invariants shipped (the soak wires all of them):
 
   CounterFlat       a counter must not move (zero shadow drift, zero
                     expired assumes)
+  CounterMoved      a counter must move by at least min_delta (the
+                    drill's disruption really exercised its path —
+                    leader transitions under failover chaos)
   GaugeBaseline     a gauge must RETURN to its starting band by the end
                     (queue depth after each chaos wave, watcher count)
   BoundedGrowth     first-window vs last-window growth of a gauge stays
@@ -129,6 +132,32 @@ class CounterFlat(Invariant):
         if delta > self.allow:
             return [f"{self.name}: {self.metric} moved by {delta:g} "
                     f"(allowed {self.allow:g})"]
+        return []
+
+
+class CounterMoved(Invariant):
+    """The inverse of CounterFlat: a counter that MUST move over the run
+    by at least `min_delta` — proof that a drill actually exercised the
+    path it claims to (e.g. scheduler_leader_transitions_total under a
+    failover mix, scheduler_fencing_rejections_total after a stale-token
+    replay). A chaos run whose injection silently no-opped passes every
+    convergence check; this is the one that fails it."""
+
+    def __init__(self, metric: str, min_delta: float = 1.0,
+                 label: str = ""):
+        self.metric = metric
+        self.min_delta = min_delta
+        self.name = label or f"moved:{metric}"
+
+    def check(self, samples):
+        if len(samples) < 2:
+            return []
+        delta = total(samples[-1][1], self.metric) - total(
+            samples[0][1], self.metric)
+        if delta < self.min_delta:
+            return [f"{self.name}: {self.metric} moved by {delta:g} "
+                    f"(expected >= {self.min_delta:g} — the disruption "
+                    f"never exercised this path)"]
         return []
 
 

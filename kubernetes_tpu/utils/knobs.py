@@ -295,6 +295,12 @@ _declare("KTPU_WATCH_EVICT_AFTER", "float", 10.0,
          "max seconds a watcher may hold queued frames with zero socket "
          "progress before eviction")
 
+# -- scheduler failover / leader election
+_declare("KTPU_LEASE_FENCE_MARGIN", "float", 2.0,
+         "seconds before lease expiry a leader self-fences (stops "
+         "renewing and demotes) so a GC-paused or partitioned instance "
+         "never races the successor's adoption")
+
 # -- harness / test gates (read by scripts/ and tests/, never by the
 #    package; declared so the README table and the knob checker cover
 #    the whole KTPU_* surface)

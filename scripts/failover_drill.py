@@ -1,0 +1,259 @@
+"""Failover drill: run the scheduler-failover disruption matrix against
+a live dual-scheduler cluster and report split-brain safety.
+
+Sibling of fault_drill.py (device faults) and crash_drill.py
+(control-plane crashes); this one drills the LEADERSHIP layer: graceful
+abdications, leader netsplits (self-fence margin vs a standby's
+adoption window), and pipeline-worker kills on the leader — all while a
+pod stream keeps both instances' queues warm. Between the scripted
+phases it measures failover-to-first-bind latency (leadership lost ->
+the promoted standby's first successful bind) and replays a deposed
+epoch's bind to prove the apiserver fence rejects it without touching
+the store. Prints a recovery report and exits nonzero on any lost,
+double-bound, or fence-escaped pod.
+
+Runs on CPU (the TPU backend rides the hoisted session there):
+
+    JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python scripts/failover_drill.py
+"""
+
+import argparse
+import os
+import random
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.api import types as v1  # noqa: E402
+from kubernetes_tpu.apiserver.server import FenceExpired  # noqa: E402
+from kubernetes_tpu.cluster import Cluster  # noqa: E402
+from kubernetes_tpu.scheduler import metrics  # noqa: E402
+from kubernetes_tpu.testing.chaos import ChaosMonkey  # noqa: E402
+from kubernetes_tpu.testing.faults import (  # noqa: E402
+    BindIntegrityChecker,
+    FaultInjector,
+)
+from kubernetes_tpu.testing.invariants import (  # noqa: E402
+    CounterMoved,
+    InvariantSuite,
+)
+
+# fast lease timings: production defaults (15s/10s/2s) would make every
+# failover a coffee break
+ELECTION = dict(
+    lease_duration=1.5,
+    renew_deadline=1.0,
+    retry_period=0.05,
+    fence_margin=0.3,
+)
+
+
+def wait_until(fn, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def pod(name: str, cpu: str = "20m") -> v1.Pod:
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        spec=v1.PodSpec(containers=[v1.Container(
+            name="c", image="img:1",
+            resources=v1.ResourceRequirements(requests={"cpu": cpu}),
+        )]),
+    )
+
+
+def counter_total(counter) -> float:
+    return sum(val for _, val in counter.items())
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--schedulers", type=int, default=2)
+    ap.add_argument("--pods", type=int, default=60,
+                    help="pod stream length during chaos")
+    ap.add_argument("--duration", type=float, default=8.0,
+                    help="seconds of chaos")
+    ap.add_argument("--period", type=float, default=0.6,
+                    help="disruption period")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+
+    rng = random.Random(args.seed)
+    inj = FaultInjector()
+    failures = []
+    transitions0 = metrics.leader_transitions.value()
+    rejections0 = counter_total(metrics.fencing_rejections)
+    reconcile0 = {k: val for k, val in metrics.restart_reconcile.items()}
+
+    with Cluster(
+        n_nodes=args.nodes,
+        n_schedulers=args.schedulers,
+        election_opts=dict(ELECTION),
+        # nodelifecycle lifts the not-ready admission taint; without it
+        # every node stays NoSchedule-tainted and nothing ever binds
+        controllers=["replicaset", "deployment", "nodelifecycle"],
+        controller_opts={
+            "node_monitor_period": 0.3,
+            "node_monitor_grace_period": 2.0,
+        },
+        fault_injector=inj,
+    ) as c:
+        checker = BindIntegrityChecker().attach(c.kcm.informers.pods())
+        suite = InvariantSuite([
+            # a failover drill whose chaos never flipped the lease, or
+            # whose stale replay never hit the fence, proved nothing
+            CounterMoved("scheduler_leader_transitions_total", min_delta=2),
+            CounterMoved("scheduler_fencing_rejections_total", min_delta=1),
+        ])
+        if not wait_until(
+                lambda: any(s.elector.is_leader.is_set()
+                            for s in c.schedulers), timeout=15):
+            print("FAIL: no leader elected")
+            return 1
+        suite.sample()
+
+        for i in range(8):
+            c.client.pods.create(pod(f"seed-{i}"))
+
+        def n_bound():
+            pods, _ = c.client.pods.list(namespace="default")
+            return sum(1 for p in pods if p.spec.node_name)
+
+        if not wait_until(lambda: n_bound() == 8, timeout=30):
+            print(f"FAIL: initial convergence ({n_bound()}/8)")
+            return 1
+        leader = c.active_scheduler
+        print(f"seeded: 8 pods on {args.nodes} nodes, leader "
+              f"{leader.elector.cfg.identity} "
+              f"(epoch {leader.elector.fencing_token().transitions})")
+
+        # -- measured failover: leadership lost -> first bind by the
+        # promoted standby (the pods created at t0 can only be bound by
+        # the successor; the old leader is demoted and paused)
+        old = leader
+        t0 = time.monotonic()
+        old.elector.abdicate(cooldown=2.0 * ELECTION["lease_duration"])
+        for i in range(4):
+            c.client.pods.create(pod(f"failover-{i}"))
+        if not wait_until(lambda: n_bound() == 12, timeout=30):
+            failures.append(
+                f"failover batch never bound ({n_bound()}/12)")
+            latency = float("nan")
+        else:
+            latency = time.monotonic() - t0
+        new = c.active_scheduler
+        print(f"failover: {old.elector.cfg.identity} -> "
+              f"{new.elector.cfg.identity}, first bind after "
+              f"{latency * 1000:.0f} ms")
+
+        # -- stale-epoch replay: the deposed leader's latched token must
+        # bounce off the apiserver fence without touching the store
+        stale = old._fence
+        live_epoch = new.elector.fencing_token().transitions
+        if stale is None or stale.transitions >= live_epoch:
+            failures.append(
+                f"no stale token to replay (old fence {stale}, live "
+                f"epoch {live_epoch})")
+        else:
+            # a pod no node can fit: the live leader parks it
+            # unschedulable, so nothing races the replay
+            c.client.pods.create(pod("fence-probe", cpu="999000m"))
+            nodes, _ = c.client.nodes.list()
+            try:
+                c.client.pods.bind("default", "fence-probe",
+                                   nodes[0].metadata.name, fence=stale)
+                failures.append(
+                    f"stale epoch {stale.transitions} bind was ACCEPTED "
+                    f"(live epoch {live_epoch}) — the fence is open")
+            except FenceExpired as e:
+                print(f"fence held: {e}")
+            probe = c.client.pods.get("fence-probe", "default")
+            if probe.spec.node_name:
+                failures.append(
+                    f"rejected stale bind still mutated the store: "
+                    f"fence-probe bound to {probe.spec.node_name!r}")
+            c.client.pods.delete("fence-probe", "default")
+        suite.sample()
+
+        # -- chaos: abdications + netsplits + leader pipeline kills over
+        # a pod stream
+        monkey = ChaosMonkey(
+            c, period=args.period, rng=rng,
+            disruptions=["failover-scheduler", "partition-scheduler",
+                         "crash-scheduler"],
+        )
+        monkey.run()
+        created = 0
+        deadline = time.monotonic() + args.duration
+        last_sample = 0.0
+        while time.monotonic() < deadline:
+            for _ in range(rng.randrange(1, 5)):
+                if created < args.pods:
+                    c.client.pods.create(pod(f"w-{created}"))
+                    created += 1
+            if time.monotonic() - last_sample >= 0.5:
+                last_sample = time.monotonic()
+                suite.sample()
+            time.sleep(0.05)
+        while created < args.pods:
+            c.client.pods.create(pod(f"w-{created}"))
+            created += 1
+        monkey.stop()
+        inj.disarm()
+        monkey.restart_all_dead(timeout=30)
+
+        total = 12 + args.pods  # seeds + failover batch + stream
+
+        def converged():
+            pods, _ = c.client.pods.list(namespace="default")
+            return (len(pods) == total
+                    and all(p.spec.node_name for p in pods))
+
+        if not wait_until(converged, timeout=90):
+            pods, _ = c.client.pods.list(namespace="default")
+            unbound = [p.metadata.name for p in pods if not p.spec.node_name]
+            failures.append(
+                f"lost pods: {len(unbound)} unbound of {len(pods)} "
+                f"({total} expected): {unbound[:8]}")
+        if checker.violations:
+            failures.append(f"double binds: {checker.violations}")
+        failures.extend(suite.finish())
+
+        by_kind = {}
+        for d in monkey.history:
+            by_kind[d.kind] = by_kind.get(d.kind, 0) + 1
+        reconcile_delta = {
+            k[0]: val - reconcile0.get(k, 0.0)
+            for k, val in metrics.restart_reconcile.items()
+            if val - reconcile0.get(k, 0.0) > 0
+        }
+        print("--- recovery report ---")
+        print(f"disruptions:         {by_kind}")
+        print(f"leader transitions:  "
+              f"{metrics.leader_transitions.value() - transitions0:.0f}")
+        print(f"fencing rejections:  "
+              f"{counter_total(metrics.fencing_rejections) - rejections0:.0f}")
+        print(f"reconcile outcomes:  {reconcile_delta}")
+        print(f"failover-to-first-bind: {latency * 1000:.0f} ms")
+        print(f"final bind count:    {n_bound()}/{total}")
+
+    if failures:
+        print("FAIL:\n  " + "\n  ".join(failures))
+        return 1
+    print("PASS: leadership survived the failover matrix "
+          "(zero lost, zero double-bound, fence held)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,10 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--watchdog", type=float, default=0.5,
                     help="dispatch watchdog (s)")
+    ap.add_argument("--failover", action="store_true",
+                    help="run TWO leader-elected scheduler instances and "
+                         "add the failover/partition kinds to the mix — "
+                         "device faults and leadership churn at once")
     ap.add_argument("--dump-trace", nargs="?", const="fault_drill_trace.json",
                     default="", metavar="PATH",
                     help="run with KTPU_TRACE=2, write the end-of-drill "
@@ -122,15 +126,25 @@ def main() -> int:
             "node_monitor_grace_period": 2.0,
         },
         fault_injector=inj,
+        n_schedulers=2 if args.failover else 1,
+        election_opts=dict(
+            lease_duration=1.5, renew_deadline=1.0,
+            retry_period=0.05, fence_margin=0.3,
+        ) if args.failover else None,
     ) as c:
         tpu = c.scheduler.tpu
         if tpu is None:
             print("FAIL: drill needs the TPU scheduler backend")
             return 1
-        tpu.watchdog_timeout = args.watchdog
-        tpu.retry_base = 0.01
-        tpu.ladder._probe_interval = 0.1
-        tpu.ladder._probe_delay = 0.1
+        # either instance can hold the lease, so both backends get the
+        # drill's aggressive fault-recovery timings
+        for sched in c.schedulers:
+            if sched.tpu is None:
+                continue
+            sched.tpu.watchdog_timeout = args.watchdog
+            sched.tpu.retry_base = 0.01
+            sched.tpu.ladder._probe_interval = 0.1
+            sched.tpu.ladder._probe_delay = 0.1
         checker = BindIntegrityChecker().attach(c.kcm.informers.pods())
         c.client.resource("deployments").create(
             deployment("ha", args.replicas))
@@ -146,19 +160,23 @@ def main() -> int:
         print(f"seeded: {args.replicas} replicas on {args.nodes} nodes "
               f"(backend rung: {tpu.ladder.mode()})")
 
-        monkey = ChaosMonkey(
-            c, period=args.period, rng=rng,
-            disruptions=[
-                "wedge-device", "crash-scheduler", "overload",
-                "kill-kubelet", "restart-kubelet", "delete-pod",
-            ],
-        )
+        kinds = [
+            "wedge-device", "crash-scheduler", "overload",
+            "kill-kubelet", "restart-kubelet", "delete-pod",
+        ]
+        if args.failover:
+            kinds += ["failover-scheduler", "partition-scheduler"]
+        monkey = ChaosMonkey(c, period=args.period, rng=rng,
+                             disruptions=kinds)
         monkey.run()
         time.sleep(args.duration)
         monkey.stop()
         inj.disarm()  # end of the injection window
         monkey.restart_all_dead(timeout=30)
 
+        # the ladder that matters is the lease holder's: a demoted
+        # standby dispatches nothing, so its rung never re-probes
+        tpu = c.active_scheduler.tpu
         if not wait_until(lambda: tpu.ladder.rung() >= tpu.ladder.top,
                           timeout=30):
             failures.append(

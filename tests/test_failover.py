@@ -1,0 +1,434 @@
+"""Split-brain-safe scheduler failover: fenced writes, clock-skew
+hardening, cold-restart reconciliation, dual-scheduler chaos.
+
+The invariant under test is the split-brain one: across leader crashes,
+netsplits, and graceful handoffs, every pod is bound EXACTLY once —
+zero lost, zero double-bound — because (a) a deposed leader's writes
+carry a dead lease epoch the apiserver rejects (FenceExpired), (b) a
+partitioned/paused leader self-fences a margin BEFORE its lease
+expires, strictly before any peer's adoption window opens, and (c) a
+promoted (or cold-restarted) instance reconciles the authoritative
+store — adopt bound pods, clear stale nominations, requeue unbound
+pods exactly once — before it pops anything.
+
+Fast deterministic variants run in tier-1; the multi-seed soak is
+`slow`.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.server import APIServer, FenceExpired
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.client.leaderelection import (
+    FencingToken,
+    LeaderElectionConfig,
+    LeaderElector,
+)
+from kubernetes_tpu.cluster import Cluster
+from kubernetes_tpu.scheduler import metrics as sched_metrics
+from kubernetes_tpu.scheduler.factory import create_scheduler
+from kubernetes_tpu.testing.chaos import ChaosMonkey
+from kubernetes_tpu.testing.faults import BindIntegrityChecker
+
+from .util import wait_until
+
+# fast lease timings for the dual-scheduler tests (production defaults
+# are 15s/10s/2s — a failover per test would blow the tier-1 budget)
+FAST_ELECTION = dict(
+    lease_duration=1.5,
+    renew_deadline=1.0,
+    retry_period=0.05,
+    fence_margin=0.3,
+)
+
+
+def _pod(name: str, cpu: str = "20m") -> v1.Pod:
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        spec=v1.PodSpec(containers=[v1.Container(
+            name="c", image="img:1",
+            resources=v1.ResourceRequirements(requests={"cpu": cpu}),
+        )]),
+    )
+
+
+# -- satellite 1: clock-skew hardening (self-fence margin) -----------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self._lock = threading.Lock()
+
+    def now(self) -> float:
+        with self._lock:
+            return self.t
+
+    def advance(self, dt: float) -> None:
+        with self._lock:
+            self.t += dt
+
+
+def test_fence_margin_demotes_partitioned_leader_before_adoption():
+    """A partitioned leader must demote at lease_duration - fence_margin
+    on its OWN clock, strictly before a peer's adoption window opens at
+    lease_duration — the window in which both could believe they lead
+    is the margin, by construction, not the clock skew."""
+    clock = FakeClock()
+    client = Clientset(APIServer())
+    demoted_at = []
+    adopted_at = []
+
+    # lease_duration - fence_margin (8.0) < renew_deadline-from-now
+    # (9.0): the MARGIN governs the self-fence deadline, which is the
+    # configuration this test pins (with margin 0 the renew deadline
+    # would fire at 9.0 instead — still before expiry, but only by
+    # whatever slack renew_deadline happens to leave)
+    def cfg(identity):
+        return LeaderElectionConfig(
+            identity=identity, lease_duration=10.0, renew_deadline=9.0,
+            retry_period=0.02, fence_margin=2.0,
+        )
+
+    a = LeaderElector(
+        client, cfg("a"),
+        on_started_leading=lambda: None,
+        on_stopped_leading=lambda: demoted_at.append(clock.now()),
+        now=clock.now,
+    )
+    b = LeaderElector(
+        client, cfg("b"),
+        on_started_leading=lambda: adopted_at.append(clock.now()),
+        on_stopped_leading=lambda: None,
+        now=clock.now,
+    )
+    try:
+        a.start()
+        assert wait_until(a.is_leader.is_set, timeout=5)
+        a.partitioned = True  # netsplit: renews fail, token freezes
+        b.start()
+        # walk fake time past expiry; real-time sleeps let the elector
+        # threads observe each step
+        while clock.now() < 12.0 and not adopted_at:
+            clock.advance(0.25)
+            time.sleep(0.04)  # >= 2 retry_periods: both electors poll
+        assert demoted_at, "partitioned leader never self-fenced"
+        assert adopted_at, "standby never adopted the expired lease"
+        # demotion on the margin: at >= 8.0 (the self-fence deadline)
+        # but < 9.0 (where the renew deadline would have fired) — the
+        # margin, not renew_deadline, ended the leadership
+        assert 8.0 <= demoted_at[0] < 9.0, demoted_at
+        # adoption only after full expiry at 10.0: the no-overlap gap
+        # between the zombie's demotion and the successor is >= margin
+        assert adopted_at[0] >= 10.0, adopted_at
+        assert b.fencing_token().transitions == 1  # epoch bumped
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_elector_rejects_margin_wider_than_lease():
+    with pytest.raises(ValueError):
+        LeaderElector(
+            Clientset(APIServer()),
+            LeaderElectionConfig(identity="x", lease_duration=1.0,
+                                 renew_deadline=0.5, retry_period=0.1,
+                                 fence_margin=1.0),
+            on_started_leading=lambda: None,
+            on_stopped_leading=lambda: None,
+        )
+
+
+# -- tentpole: fenced writes rejected server-side --------------------------
+
+
+def test_stale_fence_token_rejected_without_corrupting_store():
+    """A deposed epoch's write bounces off the fencing precondition:
+    FenceExpired raised, the rejection counter bumped, and the store
+    object untouched — while the live epoch's identical write lands."""
+    api = APIServer()
+    client = Clientset(api)
+    leases = client.resource("leases")
+    leases.create(v1.Lease(
+        metadata=v1.ObjectMeta(name="kube-scheduler", namespace="kube-system"),
+        spec=v1.LeaseSpec(holder_identity="sched-a", renew_time=time.time(),
+                          lease_duration_seconds=15),
+    ))
+    token_a = FencingToken("kube-scheduler", "kube-system", "sched-a", 0)
+    client.pods.create(_pod("p0"))
+    client.pods.bind("default", "p0", "n1", fence=token_a)  # valid epoch
+    assert client.pods.get("p0", "default").spec.node_name == "n1"
+
+    # failover: sched-b adopts, bumping the transitions epoch
+    lease = leases.get("kube-scheduler", "kube-system")
+    lease.spec.holder_identity = "sched-b"
+    lease.spec.lease_transitions += 1
+    leases.update(lease)
+
+    client.pods.create(_pod("p1"))
+    before = sched_metrics.fencing_rejections.value(op="bind")
+    with pytest.raises(FenceExpired):
+        client.pods.bind("default", "p1", "n1", fence=token_a)
+    assert sched_metrics.fencing_rejections.value(op="bind") == before + 1
+    assert client.pods.get("p1", "default").spec.node_name == ""
+
+    # same write, stale epoch via bind_many: collected, not raised
+    outcomes = client.pods.bind_many([("default", "p1", "n1")], fence=token_a)
+    assert isinstance(outcomes[0], FenceExpired)
+    assert client.pods.get("p1", "default").spec.node_name == ""
+
+    # the live epoch's token binds the same pod fine
+    token_b = FencingToken("kube-scheduler", "kube-system", "sched-b", 1)
+    client.pods.bind("default", "p1", "n1", fence=token_b)
+    assert client.pods.get("p1", "default").spec.node_name == "n1"
+
+    # stale update_status and delete are fenced through the same gate
+    p1 = client.pods.get("p1", "default")
+    p1.status.nominated_node_name = "bogus"
+    with pytest.raises(FenceExpired):
+        client.pods.update_status(p1, fence=token_a)
+    assert client.pods.get("p1", "default").status.nominated_node_name == ""
+    with pytest.raises(FenceExpired):
+        client.pods.delete("p1", "default", fence=token_a)
+    assert client.pods.get("p1", "default") is not None
+
+
+# -- satellite 2: requeue-exactly-once reconciliation ----------------------
+
+
+def test_reconcile_adopt_requeue_clear_outcomes():
+    """reconcile_from_store against a store with one of everything: a
+    bound pod (adopt), an unbound pod (requeue), an unbound pod with a
+    stale nomination (clear + requeue), a deleting pod (skip). A second
+    reconcile is a no-op, and a generation the demotion drain already
+    requeued is skipped — requeue-exactly-once."""
+    c = Cluster(n_nodes=0)  # components built, nothing started: the
+    # queue only sees what reconcile puts there
+    try:
+        s = c.scheduler
+        client = c.client
+        client.pods.create(_pod("bound"))
+        client.pods.bind("default", "bound", "node-1")
+        client.pods.create(_pod("plain"))
+        nom = _pod("nominated")
+        client.pods.create(nom)
+        nom = client.pods.get("nominated", "default")
+        nom.status.nominated_node_name = "node-9"
+        client.pods.update_status(nom)
+
+        def reading(outcome):
+            return sched_metrics.restart_reconcile.value(outcome=outcome)
+
+        base = {k: reading(k) for k in ("adopted", "requeued", "cleared")}
+        counts = s.reconcile_from_store()
+        assert counts == {"adopted": 1, "requeued": 2, "cleared": 1}, counts
+        assert s.cache.has_pod("default/bound")
+        queued = {v1.pod_key(p) for p in s.queue.pending_pods()}
+        assert queued == {"default/plain", "default/nominated"}
+        # the stale nomination is gone from the API object
+        assert client.pods.get(
+            "nominated", "default").status.nominated_node_name == ""
+        for k in ("adopted", "requeued", "cleared"):
+            assert reading(k) == base[k] + counts[k]
+
+        # idempotent: everything is adopted/queued already
+        counts2 = s.reconcile_from_store()
+        assert counts2 == {"adopted": 0, "requeued": 0, "cleared": 0}, counts2
+
+        # a pod the demotion drain requeued (same generation) must NOT
+        # be requeued again by the relist
+        drained = _pod("drained")
+        client.pods.create(drained)
+        fresh = client.pods.get("drained", "default")
+        s._drain_requeued["default/drained"] = fresh.metadata.generation or 0
+        counts3 = s.reconcile_from_store()
+        assert counts3["requeued"] == 0, counts3
+        # ... and the dedupe record is consumed: the NEXT reconcile (no
+        # drain in between) picks the pod up normally
+        counts4 = s.reconcile_from_store()
+        assert counts4["requeued"] == 1, counts4
+    finally:
+        c.scheduler.shutdown(timeout=10)
+        c._teardown()
+
+
+# -- tentpole: cold-restart reconciliation parity --------------------------
+
+
+def test_cold_restart_reconcile_parity():
+    """Kill the scheduler with a staged backlog, bring up a FRESH
+    instance over the same store, reconcile, finish — the final
+    assignment of the backlog must be BIT-IDENTICAL to the control run
+    that never crashed. Restart-then-reschedule == never-crashed, on
+    the same surviving pod set. Two crash windows share one cluster
+    (the session JIT dominates a per-window cluster): 0.0 kills the
+    instance before the pipeline moves, 0.15 kills it mid-flight."""
+    n_backlog = 24
+    with Cluster(n_nodes=4) as c:
+        for i in range(8):
+            c.client.pods.create(_pod(f"base-{i}"))
+
+        def all_bound(names):
+            pods, _ = c.client.pods.list(namespace="default")
+            got = {p.metadata.name: p.spec.node_name for p in pods}
+            return all(got.get(n) for n in names)
+
+        assert wait_until(
+            lambda: all_bound([f"base-{i}" for i in range(8)]), timeout=30)
+
+        names = [f"pod-{i}" for i in range(n_backlog)]
+
+        def stage(sched):
+            sched.pause()
+            assert sched.wait_idle(timeout=30)
+            for n in names:
+                c.client.pods.create(_pod(n))
+            # let the informer deliver the backlog into the queue
+            assert wait_until(
+                lambda: len(sched.queue.pending_pods()) >= n_backlog,
+                timeout=10)
+
+        def assignments():
+            pods, _ = c.client.pods.list(namespace="default")
+            return {p.metadata.name: p.spec.node_name
+                    for p in pods if p.metadata.name in set(names)}
+
+        def reset(sched):
+            for n in names:
+                c.client.pods.delete(n, "default")
+            assert wait_until(
+                lambda: not assignments() and sched.wait_idle(timeout=1),
+                timeout=60)
+
+        # control: stage, resume, drain — no crash
+        stage(c.scheduler)
+        c.scheduler.resume()
+        assert wait_until(lambda: all_bound(names), timeout=60)
+        control = assignments()
+        assert all(control.values())
+        reset(c.scheduler)
+
+        current, factories = c.scheduler, []
+        try:
+            for crash_window in (0.0, 0.15):
+                # crash run: stage the same backlog, let the pipeline
+                # run for crash_window seconds, then kill the instance
+                # mid-whatever
+                stage(current)
+                current.resume()
+                time.sleep(crash_window)
+                current.shutdown(timeout=30)
+
+                # cold restart: fresh instance, fresh caches, same store
+                factory = SharedInformerFactory(c.client)
+                factories.append(factory)
+                current = create_scheduler(
+                    c.client, factory, c.scheduler_config)
+                factory.start()
+                assert factory.wait_for_cache_sync()
+                current.reconcile_from_store()
+                current.start()
+                assert wait_until(lambda: all_bound(names), timeout=60), (
+                    assignments())
+                assert assignments() == control, crash_window
+                reset(current)
+        finally:
+            if current is not c.scheduler:
+                current.shutdown(timeout=30)
+            for factory in factories:
+                factory.stop()
+        # hand the (dead) original back to Cluster teardown — shutdown
+        # is idempotent
+
+
+# -- tentpole: dual-scheduler failover chaos -------------------------------
+
+
+def _failover_mix(seed: int, duration: float, n_pods: int,
+                  disruptions=None) -> None:
+    rng = random.Random(seed)
+    with Cluster(
+        n_nodes=4,
+        n_schedulers=2,
+        election_opts=dict(FAST_ELECTION),
+        # nodelifecycle must ride along: admission taints every new node
+        # not-ready:NoSchedule, and only its monitor lifts the taint
+        controllers=["replicaset", "deployment", "nodelifecycle"],
+        controller_opts={
+            "node_monitor_period": 0.3,
+            "node_monitor_grace_period": 2.0,
+        },
+    ) as c:
+        checker = BindIntegrityChecker().attach(c.kcm.informers.pods())
+        assert wait_until(
+            lambda: any(s.elector.is_leader.is_set() for s in c.schedulers),
+            timeout=15,
+        ), "no leader elected"
+        transitions0 = sched_metrics.leader_transitions.value()
+
+        monkey = ChaosMonkey(
+            c, period=max(0.3, duration / 6), rng=rng,
+            disruptions=list(
+                disruptions or ["failover-scheduler", "partition-scheduler"]),
+        )
+        monkey.run()
+        created = 0
+        deadline = time.monotonic() + duration
+        while time.monotonic() < deadline:
+            burst = rng.randrange(1, 5)
+            for _ in range(burst):
+                if created < n_pods:
+                    c.client.pods.create(_pod(f"w-{seed}-{created}"))
+                    created += 1
+            time.sleep(0.05)
+        while created < n_pods:
+            c.client.pods.create(_pod(f"w-{seed}-{created}"))
+            created += 1
+        monkey.stop()
+        monkey.restart_all_dead(timeout=30)
+        assert monkey.history, "chaos injected nothing"
+
+        def all_bound():
+            pods, _ = c.client.pods.list(namespace="default")
+            return (len(pods) == n_pods
+                    and all(p.spec.node_name for p in pods))
+
+        assert wait_until(all_bound, timeout=90), [
+            (p.metadata.name, p.spec.node_name)
+            for p in c.client.pods.list(namespace="default")[0]
+            if not p.spec.node_name
+        ]
+        # zero double binds across every failover: no pod ever moved
+        # node-to-node in place
+        assert not checker.violations, checker.violations
+        # the mix really failed over: this-instance promotions happened
+        # beyond the initial election
+        assert sched_metrics.leader_transitions.value() > transitions0
+
+
+def test_dual_scheduler_failover_deterministic():
+    """Tier-1 slice: one seeded failover mix — graceful handoffs and a
+    netsplit over a pod stream; zero lost, zero double-bound."""
+    _failover_mix(seed=0, duration=2.0, n_pods=30)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_dual_scheduler_failover_soak(seed):
+    """The long mix adds pipeline-worker kills on top of the failover
+    kinds, per ISSUE's >=3-seed soak bar. (No delete-pod here: the
+    stream is bare pods — nothing recreates them, which would void the
+    every-pod-bound convergence check.)"""
+    _failover_mix(
+        seed=seed, duration=12.0, n_pods=150,
+        disruptions=["failover-scheduler", "partition-scheduler",
+                     "crash-scheduler"],
+    )
