@@ -40,7 +40,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 from ..api import types as v1
-from ..store import kv
+from ..store import kv, wal
 from ..utils import knobs, serde
 from ..utils.metrics import Counter, Gauge, Histogram, legacy_registry
 from .server import APIError, APIServer, NotFound, ResourceInfo, WatchEvent
@@ -93,6 +93,52 @@ watch_buffer_depth = legacy_registry.register(
         ("watcher",),
     )
 )
+wire_events = legacy_registry.register(
+    Counter(
+        "apiserver_wire_events_total",
+        "Store events pulled off the shared fan-out watch, counted ONCE "
+        "per event regardless of how many watchers receive it. The "
+        "denominator of the single-serialize invariant: "
+        "wire_serializations_total / wire_events_total must equal the "
+        "number of wire encodings in use (1 per encoding), never the "
+        "watcher count — scripts/probe_wire.py asserts exactly that.",
+        (),
+    )
+)
+wire_serializations = legacy_registry.register(
+    Counter(
+        "apiserver_wire_serializations_total",
+        "Watch events actually serialized into wire frames (frame-memo "
+        "misses), per encoding. The fan-out serializes each event once "
+        "per encoding and shares the bytes by reference across every "
+        "matching watcher, so this grows with event volume — NOT with "
+        "watcher count. A ratio above encodings-in-use per event names "
+        "a broken memo (the pre-fan-out per-watcher tax coming back).",
+        ("encoding",),
+    )
+)
+wire_frames = legacy_registry.register(
+    Counter(
+        "apiserver_wire_frames_total",
+        "Event frames enqueued into watcher send buffers, per encoding "
+        "(one per event per matching watcher; heartbeats excluded). "
+        "With wire_events_total this gives the fan-out amplification, "
+        "and per unit time the aggregate frames/s the WireFanout bench "
+        "headlines.",
+        ("encoding",),
+    )
+)
+wire_encode_bytes = legacy_registry.register(
+    Counter(
+        "apiserver_wire_encode_bytes_total",
+        "Bytes produced by wire serialization (watch frame encodes and "
+        "binary list entries), per encoding. Counted at encode time — "
+        "shared fan-out frames count once no matter how many watchers "
+        "the bytes reach, so this measures serialization cost, not "
+        "socket volume.",
+        ("encoding",),
+    )
+)
 
 
 def _status_body(code: int, message: str, reason: str = "") -> bytes:
@@ -112,45 +158,322 @@ _watch_ids = _itertools.count(1)
 
 _RAW_EVENT_CAP = 8192
 
+# wire media types: JSON is the default and the fallback; ktpu-binary is
+# the store/wal.py record grammar on the socket (shared with
+# native/kvstore.cpp's framing), negotiated per request via Accept
+MEDIA_JSON = "application/json"
+MEDIA_BINARY = "application/ktpu-binary"
 
-class _RawEventMemo:
+ENC_JSON = "json"
+ENC_BINARY = "binary"
+
+_TYPE_TO_OP = {kv.ADDED: wal.OP_CREATE, kv.MODIFIED: wal.OP_UPDATE,
+               kv.DELETED: wal.OP_DELETE}
+_OP_TO_TYPE = {v: k for k, v in _TYPE_TO_OP.items()}
+
+# heartbeat frames precomputed once per media type: 1000 idle watchers
+# tick twice a second each, and rebuilding the frame per watcher per
+# tick was measurable for exactly zero information content. The JSON
+# heartbeat is the pre-binary wire's exact bytes (a blank line the
+# client's readline loop skips); the binary one is an OP_HEARTBEAT
+# record the binary decode loop drops.
+_pack_u32 = wal._U32.pack  # the snapshot grammar's crc32 trailer width
+
+HEARTBEAT_JSON = b" \n"
+HEARTBEAT_BINARY = wal.encode_record(
+    wal.Record(wal.OP_HEARTBEAT, "", None, 0, 0))
+_HEARTBEATS = {ENC_JSON: HEARTBEAT_JSON, ENC_BINARY: HEARTBEAT_BINARY}
+
+
+def _stamped_object(ev) -> Dict:
+    obj = dict(ev.value)
+    meta = dict(obj.get("metadata") or {})
+    # the event revision is the object's resourceVersion (etcd3
+    # semantics; TypedWatch._hydrate stamps the same way)
+    meta["resourceVersion"] = str(ev.revision)
+    obj["metadata"] = meta
+    return obj
+
+
+def encode_json_frame(ev) -> bytes:
+    """One JSON watch frame — byte-identical to the pre-binary wire."""
+    return json.dumps({
+        "type": ev.type, "revision": ev.revision,
+        "object": _stamped_object(ev),
+    }).encode() + b"\n"
+
+
+def encode_binary_frame(ev) -> bytes:
+    """One binary watch frame: a wal.py record whose value is the
+    resourceVersion-stamped object — the WAL grammar on the socket."""
+    return wal.encode_record(wal.Record(
+        _TYPE_TO_OP[ev.type], ev.key, _stamped_object(ev), ev.revision, 0))
+
+
+_FRAME_ENCODERS = {ENC_JSON: encode_json_frame, ENC_BINARY: encode_binary_frame}
+
+
+class _FrameMemo:
     """Cross-watcher frame memo for ONE hub/store: every watcher of a
-    prefix streams identical bytes per event, encoded once.
+    prefix streams identical bytes per (event, encoding), encoded once.
 
-    The memo key (store key, revision, type) is only unique WITHIN one
-    store — two apiservers in the same process (bench_configs' 17
-    sequential workloads, multi-cluster tests) mint colliding
-    (key, revision, type) triples for different objects. A process-global
-    memo served one cluster's cached frame bytes to another cluster's
-    watcher; scoping the memo to the hub makes collisions impossible."""
+    The memo key (generation, store key, revision, type, encoding) is
+    only unique WITHIN one store — two apiservers in the same process
+    (bench_configs' 17 sequential workloads, multi-cluster tests) mint
+    colliding (key, revision, type) triples for different objects. A
+    process-global memo served one cluster's cached frame bytes to
+    another cluster's watcher; scoping the memo to the hub makes
+    collisions impossible. The GENERATION term guards the same aliasing
+    within one store across time: a durable store crash (fsync=False
+    rollback) re-mints revisions, so an un-bumped memo would serve the
+    pre-crash object's bytes for a post-crash (key, revision, type)
+    triple — the fan-out folds the store incarnation into every key."""
 
     def __init__(self, cap: int = _RAW_EVENT_CAP):
-        self._memo: Dict[Tuple[str, int, str], bytes] = {}
+        self._memo: Dict[Tuple, bytes] = {}
         self._order: "_collections.deque" = _collections.deque()
         self._cap = cap
         self._lock = threading.Lock()
 
-    def encode(self, ev) -> bytes:
-        memo_key = (ev.key, ev.revision, ev.type)
+    def encode(self, ev, generation: int = 0, encoding: str = ENC_JSON) -> bytes:
+        memo_key = (generation, ev.key, ev.revision, ev.type, encoding)
         with self._lock:
             hit = self._memo.get(memo_key)
         if hit is not None:
             return hit
-        obj = dict(ev.value)
-        meta = dict(obj.get("metadata") or {})
-        # the event revision is the object's resourceVersion (etcd3
-        # semantics; TypedWatch._hydrate stamps the same way)
-        meta["resourceVersion"] = str(ev.revision)
-        obj["metadata"] = meta
-        out = json.dumps({
-            "type": ev.type, "revision": ev.revision, "object": obj,
-        }).encode() + b"\n"
+        out = _FRAME_ENCODERS[encoding](ev)
+        wire_serializations.inc(encoding=encoding)
+        wire_encode_bytes.inc(len(out), encoding=encoding)
         with self._lock:
             self._memo[memo_key] = out
             self._order.append(memo_key)
             while len(self._order) > self._cap:
                 self._memo.pop(self._order.popleft(), None)
         return out
+
+
+# backward-compat alias (the memo predates the fan-out and multi-encoding
+# support; the generation default keeps the old call shape working)
+_RawEventMemo = _FrameMemo
+
+
+class _WatchSink:
+    """One watcher's registration with the hub fan-out: a PR-11 bounded
+    frame buffer plus the eviction state machine. The dispatcher thread
+    pushes shared frame BYTES (by reference — never re-serialized per
+    watcher) under `cv`; the handler thread is the writer, coalescing
+    queued frames into chunked socket writes. Eviction (byte budget
+    blown, or frames queued with no socket progress for `evict_after`)
+    marks the sink dead and hard-closes the connection — the close is
+    both the unblock for a writer wedged mid-`send` and the re-list
+    signal for the client's reflector."""
+
+    def __init__(self, prefix: str, encoding: str, max_bytes: int,
+                 evict_after: float, connection) -> None:
+        self.prefix = prefix
+        self.encoding = encoding
+        self.max_bytes = max(1, int(max_bytes))
+        self.evict_after = float(evict_after)
+        self._connection = connection
+        self.cv = threading.Condition()
+        self.buf: "_collections.deque" = _collections.deque()  # (bytes, ready)
+        self.bytes = 0
+        self.done = False      # stream over: flush what's queued, then EOF
+        self.dead = False      # stop now: no trailer, no more writes
+        self.evicted = False
+        self.last_drain = time.monotonic()
+        self.wid = f"w{next(_watch_ids)}"
+
+    def push(self, data: bytes, ready: Optional[float]) -> bool:
+        """False = the sink is dead (or this push evicted it)."""
+        with self.cv:
+            if self.dead:
+                return False
+            stalled = bool(self.buf) and (
+                time.monotonic() - self.last_drain > self.evict_after)
+            if self.bytes + len(data) > self.max_bytes or stalled:
+                self._evict_locked()
+                return False
+            self.buf.append((data, ready))
+            self.bytes += len(data)
+            watch_buffer_depth.set(len(self.buf), watcher=self.wid)
+            self.cv.notify_all()
+            return True
+
+    def check_stall(self, now: float) -> None:
+        """Dispatcher-side stall sweep: with the writer wedged inside a
+        blocking socket write it can never run its own clock, so the
+        fan-out evicts on its poll tick — frames queued, zero drain
+        progress for evict_after."""
+        with self.cv:
+            if (not self.dead and self.buf
+                    and now - self.last_drain > self.evict_after):
+                self._evict_locked()
+
+    def finish(self) -> None:
+        """End the stream cleanly (hub shutdown / store watch died)."""
+        with self.cv:
+            self.done = True
+            self.cv.notify_all()
+
+    def _evict_locked(self) -> None:
+        self.evicted = True
+        self.dead = True
+        watch_evictions.inc()
+        self.cv.notify_all()
+        # the writer may be wedged inside a socket write: a clean
+        # chunked trailer is impossible, and closing the socket is both
+        # the unblock and the client's re-list signal
+        try:
+            self._connection.close()
+        except OSError:
+            pass
+
+
+class _WatchFanout:
+    """Per-hub broadcast path: ONE dispatcher thread polls ONE shared
+    store watch and fans every event out to all registered sinks —
+    serialized exactly once per encoding in use (frame memo), prefix
+    matching done once per distinct (prefix, encoding) group, bytes
+    enqueued by reference. This replaces a store watch + producer thread
+    PER WATCHER: at 1000 watchers the old shape serialized every event
+    1000 times and woke 2000 threads; this shape serializes once or
+    twice and wakes the writers with shared bytes.
+
+    Gap-free attach: the shared watch is opened at the store's current
+    revision; a watcher arriving later replays (since_revision,
+    last_dispatched] out of the store's retained history UNDER THE
+    DISPATCH LOCK, then rides the live feed — no missed or duplicated
+    event, and a compacted since_revision raises kv.Compacted before
+    response headers (the 410 re-list contract)."""
+
+    def __init__(self, hub: "HTTPAPIServer", store) -> None:
+        self._hub = hub
+        self._store = store
+        self._lock = threading.Lock()
+        self._sinks: List[_WatchSink] = []
+        self._watch: Optional[kv.Watch] = None
+        self._thread: Optional[threading.Thread] = None
+        self._last_rev = 0
+        self._reopens = 0
+        self._stopped = False
+        self.memo = _FrameMemo()
+
+    @property
+    def generation(self) -> Tuple[int, int]:
+        """Frame-memo epoch: (dispatcher reopen count, store
+        incarnation). The incarnation term is read live so a crashed-and-
+        rebuilt store can never alias a re-minted (key, revision, type)
+        triple onto a stale cached frame, even before the dispatcher
+        notices its watch died."""
+        return (self._reopens, int(getattr(self._store, "incarnation", 0)))
+
+    def attach(self, sink: _WatchSink, since_revision: Optional[int]) -> None:
+        with self._lock:
+            self._ensure_dispatcher()
+            since = self._last_rev if since_revision is None else since_revision
+            gen = self.generation
+            # raises kv.Compacted -> the handler's 410 path, pre-headers
+            backlog = self._store.history_since(sink.prefix, since)
+            now = time.monotonic()
+            for ev in backlog:
+                if ev.revision > self._last_rev:
+                    break  # the live dispatch loop delivers the rest
+                sink.push(self.memo.encode(ev, gen, sink.encoding), now)
+            self._sinks.append(sink)
+
+    def detach(self, sink: _WatchSink) -> None:
+        with self._lock:
+            try:
+                self._sinks.remove(sink)
+            except ValueError:
+                pass
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            w, self._watch = self._watch, None
+            sinks = list(self._sinks)
+        if w is not None:
+            w.stop()
+        for s in sinks:
+            s.finish()
+
+    def _ensure_dispatcher(self) -> None:
+        """Caller holds self._lock."""
+        if self._watch is not None or self._stopped:
+            return
+        # opening at the CURRENT revision makes the live feed start
+        # exactly where attach()'s history replay ends: zero gap
+        self._last_rev = self._store.revision
+        self._watch = self._store.watch("", since_revision=self._last_rev)
+        self._reopens += 1
+        self._thread = threading.Thread(
+            target=self._run, args=(self._watch,),
+            name="watch-fanout", daemon=True)
+        self._thread.start()
+
+    def _run(self, w: kv.Watch) -> None:
+        hub = self._hub
+        last_sweep = time.monotonic()
+        while hub.running and not self._stopped:
+            ev = w.poll(timeout=0.25)
+            now = time.monotonic()
+            if ev is None:
+                if getattr(w, "closed", False):
+                    break
+                self._sweep(now)
+                last_sweep = now
+                continue
+            # micro-batch: drain what's already queued so prefix grouping
+            # and the per-sink push run once per burst, not per event
+            events = [ev]
+            while len(events) < 256:
+                nxt = w.poll(timeout=0)
+                if nxt is None:
+                    break
+                events.append(nxt)
+            with self._lock:
+                if self._watch is not w:
+                    return  # superseded (stop/reopen)
+                self._last_rev = events[-1].revision
+                gen = self.generation
+                groups: Dict[Tuple[str, str], List[_WatchSink]] = {}
+                for s in self._sinks:
+                    groups.setdefault((s.prefix, s.encoding), []).append(s)
+                wire_events.inc(len(events))
+                for (prefix, enc), sinks in groups.items():
+                    parts = [
+                        self.memo.encode(e, gen, enc)
+                        for e in events if e.key.startswith(prefix)
+                    ]
+                    if not parts:
+                        continue
+                    data = parts[0] if len(parts) == 1 else b"".join(parts)
+                    wire_frames.inc(len(parts) * len(sinks), encoding=enc)
+                    for s in sinks:
+                        s.push(data, now)
+            if now - last_sweep > 0.25:
+                self._sweep(now)
+                last_sweep = now
+        # the shared store watch died (crash recovery stops every
+        # stream) or the hub stopped: end every response so remote
+        # reflectors re-list instead of heartbeating forever
+        with self._lock:
+            if self._watch is w:
+                self._watch = None
+                self._thread = None
+                self._reopens += 1  # memo epoch: no stale-frame aliasing
+            sinks = list(self._sinks)
+        w.stop()
+        for s in sinks:
+            s.finish()
+
+    def _sweep(self, now: float) -> None:
+        with self._lock:
+            sinks = list(self._sinks)
+        for s in sinks:
+            s.check_stall(now)
 
 
 def _split_path(path: str) -> Tuple[str, str, str, str]:
@@ -324,6 +647,13 @@ class _Handler(BaseHTTPRequestHandler):
             return _RawFacade(api, resource)
         return api.resource(resource)
 
+    def _wire_encoding(self) -> str:
+        """Per-request content negotiation: ktpu-binary only when the
+        client's Accept names it; JSON is the default and the fallback
+        (an old or kill-switched client never sees binary bytes)."""
+        accept = self.headers.get("Accept", "")
+        return ENC_BINARY if MEDIA_BINARY in accept else ENC_JSON
+
     def _verb_get(self, resource, ns, name, sub, params) -> None:
         if resource == "pods" and sub == "log":
             api = self._client_api()
@@ -337,178 +667,260 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(200, serde.to_dict(client.get(name, ns)))
         if params.get("watch") in ("1", "true"):
             return self._stream_watch(client, ns, params)
+        if self._wire_encoding() == ENC_BINARY:
+            # binary LIST fast path: stream the raw store dicts straight
+            # into kv_list entries, skipping the per-item
+            # from_dict->to_dict round trip entirely (the dominant
+            # server-side list cost in the wire profile). Only on the
+            # hub's own plain api — a secure facade must keep running
+            # authz through client.list below.
+            hub = self.hub
+            store = getattr(hub.api, "store", None)
+            if hub.secure is None and store is not None:
+                info = hub.api._info(resource)
+                prefix = (f"/registry/{info.name}/{ns}/"
+                          if info.namespaced and ns
+                          else f"/registry/{info.name}/")
+                kvs, rev = store.list(prefix)
+                return self._stream_binary_list_raw(kvs, rev)
+            items, rev = client.list(namespace=ns or None)
+            return self._stream_binary_list(resource, items, rev)
         items, rev = client.list(namespace=ns or None)
         self._send_json(200, {
             "items": [serde.to_dict(o) for o in items],
             "metadata": {"resourceVersion": str(rev)},
         })
 
-    def _stream_watch(self, client, ns, params) -> None:
-        """Chunked streaming watch (watch.go ServeHTTP): one JSON line
-        per event until the client disconnects.
+    def _write_chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
 
-        Events stream from the RAW store watch when available: the store
-        already holds JSON dicts, so hydrating to a typed object and
-        re-serializing PER WATCHER was two serde round-trips of pure
-        overhead per event — at a 10k-pod bind wave with several
-        informers watching pods, the dominant wire-tax term. The encoded
-        frame is also memoized across watchers by (key, revision, type):
-        every watcher of the same prefix streams identical bytes.
+    def _stream_binary_list(self, resource, items, rev: int) -> None:
+        """Chunked binary LIST from decoded objects (the facade path —
+        secure hubs and foreign facades). The entry value is the serde
+        dict with resourceVersion already stamped by the list path, so
+        the client rebuilds the exact objects the JSON path would
+        carry."""
+        info = self.hub.api._info(resource)
 
-        Slow-consumer backpressure: the blocking socket writes happen on
-        a dedicated writer thread behind a BOUNDED frame buffer, so this
-        (producer) thread never blocks on a wedged peer. A watcher that
-        cannot drain — buffer past hub.watch_buffer_bytes, or no write
-        progress for hub.watch_evict_after seconds with frames queued —
-        is EVICTED: counted (apiserver_watch_evictions_total) and
-        hard-closed. Eviction is safe by the existing contract: the
-        client's RemoteWatch sees EOF, sets `closed`, and its reflector
-        recovers via re-list+re-watch; the alternative (one stalled
-        reader backpressuring the store's event hub) wedges every other
-        consumer."""
-        since = params.get("resourceVersion")
-        w = client.watch(
-            namespace=ns or None,
-            since_revision=int(since) if since else None,
-        )
-        raw = w.raw_events() if hasattr(w, "raw_events") else None
+        def entries():
+            for obj in items:
+                meta = obj.metadata
+                if info.namespaced:
+                    key = (f"/registry/{info.name}/{meta.namespace}"
+                           f"/{meta.name}")
+                else:
+                    key = f"/registry/{info.name}/{meta.name}"
+                yield (key, serde.to_dict(obj), 0,
+                       int(meta.resource_version or 0))
+
+        self._stream_snapshot(entries(), len(items), rev)
+
+    def _stream_binary_list_raw(self, kvs, rev: int) -> None:
+        """Chunked binary LIST straight from store KVs: the stored dict
+        is what from_dict would re-serialize, so frame it as-is with
+        resourceVersion stamped from mod_revision (exactly what
+        APIServer._stamp does after ITS from_dict) — zero serde on the
+        serving thread."""
+
+        def entries():
+            for kvv in kvs:
+                value = dict(kvv.value)
+                meta = dict(value.get("metadata") or {})
+                meta["resourceVersion"] = str(kvv.mod_revision)
+                value["metadata"] = meta
+                yield (kvv.key, value, kvv.create_revision,
+                       kvv.mod_revision)
+
+        self._stream_snapshot(entries(), len(kvs), rev)
+
+    def _stream_snapshot(self, entries, count: int, rev: int) -> None:
+        """The shared wire body: wal.py snapshot grammar — header, one
+        kv_list-framed entry per object (streamed in ~64KiB chunks
+        instead of one monolithic json.dumps), crc32 trailer."""
+        import zlib
+
         self.send_response(200)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", MEDIA_BINARY)
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
+        head = wal.snapshot_header(count, rev, 0)
+        crc = zlib.crc32(head)
+        pending = [head]
+        nbytes = len(head)
+        total = nbytes
+        for key, value, create_rev, mod_rev in entries:
+            entry = wal.encode_snapshot_entry(
+                key, value, create_rev, mod_rev)
+            crc = zlib.crc32(entry, crc)
+            pending.append(entry)
+            nbytes += len(entry)
+            total += len(entry)
+            if nbytes >= 64 * 1024:
+                self._write_chunk(b"".join(pending))
+                pending = []
+                nbytes = 0
+        pending.append(_pack_u32(crc))
+        self._write_chunk(b"".join(pending))
+        self.wfile.write(b"0\r\n\r\n")
+        wire_encode_bytes.inc(total + 4, encoding=ENC_BINARY)
 
-        if raw is not None:
-            w = raw
-            encode = self.hub.raw_event_memo.encode
-        else:
-            def encode(ev) -> bytes:
-                return json.dumps({
-                    "type": ev.type,
-                    "revision": ev.revision,
-                    "object": serde.to_dict(ev.object),
-                }).encode() + b"\n"
+    def _stream_watch(self, client, ns, params) -> None:
+        """Chunked streaming watch (watch.go ServeHTTP) over the hub's
+        shared fan-out.
 
+        The watch is SET UP through the per-request client facade —
+        authn/authz, flow control and the Compacted check all fire
+        exactly as before — but the per-watcher store watch it returns
+        is immediately released: events reach this stream through the
+        hub's _WatchFanout, which serializes each store event once per
+        encoding in use and enqueues the frame bytes by reference into
+        every matching watcher's bounded buffer. This HANDLER thread is
+        the stream's writer (one thread per watcher, not the old
+        producer+writer pair): it coalesces queued frames into chunked
+        socket writes — byte-bounded at a quarter of the buffer budget,
+        frame-bounded by KTPU_WIRE_BATCH_FRAMES — writes heartbeats from
+        the per-media precomputed constant on idle ticks, and observes
+        the delivery SLI after each flush.
+
+        Slow-consumer backpressure is PR-11's contract unchanged: a
+        watcher whose buffer passes hub.watch_buffer_bytes, or holds
+        frames with no socket progress for hub.watch_evict_after
+        seconds, is EVICTED — counted and hard-closed, with the fan-out
+        sweeping stall clocks so a writer wedged inside send() still
+        gets evicted. Eviction is safe: the client's RemoteWatch sees
+        EOF, sets `closed`, and its reflector re-lists."""
+        since = params.get("resourceVersion")
+        since_rev = int(since) if since else None
+        w = client.watch(namespace=ns or None, since_revision=since_rev)
+        raw = w.raw_events() if hasattr(w, "raw_events") else None
         hub = self.hub
-        max_bytes = max(1, int(getattr(hub, "watch_buffer_bytes",
-                                       256 * 1024)))
-        evict_after = float(getattr(hub, "watch_evict_after", 10.0))
-        cv = threading.Condition()
-        buf: _collections.deque = _collections.deque()
-        state = {"bytes": 0, "done": False, "dead": False,
-                 "evicted": False, "last_drain": time.monotonic()}
-        wid = f"w{next(_watch_ids)}"
-
-        def writer() -> None:
-            try:
-                while True:
-                    with cv:
-                        while (not buf and not state["done"]
-                               and not state["dead"]):
-                            cv.wait(0.2)
-                        if state["dead"] or (state["done"] and not buf):
-                            return
-                        data, ready = buf.popleft()
-                        state["bytes"] -= len(data)
-                        watch_buffer_depth.set(len(buf), watcher=wid)
-                    # a slow reader blocks HERE, on this thread — never
-                    # the producer loop feeding from the store's hub
-                    self.wfile.write(
-                        f"{len(data):x}\r\n".encode() + data + b"\r\n")
-                    self.wfile.flush()
-                    if ready is not None:
-                        # event-ready -> socket-write SLI, observed only
-                        # AFTER the flush (heartbeats carry ready=None)
-                        watch_delivery.observe(time.monotonic() - ready)
-                    with cv:
-                        state["last_drain"] = time.monotonic()
-            except (BrokenPipeError, ConnectionResetError, OSError):
-                pass
-            finally:
-                with cv:
-                    state["dead"] = True
-                    cv.notify_all()
-
-        wt = threading.Thread(target=writer, name="watch-writer",
-                              daemon=True)
-        wt.start()
+        fanout = hub.fanout
+        if raw is None or fanout is None:
+            return self._stream_watch_direct(w)
+        encoding = self._wire_encoding()
+        prefix = getattr(raw, "_prefix", "")
+        # authz/flow-control/Compacted all checked above; the fan-out's
+        # shared watch carries the events from here
+        w.stop()
+        sink = _WatchSink(
+            prefix, encoding,
+            max_bytes=getattr(hub, "watch_buffer_bytes", 256 * 1024),
+            evict_after=getattr(hub, "watch_evict_after", 10.0),
+            connection=self.connection,
+        )
+        # raises kv.Compacted -> 410 while headers are still unsent
+        fanout.attach(sink, since_rev)
+        try:
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                MEDIA_BINARY if encoding == ENC_BINARY else MEDIA_JSON)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            fanout.detach(sink)
+            self.close_connection = True
+            return
         hub.watcher_started()
-
-        def enqueue(data: bytes, ready: Optional[float] = None) -> bool:
-            """False = this watcher is dead or just got evicted; the
-            producer loop stops. `ready` stamps when the frame's events
-            came off the hub (None for heartbeats) for the delivery SLI."""
+        heartbeat = _HEARTBEATS[encoding]
+        batch_frames = max(1, int(getattr(hub, "wire_batch_frames", 512)))
+        byte_cap = sink.max_bytes // 4
+        cv = sink.cv
+        buf = sink.buf
+        try:
+            while True:
+                parts: List[bytes] = []
+                ready_list: List[float] = []
+                with cv:
+                    if not buf and not sink.done and not sink.dead:
+                        cv.wait(0.5)
+                    if sink.dead:
+                        return
+                    if not hub.running:
+                        sink.done = True
+                    if buf:
+                        nbytes = 0
+                        while (buf and len(parts) < batch_frames
+                               and nbytes < byte_cap):
+                            data, ready = buf.popleft()
+                            parts.append(data)
+                            nbytes += len(data)
+                            if ready is not None:
+                                ready_list.append(ready)
+                        sink.bytes -= nbytes
+                        watch_buffer_depth.set(len(buf), watcher=sink.wid)
+                    elif sink.done:
+                        return
+                    else:
+                        # idle tick: the precomputed heartbeat keeps dead
+                        # peers detectable (and excluded from the SLI)
+                        parts.append(heartbeat)
+                # a slow reader blocks HERE, on this handler thread —
+                # never the fan-out dispatcher feeding every watcher
+                self._write_chunk(
+                    parts[0] if len(parts) == 1 else b"".join(parts))
+                self.wfile.flush()
+                if ready_list:
+                    # event-ready -> socket-write SLI, observed only
+                    # AFTER the flush (heartbeats carry no timestamp)
+                    now = time.monotonic()
+                    for r in ready_list:
+                        watch_delivery.observe(now - r)
+                with cv:
+                    sink.last_drain = time.monotonic()
+        except (BrokenPipeError, ConnectionResetError, OSError):
             with cv:
-                if state["dead"]:
-                    return False
-                stalled = bool(buf) and (
-                    time.monotonic() - state["last_drain"] > evict_after)
-                if state["bytes"] + len(data) > max_bytes or stalled:
-                    state["evicted"] = True
-                    state["dead"] = True
-                    cv.notify_all()
-                    return False
-                buf.append((data, ready))
-                state["bytes"] += len(data)
-                watch_buffer_depth.set(len(buf), watcher=wid)
-                cv.notify_all()
-                return True
+                sink.dead = True
+        finally:
+            fanout.detach(sink)
+            if not sink.evicted and not sink.dead:
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+            elif sink.evicted:
+                # eviction already hard-closed the socket; nothing to
+                # flush — the EOF/RST IS the client's re-list signal
+                pass
+            self.close_connection = True
+            watch_buffer_depth.remove(watcher=sink.wid)
+            hub.watcher_finished()
 
+    def _stream_watch_direct(self, w) -> None:
+        """Fallback for watches with no raw store feed (no fan-out):
+        hydrate-and-serialize per event on this thread. No production
+        path lands here — both client facades return TypedWatch — but
+        the wire stays correct for foreign facades."""
+        self.send_response(200)
+        self.send_header("Content-Type", MEDIA_JSON)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        hub = self.hub
+        hub.watcher_started()
         try:
             while hub.running:
                 ev = w.poll(timeout=0.5)
                 if ev is None:
                     if getattr(w, "closed", False):
-                        # the store-side watch died (apiserver crash
-                        # recovery stops every stream): end the response
-                        # so the remote reflector re-lists instead of
-                        # heartbeating against a dead watch forever
                         break
-                    # heartbeat keeps dead peers detectable — and runs
-                    # the stall clock against a blocked reader even on
-                    # an idle watch
-                    if not enqueue(b" \n"):
-                        break
-                    continue
-                # drain everything already queued into ONE chunk: a
-                # 2048-pod bind wave is 2048 MODIFIED events, and one
-                # frame+flush per event made the watch stream the wire
-                # path's throughput ceiling (the client's readline loop
-                # splits lines, so framing is free to batch)
-                ready_ts = time.monotonic()
-                batch = [encode(ev)]
-                nbytes = len(batch[0])
-                # byte-bounded too: one joined chunk past the watcher's
-                # whole budget would evict even a fast consumer
-                while len(batch) < 512 and nbytes < max_bytes // 4:
-                    ev = w.poll(timeout=0)
-                    if ev is None:
-                        break
-                    batch.append(encode(ev))
-                    nbytes += len(batch[-1])
-                if not enqueue(b"".join(batch), ready=ready_ts):
-                    break
+                    data = HEARTBEAT_JSON
+                else:
+                    data = json.dumps({
+                        "type": ev.type,
+                        "revision": ev.revision,
+                        "object": serde.to_dict(ev.object),
+                    }).encode() + b"\n"
+                self._write_chunk(data)
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
         finally:
             w.stop()
-            with cv:
-                state["done"] = True
-                cv.notify_all()
-            if state["evicted"]:
-                watch_evictions.inc()
-                # the writer may be wedged inside a socket write: a
-                # clean chunked trailer is impossible, and closing the
-                # socket is both the unblock and the re-list signal
-                try:
-                    self.connection.close()
-                except OSError:
-                    pass
-            wt.join(timeout=5)
-            if not state["evicted"]:
-                try:
-                    self.wfile.write(b"0\r\n\r\n")
-                except OSError:
-                    pass
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
             self.close_connection = True
-            watch_buffer_depth.remove(watcher=wid)
             hub.watcher_finished()
 
     def _verb_post(self, resource, ns, name, sub, params) -> None:
@@ -631,6 +1043,16 @@ class _RawFacade:
         return self._api.watch(self._resource, namespace, since_revision)
 
 
+class _WatchHTTPServer(ThreadingHTTPServer):
+    # A watch hub takes hundreds of reflector connects in one burst
+    # (cold start: every component re-lists and re-watches at once).
+    # The stdlib backlog of 5 turns that burst into SYN-retransmit
+    # stalls — measured ~136ms PER CONNECT on the bench box, 166s to
+    # attach 1000 watchers — so listen deep; the kernel clamps to
+    # net.core.somaxconn anyway.
+    request_queue_size = 1024
+
+
 class HTTPAPIServer:
     """Serve an APIServer (or SecureAPIServer) on a real socket."""
 
@@ -643,19 +1065,27 @@ class HTTPAPIServer:
             api = secure.api
         self.secure = secure
         self.api = api or (secure.api if secure else APIServer())
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd = _WatchHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.hub = self  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
         self.running = False
-        # per-hub: (key, revision, type) is unique only within one store
-        self.raw_event_memo = _RawEventMemo()
+        # per-hub broadcast path: ONE shared store watch fans out to
+        # every stream, frames serialized once per encoding (the memo
+        # lives on the fanout; per-hub because (key, revision, type) is
+        # unique only within one store)
+        store = getattr(self.api, "store", None)
+        self.fanout = _WatchFanout(self, store) if store is not None else None
+        self.raw_event_memo = (
+            self.fanout.memo if self.fanout is not None else _FrameMemo())
         # slow-consumer backpressure knobs (_stream_watch): bounded
         # per-watcher send buffer + max stall before eviction. Tests
         # shrink these per-hub; production tunes via env.
         self.watch_buffer_bytes = int(knobs.get_int("KTPU_WATCH_BUFFER"))
         self.watch_evict_after = float(
             knobs.get_float("KTPU_WATCH_EVICT_AFTER"))
+        self.wire_batch_frames = int(
+            knobs.get_int("KTPU_WIRE_BATCH_FRAMES"))
         self._watch_lock = threading.Lock()
         self.watcher_count = 0  # live streams on THIS hub
         from ..utils import configz
@@ -664,6 +1094,7 @@ class HTTPAPIServer:
             "apiserver",
             watch_buffer_bytes=self.watch_buffer_bytes,
             watch_evict_after=self.watch_evict_after,
+            wire_batch_frames=self.wire_batch_frames,
         )
 
     def watcher_started(self) -> None:
@@ -692,6 +1123,8 @@ class HTTPAPIServer:
 
     def stop(self) -> None:
         self.running = False
+        if self.fanout is not None:
+            self.fanout.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
@@ -705,7 +1138,12 @@ class HTTPAPIServer:
 class RemoteWatch:
     """TypedWatch-compatible stream over a chunked HTTP watch response:
     a reader thread feeds a queue; poll()/stop() match the in-proc
-    contract informers consume (client/informer.py reflector)."""
+    contract informers consume (client/informer.py reflector).
+
+    The reader speaks whichever encoding the response negotiated: JSON
+    lines (default), or ktpu-binary — the store/wal.py record grammar
+    decoded incrementally off the socket (iter_records stops cleanly at
+    an incomplete tail, so records may straddle reads freely)."""
 
     def __init__(self, conn_factory, typ):
         self._typ = typ
@@ -716,27 +1154,61 @@ class RemoteWatch:
         # not an eternally-stale cache
         self.closed = False
         self._resp = conn_factory()
+        ctype = ""
+        try:
+            ctype = self._resp.getheader("Content-Type") or ""
+        except Exception:  # noqa: BLE001 — non-http.client responses
+            pass
+        self.binary = ctype.startswith(MEDIA_BINARY)
         self._thread = threading.Thread(target=self._read_loop, daemon=True)
         self._thread.start()
 
     def _read_loop(self) -> None:
+        import http.client
+
         try:
-            while not self._stopped.is_set():
-                line = self._resp.readline()
-                if not line:
-                    break
-                line = line.strip()
-                if not line:
-                    continue
-                raw = json.loads(line)
-                obj = serde.from_dict(self._typ, raw["object"])
-                self._q.put(WatchEvent(raw["type"], obj, raw["revision"]))
-        except (OSError, ValueError, AttributeError):
+            if self.binary:
+                self._read_binary()
+            else:
+                self._read_json()
+        except (OSError, ValueError, AttributeError,
+                http.client.HTTPException):
             # AttributeError: http.client internals after a concurrent
-            # close() from stop() — normal shutdown, not an error
+            # close() from stop(); IncompleteRead: the server hard-closed
+            # mid-chunk (eviction) — both are the EOF the reflector acts
+            # on, not errors
             pass
         finally:
             self.closed = True
+
+    def _read_json(self) -> None:
+        while not self._stopped.is_set():
+            line = self._resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            raw = json.loads(line)
+            obj = serde.from_dict(self._typ, raw["object"])
+            self._q.put(WatchEvent(raw["type"], obj, raw["revision"]))
+
+    def _read_binary(self) -> None:
+        buf = b""
+        while not self._stopped.is_set():
+            chunk = self._resp.read1(1 << 16)
+            if not chunk:
+                break
+            buf += chunk
+            end = 0
+            for rec, off in wal.iter_records(buf):
+                end = off
+                if rec.op == wal.OP_HEARTBEAT:
+                    continue
+                obj = serde.from_dict(self._typ, rec.value)
+                self._q.put(WatchEvent(_OP_TO_TYPE[rec.op], obj, rec.rev))
+            if end:
+                buf = buf[end:]
 
     def poll(self, timeout: Optional[float] = None):
         try:
@@ -785,6 +1257,19 @@ class RemoteAPIServer:
             resources = _default_resources()
         self._resources: Dict[str, ResourceInfo] = {r.name: r for r in resources}
         self._local = threading.local()  # per-thread keep-alive connection
+        # negotiate the binary wire for watch/list by default; the
+        # KTPU_WIRE_BINARY=0 kill switch drops the Accept header
+        # entirely, restoring the exact pre-binary requests and (JSON)
+        # response bytes. Servers without binary support just answer
+        # JSON — Accept is a preference, not a demand.
+        self.wire_binary = bool(knobs.get_bool("KTPU_WIRE_BINARY"))
+        # single-DESERIALIZE mirror of the server's single-serialize: a
+        # (storage key, mod_revision) pair names an immutable snapshot,
+        # so repeated binary LISTs (poll loops, reflector re-syncs)
+        # skip serde for every unchanged entry. Same sharing contract
+        # as the informer cache: callers must not mutate listed
+        # objects. Crude bound — a re-decode is cheap, a leak is not.
+        self._decode_memo: Dict[Tuple[str, int], Any] = {}
 
     # -- plumbing ----------------------------------------------------------
 
@@ -847,11 +1332,18 @@ class RemoteAPIServer:
                 self._local.conn = None
 
     def _request(self, method: str, path: str, body: Optional[Dict] = None,
-                 query: str = "") -> Dict:
+                 query: str = "", accept: str = "",
+                 raw_response: bool = False):
+        """JSON request/response by default; `accept` adds content
+        negotiation and `raw_response` returns (bytes, content_type)
+        for 2xx instead of a parsed dict (error bodies are always JSON
+        Status objects regardless of Accept)."""
         import http.client
 
         payload = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"}
+        if accept:
+            headers["Accept"] = accept
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
         url = path + (f"?{query}" if query else "")
@@ -885,13 +1377,15 @@ class RemoteAPIServer:
                 # server said Connection: close (error responses do):
                 # drop now so the next request gets a fresh NODELAY socket
                 self._drop_conn()
-            data = json.loads(raw) if raw else {}
             if resp.status >= 400:
+                data = json.loads(raw) if raw else {}
                 raise self._error(
                     resp.status, data.get("message", ""),
                     data.get("reason", ""),
                 )
-            return data
+            if raw_response:
+                return raw, (resp.getheader("Content-Type") or "")
+            return json.loads(raw) if raw else {}
 
     @staticmethod
     def _error(code: int, message: str, reason: str = ""):
@@ -984,14 +1478,38 @@ class RemoteAPIServer:
     def list(self, resource: str, namespace: Optional[str] = None,
              label_selector=None) -> Tuple[List[Any], int]:
         info = self._info(resource)
-        data = self._request("GET", self._path(info, namespace or ""))
-        items = [serde.from_dict(info.type, d) for d in data.get("items", [])]
+        path = self._path(info, namespace or "")
+        if self.wire_binary:
+            raw, ctype = self._request(
+                "GET", path, accept=MEDIA_BINARY, raw_response=True)
+            if ctype.startswith(MEDIA_BINARY):
+                entries, rev, _ = wal.decode_snapshot(raw, label=path)
+                memo = self._decode_memo
+                if len(memo) > 65536:
+                    memo.clear()
+                items = []
+                for key, value, _crev, mrev in entries:
+                    obj = memo.get((key, mrev))
+                    if obj is None:
+                        obj = serde.from_dict(info.type, value)
+                        memo[(key, mrev)] = obj
+                    items.append(obj)
+            else:  # older server: negotiated down to JSON
+                data = json.loads(raw) if raw else {}
+                items = [serde.from_dict(info.type, d)
+                         for d in data.get("items", [])]
+                rev = int(data.get("metadata", {})
+                          .get("resourceVersion", "0"))
+        else:
+            data = self._request("GET", path)
+            items = [serde.from_dict(info.type, d)
+                     for d in data.get("items", [])]
+            rev = int(data.get("metadata", {}).get("resourceVersion", "0"))
         if label_selector is not None:
             items = [
                 o for o in items
                 if label_selector.matches(o.metadata.labels or {})
             ]
-        rev = int(data.get("metadata", {}).get("resourceVersion", "0"))
         return items, rev
 
     def watch(self, resource: str, namespace: Optional[str] = None,
@@ -1007,6 +1525,8 @@ class RemoteAPIServer:
         def connect():
             conn = http.client.HTTPConnection(self._host, self._port)
             headers = {}
+            if self.wire_binary:
+                headers["Accept"] = MEDIA_BINARY
             if self.token:
                 headers["Authorization"] = f"Bearer {self.token}"
             conn.request("GET", f"{path}?{query}", headers=headers)

@@ -121,6 +121,13 @@ class KVStore:
     #: layer probes before trusting guaranteed_update to be race-free
     supports_precondition = True
 
+    #: bumped by crashing store facades (DurableKVStore) each time the
+    #: live state is rebuilt; a plain in-memory store never restarts.
+    #: Consumers (the HTTP fan-out's frame memo) fold it into cache keys
+    #: so a (key, revision, type) triple re-minted by a rollback can
+    #: never alias a stale cached frame.
+    incarnation = 0
+
     def __init__(self, history_limit: int = 100_000):
         self._lock = threading.RLock()
         self._data: Dict[str, KeyValue] = {}
@@ -251,6 +258,25 @@ class KVStore:
             self._watches.append(w)
             return w
 
+    def history_since(
+        self, prefix: str = "", since_revision: int = 0,
+    ) -> List[Event]:
+        """Retained events with revision > since_revision under prefix —
+        the watch() replay as a value, for fan-out hubs that attach a
+        late watcher to an already-running shared stream: replay the gap
+        under the store lock, then ride the shared live feed with no
+        missed or duplicated event. Raises Compacted exactly as watch()
+        would."""
+        with self._lock:
+            if since_revision < self._compacted_rev:
+                raise Compacted(
+                    f"revision {since_revision} compacted (floor {self._compacted_rev})"
+                )
+            return [
+                ev for ev in self._history
+                if ev.revision > since_revision and ev.key.startswith(prefix)
+            ]
+
     def _remove_watch(self, w: Watch) -> None:
         with self._lock:
             try:
@@ -320,6 +346,7 @@ class DurableKVStore:
         # one writer lock over apply+log keeps WAL order == revision order
         self._dlock = threading.RLock()
         self._records_since_snapshot = 0
+        self.incarnation = 0
         self._inner = self._rebuild()
         self._writer = wal.WALWriter(self._wal_path, fsync=fsync)
 
@@ -411,6 +438,12 @@ class DurableKVStore:
         # re-listing
         with self._dlock:
             return self._inner.watch(prefix, since_revision)
+
+    def history_since(
+        self, prefix: str = "", since_revision: int = 0,
+    ) -> List[Event]:
+        with self._dlock:
+            return self._inner.history_since(prefix, since_revision)
 
     # -- writes: apply, then log before acknowledging ----------------------
 
@@ -516,6 +549,10 @@ class DurableKVStore:
             self._inner = self._rebuild()
             self._writer = wal.WALWriter(self._wal_path, fsync=self._fsync)
             self._records_since_snapshot = 0
+            # the rebuilt store can re-mint (key, revision) pairs the old
+            # incarnation already emitted (fsync=False rollback); anyone
+            # caching per-revision artifacts must treat this as an epoch
+            self.incarnation += 1
         with old._lock:
             watches = list(old._watches)
         for w in watches:

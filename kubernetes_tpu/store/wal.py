@@ -37,6 +37,10 @@ OP_CREATE = 0  # matches the native event type ids (kvstore.cpp)
 OP_UPDATE = 1
 OP_DELETE = 2
 OP_COMPACT = 3
+# wire-only op: the HTTP watch path frames its keep-alive ticks in the
+# same record grammar so a binary stream is records all the way down.
+# Never valid on disk — WAL replay knows only ops 0..3.
+OP_HEARTBEAT = 4
 
 _FRAME = struct.Struct("<II")  # payload length, crc32(payload)
 _U32 = struct.Struct("<I")
@@ -161,45 +165,55 @@ def rewrite(path: str, records: List[Record]) -> None:
     _atomic_write(path, b"".join(encode_record(r) for r in records))
 
 
+def snapshot_header(count: int, rev: int, compacted_rev: int) -> bytes:
+    return (_SNAP_MAGIC + _U32.pack(_SNAP_VERSION)
+            + _SNAP_HEAD.pack(rev, compacted_rev, count))
+
+
+def encode_snapshot_entry(
+    key: str, value: Any, create_rev: int, mod_rev: int,
+) -> bytes:
+    """One kv_list-framed entry — the unit the HTTP binary list streams."""
+    k = key.encode()
+    val = json.dumps(value).encode()
+    return (_U32.pack(len(k)) + k + _U32.pack(len(val)) + val
+            + _ENTRY_REVS.pack(create_rev, mod_rev))
+
+
+def encode_snapshot(
+    items: List[Tuple[str, Any, int, int]],  # (key, value, create_rev, mod_rev)
+    rev: int,
+    compacted_rev: int,
+) -> bytes:
+    body = bytearray()
+    body += snapshot_header(len(items), rev, compacted_rev)
+    for key, value, create_rev, mod_rev in items:
+        body += encode_snapshot_entry(key, value, create_rev, mod_rev)
+    body += _U32.pack(zlib.crc32(bytes(body)))
+    return bytes(body)
+
+
 def write_snapshot(
     path: str,
     items: List[Tuple[str, Any, int, int]],  # (key, value, create_rev, mod_rev)
     rev: int,
     compacted_rev: int,
 ) -> None:
-    body = bytearray()
-    body += _SNAP_MAGIC
-    body += _U32.pack(_SNAP_VERSION)
-    body += _SNAP_HEAD.pack(rev, compacted_rev, len(items))
-    for key, value, create_rev, mod_rev in items:
-        k = key.encode()
-        val = json.dumps(value).encode()
-        body += _U32.pack(len(k)) + k
-        body += _U32.pack(len(val)) + val
-        body += _ENTRY_REVS.pack(create_rev, mod_rev)
-    body += _U32.pack(zlib.crc32(bytes(body)))
-    _atomic_write(path, bytes(body))
+    _atomic_write(path, encode_snapshot(items, rev, compacted_rev))
 
 
-def read_snapshot(
-    path: str,
-) -> Optional[Tuple[List[Tuple[str, Any, int, int]], int, int]]:
-    """-> (items, rev, compacted_rev), or None when no snapshot exists.
-    Raises WALError on corruption: snapshots are written atomically, so a
-    bad one is disk damage, not a crash artifact."""
-    try:
-        with open(path, "rb") as f:
-            buf = f.read()
-    except FileNotFoundError:
-        return None
+def decode_snapshot(
+    buf: bytes, label: str = "<buf>",
+) -> Tuple[List[Tuple[str, Any, int, int]], int, int]:
+    """-> (items, rev, compacted_rev). Raises WALError on corruption."""
     head_len = len(_SNAP_MAGIC) + _U32.size + _SNAP_HEAD.size
     if len(buf) < head_len + _U32.size or buf[:4] != _SNAP_MAGIC:
-        raise WALError(f"snapshot {path}: bad magic/size")
+        raise WALError(f"snapshot {label}: bad magic/size")
     if zlib.crc32(buf[:-4]) != _U32.unpack_from(buf, len(buf) - 4)[0]:
-        raise WALError(f"snapshot {path}: checksum mismatch")
+        raise WALError(f"snapshot {label}: checksum mismatch")
     version = _U32.unpack_from(buf, 4)[0]
     if version != _SNAP_VERSION:
-        raise WALError(f"snapshot {path}: unknown version {version}")
+        raise WALError(f"snapshot {label}: unknown version {version}")
     rev, compacted_rev, count = _SNAP_HEAD.unpack_from(buf, 8)
     off = head_len
     items: List[Tuple[str, Any, int, int]] = []
@@ -215,8 +229,22 @@ def read_snapshot(
             off += _ENTRY_REVS.size
             items.append((key, value, create_rev, mod_rev))
     except (struct.error, ValueError, UnicodeDecodeError) as e:
-        raise WALError(f"snapshot {path}: truncated entries: {e}")
+        raise WALError(f"snapshot {label}: truncated entries: {e}")
     return items, rev, compacted_rev
+
+
+def read_snapshot(
+    path: str,
+) -> Optional[Tuple[List[Tuple[str, Any, int, int]], int, int]]:
+    """-> (items, rev, compacted_rev), or None when no snapshot exists.
+    Raises WALError on corruption: snapshots are written atomically, so a
+    bad one is disk damage, not a crash artifact."""
+    try:
+        with open(path, "rb") as f:
+            buf = f.read()
+    except FileNotFoundError:
+        return None
+    return decode_snapshot(buf, label=path)
 
 
 class WALWriter:

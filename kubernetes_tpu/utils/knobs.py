@@ -294,6 +294,12 @@ _declare("KTPU_WATCH_BUFFER", "int", 256 * 1024,
 _declare("KTPU_WATCH_EVICT_AFTER", "float", 10.0,
          "max seconds a watcher may hold queued frames with zero socket "
          "progress before eviction")
+_declare("KTPU_WIRE_BINARY", "bool", True,
+         "clients negotiate the ktpu-binary wire encoding for watch/list "
+         "(0 = kill switch: plain JSON, the pre-binary wire bytes)")
+_declare("KTPU_WIRE_BATCH_FRAMES", "int", 512,
+         "max queued watch frames coalesced into one chunked socket "
+         "write (byte-bounded at a quarter of KTPU_WATCH_BUFFER)")
 
 # -- scheduler failover / leader election
 _declare("KTPU_LEASE_FENCE_MARGIN", "float", 2.0,

@@ -285,6 +285,20 @@ def main() -> int:
                         f"trace_report timeline/span reconciliation "
                         f"failed for {args.devtime}")
 
+    # wire smoke row: a small fan-out probe (8 watchers, both encodings
+    # plus the mixed pass) — the single-serialize and eviction contracts
+    # must hold even right after a drill's worth of global metric churn
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import probe_wire
+
+    wire_rows, wire_failures = probe_wire.run_probe(
+        [8], writers=2, events=120, slack=4.0, timeout=60)
+    for row in wire_rows:
+        print(f"wire probe:       {row['name']} "
+              f"p99={row['delivery_p99_s'] * 1e3:.1f}ms "
+              f"ser/event={row['serializations_per_event']:.2f}")
+    failures.extend(f"wire probe: {f}" for f in wire_failures)
+
     if failures:
         print("FAIL:\n  " + "\n  ".join(failures))
         return 1

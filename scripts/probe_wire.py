@@ -1,0 +1,350 @@
+#!/usr/bin/env python3
+"""Standalone wire fan-out probe (ISSUE 18): N raw-socket watchers x M
+in-proc writers against a fresh HTTPAPIServer, per encoding.
+
+What it asserts (exit 1 on violation):
+
+  * SINGLE SERIALIZE — apiserver_wire_serializations_total advances by
+    exactly ONE per event per encoding IN USE, never per watcher: the
+    hub's broadcast path serializes once and pushes frame bytes by
+    reference. A mixed pass (half binary, half JSON watchers) must show
+    exactly 2 serializations per event, one per encoding.
+  * DELIVERY — every watcher received every event (delivery-histogram
+    count delta == events x watchers) with zero evictions.
+  * NO P99 REGRESSION — the binary pass's windowed delivery p99
+    (bucket-delta p99 of apiserver_watch_delivery_seconds) must not
+    exceed slack x the JSON pass measured in the same run (the live
+    JSON baseline), unless both sit under an absolute floor where the
+    comparison is bucket noise.
+
+Usage: python scripts/probe_wire.py [--watchers 100,1000] [--writers 2]
+           [--events 200] [--slack 2.0] [--json OUT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import selectors
+import socket
+import sys
+import threading
+import time
+from typing import Dict, List, Tuple
+from urllib.parse import urlsplit
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from kubernetes_tpu.api import types as v1  # noqa: E402
+from kubernetes_tpu.apiserver import APIServer  # noqa: E402
+from kubernetes_tpu.apiserver.http import (  # noqa: E402
+    HTTPAPIServer,
+    MEDIA_BINARY,
+)
+from kubernetes_tpu.testing.invariants import (  # noqa: E402
+    bucket_counts,
+    parse_metrics,
+    total,
+    window_p99,
+)
+from kubernetes_tpu.utils import configz  # noqa: E402
+
+DELIVERY = "apiserver_watch_delivery_seconds"
+FRAMES = "apiserver_wire_frames_total"
+# below this absolute p99 the binary-vs-json comparison is bucket noise
+# on the 1-core box, not a regression signal
+P99_FLOOR_S = 0.05
+
+
+def _make_pod(name: str) -> v1.Pod:
+    return v1.Pod(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        spec=v1.PodSpec(containers=[v1.Container(
+            name="c", resources=v1.ResourceRequirements(
+                requests={"cpu": "10m"}))]),
+    )
+
+
+def _snapshot() -> Dict[str, float]:
+    return parse_metrics(configz.metricsz_body())
+
+
+def _delivered(reading: Dict[str, float]) -> float:
+    return bucket_counts(reading, DELIVERY).get(float("inf"), 0.0)
+
+
+def _frames(reading: Dict[str, float]) -> float:
+    # one frame per event per sink, counted at push time across encodings
+    return total(reading, FRAMES)
+
+
+class _Drainer:
+    """One selector thread draining every watcher socket (1-core box:
+    one poll loop beats a thread per socket on the CLIENT side; the
+    server side is the thread-per-watcher under test)."""
+
+    def __init__(self) -> None:
+        self.sel = selectors.DefaultSelector()
+        self.bytes_rx = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True,
+                                   name="probe-drainer")
+        self._t.start()
+
+    def add(self, sock: socket.socket) -> None:
+        sock.setblocking(False)
+        self.sel.register(sock, selectors.EVENT_READ)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            for key, _ in self.sel.select(timeout=0.2):
+                try:
+                    data = key.fileobj.recv(1 << 16)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    data = b""
+                if not data:
+                    try:
+                        self.sel.unregister(key.fileobj)
+                    except (KeyError, ValueError):
+                        pass
+                    continue
+                self.bytes_rx += len(data)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._t.join(timeout=5)
+        for key in list(self.sel.get_map().values()):
+            try:
+                key.fileobj.close()
+            except OSError:
+                pass
+        self.sel.close()
+
+
+def _attach_watchers(
+    address: str, n: int, binary: bool, drainer: _Drainer,
+) -> List[socket.socket]:
+    split = urlsplit(address)
+    accept = f"Accept: {MEDIA_BINARY}\r\n" if binary else ""
+    req = ("GET /api/v1/namespaces/default/pods?watch=true HTTP/1.1\r\n"
+           f"Host: {split.hostname}\r\n{accept}\r\n").encode()
+    socks = []
+    for _ in range(n):
+        s = socket.create_connection((split.hostname, split.port),
+                                     timeout=10)
+        s.sendall(req)
+        drainer.add(s)
+        socks.append(s)
+    return socks
+
+
+def run_pass(
+    watchers: int,
+    writers: int,
+    events: int,
+    mixed: bool = False,
+    binary: bool = False,
+    n_pods: int = 32,
+    timeout: float = 180.0,
+) -> dict:
+    """One encoding pass: fresh server, attach, write, drain, measure.
+    Returns the row dict; raises AssertionError on a contract breach."""
+    server = HTTPAPIServer(APIServer())
+    server.start()
+    drainer = _Drainer()
+    encodings = (("binary", "json") if mixed
+                 else (("binary",) if binary else ("json",)))
+    label = "+".join(encodings)
+    try:
+        api = server.api
+        pods = [api.create("pods", _make_pod(f"w{i}"))
+                for i in range(n_pods)]
+        if mixed:
+            _attach_watchers(server.address, watchers // 2, True, drainer)
+            _attach_watchers(server.address, watchers - watchers // 2,
+                             False, drainer)
+        else:
+            _attach_watchers(server.address, watchers, binary, drainer)
+        deadline = time.monotonic() + timeout
+        while server.watcher_count < watchers:
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"only {server.watcher_count}/{watchers} watchers "
+                    "attached before timeout")
+            time.sleep(0.02)
+
+        before = _snapshot()
+        quotas = [events // writers + (1 if k < events % writers else 0)
+                  for k in range(writers)]
+
+        def _writer(k: int) -> None:
+            mine = pods[k::writers] or pods
+            cur = list(mine)
+            for i in range(quotas[k]):
+                pod = cur[i % len(cur)]
+                pod.metadata.annotations = {"seq": f"{k}.{i}"}
+                cur[i % len(cur)] = api.update("pods", pod)
+
+        t0 = time.monotonic()
+        ws = [threading.Thread(target=_writer, args=(k,), daemon=True)
+              for k in range(writers)]
+        for t in ws:
+            t.start()
+        for t in ws:
+            t.join(timeout=timeout)
+
+        # one frame per event per sink, counted at push time; the burst
+        # coalescer may fold many events into one socket write, so the
+        # delivery HISTOGRAM counts batches — frames are the exact unit
+        want_frames = _frames(before) + events * watchers
+        while _frames(_snapshot()) < want_frames:
+            if time.monotonic() > deadline:
+                got = _frames(_snapshot()) - _frames(before)
+                raise AssertionError(
+                    f"[{label} {watchers}w] pushed {got:.0f}"
+                    f"/{events * watchers} frames before timeout")
+            time.sleep(0.05)
+        wall = time.monotonic() - t0
+        # pushed != flushed: wait until every sink buffer is drained and
+        # the delivery histogram (observed AFTER the chunked flush) has
+        # stopped moving — heartbeats keep raw sockets busy forever, so
+        # byte-quiescence is not a usable signal
+        quiet, seen = 0, -1.0
+        while quiet < 2:
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"[{label} {watchers}w] delivery never quiesced")
+            time.sleep(0.15)
+            snap = _snapshot()
+            depth = total(snap, "apiserver_watch_buffer_depth")
+            done = _delivered(snap)
+            quiet = quiet + 1 if (depth == 0 and done == seen) else 0
+            seen = done
+        after = _snapshot()
+
+        ev_delta = total(after, "apiserver_wire_events_total") - \
+            total(before, "apiserver_wire_events_total")
+        assert ev_delta == events, (
+            f"[{label}] wire_events moved {ev_delta}, wrote {events}")
+        spe: Dict[str, float] = {}
+        for enc in ("binary", "json"):
+            key = f'apiserver_wire_serializations_total{{encoding="{enc}"}}'
+            delta = after.get(key, 0.0) - before.get(key, 0.0)
+            spe[enc] = delta / events
+            want = 1.0 if enc in encodings else 0.0
+            assert spe[enc] == want, (
+                f"[{label} {watchers}w] {delta:.0f} {enc} serializations "
+                f"for {events} events — {spe[enc]:.3f}/event, want {want:.0f}"
+                " (per-encoding, never per-watcher)")
+        evict = total(after, "apiserver_watch_evictions_total") - \
+            total(before, "apiserver_watch_evictions_total")
+        assert evict == 0, f"[{label} {watchers}w] {evict:.0f} evictions"
+
+        frames = sum(
+            after.get(k, 0.0) - before.get(k, 0.0)
+            for k in after
+            if k.startswith("apiserver_wire_frames_total"))
+        enc_bytes = sum(
+            after.get(k, 0.0) - before.get(k, 0.0)
+            for k in after
+            if k.startswith("apiserver_wire_encode_bytes_total"))
+        return {
+            "name": f"WireFanout-probe-{watchers}w-{label}",
+            "watchers": watchers,
+            "writers": writers,
+            "events": events,
+            "encodings": list(encodings),
+            "delivery_p99_s": window_p99(before, after, DELIVERY),
+            "frames_per_sec": frames / wall if wall > 0 else 0.0,
+            "frames": frames,
+            "serializations_per_event": sum(spe.values()),
+            "encode_bytes": enc_bytes,
+            "bytes_rx": drainer.bytes_rx,
+            "evictions": evict,
+            "wall_s": wall,
+        }
+    finally:
+        drainer.stop()
+        server.stop()
+
+
+def run_probe(
+    watcher_counts: List[int],
+    writers: int,
+    events: int,
+    slack: float,
+    timeout: float = 180.0,
+) -> Tuple[List[dict], List[str]]:
+    rows: List[dict] = []
+    failures: List[str] = []
+    for n in watcher_counts:
+        try:
+            base = run_pass(n, writers, events, binary=False,
+                            timeout=timeout)
+            rows.append(base)
+            binr = run_pass(n, writers, events, binary=True,
+                            timeout=timeout)
+            rows.append(binr)
+            p99_j, p99_b = base["delivery_p99_s"], binr["delivery_p99_s"]
+            if (p99_b > slack * p99_j and p99_b > P99_FLOOR_S
+                    and math.isfinite(p99_b)):
+                failures.append(
+                    f"{n}w: binary delivery p99 {p99_b:.4f}s regressed "
+                    f"past {slack:.1f}x the JSON baseline {p99_j:.4f}s")
+        except AssertionError as e:
+            failures.append(str(e))
+    # one mixed pass at the smallest scale: encodings-count semantics
+    try:
+        n = min(watcher_counts)
+        mixed = run_pass(max(2, n), writers, events, mixed=True,
+                         timeout=timeout)
+        rows.append(mixed)
+        if mixed["serializations_per_event"] != 2.0:
+            failures.append(
+                f"mixed pass: {mixed['serializations_per_event']:.3f} "
+                "serializations/event, want exactly 2 (one per encoding)")
+    except AssertionError as e:
+        failures.append(str(e))
+    return rows, failures
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--watchers", default="100,1000",
+                    help="comma-separated watcher counts")
+    ap.add_argument("--writers", type=int, default=2)
+    ap.add_argument("--events", type=int, default=200)
+    ap.add_argument("--slack", type=float, default=2.0,
+                    help="binary p99 must stay within slack x JSON p99")
+    ap.add_argument("--timeout", type=float, default=180.0)
+    ap.add_argument("--json", default="",
+                    help="also write rows as JSON lines to this path")
+    args = ap.parse_args(argv)
+    counts = [int(x) for x in args.watchers.split(",") if x]
+
+    rows, failures = run_probe(counts, args.writers, args.events,
+                               args.slack, timeout=args.timeout)
+    for r in rows:
+        print(f"{r['name']:40s} p99={r['delivery_p99_s'] * 1e3:8.2f}ms "
+              f"frames/s={r['frames_per_sec']:10.0f} "
+              f"ser/event={r['serializations_per_event']:.2f} "
+              f"rx={r['bytes_rx'] / 1e6:7.1f}MB wall={r['wall_s']:.2f}s")
+    if args.json:
+        with open(args.json, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+    if failures:
+        print("\nPROBE FAILURES:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("\nwire probe OK: single-serialize held, no p99 regression")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

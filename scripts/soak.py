@@ -89,8 +89,19 @@ def deployment(name: str, replicas: int) -> apps.Deployment:
     )
 
 
-def build_suite(checker: BindIntegrityChecker, assume_ttl: float):
-    return inv.InvariantSuite([
+def build_suite(checker: BindIntegrityChecker, assume_ttl: float,
+                watchers: int = 0):
+    extra = []
+    if watchers:
+        # wire fan-out SLI (ISSUE 18): with N watchers riding the hub
+        # through the whole chaos window, the delivery p99 must stay
+        # flat — a rising tail here is the broadcast path drifting
+        # toward eviction under churn. Generous ratio/floor: the
+        # 1-core box schedules ~N writer threads per event burst.
+        extra.append(inv.HistogramP99Flat(
+            "apiserver_watch_delivery_seconds",
+            ratio=8.0, floor=0.5, label="watch-delivery-p99-flat"))
+    return inv.InvariantSuite(extra + [
         inv.CounterFlat("scheduler_parity_drift_total",
                         label="zero-shadow-drift"),
         inv.CounterFlat("scheduler_cache_expired_assumes_total",
@@ -132,6 +143,11 @@ def main() -> int:
     ap.add_argument("--allow-no-shed", action="store_true",
                     help="do not require a full shed->restore cycle "
                          "(hardware fast enough to never overload)")
+    ap.add_argument("--watchers", type=int, default=0,
+                    help="attach N wire watchers (half binary, half "
+                         "JSON raw sockets) to an HTTP hub over the "
+                         "cluster's apiserver and hold the watch "
+                         "delivery p99 flat for the whole window")
     args = ap.parse_args()
 
     rng = random.Random(args.seed)
@@ -174,7 +190,33 @@ def main() -> int:
               f"shadow_sample={tpu.shadow_sample}, depth="
               f"{sched.pipeline_depth}, rung={tpu.ladder.mode()}")
 
-        suite = build_suite(checker, assume_ttl=sched.cache._ttl)
+        wire_hub = drainer = None
+        if args.watchers:
+            # PRODUCTION wire shape: N reflector-like watchers on a real
+            # HTTP hub over the SAME store, attached BEFORE the baseline
+            # sample so fd/thread/watcher baselines include them
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            import probe_wire
+            from kubernetes_tpu.apiserver.http import HTTPAPIServer
+
+            wire_hub = HTTPAPIServer(c.api).start()
+            drainer = probe_wire._Drainer()
+            half = args.watchers // 2
+            probe_wire._attach_watchers(
+                wire_hub.address, half, True, drainer)
+            probe_wire._attach_watchers(
+                wire_hub.address, args.watchers - half, False, drainer)
+            if not wait_until(
+                    lambda: wire_hub.watcher_count >= args.watchers,
+                    timeout=60):
+                print(f"FAIL: only {wire_hub.watcher_count}/"
+                      f"{args.watchers} wire watchers attached")
+                return 1
+            print(f"wire watchers:     {args.watchers} attached "
+                  f"({half} binary, {args.watchers - half} json)")
+
+        suite = build_suite(checker, assume_ttl=sched.cache._ttl,
+                            watchers=args.watchers)
         suite.sample()  # baseline BEFORE the chaos window
 
         # churn-heavy mix (delete-pod thrice-weighted keeps batches
@@ -197,6 +239,16 @@ def main() -> int:
         monkey.restart_all_dead(timeout=30)
 
         ov = sched.overload
+
+        def churn_tick():
+            pods, _ = c.client.pods.list(namespace="default")
+            live = [p for p in pods
+                    if p.metadata.deletion_timestamp is None]
+            if live:
+                p = rng.choice(live)
+                c.client.pods.delete(
+                    p.metadata.name, p.metadata.namespace)
+
         if ov.cycles < 1 and not args.allow_no_shed:
             # the random mix never completed a full cycle inside the
             # window: run one DIRECTED wave so the report always shows
@@ -206,15 +258,6 @@ def main() -> int:
                   "running a directed overload wave")
             inj.arm("stall-completion", shots=50)
 
-            def churn_tick():
-                pods, _ = c.client.pods.list(namespace="default")
-                live = [p for p in pods
-                        if p.metadata.deletion_timestamp is None]
-                if live:
-                    p = rng.choice(live)
-                    c.client.pods.delete(
-                        p.metadata.name, p.metadata.namespace)
-
             deadline = time.monotonic() + 30
             while ov.level() == 0 and time.monotonic() < deadline:
                 churn_tick()
@@ -222,6 +265,24 @@ def main() -> int:
                 suite.sample()
             inj.disarm("stall-completion")
             deadline = time.monotonic() + 30
+            while ov.level() > 0 and time.monotonic() < deadline:
+                churn_tick()
+                time.sleep(0.3)
+                suite.sample()
+
+        if ov.level() > 0:
+            # recovery drain: the pressure sources are gone (chaos
+            # stopped, injectors disarmed) but the monitor only
+            # re-evaluates while the scheduler is doing work, and
+            # restore-dwell needs consecutive calm ticks — churn
+            # lightly until every lever restores. Bounded, so a wedged
+            # monitor still fails the levers-still-shed check below.
+            # With --watchers at 1000 the event drain keeps a small box
+            # hot through the whole chaos window, so restore
+            # legitimately lands in this tail rather than mid-chaos.
+            print(f"recovery drain: {ov.shed_names()} still shed; "
+                  f"churning until restored")
+            deadline = time.monotonic() + 45
             while ov.level() > 0 and time.monotonic() < deadline:
                 churn_tick()
                 time.sleep(0.3)
@@ -279,6 +340,14 @@ def main() -> int:
               + ("ALL HELD" if not violations else "VIOLATED"))
         for v in violations:
             print(f"  VIOLATION: {v}")
+        if wire_hub is not None:
+            evicted = inv.total(suite.samples[-1][1],
+                                "apiserver_watch_evictions_total")
+            print(f"wire watchers:     {wire_hub.watcher_count} still "
+                  f"attached at exit, {evicted:.0f} evictions, "
+                  f"{drainer.bytes_rx / 1e6:.1f}MB drained")
+            drainer.stop()
+            wire_hub.stop()
 
         if failures:
             # queue post-mortem: for every entry still parked in the

@@ -1,0 +1,348 @@
+"""Wire-protocol contract for the watch/list fan-out (ISSUE 18).
+
+Three claims under test:
+
+  * ENCODING EQUIVALENCE — a binary (ktpu-binary, the store/wal.py
+    record grammar) and a JSON watch of the same stream decode to
+    identical event sequences, property-tested over random op
+    interleavings; binary and JSON LIST responses rebuild identical
+    objects.
+  * KILL SWITCH — KTPU_WIRE_BINARY=0 restores the exact pre-binary wire
+    bytes: no Accept header on requests, and JSON frames byte-identical
+    to the pre-fan-out encoder.
+  * SINGLE SERIALIZE — the hub serializes each event once per encoding
+    in use, never per watcher, and the frame memo is keyed on the hub
+    generation so a crashed store re-minting (key, revision, type)
+    triples can never alias a stale cached frame.
+
+Plus the resume story: an evicted binary reflector re-lists and resumes
+cleanly (including across a media-type flip), and a compacted
+since_revision surfaces as kv.Compacted through the 410 path in both
+encodings.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver.http import (
+    HTTPAPIServer,
+    RemoteAPIServer,
+    watch_evictions,
+    wire_events,
+    wire_serializations,
+)
+from kubernetes_tpu.client import Clientset, SharedInformerFactory
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.utils import serde
+
+from .util import make_pod, wait_until
+
+
+@pytest.fixture()
+def hub():
+    server = HTTPAPIServer(APIServer())
+    server.start()
+    try:
+        yield server
+    finally:
+        server.stop()
+
+
+def _remote(hub, binary: bool) -> RemoteAPIServer:
+    r = RemoteAPIServer(hub.address)
+    r.wire_binary = binary
+    return r
+
+
+def _drain(watch, n, timeout=10.0):
+    out = []
+    deadline = time.monotonic() + timeout
+    while len(out) < n and time.monotonic() < deadline:
+        ev = watch.poll(timeout=0.2)
+        if ev is not None:
+            out.append(ev)
+    return out
+
+
+def _sig(ev):
+    return (
+        ev.type,
+        ev.revision,
+        ev.object.metadata.name,
+        ev.object.metadata.resource_version,
+        serde.to_dict(ev.object),
+    )
+
+
+def test_binary_and_json_streams_decode_identically(hub):
+    """Property test: random create/update/delete interleavings produce
+    BIT-IDENTICAL decoded event sequences on a binary and a JSON watch
+    of the same stream."""
+    api = hub.api
+    rng = random.Random(18)
+    wb = _remote(hub, binary=True).watch("pods", namespace="default",
+                                         since_revision=0)
+    wj = _remote(hub, binary=False).watch("pods", namespace="default",
+                                          since_revision=0)
+    assert wb.binary and not wj.binary
+    live = {}
+    n_events = 0
+    for i in range(120):
+        op = rng.choice(("create", "update", "update", "delete"))
+        if op == "create" or not live:
+            name = f"p{i}"
+            live[name] = api.create(
+                "pods", make_pod(name, namespace="default", cpu="10m"))
+        elif op == "update":
+            name = rng.choice(sorted(live))
+            pod = live[name]
+            pod.metadata.annotations = {"seq": str(i)}
+            live[name] = api.update("pods", pod)
+        else:
+            name = rng.choice(sorted(live))
+            api.delete("pods", name, "default")
+            del live[name]
+        n_events += 1
+    got_b = _drain(wb, n_events)
+    got_j = _drain(wj, n_events)
+    wb.stop()
+    wj.stop()
+    assert len(got_b) == n_events and len(got_j) == n_events
+    assert [_sig(e) for e in got_b] == [_sig(e) for e in got_j]
+
+
+def test_binary_and_json_list_equivalence(hub):
+    api = hub.api
+    for i in range(7):
+        api.create("pods", make_pod(f"p{i}", namespace="default", cpu="5m"))
+    items_b, rev_b = _remote(hub, True).list("pods", namespace="default")
+    items_j, rev_j = _remote(hub, False).list("pods", namespace="default")
+    assert rev_b == rev_j
+    assert [serde.to_dict(o) for o in items_b] == \
+        [serde.to_dict(o) for o in items_j]
+    assert items_b[0].metadata.resource_version
+
+
+def test_kill_switch_restores_pre_binary_wire_bytes(hub):
+    """KTPU_WIRE_BINARY=0: the client sends no Accept header and the
+    server streams frames byte-identical to the pre-fan-out encoder —
+    json.dumps of {type, revision, object-with-stamped-RV} plus a
+    newline, heartbeats a literal b' \\n'."""
+    import http.client
+    from urllib.parse import urlsplit
+
+    api = hub.api
+    pod = api.create("pods", make_pod("a", namespace="default", cpu="5m"))
+    pod.metadata.annotations = {"n": "1"}
+    api.update("pods", pod)
+
+    # the pre-PR encoder, reimplemented literally from the old code
+    expected = []
+    for ev in api.store.history_since("/registry/pods/", 0):
+        obj = dict(ev.value)
+        meta = dict(obj.get("metadata") or {})
+        meta["resourceVersion"] = str(ev.revision)
+        obj["metadata"] = meta
+        expected.append(json.dumps({
+            "type": ev.type, "revision": ev.revision, "object": obj,
+        }).encode() + b"\n")
+    assert len(expected) == 2
+
+    split = urlsplit(hub.address)
+    conn = http.client.HTTPConnection(split.hostname, split.port)
+    conn.request(
+        "GET",
+        "/api/v1/namespaces/default/pods?watch=true&resourceVersion=0",
+    )
+    resp = conn.getresponse()
+    assert (resp.getheader("Content-Type") or "").startswith(
+        "application/json")
+    got = []
+    while len(got) < 2:
+        line = resp.readline()
+        assert line, "stream ended before both frames arrived"
+        if line == b" \n":  # heartbeat: pre-PR bytes too
+            continue
+        got.append(line)
+    conn.close()
+    assert got == expected
+
+
+def test_serializations_count_encodings_not_watchers(hub):
+    """8 watchers (4 binary + 4 JSON) of one stream: each event is
+    serialized exactly once per ENCODING, and every watcher still
+    receives every event."""
+    api = hub.api
+    pod = api.create("pods", make_pod("a", namespace="default", cpu="5m"))
+    watches = (
+        [_remote(hub, True).watch("pods", namespace="default")
+         for _ in range(4)]
+        + [_remote(hub, False).watch("pods", namespace="default")
+           for _ in range(4)]
+    )
+    assert wait_until(lambda: hub.watcher_count == 8)
+    ev0 = wire_events.value()
+    sb0 = wire_serializations.value(encoding="binary")
+    sj0 = wire_serializations.value(encoding="json")
+    n = 25
+    for i in range(n):
+        pod.metadata.annotations = {"seq": str(i)}
+        pod = api.update("pods", pod)
+    per_watch = [_drain(w, n) for w in watches]
+    for w in watches:
+        w.stop()
+    assert all(len(evs) == n for evs in per_watch)
+    assert wire_events.value() - ev0 == n
+    assert wire_serializations.value(encoding="binary") - sb0 == n
+    assert wire_serializations.value(encoding="json") - sj0 == n
+
+
+def test_compacted_resume_raises_410_in_both_encodings(hub):
+    """A compacted since_revision must surface as kv.Compacted (the 410
+    Gone re-list signal) on watch setup, whatever the encoding."""
+    store = kv.KVStore(history_limit=4)
+    api = APIServer(store=store)
+    server = HTTPAPIServer(api)
+    server.start()
+    try:
+        pod = api.create("pods", make_pod("a", namespace="default",
+                                          cpu="5m"))
+        for i in range(10):
+            pod.metadata.annotations = {"seq": str(i)}
+            pod = api.update("pods", pod)
+        for binary in (True, False):
+            with pytest.raises(kv.Compacted):
+                _remote(server, binary).watch(
+                    "pods", namespace="default", since_revision=1)
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("binary", (True, False), ids=("binary", "json"))
+def test_reflector_resumes_after_eviction(hub, binary):
+    """An evicted reflector — PR-11 overflow close — re-lists and
+    resumes cleanly in either encoding: the informer cache converges on
+    post-eviction state."""
+    api = hub.api
+    pod = api.create("pods", make_pod("victim", namespace="default",
+                                      cpu="5m"))
+    cs = Clientset(_remote(hub, binary))
+    factory = SharedInformerFactory(cs)
+    informer = factory.pods()
+    factory.start()
+    try:
+        assert factory.wait_for_cache_sync()
+        ev0 = watch_evictions.value()
+        # deterministic eviction: force every live sink out, exactly the
+        # hard close an overflowed buffer triggers
+        assert wait_until(lambda: len(hub.fanout._sinks) >= 1)
+        for sink in list(hub.fanout._sinks):
+            with sink.cv:
+                sink._evict_locked()
+        assert wait_until(lambda: watch_evictions.value() > ev0)
+        # the reflector must notice the dead stream, re-list, re-watch,
+        # and see writes made after the eviction
+        pod.metadata.annotations = {"after": "eviction"}
+        api.update("pods", pod)
+
+        def converged():
+            got = informer.get("default/victim")
+            return (got is not None and
+                    (got.metadata.annotations or {}).get("after")
+                    == "eviction")
+
+        assert wait_until(converged, timeout=15), (
+            "reflector did not resume after eviction")
+    finally:
+        factory.stop()
+
+
+def test_resume_across_media_types(hub):
+    """A watcher evicted mid-stream on the binary wire resumes over JSON
+    (kill switch flipped between attempts) with no gap and no duplicate:
+    revisions across the boundary are contiguous."""
+    api = hub.api
+    pod = api.create("pods", make_pod("a", namespace="default", cpu="5m"))
+    remote = _remote(hub, True)
+    w = remote.watch("pods", namespace="default", since_revision=0)
+    assert w.binary
+    for i in range(5):
+        pod.metadata.annotations = {"seq": str(i)}
+        pod = api.update("pods", pod)
+    first = _drain(w, 6)
+    assert [e.revision for e in first] == list(range(1, 7))
+    # hard-close the stream server-side (the eviction shape)
+    assert wait_until(lambda: len(hub.fanout._sinks) >= 1)
+    for sink in list(hub.fanout._sinks):
+        with sink.cv:
+            sink._evict_locked()
+    assert wait_until(lambda: w.closed)
+    w.stop()
+    # resume over JSON from the last seen revision
+    remote.wire_binary = False
+    w2 = remote.watch("pods", namespace="default",
+                      since_revision=first[-1].revision)
+    assert not w2.binary
+    for i in range(5, 8):
+        pod.metadata.annotations = {"seq": str(i)}
+        pod = api.update("pods", pod)
+    second = _drain(w2, 3)
+    w2.stop()
+    assert [e.revision for e in second] == list(range(7, 10))
+    assert (second[-1].object.metadata.annotations or {}) == {"seq": "7"}
+
+
+def test_frame_memo_keyed_on_generation(tmp_path):
+    """A durable-store crash (fsync=False) rolls revisions back and can
+    re-mint a (key, revision, type) triple for a DIFFERENT object. The
+    frame memo folds the hub generation (store incarnation) into its
+    key, so the re-minted event must stream fresh bytes, never the
+    pre-crash frame."""
+    store = kv.DurableKVStore(str(tmp_path / "s"), fsync=False)
+    api = APIServer(store=store)
+    server = HTTPAPIServer(api)
+    server.start()
+    try:
+        api.create("pods", make_pod("a", namespace="default", cpu="5m",
+                                    labels={"epoch": "one"}))
+        w = _remote(server, True).watch("pods", namespace="default",
+                                        since_revision=0)
+        (first,) = _drain(w, 1)
+        assert first.object.metadata.labels == {"epoch": "one"}
+        assert first.revision == 1
+        w.stop()
+        # crash: nothing was synced, so revision 1 is re-mintable
+        store.crash()
+        assert store.revision == 0
+        api.create("pods", make_pod("a", namespace="default", cpu="5m",
+                                    labels={"epoch": "two"}))
+        w2 = _remote(server, True).watch("pods", namespace="default",
+                                         since_revision=0)
+        (again,) = _drain(w2, 1)
+        w2.stop()
+        assert again.revision == 1, "re-minted revision expected"
+        assert again.object.metadata.labels == {"epoch": "two"}, (
+            "stale pre-crash frame served for a re-minted revision")
+    finally:
+        server.stop()
+
+
+def test_binary_idle_heartbeat_keeps_stream_alive(hub):
+    """OP_HEARTBEAT records flow on an idle binary watch and are dropped
+    by the decoder: the stream stays open with no phantom events, and a
+    later write still arrives."""
+    api = hub.api
+    w = _remote(hub, True).watch("pods", namespace="default")
+    time.sleep(1.3)  # > two heartbeat ticks
+    assert not w.closed
+    assert w.poll(timeout=0.05) is None
+    api.create("pods", make_pod("late", namespace="default", cpu="5m"))
+    ev = w.poll(timeout=5)
+    w.stop()
+    assert ev is not None and ev.object.metadata.name == "late"
