@@ -81,6 +81,7 @@ class Cluster:
         n_schedulers: int = 1,
         leader_election: bool = False,
         election_opts: Optional[Dict] = None,
+        scheduler_config=None,
     ):
         # save the process-global gate overrides so stop() can restore them
         # (gates must not leak across Cluster instances)
@@ -102,6 +103,7 @@ class Cluster:
                 n_schedulers,
                 leader_election,
                 election_opts,
+                scheduler_config,
             )
         except BaseException:
             default_feature_gate.restore(self._fg_saved)
@@ -124,6 +126,7 @@ class Cluster:
         n_schedulers=1,
         leader_election=False,
         election_opts=None,
+        scheduler_config=None,
     ) -> None:
         if feature_gates:
             default_feature_gate.set_from_string(feature_gates)
@@ -164,7 +167,14 @@ class Cluster:
                 self.proxiers.append(
                     Proxier(self.kcm.informers, node_name=kl.config.node_name)
                 )
-        self.scheduler_config = default_configuration()
+        # scheduler_config: full KubeSchedulerConfiguration override (e.g.
+        # apis.config.gang_configuration() for gang drills); the
+        # scheduler_backend kwarg still applies on top
+        self.scheduler_config = (
+            scheduler_config
+            if scheduler_config is not None
+            else default_configuration()
+        )
         if scheduler_backend:
             for profile in self.scheduler_config.profiles:
                 profile.backend = scheduler_backend

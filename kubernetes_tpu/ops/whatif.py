@@ -118,7 +118,7 @@ def ipa_victim_matches_np(tt: Dict, rows_list: List[Dict]):
 )
 def _whatif_run(
     S: Dict, c_static: Dict, carry: Dict,
-    v_valid, v_req, v_mfs, v_manti, v_mall,
+    v_valid, v_cnt, v_req, v_mfs, v_manti, v_mall,
     nom_req, nom_cnt, nom_mfs, nom_manti, nom_mall,
     pre_req, pre_cnt, pre_shared, pre_anti, pre_aff, pre_atot,
     tj: int = 0, dyn_ipa: bool = False, dyn_ports: bool = False,
@@ -134,7 +134,14 @@ def _whatif_run(
     pre_shared/pre_anti/pre_aff at topology-PAIR granularity because a
     claimed victim on another node still drains this node's shared
     groups. All adjustments are exact at the evaluated node, which is
-    the only lane each node's verdict reads."""
+    the only lane each node's verdict reads.
+
+    A slot may hold a whole same-node GANG UNIT (gang-aware preemption:
+    a gang's co-located members evict together or not at all): its
+    req/mfs/manti/mall are the members' sums and v_cnt [N, L] carries
+    the member count the pod-count filter must release/re-add per slot.
+    Singleton slots pass v_cnt == v_valid, preserving the original
+    per-pod arithmetic bit-for-bit."""
 
     def sel(key):
         return S[key][tj]
@@ -304,7 +311,7 @@ def _whatif_run(
     fits_now = feas(zero_ev)
     all_ev = (
         jnp.sum(v_req, axis=1),
-        jnp.sum(v_valid, axis=1).astype(_I64),
+        jnp.sum(v_cnt, axis=1).astype(_I64),
         jnp.sum(v_mfs, axis=1),
         jnp.sum(v_manti, axis=1),
         jnp.sum(v_mall, axis=1).astype(_CNT),
@@ -316,7 +323,7 @@ def _whatif_run(
         valid_l = v_valid[:, l]
         cand = (
             ev_req - v_req[:, l],
-            ev_cnt - valid_l.astype(_I64),
+            ev_cnt - v_cnt[:, l].astype(_I64),
             ev_mfs - v_mfs[:, l],
             ev_manti - v_manti[:, l],
             ev_mall - v_mall[:, l].astype(_CNT),
@@ -337,6 +344,55 @@ def _whatif_run(
         "base": base,
         "victims": jnp.transpose(victims),  # [N, L]
     }
+
+
+@functools.partial(jax.jit, static_argnames=("tj", "dyn_ports"))
+def _gang_fits_run(S: Dict, c_static: Dict, carry: Dict, k,
+                   tj: int = 0, dyn_ports: bool = False):
+    """Joint co-placement feasibility for k members of template tj as
+    one positive-delta launch: per-node template MULTIPLICITY m_i = how
+    many copies the node absorbs at once (min over checked dims of
+    floor(free / req), capped by pod-count headroom, zeroed where the
+    eviction-invariant static gate fails), feasible iff
+    sum(min(m_i, k)) >= k.
+
+    This is the gang-level upgrade of fits_now: k independent per-member
+    fit checks all pass on a node with room for ONE member, yet the gang
+    as a whole may not place — exactly the blind spot that lets two
+    half-reserved gangs deadlock. Optimistic by design: affinity/spread
+    couplings between the members themselves (and same-host-port
+    members beyond the first) are not modeled, so False is definitive
+    ("cannot place even ignoring inter-member constraints") while True
+    means "capacity exists". The deadlock breaker wants exactly that
+    polarity — it prefers backing off a gang whose demand provably
+    exceeds the cluster."""
+
+    def sel(key):
+        return S[key][tj]
+
+    req = sel("req")
+    req_check = sel("req_check")
+    free = c_static["alloc"] - carry["requested"]          # [N, R]
+    headroom = (
+        c_static["allowed_pods"] - carry["pod_count"].astype(_I64)
+    )                                                      # [N]
+    gate = sel("static_mask")
+    if dyn_ports:
+        gate = gate & K.ports_mask(
+            carry["cp_any"], carry["cp_wild"], carry["cp_trip"],
+            {p: sel(p) for p in _PORT_STEP_KEYS},
+        )
+    big = jnp.asarray(jnp.iinfo(_I64).max // 2, _I64)
+    checked = req_check & (req > 0)
+    per_dim = jnp.where(
+        checked[None, :],
+        jnp.floor_divide(free, jnp.where(checked, req, 1)[None, :])
+        .astype(_I64),
+        big,
+    )                                                      # [N, R]
+    m = jnp.minimum(jnp.min(per_dim, axis=1), headroom)    # [N]
+    m = jnp.where(gate, jnp.maximum(m, 0), 0)
+    return jnp.sum(jnp.minimum(m, k)) >= k
 
 
 # ---------------------------------------------------------------------------
@@ -473,11 +529,15 @@ class WhatifContext:
         return self._run_impl(tj, v, nom, pre, sess)
 
     def _run_impl(self, tj: int, v, nom, pre, sess):
+        # singleton slots: count == validity (one member per slot)
+        v_cnt = v.get("cnt")
+        if v_cnt is None:
+            v_cnt = np.asarray(v["valid"]).astype(np.int64)
         return _whatif_run(
             sess._S, sess._c_static, self.carry,
-            jnp.asarray(v["valid"]), jnp.asarray(v["req"]),
-            jnp.asarray(v["mfs"]), jnp.asarray(v["manti"]),
-            jnp.asarray(v["mall"]),
+            jnp.asarray(v["valid"]), jnp.asarray(v_cnt),
+            jnp.asarray(v["req"]), jnp.asarray(v["mfs"]),
+            jnp.asarray(v["manti"]), jnp.asarray(v["mall"]),
             jnp.asarray(nom["req"]), jnp.asarray(nom["cnt"]),
             jnp.asarray(nom["mfs"]), jnp.asarray(nom["manti"]),
             jnp.asarray(nom["mall"]),
@@ -487,6 +547,18 @@ class WhatifContext:
             tj=tj, dyn_ipa=self.dyn_ipa, dyn_ports=self.dyn_ports,
             has_nom=bool(nom["has_nom"]),
         )
+
+    def gang_fits(self, tj: int, k: int) -> bool:
+        """Can k members of template tj co-place right now? One launch
+        over the scratch carry (_gang_fits_run); optimistic on
+        inter-member couplings — see the kernel docstring."""
+        if k <= 1:
+            k = 1
+        out = _gang_fits_run(
+            self._sess._S, self._sess._c_static, self.carry,
+            jnp.asarray(k, _I64), tj=tj, dyn_ports=self.dyn_ports,
+        )
+        return bool(out)
 
 
 def slot_bucket(n_slots: int) -> int:

@@ -333,6 +333,20 @@ class Result:
     preemption_planner_paths: Optional[Dict[str, int]] = None
     whatif_launches: int = 0
     whatif_fallbacks: Optional[Dict[str, int]] = None
+    # gang all-or-nothing accounting (in-window counter deltas): waves
+    # admitted whole / rejected{reason} / rolled back{reason}, plus
+    # members evicted as whole-gang victim units — the atomicity ledger
+    # for the Gang-* rows (admitted * gang_size == num_bound on a clean
+    # run; any rollback names its reason). Admission percentiles are
+    # EXACT, from the Coscheduling plugin's per-wave sample buffer
+    # (first member parked -> wave admitted), not histogram buckets.
+    # All zero/None on rows without gangs.
+    gang_admitted: int = 0
+    gang_rejected: Optional[Dict[str, int]] = None
+    gang_rollbacks: Optional[Dict[str, int]] = None
+    gang_preempted: int = 0
+    gang_admission_p50: float = 0.0
+    gang_admission_p99: float = 0.0
     # per-stage latency attribution (KTPU_TRACE >= 1): flight-recorder
     # span summaries over the measured window, stage -> {count, total_s,
     # p50_s, p99_s} for pop / encode / delta-apply / dispatch / wait /
@@ -763,6 +777,10 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
 
         from ..scheduler.metrics import (
             conflict_replays,
+            gang_admitted as gang_admitted_ctr,
+            gang_preempted as gang_preempted_ctr,
+            gang_rejected as gang_rejected_ctr,
+            gang_rollbacks as gang_rollbacks_ctr,
             multipod_conflicts,
             parity_drift,
             preemption_planner,
@@ -786,6 +804,18 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         whatif_fb0 = _label_counts(whatif_fallbacks)
         shadow0 = _counter_total(shadow_samples_ctr)
         drift0 = _label_counts(parity_drift)
+        gang_adm0 = _counter_total(gang_admitted_ctr)
+        gang_rej0 = _label_counts(gang_rejected_ctr)
+        gang_rb0 = _label_counts(gang_rollbacks_ctr)
+        gang_pre0 = _counter_total(gang_preempted_ctr)
+        # admission-latency samples are read from the plugin's buffer,
+        # windowed by length mark (maxlen 100k >> any bench's wave
+        # count, so init-phase samples never push measured ones out)
+        gang_plugin = sched._gang_plugin()
+        gang_samp0 = (
+            len(gang_plugin.admission_samples)
+            if gang_plugin is not None else 0
+        )
         bound0 = bound_count()
         n_ts0 = len(sched.bind_timestamps)
         from ..utils import devtime, tracing
@@ -904,6 +934,18 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
         )
         n_shadow = _counter_total(shadow_samples_ctr) - shadow0
         shadow_drift = _counter_window(_label_counts(parity_drift), drift0)
+        n_gang_adm = _counter_total(gang_admitted_ctr) - gang_adm0
+        gang_rej = _counter_window(
+            _label_counts(gang_rejected_ctr), gang_rej0
+        )
+        gang_rb = _counter_window(
+            _label_counts(gang_rollbacks_ctr), gang_rb0
+        )
+        n_gang_pre = _counter_total(gang_preempted_ctr) - gang_pre0
+        gang_samples = (
+            list(gang_plugin.admission_samples)[gang_samp0:]
+            if gang_plugin is not None else []
+        )
         session_kind = (
             type(sched.tpu._session).__name__
             if sched.tpu is not None and sched.tpu._session is not None
@@ -985,6 +1027,12 @@ def run_workload(w: Workload, quiet: bool = True) -> Result:
             preemption_planner_paths=planner_paths,
             whatif_launches=n_whatif,
             whatif_fallbacks=whatif_fb,
+            gang_admitted=n_gang_adm,
+            gang_rejected=gang_rej,
+            gang_rollbacks=gang_rb,
+            gang_preempted=n_gang_pre,
+            gang_admission_p50=round(_percentile(gang_samples, 50), 4),
+            gang_admission_p99=round(_percentile(gang_samples, 99), 4),
             stage_latency=stage_latency,
             stage_window_s=stage_window,
             trace_level=tracing.level(),

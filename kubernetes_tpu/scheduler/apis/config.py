@@ -115,6 +115,26 @@ def default_configuration() -> KubeSchedulerConfiguration:
     return KubeSchedulerConfiguration(profiles=[KubeSchedulerProfile()])
 
 
+def gang_configuration(
+    permit_timeout: float = 60.0,
+) -> KubeSchedulerConfiguration:
+    """The default profile plus the Coscheduling gang gate, enabled at
+    BOTH of its extension points (Permit gates the wave, Reserve indexes
+    members into it) — the config-surface analog of the perf harness's
+    gang_size wiring, for clusters (drills, soaks) built through
+    `Cluster(scheduler_config=...)`."""
+    plugins = Plugins()
+    plugins.permit.enabled.append(Plugin("Coscheduling", 1))
+    plugins.reserve.enabled.append(Plugin("Coscheduling", 1))
+    profile = KubeSchedulerProfile(
+        plugins=plugins,
+        plugin_config={
+            "Coscheduling": {"permit_timeout_seconds": permit_timeout}
+        },
+    )
+    return KubeSchedulerConfiguration(profiles=[profile])
+
+
 # -- plugin merge (v1beta1 mergePlugins semantics) --------------------------
 
 

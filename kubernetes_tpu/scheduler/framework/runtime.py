@@ -40,6 +40,15 @@ class WaitingPod:
         self._status: Optional[Status] = None
         self._deadline = time.monotonic() + max(plugin_timeouts.values())
         self._listeners: List[Callable[[], None]] = []
+        self._gate = None
+
+    def set_gate(self, gate) -> None:
+        """Attach a resolution arbiter (plugins/coscheduling.py GangGate).
+        When the deadline and the gang's completion race, exactly one
+        side wins: a timeout may resolve this pod ONLY after flipping
+        the gate to failed; if the gate already completed, the allow()
+        from the completing thread is in flight and timeout yields."""
+        self._gate = gate
 
     def pending_plugins(self) -> List[str]:
         with self._cv:
@@ -87,15 +96,32 @@ class WaitingPod:
 
     def timeout_if_due(self, now: float) -> bool:
         """Resolve with the timeout status if the deadline passed (the
-        drainer's replacement for the per-thread wait loop's timeout)."""
+        drainer's replacement for the per-thread wait loop's timeout).
+        Returns False when a gang gate says completion won the race:
+        the pod is NOT resolved here — the completing thread's allow()
+        is about to resolve it success."""
         with self._cv:
             if self._resolved or now < self._deadline:
                 return self._resolved
-            self._resolved = True
-            self._status = Status.unschedulable(
-                f"pod {self.pod.metadata.name!r} timed out waiting at Permit"
-            )
-            self._cv.notify_all()
+        return self._try_timeout()
+
+    def _try_timeout(self) -> bool:
+        """Arbitrate a due deadline against the gang gate (if any).
+        True: this pod is resolved (timed out, or something else
+        resolved it concurrently). False: the gate completed first —
+        yield to the completing thread's allow()."""
+        gate = self._gate
+        if gate is not None and not gate.fail():
+            with self._cv:
+                return self._resolved
+        with self._cv:
+            if not self._resolved:
+                self._resolved = True
+                self._status = Status.unschedulable(
+                    f"pod {self.pod.metadata.name!r} timed out waiting at "
+                    f"Permit"
+                )
+                self._cv.notify_all()
             fire = self._take_listeners_locked()
         for fn in fire:
             fn()
@@ -108,18 +134,27 @@ class WaitingPod:
         return fire
 
     def wait(self) -> Optional[Status]:
-        with self._cv:
-            while not self._resolved:
-                remaining = self._deadline - time.monotonic()
-                if remaining <= 0:
-                    self._resolved = True
-                    self._status = Status.unschedulable(
-                        f"pod {self.pod.metadata.name!r} timed out waiting at Permit"
-                    )
+        while True:
+            with self._cv:
+                while not self._resolved:
+                    remaining = self._deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=min(remaining, 0.5))
+                if self._resolved:
+                    status = self._status
+                    fire = self._take_listeners_locked()
                     break
-                self._cv.wait(timeout=min(remaining, 0.5))
-            status = self._status
-            fire = self._take_listeners_locked()
+            if self._try_timeout():
+                with self._cv:
+                    status = self._status
+                    fire = self._take_listeners_locked()
+                break
+            # the gang gate completed first: allow() is in flight on the
+            # completing thread — wait for it to land, then re-read
+            with self._cv:
+                if not self._resolved:
+                    self._cv.wait(timeout=0.05)
         for fn in fire:
             fn()
         return status
@@ -407,6 +442,15 @@ class Framework:
             wp = WaitingPod(pod, plugin_timeouts)
             with self._waiting_lock:
                 self._waiting_pods[pod_key(pod)] = wp
+            # notify the WAIT-returning plugins AFTER publishing the
+            # map entry: a gang plugin attaches its gate and records
+            # the park time here, and any later member completing the
+            # gang must be able to find this pod via get_waiting_pod
+            for pl in self.permit_plugins:
+                if pl.name in plugin_timeouts:
+                    on_waiting = getattr(pl, "on_waiting", None)
+                    if on_waiting is not None:
+                        on_waiting(wp)
             return Status(Code.WAIT)
         return None
 

@@ -73,6 +73,14 @@ class CacheListener:
         for pod, node_name in items:
             self.on_add_pod(pod, node_name)
 
+    def on_forget_pods(self, items: List[Tuple[v1.Pod, str]]) -> None:
+        """One batched hook per forget_pods call — the retraction dual
+        of on_assume_pods: a gang rollback releases every member's
+        reserved capacity at once, so a listener can land the whole
+        wave as one delta batch. Default: per-pod on_remove_pod."""
+        for pod, node_name in items:
+            self.on_remove_pod(pod, node_name)
+
 
 class _PodState:
     __slots__ = ("pod", "deadline", "binding_finished")
@@ -392,6 +400,39 @@ class SchedulerCache:
                 self._foreign_mutations += 1
             else:
                 raise ValueError(f"pod {key} wasn't assumed so cannot be forgotten")
+
+    def forget_pods(self, pods: List[v1.Pod]) -> None:
+        """Batch forget_pod under ONE lock acquisition with ONE batched
+        listener event (on_forget_pods): a gang rollback retracts every
+        member's assumed placement as one wave, and the device-session
+        listener absorbs the whole wave as one carry-delta batch
+        instead of N per-pod removes. Pods not assumed (already
+        forgotten, or never assumed) are skipped — rollback paths race
+        informer echoes and must stay idempotent."""
+        with self._lock:
+            dropped: List[Tuple[v1.Pod, str]] = []
+            for pod in pods:
+                key = v1.pod_key(pod)
+                ps = self._pod_states.get(key)
+                if ps is None or not self._assumed_pods.get(key):
+                    continue
+                node_name = ps.pod.spec.node_name
+                self._col_assumed_delta(node_name, -1)
+                ni = self._nodes.get(node_name)
+                if ni is not None:
+                    res3 = calculate_resource(ps.pod)
+                    ni.remove_pod(ps.pod, res3)
+                    self._touch(node_name)
+                    if self._columnar:
+                        self._col_pod_delta(node_name, res3, -1)
+                self._prio_remove(ps.pod)
+                del self._pod_states[key]
+                del self._assumed_pods[key]
+                self._foreign_mutations += 1
+                dropped.append((ps.pod, node_name))
+            if dropped:
+                for l in self._listeners:
+                    l.on_forget_pods(dropped)
 
     def is_assumed_pod(self, pod: v1.Pod) -> bool:
         with self._lock:

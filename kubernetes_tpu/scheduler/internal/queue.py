@@ -177,6 +177,30 @@ class PriorityQueue:
             self._active.push(info)
             self._lock.notify()
 
+    def requeue_with_backoff(self, pod: v1.Pod) -> None:
+        """Failed-attempt requeue for a pod that HELD capacity (a
+        rolled-back gang member): enter through the backoff heap at the
+        initial backoff, not the active heap. An active-heap re-entry
+        would let the rollback's own members instantly re-camp the
+        capacity their rollback just released — under the gang deadlock
+        breaker that is a livelock: the backed-off wave's members beat
+        the stalled rival gang's pending member to every pop, the
+        mutual stall re-forms, and the breaker alternates victims
+        forever with zero progress."""
+        with self._lock:
+            key = v1.pod_key(pod)
+            if (
+                key in self._unschedulable
+                or self._active.get(pod)
+                or self._backoff.get(pod)
+            ):
+                return
+            info = QueuedPodInfo(pod, timestamp=self._now())
+            info.attempts = 1  # first backoff rung (initial_backoff)
+            info.last_failure_timestamp = self._now()
+            self._backoff.push(info)
+            self._lock.notify()
+
     def add_unschedulable_if_not_present(
         self, info: QueuedPodInfo, pod_scheduling_cycle: int
     ) -> None:

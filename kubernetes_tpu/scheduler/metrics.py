@@ -381,6 +381,70 @@ leader_transitions = legacy_registry.register(
         (),
     )
 )
+gang_admitted = legacy_registry.register(
+    Counter(
+        "scheduler_gang_admitted_total",
+        "Gangs whose Permit transaction committed: every member was "
+        "reserved, the gang gate flipped waiting->completed exactly "
+        "once, and all members were released to bind as one batch. "
+        "The all-or-nothing success count; pairs with "
+        "scheduler_gang_rollbacks_total as the failure count.",
+        (),
+    )
+)
+gang_rejected = legacy_registry.register(
+    Counter(
+        "scheduler_gang_rejected_total",
+        "Gang members bounced at Permit before reserving completed, by "
+        "reason: reason=invalid (min-available < 1), reason=late (a "
+        "member arrived after its gang already failed this wave — it "
+        "requeues rather than camp on a dead transaction). Counted per "
+        "member, not per gang; these never held a reservation.",
+        ("reason",),
+    )
+)
+gang_rollbacks = legacy_registry.register(
+    Counter(
+        "scheduler_gang_rollbacks_total",
+        "Whole-gang rollbacks (every reserved/waiting member released "
+        "and requeued as one wave), by reason: reason=timeout "
+        "(KTPU_GANG_PERMIT_TIMEOUT elapsed before completion), "
+        "reason=member-deleted (a waiting member was deleted "
+        "mid-permit), reason=member-rejected (a Permit plugin rejected "
+        "a member), reason=deadlock (the deadlock breaker backed off "
+        "the youngest of mutually-blocking gangs), reason=reconcile "
+        "(promotion reconcile found an orphaned gang reservation from "
+        "a dead leader), reason=device-fault (a member's dispatch "
+        "abandoned — the whole gang re-drives through recovery), "
+        "reason=demotion (leader demoted with the gang mid-permit), "
+        "reason=preempted (the gang's bound members were chosen as "
+        "preemption victims — its waiting wave unwinds too). "
+        "Counted once per gang per rollback.",
+        ("reason",),
+    )
+)
+gang_preempted = legacy_registry.register(
+    Counter(
+        "scheduler_gang_preempted_total",
+        "Gangs evicted whole by gang-aware preemption: the victim scan "
+        "groups same-node members into one eviction unit, and "
+        "_apply_preemptions closes over the gang's off-node siblings "
+        "so no partial gang survives a preemption. Counted once per "
+        "gang per preemption (however many members it had).",
+        (),
+    )
+)
+gang_admission_duration = legacy_registry.register(
+    Histogram(
+        "scheduler_gang_admission_duration_seconds",
+        "Gang admission latency: first member parked at Permit to the "
+        "gang gate committing (waiting->completed). The gang-level "
+        "SLO the Gang-{8,64,256} bench rows report as "
+        "gang_admission_p99; one observation per admitted gang.",
+        (),
+        buckets=tuple(0.001 * 2**i for i in range(20)),
+    )
+)
 
 
 def dump_seam(seam: str, **attrs) -> None:

@@ -13,17 +13,33 @@ implemented here:
     the count is below min-available the pod WAITs (holding its
     reservation) up to the configured timeout;
   * the member that completes the gang allows every waiting member;
-  * when a member is rejected or unreserved, the whole gang is rejected
-    so partial gangs don't hold capacity (coscheduling's PostFilter/
-    Unreserve behavior).
+  * when a member is rejected, deleted, or unreserved, the whole gang
+    rolls back so partial gangs don't hold capacity.
+
+Gang admission is a TRANSACTION, arbitrated by a single-assignment
+``GangGate`` per waiting wave: the gate flips exactly once, to
+``completed`` (the completing member commits, every waiting member is
+allowed, they bind as one batch) or to ``failed`` (timeout, member
+deletion/rejection, deadlock back-off, reconcile, device fault — the
+whole wave is rejected and every member requeues). A permit timeout
+firing concurrently with gang completion is therefore deterministic:
+whichever side flips the gate wins whole — the loser observes the flip
+and stands down (``WaitingPod._try_timeout`` yields to a completed
+gate; a completing member whose ``gate.complete()`` loses bounces and
+requeues with its siblings). The pre-gate implementation had a
+documented self-healing race here (a timed-out member stayed counted
+as reserved until its unreserve); the gate closes it.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Optional, Tuple
+import time
+from collections import deque
+from typing import Dict, List, Optional, Set, Tuple
 
 from ...api import types as v1
+from .. import metrics
 from ..framework import interface as fwk
 from ..framework.interface import CycleState, Status
 
@@ -58,16 +74,93 @@ def pod_group(pod: v1.Pod) -> Tuple[str, int]:
     return group, min_available
 
 
+class GangGate:
+    """Single-assignment resolution arbiter for one gang WAVE (the set
+    of members parked at Permit between two resolutions). The gate is
+    the transaction's commit point: ``complete()`` and ``fail()`` race,
+    exactly one flips the state, and both sides act only on the flip
+    they own — all-or-nothing falls out of single assignment."""
+
+    WAITING = "waiting"
+    COMPLETED = "completed"
+    FAILED = "failed"
+
+    def __init__(self, namespace: str, group: str, min_available: int,
+                 on_fail=None):
+        self.namespace = namespace
+        self.group = group
+        self.min_available = min_available
+        self._lock = threading.Lock()
+        self.state = self.WAITING
+        self.reason: Optional[str] = None
+        self.message = ""
+        self.first_park: Optional[float] = None
+        self.member_keys: Set[str] = set()
+        self._on_fail = on_fail
+
+    def note_parked(self, key: str, now: float) -> None:
+        with self._lock:
+            self.member_keys.add(key)
+            if self.first_park is None:
+                self.first_park = now
+
+    def complete(self) -> bool:
+        """Commit the wave. True exactly once; False if the wave
+        already failed (or someone else committed) — the caller must
+        NOT bind."""
+        with self._lock:
+            if self.state != self.WAITING:
+                return False
+            self.state = self.COMPLETED
+            return True
+
+    def fail(self, reason: str = "timeout", message: str = "") -> bool:
+        """Roll the wave back. True when the wave is failed (by this
+        call or an earlier one) — the caller may resolve members as
+        failed; False when completion won the race — the caller must
+        stand down (the committing thread's allow() is in flight). The
+        on_fail cascade (reject every waiting member, count the
+        rollback) fires exactly once, on the flip, outside the lock."""
+        fire = False
+        with self._lock:
+            if self.state == self.COMPLETED:
+                return False
+            if self.state == self.WAITING:
+                self.state = self.FAILED
+                self.reason = reason
+                self.message = message
+                fire = True
+        if fire and self._on_fail is not None:
+            self._on_fail(self)
+        return True
+
+    @property
+    def failed(self) -> bool:
+        with self._lock:
+            return self.state == self.FAILED
+
+    def has_member(self, key: str) -> bool:
+        with self._lock:
+            return key in self.member_keys
+
+    def members(self) -> Set[str]:
+        """Snapshot of the wave's parked member keys (safe to iterate;
+        the live set mutates under the gate lock)."""
+        with self._lock:
+            return set(self.member_keys)
+
+    def age(self, now: float) -> float:
+        with self._lock:
+            if self.first_park is None:
+                return 0.0
+            return now - self.first_park
+
+
 class Coscheduling(fwk.PermitPlugin, fwk.ReservePlugin):
     """Must be enabled at BOTH the permit and reserve extension points:
     reserve maintains the per-group membership index and unreserve performs
-    the gang-wide rejection.
-
-    Known (tiny, self-healing) race: a member whose Permit wait just timed
-    out stays counted as reserved for the microseconds between its timeout
-    and its unreserve on the same binding thread; a gang completed inside
-    that window binds without the dead member, which then retries, sees the
-    bound members, and re-joins immediately."""
+    the gang-wide rejection (through the wave gate, so it is atomic with
+    completion)."""
 
     name = "Coscheduling"
 
@@ -80,7 +173,15 @@ class Coscheduling(fwk.PermitPlugin, fwk.ReservePlugin):
         # not unreserved — O(group) permit counting instead of scanning the
         # whole scheduler cache per permit
         self._groups: dict = {}
+        # (namespace, group) -> GangGate for the CURRENT waiting wave;
+        # failed gates are popped by the on_fail cascade so a fresh wave
+        # starts clean
+        self._gates: Dict[Tuple[str, str], GangGate] = {}
         self._reserve_count = 0
+        # committed-gang admission latencies (first park -> commit), the
+        # exact-sample source for the harness's gang_admission_p99 (the
+        # histogram on /metricsz is bucketed; bench wants exact)
+        self.admission_samples = deque(maxlen=100_000)
 
     # -- counting ----------------------------------------------------------
 
@@ -138,6 +239,119 @@ class Coscheduling(fwk.PermitPlugin, fwk.ReservePlugin):
                 out.append(wp)
         return out
 
+    # -- gates -------------------------------------------------------------
+
+    def on_waiting(self, wp) -> None:
+        """Framework hook: a member of ours just parked (run_permit_plugins
+        published its WaitingPod). Attach the current wave's gate so the
+        permit timeout and gang completion arbitrate through it, and
+        record the park for admission latency + wave membership."""
+        pod = wp.pod
+        group, min_available = pod_group(pod)
+        if not group or min_available <= 1:
+            return
+        namespace = pod.metadata.namespace
+        with self._lock:
+            gate = self._gates.get((namespace, group))
+            if gate is None:
+                gate = GangGate(namespace, group, min_available,
+                                on_fail=self._on_gate_failed)
+                self._gates[(namespace, group)] = gate
+        gate.note_parked(v1.pod_key(pod), time.monotonic())
+        wp.set_gate(gate)
+
+    def _on_gate_failed(self, gate: GangGate) -> None:
+        """The fail() flip's cascade — runs exactly once per wave, on
+        whichever thread won the flip (timeout drainer, unreserve,
+        delete handler, deadlock breaker, reconcile). Pops the gate
+        (next wave starts clean), drops the
+        wave's members from the reserved index so a late member can't
+        count dead reservations toward a new completion, counts the
+        rollback once, and rejects every still-waiting member — the
+        whole gang requeues, never a prefix."""
+        gkey = (gate.namespace, gate.group)
+        wave = gate.members()
+        with self._lock:
+            if self._gates.get(gkey) is gate:
+                del self._gates[gkey]
+            members = self._groups.get(gkey)
+            if members is not None:
+                members -= wave
+        metrics.gang_rollbacks.inc(reason=gate.reason or "timeout")
+        msg = gate.message or (
+            f"gang {gate.group!r} wave rolled back ({gate.reason})"
+        )
+        # enumerate the waiting members from the WAVE snapshot, not the
+        # reserved index — the index was just pruned above, and an
+        # index-driven lookup here would reject nobody (the members
+        # would camp parked until their permit timeouts fired)
+        for wp in self._waiting_pods_of(wave, gate.group, gate.namespace):
+            wp.reject(self.name, msg)
+
+    def _waiting_pods_of(self, keys: Set[str], group: str, namespace: str):
+        """Waiting pods for an explicit key set (a failed wave's
+        snapshot): O(wave) get_waiting_pod lookups, with the
+        iterate_waiting_pods fallback for unit-test fakes."""
+        handle = self._handle
+        if handle is None:
+            return []
+        if hasattr(handle, "get_waiting_pod"):
+            out = []
+            for key in keys:
+                wp = handle.get_waiting_pod(key)
+                if wp is not None:
+                    out.append(wp)
+            return out
+        if not hasattr(handle, "iterate_waiting_pods"):
+            return []
+        out = []
+        for wp in handle.iterate_waiting_pods():
+            if wp.pod.metadata.namespace != namespace:
+                continue
+            g, _ = pod_group(wp.pod)
+            if g == group:
+                out.append(wp)
+        return out
+
+    def reject_gang(self, namespace: str, group: str, reason: str,
+                    message: str = "") -> bool:
+        """Scheduler-side whole-gang rollback (deadlock breaker, member
+        deletion, device fault, demotion, reconcile). True when a
+        waiting wave was rolled back by this call or an earlier one;
+        False when there is no waiting wave or it already committed."""
+        with self._lock:
+            gate = self._gates.get((namespace, group))
+        if gate is None:
+            return False
+        return gate.fail(reason=reason, message=message)
+
+    def reject_gang_of(self, pod: v1.Pod, reason: str,
+                       message: str = "") -> bool:
+        group, min_available = pod_group(pod)
+        if not group or min_available <= 1:
+            return False
+        return self.reject_gang(pod.metadata.namespace, group, reason,
+                                message=message)
+
+    def waiting_gangs(self) -> List[GangGate]:
+        """Snapshot of the waves currently parked at Permit (deadlock
+        breaker + promotion reconcile input)."""
+        with self._lock:
+            return list(self._gates.values())
+
+    def seed_reserved(self, pod: v1.Pod) -> None:
+        """Promotion reconcile adoption: a BOUND gang member from a prior
+        leader enters the reserved index so re-driven siblings can
+        rejoin it instead of waiting for a full fresh wave that will
+        never assemble (restart parity for partially-bound gangs)."""
+        group, min_available = pod_group(pod)
+        if not group or min_available <= 1:
+            return
+        with self._lock:
+            self._groups.setdefault(
+                (pod.metadata.namespace, group), set()
+            ).add(v1.pod_key(pod))
+
     # -- Permit ------------------------------------------------------------
 
     def permit(self, state: CycleState, pod: v1.Pod, node_name: str) -> Tuple[Optional[Status], float]:
@@ -148,6 +362,7 @@ class Coscheduling(fwk.PermitPlugin, fwk.ReservePlugin):
             # a grouped pod with a missing/garbled min-available label must
             # not silently bind solo while its siblings wait on it forever —
             # surface the misconfiguration
+            metrics.gang_rejected.inc(reason="invalid")
             return (
                 Status.unschedulable_and_unresolvable(
                     f"gang {group!r}: invalid or missing "
@@ -158,6 +373,19 @@ class Coscheduling(fwk.PermitPlugin, fwk.ReservePlugin):
         if min_available == 1:
             return None, 0
         namespace = pod.metadata.namespace
+        with self._lock:
+            gate = self._gates.get((namespace, group))
+        if gate is not None and gate.failed:
+            # the current wave is mid-rollback (the on_fail cascade pops
+            # the gate momentarily): joining it would hand this member a
+            # reservation nobody will complete — requeue with the rest
+            metrics.gang_rejected.inc(reason="late")
+            return (
+                Status.unschedulable(
+                    f"gang {group!r}: wave rolled back while joining"
+                ),
+                0,
+            )
         # the reserved index includes this pod (Reserve ran) and the waiting
         # pods (they reserved too): total == index size
         total = self._reserved_members(group, namespace)
@@ -166,6 +394,28 @@ class Coscheduling(fwk.PermitPlugin, fwk.ReservePlugin):
             # (deleted after binding) can't fake a full gang
             total = self._reserved_members(group, namespace, prune=True)
         if total >= min_available:
+            if gate is not None:
+                if not gate.complete():
+                    # a timeout/rollback flipped the gate first: the wave
+                    # is dead, this member bounces and requeues with its
+                    # siblings (its unreserve finds the gate already
+                    # failed — no double-count)
+                    return (
+                        Status.unschedulable(
+                            f"gang {group!r}: wave failed while completing"
+                        ),
+                        0,
+                    )
+                # committed: the gate is spent — pop it so the next wave
+                # (if this gang ever re-forms) starts clean
+                with self._lock:
+                    if self._gates.get((namespace, group)) is gate:
+                        del self._gates[(namespace, group)]
+                metrics.gang_admitted.inc()
+                if gate.first_park is not None:
+                    dt = max(0.0, time.monotonic() - gate.first_park)
+                    metrics.gang_admission_duration.observe(dt)
+                    self.admission_samples.append(dt)
             for wp in self._waiting_members(group, namespace):
                 wp.allow(self.name)
             return None, 0
@@ -197,21 +447,43 @@ class Coscheduling(fwk.PermitPlugin, fwk.ReservePlugin):
             return
         known = {v1.pod_key(p) for p in cache.list_pods()}
         with self._lock:
+            waiting = set()
+            for gate in self._gates.values():
+                waiting |= gate.members()
             for key in list(self._groups):
-                self._groups[key] &= known
+                self._groups[key] &= known | waiting
                 if not self._groups[key]:
                     del self._groups[key]
 
     def unreserve(self, state: CycleState, pod: v1.Pod, node_name: str) -> None:
-        """A member failed after Reserve: drop it from the index and reject
-        the whole waiting gang so a partial gang doesn't camp on capacity
-        until every timeout fires."""
+        """A member failed after Reserve: drop it from the index and roll
+        the whole waiting wave back (through the gate, so a concurrent
+        completion is arbitrated instead of raced) — a partial gang must
+        not camp on capacity until every timeout fires."""
         group, min_available = pod_group(pod)
         if not group or min_available <= 1:
             return
+        namespace = pod.metadata.namespace
+        key = v1.pod_key(pod)
         with self._lock:
-            members = self._groups.get((pod.metadata.namespace, group))
+            members = self._groups.get((namespace, group))
             if members is not None:
-                members.discard(v1.pod_key(pod))
-        for wp in self._waiting_members(group, pod.metadata.namespace):
+                members.discard(key)
+            gate = self._gates.get((namespace, group))
+        if gate is not None:
+            # only a member of the CURRENT wave takes the wave down with
+            # it: a prior wave's members drain their unreserves through
+            # the binder/drainer threads after the rollback already
+            # started a fresh wave, and those stragglers must not keep
+            # killing every new wave (livelock)
+            if gate.has_member(key):
+                gate.fail(
+                    reason="member-rejected",
+                    message=f"gang member {pod.metadata.name!r} was "
+                            f"unreserved",
+                )
+            return
+        # no live gate (unit-test fakes drive unreserve directly, or the
+        # wave already resolved): fall back to direct rejection
+        for wp in self._waiting_members(group, namespace):
             wp.reject(self.name, f"gang member {pod.metadata.name!r} was unreserved")

@@ -48,6 +48,53 @@ def _pod_priority(pod: v1.Pod) -> int:
     return pod.spec.priority or 0
 
 
+def _unit_prio(unit: List[PodInfo]) -> int:
+    return max(_pod_priority(pi.pod) for pi in unit)
+
+
+def _unit_sort_key(unit: List[PodInfo]):
+    """MoreImportantPod lifted to eviction units: highest member
+    priority desc, then the earliest start among the highest-priority
+    members (a singleton degenerates to the original per-pod key)."""
+    hi = _unit_prio(unit)
+    return (
+        -hi,
+        min(pi.pod.status.start_time or 0.0
+            for pi in unit if _pod_priority(pi.pod) == hi),
+    )
+
+
+def _victim_units(node_info: NodeInfo, pod_prio: int) -> List[List[PodInfo]]:
+    """Same-node eviction units: singletons for plain pods, WHOLE gangs
+    for co-located gang members (gang-aware preemption evicts whole
+    gangs or none, so the dry run removes/reprieves a gang's local
+    members as one indivisible unit). A gang unit is evictable only
+    when EVERY co-located member outranks below the preemptor — a mixed
+    gang stays untouched rather than losing a prefix. Members are
+    pre-sorted by MoreImportantPod so PDB allowance consumption and the
+    victim append order are deterministic."""
+    from .coscheduling import pod_group
+
+    def key(pi: PodInfo):
+        return (-_pod_priority(pi.pod), pi.pod.status.start_time or 0.0)
+
+    gangs: Dict[Tuple[str, str], List[PodInfo]] = {}
+    units: List[List[PodInfo]] = []
+    for pi in list(node_info.pods):
+        group, min_available = pod_group(pi.pod)
+        if group and min_available > 1:
+            gangs.setdefault(
+                (pi.pod.metadata.namespace, group), []
+            ).append(pi)
+        elif _pod_priority(pi.pod) < pod_prio:
+            units.append([pi])
+    for members in gangs.values():
+        if all(_pod_priority(pi.pod) < pod_prio for pi in members):
+            members.sort(key=key)
+            units.append(members)
+    return units
+
+
 class DefaultPreemption(fwk.PostFilterPlugin):
     name = "DefaultPreemption"
 
@@ -152,10 +199,11 @@ class DefaultPreemption(fwk.PostFilterPlugin):
         state = state.clone()
         node_info = node_info.clone()
         pod_prio = _pod_priority(pod)
-        potential_victims: List[PodInfo] = [
-            pi for pi in list(node_info.pods) if _pod_priority(pi.pod) < pod_prio
-        ]
-        if not potential_victims:
+        # same-node eviction units: gangs are indivisible (whole gangs
+        # or none); a singleton unit reproduces the original per-pod
+        # dry run exactly
+        units = _victim_units(node_info, pod_prio)
+        if not units:
             return None
         # :612 sorts by MoreImportantPod (priority desc, earlier start
         # first) BEFORE filterPodsWithPDBViolation: PDB allowances are
@@ -163,37 +211,39 @@ class DefaultPreemption(fwk.PostFilterPlugin):
         # victims than it allows, the LEAST important ones are the
         # violating group. The reprieve re-sorts each group with the
         # same key, so the sort changes only allowance consumption.
-        potential_victims.sort(
-            key=lambda pi: (-_pod_priority(pi.pod),
-                            pi.pod.status.start_time or 0.0)
-        )
-        for pi in potential_victims:
-            node_info.remove_pod(pi.pod)
-            self.handle.run_pre_filter_extension_remove_pod(state, pod, pi, node_info)
-        # base feasibility with every lower-priority pod gone
+        units.sort(key=_unit_sort_key)
+        for unit in units:
+            for pi in unit:
+                node_info.remove_pod(pi.pod)
+                self.handle.run_pre_filter_extension_remove_pod(
+                    state, pod, pi, node_info)
+        # base feasibility with every lower-priority unit gone
         if self._run_filters(state, pod, node_info) is not None:
             return None
-        violating, non_violating = self._split_by_pdb(potential_victims, pdbs)
+        violating, non_violating = self._split_units_by_pdb(units, pdbs)
         victims: List[v1.Pod] = []
         num_violations = 0
 
-        def reprieve(pi: PodInfo) -> bool:
-            node_info.add_pod_info(pi)
-            self.handle.run_pre_filter_extension_add_pod(state, pod, pi, node_info)
+        def reprieve(unit: List[PodInfo]) -> bool:
+            for pi in unit:
+                node_info.add_pod_info(pi)
+                self.handle.run_pre_filter_extension_add_pod(
+                    state, pod, pi, node_info)
             if self._run_filters(state, pod, node_info) is None:
-                return True  # fits with this pod back — reprieved
-            node_info.remove_pod(pi.pod)
-            self.handle.run_pre_filter_extension_remove_pod(state, pod, pi, node_info)
-            victims.append(pi.pod)
+                return True  # fits with this unit back — reprieved
+            for pi in unit:
+                node_info.remove_pod(pi.pod)
+                self.handle.run_pre_filter_extension_remove_pod(
+                    state, pod, pi, node_info)
+            victims.extend(pi.pod for pi in unit)
             return False
 
         # highest priority first, PDB-violating group first (:633-646)
-        key = lambda pi: (-_pod_priority(pi.pod), pi.pod.status.start_time or 0.0)
-        for pi in sorted(violating, key=key):
-            if not reprieve(pi):
-                num_violations += 1
-        for pi in sorted(non_violating, key=key):
-            reprieve(pi)
+        for unit in sorted(violating, key=_unit_sort_key):
+            if not reprieve(unit):
+                num_violations += len(unit)
+        for unit in sorted(non_violating, key=_unit_sort_key):
+            reprieve(unit)
         if not victims:
             return None
         return Candidate(node_info.node.metadata.name, victims, num_violations)
@@ -233,6 +283,39 @@ class DefaultPreemption(fwk.PostFilterPlugin):
                 else:
                     allowed[i] -= 1
             (violating if hit else ok).append(pi)
+        return violating, ok
+
+    def _split_units_by_pdb(
+        self, units: List[List[PodInfo]], pdbs: List[v1.PodDisruptionBudget]
+    ) -> Tuple[List[List[PodInfo]], List[List[PodInfo]]]:
+        """_split_by_pdb lifted to eviction units: members consume
+        allowances in the caller's unit order (members within a unit in
+        their pre-sorted order); a unit is violating when ANY member
+        hits an exhausted budget — the whole gang moves to the
+        reprieved-last group together."""
+        if not pdbs:
+            return [], list(units)
+        allowed = [p.status.disruptions_allowed for p in pdbs]
+        selectors = [
+            Selector.from_label_selector(p.spec.selector) if p.spec.selector else None
+            for p in pdbs
+        ]
+        violating, ok = [], []
+        for unit in units:
+            hit = False
+            for pi in unit:
+                pod = pi.pod
+                for i, pdb in enumerate(pdbs):
+                    if pdb.metadata.namespace != pod.metadata.namespace:
+                        continue
+                    sel = selectors[i]
+                    if sel is None or not sel.matches(pod.metadata.labels):
+                        continue
+                    if allowed[i] <= 0:
+                        hit = True
+                    else:
+                        allowed[i] -= 1
+            (violating if hit else ok).append(unit)
         return violating, ok
 
     # -- candidate choice (:457 pickOneNodeForPreemption) ------------------

@@ -262,9 +262,18 @@ class FastPreemptionPlanner:
         lo_sum = {p: np.zeros((D, N), dtype=np.int64) for p in wave_prios}
         lo_cnt = {p: np.zeros(N, dtype=np.int64) for p in wave_prios}
         per_node: List[List] = []
+        from .plugins.coscheduling import pod_group
+
         for i, ni in enumerate(self.nodes):
             self._npods[i] = len(ni.pods)
             self._max_pods[i] = ni.allocatable.allowed_pod_number
+            # victim slots are same-node eviction UNITS: singletons for
+            # plain pods, whole gangs for co-located gang members (the
+            # oracle's _victim_units — whole gangs or none). A unit's
+            # slot carries the members' summed request vector; its
+            # priority is the members' MAX so the `< prio` validity
+            # check admits a gang only when EVERY member is outranked
+            gang_units: Dict[Tuple[str, str], List[v1.Pod]] = {}
             victims = []
             for pi in ni.pods:
                 if v1.pod_key(pi.pod) in self.claimed_victims:
@@ -274,17 +283,42 @@ class FastPreemptionPlanner:
                     self._used[:, i] -= self._req_vec(pi.pod)
                     self._npods[i] -= 1
                     continue
+                group, min_available = pod_group(pi.pod)
+                if group and min_available > 1:
+                    gang_units.setdefault(
+                        (pi.pod.metadata.namespace, group), []
+                    ).append(pi.pod)
+                    continue
                 vp = _prio(pi.pod)
                 if vp >= wave_prios[-1]:
                     continue
                 vec = self._req_vec(pi.pod)
                 victims.append(
-                    (vp, pi.pod.status.start_time or 0.0, vec, pi.pod)
+                    (vp, pi.pod.status.start_time or 0.0, vec, [pi.pod])
                 )
                 for p in wave_prios:
                     if vp < p:
                         lo_sum[p][:, i] += vec
                         lo_cnt[p][i] += 1
+            for members in gang_units.values():
+                vp = max(_prio(m) for m in members)
+                if vp >= wave_prios[-1]:
+                    continue
+                members.sort(
+                    key=lambda m: (-_prio(m), m.status.start_time or 0.0)
+                )
+                vec = np.sum(
+                    [self._req_vec(m) for m in members], axis=0
+                ).astype(np.int64)
+                start = min(
+                    m.status.start_time or 0.0
+                    for m in members if _prio(m) == vp
+                )
+                victims.append((vp, start, vec, members))
+                for p in wave_prios:
+                    if vp < p:
+                        lo_sum[p][:, i] += vec
+                        lo_cnt[p][i] += len(members)
             # victims stored in ni.pods ORDER; both PDB allowance
             # consumption (:612 sorts by MoreImportantPod BEFORE
             # filterPodsWithPDBViolation) and the reprieve (highest
@@ -303,11 +337,22 @@ class FastPreemptionPlanner:
         self._vprio = np.full((N, max(Vmax, 1)), _PRIO_SENTINEL, dtype=np.int64)
         self._vstart = np.zeros((N, max(Vmax, 1)), dtype=np.float64)
         self._valive = np.zeros((N, max(Vmax, 1)), dtype=bool)
-        self._vpods: List[List[Optional[v1.Pod]]] = []
-        # PDB match tensor [N, Vmax, P]: does evicting victim (i, j)
-        # consume pdb p's budget (same namespace + selector match)?
+        # per-slot unit shape: member count (pod-count arithmetic +
+        # victim tallies), summed member priority (the pick ladder's
+        # sum_prio is per POD), and the LATEST start among the slot's
+        # highest-priority members (_vstart keeps the EARLIEST — the
+        # MoreImportantPod sort key — while the ladder's latest-start
+        # tiebreak reads per-pod maxima)
+        self._vsize = np.zeros((N, max(Vmax, 1)), dtype=np.int64)
+        self._vpriosum = np.zeros((N, max(Vmax, 1)), dtype=np.int64)
+        self._vlatest_hi = np.zeros((N, max(Vmax, 1)), dtype=np.float64)
+        self._vpods: List[List[List[v1.Pod]]] = []
+        # PDB match tensor [N, Vmax, P]: how many of slot (i, j)'s
+        # members consume pdb p's budget (same namespace + selector
+        # match)? Counts, not booleans — a gang unit can hold several
+        # matching members
         P = len(self.pdbs)
-        self._pdb_match = np.zeros((N, max(Vmax, 1), max(P, 1)), dtype=bool)
+        self._pdb_match = np.zeros((N, max(Vmax, 1), max(P, 1)), dtype=np.int64)
         self._pdb_allowed = np.zeros(max(P, 1), dtype=np.int64)
         sels = []
         if P:
@@ -320,20 +365,27 @@ class FastPreemptionPlanner:
                     if pdb.spec.selector else None
                 )
         for i, victims in enumerate(per_node):
-            pods_row: List[Optional[v1.Pod]] = []
-            for j, (vp, start, vec, vpod) in enumerate(victims):
+            pods_row: List[List[v1.Pod]] = []
+            for j, (vp, start, vec, members) in enumerate(victims):
                 self._vvec[i, j] = vec
                 self._vprio[i, j] = vp
                 self._vstart[i, j] = start
                 self._valive[i, j] = True
-                pods_row.append(vpod)
-                for p_i, pdb in enumerate(self.pdbs):
-                    if pdb.metadata.namespace != vpod.metadata.namespace:
-                        continue
-                    sel = sels[p_i]
-                    if sel is not None and sel.matches(
-                            vpod.metadata.labels):
-                        self._pdb_match[i, j, p_i] = True
+                self._vsize[i, j] = len(members)
+                self._vpriosum[i, j] = sum(_prio(m) for m in members)
+                self._vlatest_hi[i, j] = max(
+                    m.status.start_time or 0.0
+                    for m in members if _prio(m) == vp
+                )
+                pods_row.append(members)
+                for vpod in members:
+                    for p_i, pdb in enumerate(self.pdbs):
+                        if pdb.metadata.namespace != vpod.metadata.namespace:
+                            continue
+                        sel = sels[p_i]
+                        if sel is not None and sel.matches(
+                                vpod.metadata.labels):
+                            self._pdb_match[i, j, p_i] += 1
             self._vpods.append(pods_row)
         # reprieve permutation: order victims (highest priority, earliest
         # start); padding rows sort last
@@ -508,22 +560,23 @@ class FastPreemptionPlanner:
                     & (violating[rows, j] == in_violating_group)
                 )
                 vec = self._vvec[C, j].T  # [D, C]
-                can = valid & (slots >= 1) & np.all(vec <= free, axis=0)
+                size = self._vsize[C, j]  # unit member count [C]
+                can = valid & (slots >= size) & np.all(vec <= free, axis=0)
                 free = free - np.where(can, vec, 0)
-                slots = slots - can
+                slots = slots - np.where(can, size, 0)
                 vic = valid & ~can
                 victim_mask[rows, j] |= vic
-                n_vict += vic
+                n_vict += np.where(vic, size, 0)
                 if in_violating_group:
-                    n_pdbv += vic
+                    n_pdbv += np.where(vic, size, 0)
+                sum_prio += np.where(vic, self._vpriosum[C, j], 0)
                 vp = self._vprio[C, j]
-                sum_prio += np.where(vic, vp, 0)
                 max_prio = np.maximum(
                     max_prio, np.where(vic, vp, np.iinfo(np.int64).min))
         # latest start among each candidate's HIGHEST-priority victims
         hi_mask = victim_mask & (self._vprio[C] == max_prio[:, None])
         latest = np.max(
-            np.where(hi_mask, self._vstart[C], -np.inf), axis=1
+            np.where(hi_mask, self._vlatest_hi[C], -np.inf), axis=1
         )
         ci = self._pick_index(n_vict > 0, n_pdbv, max_prio, sum_prio,
                               n_vict, latest)
@@ -552,7 +605,13 @@ class FastPreemptionPlanner:
         is host bookkeeping on both rungs)."""
         Csz = C.size
         rows = np.arange(Csz)
-        violating = np.zeros((Csz, self._vmax), dtype=bool)
+        # width max(vmax, 1) like every sibling wave-book array
+        # (_valive/_vprio/_vsort): the device rung gathers through the
+        # _vsort permutation even when ZERO eviction units exist
+        # cluster-wide (e.g. every resident pod sits inside a mixed
+        # gang) — it still owes the caller the launch's fits_now
+        # verdict — and a width-0 row here would throw the gather
+        violating = np.zeros((Csz, max(self._vmax, 1)), dtype=bool)
         if self.pdbs:
             allowed_rem = np.repeat(
                 self._pdb_allowed[:, None], Csz, axis=1
@@ -560,9 +619,16 @@ class FastPreemptionPlanner:
             for v in range(self._vmax):
                 j = self._vsort[C, v]  # per-candidate column [C]
                 valid_o = self._valive[C, j] & (self._vprio[C, j] < prio)
-                m = self._pdb_match[C, j, :].T & valid_o[None, :]  # [P, C]
-                violating[rows, j] = np.any(m & (allowed_rem <= 0), axis=0)
-                allowed_rem -= m & (allowed_rem > 0)
+                # per-slot MATCH COUNTS (a gang unit may hold several
+                # members of one budget): the unit violates when its
+                # members outnumber the remaining allowance — the exact
+                # member-sequential consumption the oracle runs, since
+                # members beyond the allowance each hit an exhausted
+                # budget at their turn
+                m = self._pdb_match[C, j, :].T * valid_o[None, :]  # [P, C]
+                avail = np.maximum(allowed_rem, 0)
+                violating[rows, j] = np.any(m > avail, axis=0)
+                allowed_rem -= np.minimum(m, avail)
         return violating
 
     @staticmethod
@@ -601,33 +667,39 @@ class FastPreemptionPlanner:
             else min(self._nom_min_prio, prio)
         )
         victim_keys = {v1.pod_key(v) for v in cand.victims}
-        for j, vpod in enumerate(self._vpods[i]):
-            if vpod is None or v1.pod_key(vpod) not in victim_keys:
+        for j, slot_pods in enumerate(self._vpods[i]):
+            if not slot_pods or not any(
+                v1.pod_key(vp) in victim_keys for vp in slot_pods
+            ):
                 continue
             # gone from the node: present-resources AND the
-            # lower-priority prefixes both drop
+            # lower-priority prefixes both drop. Units leave WHOLE
+            # (candidates only ever contain complete units)
             vp = int(self._vprio[i, j])
             vec = self._vvec[i, j]
+            size = int(self._vsize[i, j])
             self._valive[i, j] = False
-            self._vpods[i][j] = None
+            self._vpods[i][j] = []
             self._used[:, i] -= vec
-            self._npods[i] -= 1
+            self._npods[i] -= size
             for p in self._lower_sum:
                 if vp < p:
                     self._lower_sum[p][:, i] -= vec
-                    self._lower_cnt[p][i] -= 1
+                    self._lower_cnt[p][i] -= size
 
 
 def _ordered_victims(pods_row, victim_mask, violating_row, vsort, vmax):
     """Victims in the oracle's append order: the violating group first,
     then the rest, each in reprieve (priority desc, start asc) order —
-    Candidate.victims ordering is observable (eviction order)."""
+    Candidate.victims ordering is observable (eviction order). A slot's
+    members (one pod, or a whole gang unit pre-sorted by
+    MoreImportantPod) append consecutively."""
     out = []
     for in_violating_group in (True, False):
         for v in range(vmax):
             j = int(vsort[v])
             if victim_mask[j] and bool(violating_row[j]) == in_violating_group:
-                out.append(pods_row[j])
+                out.extend(pods_row[j])
     return out
 
 

@@ -124,29 +124,34 @@ class DevicePreemptionPlanner(FastPreemptionPlanner):
              for ni in self.nodes],
             dtype=np.int64,
         )
-        # victim device rows, dense by (planner node, victim slot):
-        # encoding-dim request rows + label rows for the per-template
-        # match tensors; terminating victims carry a flag (their PTS
-        # count contribution is zero — the prologue's ~pterm gate)
+        # victim device rows, dense by (planner node, victim slot): a
+        # slot is an eviction UNIT (singleton or whole co-located gang)
+        # — its request row is the members' SUM, while label rows and
+        # terminating flags stay per member (match tensors and the
+        # prologue's ~pterm PTS gate are per-pod facts the slot
+        # aggregates at tensor-prep time)
         R = enc._arrays["requested"].shape[1] if enc._arrays else 0
         self._enc_r = R
         vm = max(self._vmax, 1)
         self._v_enc_req = np.zeros((self.n, vm, R), np.int64)
-        self._v_rows: List[List[Optional[Dict]]] = [
-            [None] * vm for _ in range(self.n)
+        self._v_rows: List[List[List[Dict]]] = [
+            [[] for _ in range(vm)] for _ in range(self.n)
         ]
-        self._v_terminating = np.zeros((self.n, vm), bool)
+        self._v_term: List[List[List[bool]]] = [
+            [[] for _ in range(vm)] for _ in range(self.n)
+        ]
         for i in range(self.n):
-            for j, vpod in enumerate(self._vpods[i]):
-                if vpod is None:
-                    continue
-                vec, _nz = enc.pod_row_delta(vpod)
-                if vec.shape[0] == R:
-                    self._v_enc_req[i, j] = vec
-                self._v_rows[i][j] = self.backend._pod_self_rows(vpod)
-                self._v_terminating[i, j] = (
-                    vpod.metadata.deletion_timestamp is not None
-                )
+            for j, slot_pods in enumerate(self._vpods[i]):
+                for vpod in slot_pods:
+                    vec, _nz = enc.pod_row_delta(vpod)
+                    if vec.shape[0] == R:
+                        self._v_enc_req[i, j] += vec
+                    self._v_rows[i][j].append(
+                        self.backend._pod_self_rows(vpod)
+                    )
+                    self._v_term[i][j].append(
+                        vpod.metadata.deletion_timestamp is not None
+                    )
         # claimed victims (earlier in-flight waves): resident in the
         # encoding but already spoken for — every what-if state drains
         # them, at topology-pair granularity (their groups span nodes)
@@ -189,12 +194,19 @@ class DevicePreemptionPlanner(FastPreemptionPlanner):
         keys = {v1.pod_key(vp) for vp in cand.victims}
         claimed_rows = []
         if lane >= 0:
-            for j, vp in enumerate(self._vpods[i]):
-                if vp is not None and v1.pod_key(vp) in keys:
+            enc = self.backend.enc
+            for j, slot_pods in enumerate(self._vpods[i]):
+                for m, vp in enumerate(slot_pods):
+                    if v1.pod_key(vp) not in keys:
+                        continue
+                    # per-MEMBER request rows (the slot's _v_enc_req is
+                    # the unit sum; claimed drains stay per pod)
+                    vec, _nz = enc.pod_row_delta(vp)
                     claimed_rows.append((
-                        lane, self._v_rows[i][j],
-                        self._v_enc_req[i, j].copy(),
-                        bool(self._v_terminating[i, j]),
+                        lane, self._v_rows[i][j][m],
+                        vec if vec.shape[0] == self._enc_r
+                        else np.zeros(self._enc_r, np.int64),
+                        bool(self._v_term[i][j][m]),
                     ))
         super()._claim(cand, pod, prio, req)
         # the victims just left the books; later what-ifs must drain
@@ -317,38 +329,47 @@ class DevicePreemptionPlanner(FastPreemptionPlanner):
             slot_vio = np.concatenate(
                 [slot_vio, np.zeros((self.n, pad), bool)], axis=1)
 
-        # -- victim tensors in encoding-lane space -------------------------
+        # -- victim tensors in encoding-lane space: a slot aggregates
+        # its unit's members (per-member match rows summed; request row
+        # is the prebuilt unit sum; cnt carries the member count the
+        # kernel's pod-count filter releases/re-adds per slot) ---------
         same_key = nps["f_same_key"].astype(np.int32)      # [C, C]
         C_n = same_key.shape[0]
         taa = nps["ipaaa_valid"].shape[0]
         flat_rows: List[Dict] = []
-        flat_pos: List[Tuple[int, int]] = []  # (planner node, slot)
+        flat_pos: List[Tuple[int, int, int]] = []  # (node, slot, member)
         for i in range(self.n):
             for s in range(L):
                 if slot_valid[i, s]:
-                    flat_rows.append(self._v_rows[i][int(slot_j[i, s])])
-                    flat_pos.append((i, s))
+                    j = int(slot_j[i, s])
+                    for m, row in enumerate(self._v_rows[i][j]):
+                        flat_rows.append(row)
+                        flat_pos.append((i, s, m))
         mf_flat, manti_flat, mall_flat = self._match_rows(
             ctx, nps, tj, flat_rows)
         # terminating victims never entered the PTS counts (~pterm gate)
-        for b, (i, s) in enumerate(flat_pos):
-            if self._v_terminating[i, int(slot_j[i, s])]:
+        for b, (i, s, m) in enumerate(flat_pos):
+            if self._v_term[i][int(slot_j[i, s])][m]:
                 mf_flat[b] = 0
         mfs_flat = mf_flat @ same_key.T                    # [B, C]
         v = {
             "valid": np.zeros((Ncap, L), bool),
+            "cnt": np.zeros((Ncap, L), np.int64),
             "req": np.zeros((Ncap, L, self._enc_r), np.int64),
             "mfs": np.zeros((Ncap, L, C_n), np.int32),
             "manti": np.zeros((Ncap, L, taa), np.int32),
             "mall": np.zeros((Ncap, L), np.int32),
         }
-        for b, (i, s) in enumerate(flat_pos):
+        for b, (i, s, m) in enumerate(flat_pos):
             lane = int(lanes[i])
-            v["valid"][lane, s] = True
-            v["req"][lane, s] = self._v_enc_req[i, int(slot_j[i, s])]
-            v["mfs"][lane, s] = mfs_flat[b]
-            v["manti"][lane, s] = manti_flat[b]
-            v["mall"][lane, s] = mall_flat[b]
+            j = int(slot_j[i, s])
+            if not v["valid"][lane, s]:
+                v["valid"][lane, s] = True
+                v["cnt"][lane, s] = self._vsize[i, j]
+                v["req"][lane, s] = self._v_enc_req[i, j]
+            v["mfs"][lane, s] += mfs_flat[b]
+            v["manti"][lane, s] += manti_flat[b]
+            v["mall"][lane, s] += mall_flat[b]
 
         nom = self._nom_tensors(ctx, nps, tj, prio, Ncap, C_n, taa,
                                 same_key)
@@ -385,22 +406,30 @@ class DevicePreemptionPlanner(FastPreemptionPlanner):
         vmask = vmask & slot_valid[Cc]
         sj = slot_j[Cc]
         vprio = self._vprio[Cc[:, None], sj]
-        vstart = self._vstart[Cc[:, None], sj]
-        n_vict = vmask.sum(axis=1)
-        n_pdbv = (vmask & slot_vio[Cc]).sum(axis=1)
-        sum_prio = np.where(vmask, vprio, 0).sum(axis=1)
+        vsize = self._vsize[Cc[:, None], sj]
+        # pick-ladder tallies are per POD, not per slot: a gang unit
+        # contributes its member count / summed priorities / latest
+        # highest-priority start
+        n_vict = np.where(vmask, vsize, 0).sum(axis=1)
+        n_pdbv = np.where(vmask & slot_vio[Cc], vsize, 0).sum(axis=1)
+        sum_prio = np.where(
+            vmask, self._vpriosum[Cc[:, None], sj], 0
+        ).sum(axis=1)
         max_prio = np.where(vmask, vprio, _I64_MIN).max(
             axis=1, initial=_I64_MIN)
         hi_mask = vmask & (vprio == max_prio[:, None])
-        latest = np.max(np.where(hi_mask, vstart, -np.inf), axis=1)
+        latest = np.max(np.where(
+            hi_mask, self._vlatest_hi[Cc[:, None], sj], -np.inf
+        ), axis=1)
         ci = self._pick_index(n_vict > 0, n_pdbv, max_prio, sum_prio,
                               n_vict, latest)
         if ci is None:
             return False, None
         i = int(Cc[ci])
         victims = [
-            self._vpods[i][int(sj[ci, s])]
+            vp
             for s in range(L) if vmask[ci, s]
+            for vp in self._vpods[i][int(sj[ci, s])]
         ]
         cand = Candidate(
             self.nodes[i].node.metadata.name, victims,

@@ -197,6 +197,13 @@ class Scheduler:
         self._permit_released: List[Tuple] = []
         self._permit_wake = threading.Event()
         self._permit_thread: Optional[threading.Thread] = None
+        # gang deadlock-breaker hysteresis: (ns, group) -> (membership
+        # signature, consecutive stalled ticks); a back-off fires only
+        # after KTPU_GANG_DEADLOCK_TICKS identical observations with
+        # >=2 gangs stalled, and never the same gang twice in a row
+        self._gang_stall: Dict[Tuple[str, str], Tuple] = {}
+        self._gang_tick_last = 0.0
+        self._gang_last_backoff: Optional[Tuple[str, str]] = None
         # in-flight preemptions, tracked per NOMINATED NODE: a node's
         # preemptors are parked until the node's ENTIRE claimed victim
         # set has delete-echoed, then queue.activate()d together —
@@ -452,6 +459,28 @@ class Scheduler:
                 self.nominator.delete_nominated_pod_if_exists(pod)
                 self.queue.delete(pod)
                 self._clear_preempt_tracking(pod)
+                # a deleted pod parked at Permit must resolve NOW, not
+                # camp assumed until its timeout — and if it is a gang
+                # member, the whole gang rolls back with it (its wave
+                # can never complete; partial gangs must not hold
+                # capacity)
+                fwk = self.framework
+                if fwk is not None and hasattr(fwk, "get_waiting_pod") \
+                        and fwk.get_waiting_pod(v1.pod_key(pod)) is not None:
+                    gang = self._gang_plugin()
+                    if gang is not None:
+                        gang.reject_gang_of(
+                            pod, "member-deleted",
+                            message=f"gang member "
+                                    f"{pod.metadata.name!r} was deleted "
+                                    f"while waiting at Permit",
+                        )
+                    # non-gang waiting pods (or a raced gate): direct
+                    # rejection is the idempotent backstop
+                    fwk.reject_waiting_pod(
+                        v1.pod_key(pod), "Scheduler",
+                        "pod deleted while waiting at Permit",
+                    )
 
         pods.add_event_handler(
             EventHandler(on_add=on_pod_add, on_update=on_pod_update, on_delete=on_pod_delete)
@@ -577,6 +606,19 @@ class Scheduler:
         writes still in binder threads must be rejected server-side,
         not escape unfenced."""
         self.pause()
+        # roll back every waiting gang BEFORE draining: the parked
+        # members hold assumed capacity this instance no longer owns —
+        # the successor relists and reschedules them, and a deposed
+        # leader completing a gang later would only bounce off the
+        # fence one member-bind at a time. Whole waves, never a prefix.
+        gang = self._gang_plugin()
+        if gang is not None:
+            for gate in gang.waiting_gangs():
+                gang.reject_gang(
+                    gate.namespace, gate.group, "demotion",
+                    message="scheduler demoted while the gang waited "
+                            "at Permit",
+                )
         with self._completion_cv:
             fifo_pods = [
                 info.pod for item in self._completions for info in item[0]
@@ -651,6 +693,13 @@ class Scheduler:
                     continue  # an in-flight bind of ours owns it
                 self.queue.add(pod)
                 counts["requeued"] += 1
+            gang = self._gang_plugin()
+            if gang is not None:
+                try:
+                    self._reconcile_gangs(gang, pods)
+                except Exception:  # noqa: BLE001 — gang healing must
+                    # not break the base reconcile
+                    traceback.print_exc()
             self._drain_requeued.clear()
             for outcome, n in counts.items():
                 if n:
@@ -661,6 +710,48 @@ class Scheduler:
                 counts["adopted"], counts["requeued"], counts["cleared"],
             )
             return counts
+
+    def _reconcile_gangs(self, gang, pods: List[v1.Pod]) -> None:
+        """Promotion-time gang healing (the gang extension of the
+        cold-restart reconcile): (1) bound gang members from a prior
+        leader SEED the reserved-member index, so their re-driven
+        siblings rejoin the partially-bound gang instead of waiting on
+        a full fresh wave that can never assemble; (2) orphaned gang
+        reservations — waves still parked HERE (a re-promoted leader)
+        whose members are gone from the store, bound by another
+        instance, or older than KTPU_GANG_PERMIT_TIMEOUT — roll back
+        whole (reason=reconcile), releasing the capacity a dead
+        transaction was camping on. A deposed leader's own late
+        member-binds need no handling here: they bounce off the lease
+        fence server-side (FenceExpired -> forget, never requeue)."""
+        for pod in pods:
+            if pod.spec.node_name and pod.metadata.deletion_timestamp is None:
+                gang.seed_reserved(pod)
+        by_key = {v1.pod_key(p): p for p in pods}
+        timeout = knobs.get_float("KTPU_GANG_PERMIT_TIMEOUT") or 0.0
+        now = _time.monotonic()
+        for gate in gang.waiting_gangs():
+            reason = None
+            if gate.age(now) > timeout:
+                reason = (
+                    f"gang {gate.group!r}: wave older than "
+                    f"KTPU_GANG_PERMIT_TIMEOUT ({timeout:.0f}s) at "
+                    f"promotion"
+                )
+            else:
+                for k in gate.members():
+                    p = by_key.get(k)
+                    if p is None or p.metadata.deletion_timestamp is not None \
+                            or p.spec.node_name:
+                        reason = (
+                            f"gang {gate.group!r}: waiting member {k} is "
+                            f"no longer pending in the store"
+                        )
+                        break
+            if reason is not None:
+                gang.reject_gang(
+                    gate.namespace, gate.group, "reconcile", message=reason
+                )
 
     def _reconcile_clear_nomination(self, pod: v1.Pod) -> None:
         """A relisted unbound pod carries a nomination from a preemption
@@ -1228,6 +1319,7 @@ class Scheduler:
 
         bound: List[Tuple] = []  # (info, node)
         failed: List = []
+        gang = self._gang_plugin()
         for info in todo:
             node = by_key.get(v1.pod_key(info.pod))
             if node == RETRY_NODE:
@@ -1237,6 +1329,18 @@ class Scheduler:
                 # gate: a recovery-abandoned batch resolves RETRY while
                 # overlapping flights chained on its carry.
                 self._dropped_decisions += 1
+                if gang is not None:
+                    # a gang member's dispatch abandoned (device fault /
+                    # recovery): re-drive the ENTIRE gang, never a
+                    # prefix — roll back its waiting wave so parked
+                    # siblings release their reservations and requeue
+                    # alongside this member
+                    gang.reject_gang_of(
+                        info.pod, "device-fault",
+                        message=f"gang member "
+                                f"{info.pod.metadata.name!r} abandoned "
+                                f"mid-dispatch (device fault recovery)",
+                    )
                 self.queue.add(info.pod)
             elif node is None:
                 failed.append(info)
@@ -1635,7 +1739,9 @@ class Scheduler:
             if not self._preemption_in_flight(pod):
                 self.queue.activate(pod)
 
-        def _effects(items=items):
+        extra_victims = self._gang_preemption_closure(items)
+
+        def _effects(items=items, extra_victims=extra_victims):
             # victims first — their deletion unblocks the preemptors; the
             # status patch is observability (the in-memory nominated_node
             # already steers the queue and the placement short-circuit)
@@ -1668,6 +1774,24 @@ class Scheduler:
                             "victim delete failed for %s",
                             v1.pod_key(victim), exc_info=True,
                         )
+            # gang closure: bound siblings of evicted gang members go
+            # too (whole gangs or none), same echo bookkeeping
+            for victim in extra_victims:
+                try:
+                    self.client.pods.delete(
+                        victim.metadata.name, victim.metadata.namespace,
+                        fence=self._fence,
+                    )
+                except NotFound:
+                    if self.informers.pods().get(
+                        meta_namespace_key(victim)
+                    ) is None:
+                        self._on_victim_deleted(victim)
+                except APIError:
+                    logger.warning(
+                        "gang sibling delete failed for %s",
+                        v1.pod_key(victim), exc_info=True,
+                    )
             for info, cand in items:
                 try:
                     fresh = self.client.pods.get(
@@ -1686,6 +1810,68 @@ class Scheduler:
             with self._inflight_lock:
                 self._inflight -= 1
             _effects()
+
+    def _gang_preemption_closure(self, items: List[Tuple]) -> List[v1.Pod]:
+        """Whole-gangs-or-none eviction closure for a preemption wave.
+
+        The planners already emit same-node gang victims as indivisible
+        units; what they cannot see is a victim gang's members bound on
+        OTHER nodes.  One informer pass finds those bound siblings and
+        registers them on the claiming preemptor's node wave (so the
+        preemptor re-activates only once the whole gang's deletes have
+        echoed), returning them for _effects to delete.  Any
+        still-waiting wave of a victim gang is rolled back too — its
+        parked members release their reservations rather than straggle
+        in as a partial gang."""
+        from .plugins.coscheduling import pod_group
+
+        # (ns, group) -> node wave that claims the closure's echoes
+        gang_nodes: Dict[Tuple[str, str], str] = {}
+        claimed = set()
+        for info, cand in items:
+            for victim in cand.victims:
+                claimed.add(v1.pod_key(victim))
+                group, min_available = pod_group(victim)
+                if group and min_available > 1:
+                    gk = (victim.metadata.namespace, group)
+                    gang_nodes.setdefault(gk, cand.node_name)
+        if not gang_nodes:
+            return []
+
+        extra: List[v1.Pod] = []
+        for pod in self.informers.pods().list():
+            group, min_available = pod_group(pod)
+            if not group or min_available <= 1:
+                continue
+            node = gang_nodes.get((pod.metadata.namespace, group))
+            if node is None:
+                continue
+            key = v1.pod_key(pod)
+            if key in claimed:
+                continue
+            if not pod.spec.node_name or pod.metadata.deletion_timestamp:
+                continue
+            with self._preempt_lock:
+                if key in self._victim_waiters:
+                    continue  # already claimed by an in-flight wave
+                pending, _infos = self._node_waves.setdefault(
+                    node, (set(), [])
+                )
+                pending.add(key)
+                self._victim_waiters[key] = node
+            claimed.add(key)
+            extra.append(pod)
+
+        gangpl = self._gang_plugin()
+        for (ns, group), _node in gang_nodes.items():
+            metrics.gang_preempted.inc()
+            if gangpl is not None:
+                gangpl.reject_gang(
+                    ns, group, "preempted",
+                    message=f"gang {group!r} preempted by higher-priority "
+                            f"pod(s); rolling back its waiting members",
+                )
+        return extra
 
     def _clear_nomination(self, info) -> None:
         """util.ClearNominatedNodeName equivalent: the nomination can no
@@ -1943,11 +2129,17 @@ class Scheduler:
             # acquisition for the (vast) non-expired majority
             if now >= wp.deadline:
                 wp.timeout_if_due(now)  # fires the release listener
+        try:
+            self._gang_deadlock_tick(now)
+        except Exception:  # noqa: BLE001 — the breaker observes; a bug
+            # in it must not kill the drainer
+            traceback.print_exc()
         with self._permit_lock:
             released, self._permit_released = self._permit_released, []
         if not released:
             return
         items: List[Tuple] = []
+        aborted: List[Tuple[v1.Pod, str]] = []
         fwk = self.framework
         for assumed, node_name, state, info, _wp in released:
             try:
@@ -1955,7 +2147,7 @@ class Scheduler:
                 st = fwk.wait_on_permit(assumed)
                 if st is not None and not st.is_success():
                     fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
-                    self._abort_binding(assumed, f"Permit: {st.message()}")
+                    aborted.append((assumed, f"Permit: {st.message()}"))
                     with self._inflight_lock:
                         self._inflight -= 1
                     continue
@@ -1971,6 +2163,15 @@ class Scheduler:
                     traceback.print_exc()
                 continue
             items.append((assumed, node_name, state, info))
+        if aborted:
+            # a gang rollback rejects the whole wave into ONE drain pass:
+            # abort it as one batch (single cache lock, one carry-delta
+            # batch to the device session), each member requeued exactly
+            # once — its WaitingPod resolved exactly once to get here
+            try:
+                self._abort_binding_batch(aborted)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
         if items:
             # hand the whole release wave to the batched binding cycle;
             # swap the per-pod inflight holds for the batch's single one
@@ -2228,6 +2429,126 @@ class Scheduler:
         retry = serde.from_dict(v1.Pod, serde.to_dict(assumed))
         retry.spec.node_name = ""
         self.queue.add(retry)
+
+    def _abort_binding_batch(self, items: List[Tuple[v1.Pod, str]]) -> None:
+        """_abort_binding for a whole rollback wave (a rejected gang):
+        one batched cache forget — the device session absorbs the
+        wave's released capacity as one carry-delta batch — then each
+        member requeues unassigned, exactly once."""
+        self.cache.forget_pods([assumed for assumed, _ in items])
+        for assumed, reason in items:
+            self.recorder.event(
+                assumed, "Warning", "FailedScheduling", reason)
+            retry = serde.from_dict(v1.Pod, serde.to_dict(assumed))
+            retry.spec.node_name = ""
+            # backoff re-entry, not active: the wave's released capacity
+            # must be claimable by OTHER pods (a rival gang's stalled
+            # member) before these members re-drive, or a deadlock
+            # back-off re-forms the same stall it just broke
+            self.queue.requeue_with_backoff(retry)
+
+    # -- gang transaction seams --------------------------------------------
+
+    def _gang_plugin(self):
+        """The Coscheduling permit plugin instance, when the profile
+        enables it (None otherwise) — the scheduler-side rollback paths
+        (deletion, deadlock, device fault, demotion, reconcile) all
+        route whole-gang rejections through its wave gates."""
+        fwk = self.framework
+        if fwk is None:
+            return None
+        for pl in getattr(fwk, "permit_plugins", ()):
+            if getattr(pl, "name", "") == "Coscheduling":
+                return pl
+        return None
+
+    def _gang_deadlock_tick(self, now: float) -> None:
+        """Host-side gang deadlock breaker, ticked from the permit
+        drainer: two or more gangs each camping on partial capacity the
+        others need make no membership progress — after
+        KTPU_GANG_DEADLOCK_TICKS consecutive stalled observations (at
+        least KTPU_GANG_DEADLOCK_INTERVAL apart) the YOUNGEST stalled
+        gang (latest first park) is backed off whole, freeing its
+        reserved capacity for the elders. Bounded and hysteretic: one
+        gang per trigger, never the same gang twice in a row, never
+        with fewer than two stalled gangs, and a gang whose membership
+        moved resets its own counter. A stalled gang that is jointly
+        INFEASIBLE on the current cluster (the batched positive-delta
+        what-if says its remaining members can never co-place) is
+        preferred as the back-off victim — it can never complete, so
+        backing off a feasible younger gang instead would be waste."""
+        gang = self._gang_plugin()
+        if gang is None:
+            return
+        interval = knobs.get_float("KTPU_GANG_DEADLOCK_INTERVAL")
+        if now - self._gang_tick_last < (interval or 0.0):
+            return
+        self._gang_tick_last = now
+        gates = [g for g in gang.waiting_gangs() if not g.failed]
+        if len(gates) < 2:
+            self._gang_stall = {}
+            return
+        ticks = max(1, knobs.get_int("KTPU_GANG_DEADLOCK_TICKS") or 1)
+        stalled = []
+        nxt: Dict[Tuple[str, str], Tuple] = {}
+        for g in gates:
+            sig = frozenset(g.members())
+            prev_sig, count = self._gang_stall.get(
+                (g.namespace, g.group), (None, 0))
+            count = count + 1 if sig == prev_sig else 1
+            nxt[(g.namespace, g.group)] = (sig, count)
+            if count >= ticks:
+                stalled.append(g)
+        self._gang_stall = nxt
+        if len(stalled) < 2:
+            return
+        stalled.sort(key=lambda g: g.first_park or 0.0, reverse=True)
+        infeasible = [
+            g for g in stalled if self._gang_feasible(g) is False
+        ]
+        ordered = infeasible + [g for g in stalled if g not in infeasible]
+        victim = ordered[0]
+        if (victim.namespace, victim.group) == self._gang_last_backoff \
+                and len(ordered) > 1:
+            victim = ordered[1]
+        self._gang_last_backoff = (victim.namespace, victim.group)
+        self._gang_stall.pop((victim.namespace, victim.group), None)
+        gang.reject_gang(
+            victim.namespace, victim.group, "deadlock",
+            message=f"gang {victim.group!r} backed off by the deadlock "
+                    f"breaker ({len(stalled)} gangs mutually stalled)",
+        )
+
+    def _gang_feasible(self, gate) -> Optional[bool]:
+        """Joint co-placement feasibility for a waiting gang: can its
+        REMAINING members (beyond the ones already reserved) co-place
+        on the current cluster at all? Scored as one batched
+        positive-delta what-if launch on a scratch carry
+        (ops/whatif.py gang_fits): per-node multiplicity of the member
+        template, summed and compared against the need. None = unknown
+        (whatif off, no parked member to take the template from, or
+        the launch faulted) — callers must treat unknown as feasible."""
+        tpu = self.tpu
+        fn = getattr(tpu, "gang_feasible", None)
+        if tpu is None or fn is None or not tpu.whatif_enabled():
+            return None
+        member_keys = gate.members()
+        with self._permit_lock:
+            probe = next(
+                (self._permit_parked[k][0] for k in member_keys
+                 if k in self._permit_parked),
+                None,
+            )
+        if probe is None:
+            return None
+        gang = self._gang_plugin()
+        reserved = 0
+        if gang is not None:
+            reserved = gang._reserved_members(gate.group, gate.namespace)
+        remaining = gate.min_available - reserved
+        if remaining <= 0:
+            return True
+        return fn(probe, remaining)
 
     def _bind(
         self, assumed: v1.Pod, node_name: str, state: CycleState, info=None

@@ -800,6 +800,25 @@ class TPUBackend(CacheListener):
                 self._whatif_cache[("enc", fp)] = ctx
         return ctx
 
+    def gang_feasible(self, pod: v1.Pod, k: int) -> Optional[bool]:
+        """Joint co-placement probe for the gang deadlock breaker: can
+        k pods of this pod's template co-place on the current cluster?
+        One positive-delta what-if launch on a scratch carry
+        (ops/whatif._gang_fits_run) — False is definitive capacity-wise
+        ("cannot place even ignoring inter-member constraints"), True
+        is optimistic on inter-member couplings. None when the what-if
+        path cannot serve (disabled, demoted, template outside the
+        envelope, encode failure): the probe is advisory, and the
+        caller treats unknown as 'maybe feasible'."""
+        try:
+            enc_pa = self.pe.encode(pod)
+            pa = {n: a for n, a in enc_pa.items() if not n.startswith("_")}
+            ctx = self.whatif_context(pa)
+            tj = ctx.template_index(pa)
+            return ctx.gang_fits(tj, int(k))
+        except Exception:  # noqa: BLE001 — advisory probe, never fatal
+            return None
+
     def check_whatif_fault(self) -> None:
         """Injector seam for the what-if launch path (testing/faults.py
         raise-whatif)."""
@@ -980,6 +999,15 @@ class TPUBackend(CacheListener):
             if leftovers:
                 for pod, node_name in leftovers:
                     self.on_add_pod(pod, node_name)  # RLock: nested is fine
+
+    def on_forget_pods(self, items) -> None:
+        """Batched forget-echo (gang rollback): every member's removal
+        lands under ONE backend lock acquisition, so the whole gang's
+        release queues as one contiguous carry-delta batch the session
+        absorbs together — the retraction dual of on_assume_pods."""
+        with self._lock:
+            for pod, node_name in items:
+                self.on_remove_pod(pod, node_name)  # RLock: nested is fine
 
     def on_remove_pod(self, pod: v1.Pod, node_name: str) -> None:
         with self._lock:

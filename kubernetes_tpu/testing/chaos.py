@@ -53,6 +53,15 @@ FAULT_KINDS = ("wedge-device", "crash-scheduler", "overload")
 #: deterministically. Both no-op on clusters without leader election.
 FAILOVER_KINDS = ("partition-scheduler", "failover-scheduler")
 
+#: the gang-scheduling kinds (opt-in): `kill-gang-member` deletes one
+#: member of a live gang — the Coscheduling rollback protocol must
+#: unwind the whole waiting wave (never a prefix) and, once a
+#: replacement lands, re-complete the gang; `gang-burst` submits a
+#: fresh burst of gang pods so admission waves keep forming mid-chaos.
+#: Both no-op on clusters without gang pods / without a default
+#: namespace to burst into.
+GANG_KINDS = ("kill-gang-member", "gang-burst")
+
 
 class ChaosMonkey:
     def __init__(
@@ -70,6 +79,7 @@ class ChaosMonkey:
         self._dead: List = []  # kubelets killed and not yet restarted
         self._crashed_controllers: List[str] = []  # awaiting supervisor
         self._partitioned: List = []  # electors cut off from the store
+        self._burst_seq = 0  # gang-burst group-name sequence
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -104,6 +114,8 @@ class ChaosMonkey:
             "overload": self._overload,
             "partition-scheduler": self._partition_scheduler,
             "failover-scheduler": self._failover_scheduler,
+            "kill-gang-member": self._kill_gang_member,
+            "gang-burst": self._gang_burst,
         }[kind]
         d = fn()
         if d is not None:
@@ -249,6 +261,79 @@ class ChaosMonkey:
             except Exception:  # noqa: BLE001 — racing deletes are fine
                 pass
         return Disruption("overload", f"event-burst:{burst}")
+
+    def _kill_gang_member(self) -> Optional[Disruption]:
+        """Delete one member of a live gang (waiting or bound — the rng
+        doesn't care, and neither may the protocol): a waiting member's
+        deletion must roll the WHOLE wave back so no sibling camps on
+        capacity; a bound member's deletion leaves its siblings bound
+        (still a legal all-bound-minus-departed state) and the owner's
+        replacement re-completes the gang off the reserved index. Either
+        way the gang may never sit torn — the GangIntegrityChecker
+        holds the line. No-op when no gang pods exist."""
+        from ..scheduler.plugins.coscheduling import pod_group
+
+        pods, _ = self.cluster.client.pods.list(namespace="default")
+        candidates = []
+        for p in pods:
+            if p.metadata.deletion_timestamp is not None:
+                continue
+            group, min_available = pod_group(p)
+            if group and min_available > 1:
+                candidates.append(p)
+        if not candidates:
+            return None
+        victim = self.rng.choice(candidates)
+        self.cluster.client.pods.delete(
+            victim.metadata.name, victim.metadata.namespace
+        )
+        return Disruption(
+            "kill-gang-member",
+            f"{victim.metadata.namespace}/{victim.metadata.name}",
+        )
+
+    #: pods per injected gang-burst gang (drills override per shape)
+    gang_burst_size = 4
+    #: cpu request per burst member — small enough that a burst gang is
+    #: placeable on a drill-sized cluster, large enough to contend
+    gang_burst_cpu = "10m"
+
+    def _gang_burst(self) -> Optional[Disruption]:
+        """Submit one fresh gang (gang_burst_size pods sharing a new
+        group, min-available == size) so admission waves keep forming
+        mid-chaos — gang identity rides annotations, exactly like the
+        perf harness, so the burst never perturbs template hoisting."""
+        from ..api import types as v1
+        from ..scheduler.plugins.coscheduling import (
+            GROUP_LABEL,
+            MIN_AVAILABLE_LABEL,
+        )
+
+        seq = self._burst_seq
+        self._burst_seq += 1
+        group = f"chaos-gang-{seq}"
+        k = self.gang_burst_size
+        for i in range(k):
+            pod = v1.Pod(
+                metadata=v1.ObjectMeta(
+                    name=f"{group}-{i}",
+                    namespace="default",
+                    annotations={
+                        GROUP_LABEL: group,
+                        MIN_AVAILABLE_LABEL: str(k),
+                    },
+                ),
+                spec=v1.PodSpec(containers=[v1.Container(
+                    name="c", image="img:1",
+                    resources=v1.ResourceRequirements(
+                        requests={"cpu": self.gang_burst_cpu}),
+                )]),
+            )
+            try:
+                self.cluster.client.pods.create(pod)
+            except Exception:  # noqa: BLE001 — name races with a prior burst
+                return None
+        return Disruption("gang-burst", f"{group} x{k}")
 
     def _electing_schedulers(self) -> List:
         """Every scheduler instance with leader election armed; supports

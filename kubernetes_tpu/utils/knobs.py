@@ -307,6 +307,19 @@ _declare("KTPU_LEASE_FENCE_MARGIN", "float", 2.0,
          "renewing and demotes) so a GC-paused or partitioned instance "
          "never races the successor's adoption")
 
+# -- gang scheduling (Coscheduling permit transaction)
+_declare("KTPU_GANG_PERMIT_TIMEOUT", "float", 60.0,
+         "max seconds a gang may hold reserved capacity while waiting "
+         "for its remaining members; past this the whole gang rolls "
+         "back (also the orphaned-gang bound for promotion reconcile)")
+_declare("KTPU_GANG_DEADLOCK_TICKS", "int", 3,
+         "consecutive stalled drainer observations (>=2 gangs waiting, "
+         "no membership progress) before the deadlock breaker backs "
+         "off the youngest gang")
+_declare("KTPU_GANG_DEADLOCK_INTERVAL", "float", 0.5,
+         "min seconds between gang deadlock-breaker observations (the "
+         "hysteresis clock; ticks faster than this are ignored)")
+
 # -- harness / test gates (read by scripts/ and tests/, never by the
 #    package; declared so the README table and the knob checker cover
 #    the whole KTPU_* surface)

@@ -100,6 +100,30 @@ CONFIGS = {
         node_extended={"example.com/gpu": "8"},
         max_batch=1024, timeout=900.0,
     ),
+    # rank-scaled gang rows (round 18): the same GPU cluster at 64- and
+    # 256-rank gangs — the MPI-style tightly-coupled shapes the ROADMAP
+    # names. A 64-rank gang spans 8 nodes, a 256-rank gang 32 nodes, so
+    # these rows stress the all-or-nothing permit wave (one straggler
+    # parks 63/255 siblings) rather than per-pod throughput; the
+    # headline pair is aggregate pods/s + gang_admission_p99, and the
+    # gang_{rollbacks,rejected} counters must read 0 on a clean run.
+    # Batch >= gang_size keeps each wave inside one dispatch bucket.
+    "gang64": Workload(
+        "Gang-4000n-64x64", num_nodes=4000, num_init_pods=2048,
+        num_pods=4096, gang_size=64,
+        init_template=PodTemplate(extended={"example.com/gpu": "1"}),
+        template=PodTemplate(extended={"example.com/gpu": "1"}),
+        node_extended={"example.com/gpu": "8"},
+        max_batch=1024, timeout=900.0,
+    ),
+    "gang256": Workload(
+        "Gang-4000n-8x256", num_nodes=4000, num_init_pods=2048,
+        num_pods=2048, gang_size=256,
+        init_template=PodTemplate(extended={"example.com/gpu": "1"}),
+        template=PodTemplate(extended={"example.com/gpu": "1"}),
+        node_extended={"example.com/gpu": "8"},
+        max_batch=1024, timeout=900.0,
+    ),
     # Preemption (performance-config.yaml Preemption section shape):
     # 500 nodes saturated by 2000 low-priority pods (4 x 900m fills a
     # 4-CPU node); 500 high-priority pods must each evict a victim via
@@ -397,6 +421,22 @@ def main() -> None:
         ]
         line["whatif_fallbacks_runs"] = [
             r.get("whatif_fallbacks") for r in runs
+        ]
+        # per-rep gang atomicity accounting (round 18): the Gang-* rows'
+        # acceptance reads THESE — admitted * gang_size must equal
+        # num_bound in every rep, and a rollback/rejection storm in one
+        # rep must not hide behind the median rep's dict. Admission p99
+        # is exact per rep (plugin sample buffer, not histogram buckets).
+        line["gang_admitted_runs"] = [r.get("gang_admitted") for r in runs]
+        line["gang_rejected_runs"] = [r.get("gang_rejected") for r in runs]
+        line["gang_rollbacks_runs"] = [
+            r.get("gang_rollbacks") for r in runs
+        ]
+        line["gang_preempted_runs"] = [
+            r.get("gang_preempted") for r in runs
+        ]
+        line["gang_admission_p99_runs"] = [
+            r.get("gang_admission_p99") for r in runs
         ]
         # per-rep stage-latency attribution (round 11): with KTPU_TRACE
         # on, each rep's per-stage p50/p99 breakdown survives — the chip

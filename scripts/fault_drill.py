@@ -29,10 +29,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from kubernetes_tpu.api import apps, types as v1  # noqa: E402
 from kubernetes_tpu.cluster import Cluster  # noqa: E402
 from kubernetes_tpu.scheduler import metrics  # noqa: E402
+from kubernetes_tpu.scheduler.apis.config import gang_configuration  # noqa: E402
+from kubernetes_tpu.scheduler.plugins.coscheduling import (  # noqa: E402
+    GROUP_LABEL,
+    MIN_AVAILABLE_LABEL,
+    pod_group,
+)
 from kubernetes_tpu.testing.chaos import ChaosMonkey  # noqa: E402
 from kubernetes_tpu.testing.faults import (  # noqa: E402
     BindIntegrityChecker,
     FaultInjector,
+    GangIntegrityChecker,
 )
 
 
@@ -66,6 +73,226 @@ def counter_total(counter) -> float:
     return sum(val for _, val in counter.items())
 
 
+def gang_deployment(name: str, size: int) -> apps.Deployment:
+    """One Deployment == one self-healing gang: every replica carries the
+    same group annotations (min-available == replicas), so a killed
+    member's ReplicaSet replacement re-enters the SAME gang and
+    re-completes it off the Coscheduling reserved index."""
+    return apps.Deployment(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        spec=apps.DeploymentSpec(
+            replicas=size,
+            selector=v1.LabelSelector(match_labels={"app": name}),
+            template=apps.PodTemplateSpec(
+                metadata=v1.ObjectMeta(
+                    labels={"app": name},
+                    annotations={
+                        GROUP_LABEL: name,
+                        MIN_AVAILABLE_LABEL: str(size),
+                    },
+                ),
+                spec=v1.PodSpec(containers=[v1.Container(
+                    name="c", image="img:1",
+                    resources=v1.ResourceRequirements(requests={"cpu": "20m"}),
+                )]),
+            ),
+        ),
+    )
+
+
+def store_partial_gangs(client):
+    """Authoritative end-of-drill scan, independent of the informer-fed
+    checker: group every live pod by gang and report any TORN gang (some
+    members bound, some not)."""
+    pods, _ = client.pods.list(namespace="default")
+    gangs = {}
+    for p in pods:
+        if p.metadata.deletion_timestamp is not None:
+            continue
+        group, min_available = pod_group(p)
+        if not group or min_available <= 1:
+            continue
+        gangs.setdefault((p.metadata.namespace, group), []).append(
+            bool(p.spec.node_name))
+    return {
+        gk: (sum(bound), len(bound))
+        for gk, bound in gangs.items()
+        if 0 < sum(bound) < len(bound)
+    }
+
+
+def gang_drill(args) -> int:
+    """The gang atomicity matrix (all-or-nothing co-placement under
+    faults): DIRECTED scenarios that each force a fresh admission wave
+    and break something mid-wave — kill-member, crash-scheduler,
+    failover (leader abdicates with the gang parked at Permit), and
+    wedge-device — then a RANDOM gang-heavy chaos window. After every
+    scenario the cluster must re-converge with ZERO torn gangs: a gang
+    is always all-bound, all-waiting, or all-rolled-back."""
+    rng = random.Random(args.seed)
+    inj = FaultInjector()
+    failures = []
+    admitted0 = metrics.gang_admitted.value()
+
+    with Cluster(
+        n_nodes=args.nodes,
+        controllers=["replicaset", "deployment", "nodelifecycle"],
+        controller_opts={
+            "node_monitor_period": 0.3,
+            "node_monitor_grace_period": 2.0,
+        },
+        fault_injector=inj,
+        n_schedulers=2,
+        election_opts=dict(
+            lease_duration=1.5, renew_deadline=1.0,
+            retry_period=0.05, fence_margin=0.3,
+        ),
+        scheduler_config=gang_configuration(
+            permit_timeout=args.gang_permit_timeout),
+    ) as c:
+        for sched in c.schedulers:
+            if sched.tpu is None:
+                print("FAIL: gang drill needs the TPU scheduler backend")
+                return 1
+            sched.tpu.watchdog_timeout = args.watchdog
+            sched.tpu.retry_base = 0.01
+            sched.tpu.ladder._probe_interval = 0.1
+            sched.tpu.ladder._probe_delay = 0.1
+        checker = GangIntegrityChecker(grace=10.0).attach(
+            c.kcm.informers.pods())
+        bind_checker = BindIntegrityChecker().attach(c.kcm.informers.pods())
+        n_gangs, k = args.gangs, args.gang_size
+        dep_client = c.client.resource("deployments")
+        for i in range(n_gangs):
+            dep_client.create(gang_deployment(f"gang-{i}", k))
+
+        def n_bound():
+            pods, _ = c.client.pods.list(namespace="default")
+            return sum(1 for p in pods if p.spec.node_name
+                       and p.metadata.deletion_timestamp is None)
+
+        def converged(expect):
+            return (n_bound() >= expect
+                    and not checker.partial_gangs()
+                    and not store_partial_gangs(c.client))
+
+        total = n_gangs * k
+        if not wait_until(lambda: converged(total), timeout=60):
+            print(f"FAIL: initial gang convergence ({n_bound()}/{total} "
+                  f"bound, partial={store_partial_gangs(c.client)})")
+            return 1
+        print(f"seeded: {n_gangs} gangs x {k} members on {args.nodes} "
+              f"nodes (admitted: "
+              f"{metrics.gang_admitted.value() - admitted0:.0f} waves)")
+
+        def roll_gang(i):
+            # delete every member of gang i: the RS recreates the whole
+            # gang, forcing a FRESH admission wave through Permit
+            pods, _ = c.client.pods.list(namespace="default")
+            for p in pods:
+                if (p.metadata.labels or {}).get("app") == f"gang-{i}":
+                    c.client.pods.delete(
+                        p.metadata.name, p.metadata.namespace)
+
+        monkey = ChaosMonkey(c, rng=rng)  # manual driver for directed kinds
+
+        def scenario_kill_member():
+            # one bound member dies -> replacement re-completes; then a
+            # WAITING member dies mid-wave -> the whole wave must roll
+            # back (never a prefix) and re-form around the replacement
+            monkey.do_one("kill-gang-member")
+            roll_gang(rng.randrange(n_gangs))
+            time.sleep(0.1)  # let the fresh wave start parking
+            monkey.do_one("kill-gang-member")
+
+        def scenario_crash_scheduler():
+            inj.arm("kill-scheduler", shots=1)
+            roll_gang(rng.randrange(n_gangs))
+
+        def scenario_failover():
+            roll_gang(rng.randrange(n_gangs))
+            time.sleep(0.15)  # gang parks at Permit on the leader
+            monkey.do_one("failover-scheduler")
+
+        def scenario_wedge_device():
+            inj.arm("wedge-wait", shots=1)
+            roll_gang(rng.randrange(n_gangs))
+
+        scenarios = [
+            ("kill-member", scenario_kill_member),
+            ("crash-scheduler-mid-gang", scenario_crash_scheduler),
+            ("failover-mid-gang", scenario_failover),
+            ("wedge-device-mid-gang", scenario_wedge_device),
+        ]
+        for name, fn in scenarios:
+            before = metrics.gang_admitted.value()
+            fn()
+            ok = wait_until(lambda: converged(total), timeout=90)
+            inj.disarm()
+            waves = metrics.gang_admitted.value() - before
+            partial = store_partial_gangs(c.client)
+            print(f"scenario {name:26s} "
+                  f"{'PASS' if ok else 'FAIL'} "
+                  f"(re-admitted {waves:.0f} waves, bound {n_bound()}"
+                  f"/{total}, partial={partial or 'none'})")
+            if not ok:
+                failures.append(
+                    f"scenario {name}: no clean re-convergence "
+                    f"({n_bound()}/{total} bound, partial={partial})")
+
+        # random gang-heavy chaos window on top of the directed matrix
+        monkey = ChaosMonkey(
+            c, period=args.period, rng=rng,
+            disruptions=[
+                "kill-gang-member", "kill-gang-member", "gang-burst",
+                "wedge-device", "crash-scheduler", "failover-scheduler",
+                "delete-pod",
+            ],
+        )
+        monkey.run()
+        time.sleep(args.duration)
+        monkey.stop()
+        inj.disarm()
+        monkey.restart_all_dead(timeout=30)
+
+        # burst gangs are ownerless: all-waiting forever is legal for a
+        # burst that lost a member, so convergence here is the DEPLOYMENT
+        # gangs fully bound + zero torn gangs anywhere (checker + store)
+        if not wait_until(lambda: converged(total), timeout=90):
+            failures.append(
+                f"post-chaos: {n_bound()}/{total} deployment members "
+                f"bound, partial={store_partial_gangs(c.client)}")
+        if checker.violations:
+            failures.append(f"torn gangs (checker): {checker.violations}")
+        if bind_checker.violations:
+            failures.append(f"double binds: {bind_checker.violations}")
+        final_partial = store_partial_gangs(c.client)
+        if final_partial:
+            failures.append(f"torn gangs (store scan): {final_partial}")
+
+        by_kind = {}
+        for d in monkey.history:
+            by_kind[d.kind] = by_kind.get(d.kind, 0) + 1
+        print("--- gang drill report ---")
+        print(f"disruptions:      {by_kind}")
+        print(f"faults injected:  {dict(inj.injected)}")
+        print(f"gang admissions:  "
+              f"{metrics.gang_admitted.value() - admitted0:.0f}")
+        print(f"gang rollbacks:   "
+              f"{ {k_[0]: int(val) for k_, val in metrics.gang_rollbacks.items()} }")
+        print(f"gang rejected:    "
+              f"{ {k_[0]: int(val) for k_, val in metrics.gang_rejected.items()} }")
+        print(f"final bound:      {n_bound()} "
+              f"({n_gangs} gangs x {k} + burst survivors)")
+
+    if failures:
+        print("FAIL:\n  " + "\n  ".join(failures))
+        return 1
+    print("PASS: every gang stayed all-bound / all-waiting / "
+          "all-rolled-back through the full fault matrix")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--nodes", type=int, default=4)
@@ -95,7 +322,22 @@ def main() -> int:
                          "span ring, write the end-of-drill timeline to "
                          "PATH, and (with --dump-trace) gate the "
                          "trace_report timeline/span reconciliation")
+    ap.add_argument("--gang", action="store_true",
+                    help="run the gang atomicity matrix instead: directed "
+                         "kill-member / crash-scheduler-mid-gang / "
+                         "failover-mid-gang / wedge-device-mid-gang "
+                         "scenarios plus a random gang-heavy chaos "
+                         "window, exiting 1 on any torn gang")
+    ap.add_argument("--gangs", type=int, default=3,
+                    help="[--gang] number of deployment-backed gangs")
+    ap.add_argument("--gang-size", type=int, default=4,
+                    help="[--gang] members per gang (== min-available)")
+    ap.add_argument("--gang-permit-timeout", type=float, default=3.0,
+                    help="[--gang] Coscheduling permit timeout (s)")
     args = ap.parse_args()
+
+    if args.gang:
+        return gang_drill(args)
 
     from kubernetes_tpu.utils import devtime, tracing
 

@@ -25,11 +25,16 @@ def _rows(path):
 
 
 def _newest_round(rows):
+    """{name: that config's newest-round row} (later rows win ties).
+    PER CONFIG, not globally newest: a partial-matrix rerun (e.g. the
+    round-6 Gang-* staging) must not hide every config it didn't
+    re-measure, nor empty the wire-tax intersection below."""
     newest = max((r.get("round", 0) for r in rows), default=0)
     out = {}
     for r in rows:
-        if r.get("round", 0) == newest:
-            out[r["name"]] = r  # later rows of the same round win
+        prev = out.get(r["name"])
+        if prev is None or r.get("round", 0) >= prev.get("round", 0):
+            out[r["name"]] = r
     return newest, out
 
 
@@ -60,6 +65,12 @@ def main() -> None:
                   f"{r.get('attempts_per_sec')}, attempt_p50 "
                   f"{r.get('attempt_p50')}, reps {r.get('reps')}, "
                   f"runs {r.get('throughput_avg_runs')})")
+            if r.get("gang_admitted"):
+                print(f"    gangs: admitted {r.get('gang_admitted_runs')}, "
+                      f"rollbacks {r.get('gang_rollbacks_runs')}, "
+                      f"admission p50 {r.get('gang_admission_p50')}s / "
+                      f"p99 {r.get('gang_admission_p99')}s "
+                      f"(p99 runs {r.get('gang_admission_p99_runs')})")
     rows = _rows("BENCH_SHARDED.json")
     if rows:
         print("\n-- sharded session (BENCH_SHARDED.json) --")

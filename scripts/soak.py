@@ -55,11 +55,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from kubernetes_tpu.api import apps, types as v1  # noqa: E402
 from kubernetes_tpu.cluster import Cluster  # noqa: E402
 from kubernetes_tpu.scheduler import metrics  # noqa: E402
+from kubernetes_tpu.scheduler.apis.config import (  # noqa: E402
+    gang_configuration,
+)
+from kubernetes_tpu.scheduler.plugins.coscheduling import (  # noqa: E402
+    GROUP_LABEL,
+    MIN_AVAILABLE_LABEL,
+)
 from kubernetes_tpu.testing import invariants as inv  # noqa: E402
 from kubernetes_tpu.testing.chaos import ChaosMonkey  # noqa: E402
 from kubernetes_tpu.testing.faults import (  # noqa: E402
     BindIntegrityChecker,
     FaultInjector,
+    GangIntegrityChecker,
 )
 
 
@@ -89,9 +97,42 @@ def deployment(name: str, replicas: int) -> apps.Deployment:
     )
 
 
+def gang_deployment(name: str, size: int) -> apps.Deployment:
+    """One Deployment == one self-healing gang (see fault_drill.py):
+    every replica carries the same group annotations, so a chaos-killed
+    member's replacement re-enters and re-completes the SAME gang."""
+    return apps.Deployment(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        spec=apps.DeploymentSpec(
+            replicas=size,
+            selector=v1.LabelSelector(match_labels={"app": name}),
+            template=apps.PodTemplateSpec(
+                metadata=v1.ObjectMeta(
+                    labels={"app": name},
+                    annotations={
+                        GROUP_LABEL: name,
+                        MIN_AVAILABLE_LABEL: str(size),
+                    },
+                ),
+                spec=v1.PodSpec(containers=[v1.Container(
+                    name="c", image="img:1",
+                    resources=v1.ResourceRequirements(requests={"cpu": "20m"}),
+                )]),
+            ),
+        ),
+    )
+
+
 def build_suite(checker: BindIntegrityChecker, assume_ttl: float,
-                watchers: int = 0):
+                watchers: int = 0,
+                gang_checker: GangIntegrityChecker = None):
     extra = []
+    if gang_checker is not None:
+        # gang atomicity through the WHOLE window: a gang torn past the
+        # checker's grace (some members bound, siblings not) is a
+        # violation even if it later heals
+        extra.append(inv.Callback(
+            "zero-torn-gangs", lambda: list(gang_checker.violations)))
     if watchers:
         # wire fan-out SLI (ISSUE 18): with N watchers riding the hub
         # through the whole chaos window, the delivery p99 must stay
@@ -148,6 +189,16 @@ def main() -> int:
                          "JSON raw sockets) to an HTTP hub over the "
                          "cluster's apiserver and hold the watch "
                          "delivery p99 flat for the whole window")
+    ap.add_argument("--gangs", type=int, default=0,
+                    help="run N deployment-backed gangs through the "
+                         "chaos window (Coscheduling permit gate on, "
+                         "kill-gang-member/gang-burst in the mix) and "
+                         "hold the gang atomicity invariant: never a "
+                         "torn gang, before or after recovery")
+    ap.add_argument("--gang-size", type=int, default=4,
+                    help="members per gang (== min-available)")
+    ap.add_argument("--gang-permit-timeout", type=float, default=3.0,
+                    help="Coscheduling permit timeout (s)")
     args = ap.parse_args()
 
     rng = random.Random(args.seed)
@@ -163,6 +214,10 @@ def main() -> int:
             "node_monitor_grace_period": 2.0,
         },
         fault_injector=inj,
+        scheduler_config=(
+            gang_configuration(permit_timeout=args.gang_permit_timeout)
+            if args.gangs else None
+        ),
     ) as c:
         sched = c.scheduler
         tpu = sched.tpu
@@ -175,18 +230,35 @@ def main() -> int:
         tpu.ladder._probe_interval = 0.1
         tpu.ladder._probe_delay = 0.1
         checker = BindIntegrityChecker().attach(c.kcm.informers.pods())
+        gang_checker = None
+        if args.gangs:
+            gang_checker = GangIntegrityChecker(grace=10.0).attach(
+                c.kcm.informers.pods())
         c.client.resource("deployments").create(
             deployment("soak", args.replicas))
+        for i in range(args.gangs):
+            c.client.resource("deployments").create(
+                gang_deployment(f"gang-{i}", args.gang_size))
+        # the soak's convergence target: every DEPLOYMENT-owned pod
+        # (soak replicas + gang members); ownerless gang-burst pods are
+        # excluded — an all-waiting burst that lost a member is a legal
+        # terminal state, and they are swept before the final baseline
+        expect = args.replicas + args.gangs * args.gang_size
+
+        def owned(p):
+            return not p.metadata.name.startswith("chaos-gang-")
 
         def n_running():
             pods, _ = c.client.pods.list(namespace="default")
-            return sum(1 for p in pods if p.status.phase == "Running")
+            return sum(1 for p in pods
+                       if owned(p) and p.status.phase == "Running")
 
-        if not wait_until(lambda: n_running() == args.replicas, timeout=60):
+        if not wait_until(lambda: n_running() == expect, timeout=60):
             print(f"FAIL: initial convergence "
-                  f"({n_running()}/{args.replicas})")
+                  f"({n_running()}/{expect})")
             return 1
-        print(f"seeded: {args.replicas} replicas on {args.nodes} nodes, "
+        print(f"seeded: {args.replicas} replicas + {args.gangs} gangs x "
+              f"{args.gang_size} on {args.nodes} nodes, "
               f"shadow_sample={tpu.shadow_sample}, depth="
               f"{sched.pipeline_depth}, rung={tpu.ladder.mode()}")
 
@@ -216,19 +288,23 @@ def main() -> int:
                   f"({half} binary, {args.watchers - half} json)")
 
         suite = build_suite(checker, assume_ttl=sched.cache._ttl,
-                            watchers=args.watchers)
+                            watchers=args.watchers,
+                            gang_checker=gang_checker)
         suite.sample()  # baseline BEFORE the chaos window
 
         # churn-heavy mix (delete-pod thrice-weighted keeps batches
         # flowing so the monitor always has completion ticks to
-        # observe), overload every ~6 disruptions on average
-        monkey = ChaosMonkey(
-            c, period=args.period, rng=rng,
-            disruptions=[
-                "delete-pod", "delete-pod", "delete-pod",
-                "overload", "wedge-device", "crash-scheduler",
-            ],
-        )
+        # observe), overload every ~6 disruptions on average; with
+        # --gangs the gang kinds join so admission waves keep forming
+        # and getting broken mid-flight
+        mix = [
+            "delete-pod", "delete-pod", "delete-pod",
+            "overload", "wedge-device", "crash-scheduler",
+        ]
+        if args.gangs:
+            mix += ["kill-gang-member", "kill-gang-member", "gang-burst"]
+        monkey = ChaosMonkey(c, period=args.period, rng=rng,
+                             disruptions=mix)
         monkey.run()
         deadline = time.monotonic() + args.seconds
         while time.monotonic() < deadline:
@@ -237,6 +313,19 @@ def main() -> int:
         monkey.stop()
         inj.disarm()
         monkey.restart_all_dead(timeout=30)
+
+        if args.gangs:
+            # sweep the ownerless burst gangs: a burst that lost a member
+            # to chaos is legally all-waiting forever, which would pin
+            # the queue above its baseline — atomicity was already
+            # monitored live; the baseline checks judge the OWNED world
+            for p in c.client.pods.list(namespace="default")[0]:
+                if not owned(p) and p.metadata.deletion_timestamp is None:
+                    try:
+                        c.client.pods.delete(
+                            p.metadata.name, p.metadata.namespace)
+                    except Exception:  # noqa: BLE001 — racing deletes
+                        pass
 
         ov = sched.overload
 
@@ -295,14 +384,20 @@ def main() -> int:
 
         def converged():
             pods, _ = c.client.pods.list(namespace="default")
-            running = [p for p in pods if p.status.phase == "Running"]
-            return (len(running) == args.replicas
-                    and len(pods) == args.replicas)
+            mine = [p for p in pods if owned(p)]
+            running = [p for p in mine if p.status.phase == "Running"]
+            return (len(running) == expect and len(mine) == expect
+                    and (gang_checker is None
+                         or not gang_checker.partial_gangs()))
 
         if not wait_until(converged, timeout=90):
             failures.append(
-                f"lost pods: {args.replicas - n_running()} replicas "
+                f"lost pods: {expect - n_running()} owned pods "
                 f"missing after recovery")
+        if gang_checker is not None:
+            final_partial = gang_checker.partial_gangs()
+            if final_partial:
+                failures.append(f"torn gangs at soak end: {final_partial}")
         # settle, then close the invariant window (queue/watcher
         # baselines are judged on the LAST sample)
         time.sleep(2.0)
@@ -330,6 +425,14 @@ def main() -> int:
         for t, action, what, sig in ov.history:
             print(f"  {action:7s} {what:16s} fifo_age={sig['fifo_age']} "
                   f"queue={sig['queue_depth']}")
+        if args.gangs:
+            rollbacks = {
+                k[0]: int(val)
+                for k, val in metrics.gang_rollbacks.items() if val
+            }
+            print(f"gang admissions:   "
+                  f"{metrics.gang_admitted.value():.0f} waves, "
+                  f"rollbacks={rollbacks}")
         shadow = inv.total(suite.samples[-1][1],
                            "scheduler_shadow_samples_total")
         skips = inv.total(suite.samples[-1][1],

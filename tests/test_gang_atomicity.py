@@ -1,0 +1,429 @@
+"""Atomic gang scheduling: crash-safe all-or-nothing co-placement.
+
+Four contracts pinned here, all arbitrated through the single-assignment
+GangGate (plugins/coscheduling.py):
+
+  * permit timeout vs gang completion is a RACE with a deterministic
+    winner — whichever side flips the gate wins whole, the loser stands
+    down (the pre-gate implementation's documented "tiny, self-healing
+    race", made deterministic under directed two-thread tests);
+  * a scheduler crash/promotion mid-permit heals through
+    reconcile_from_store: orphaned gang waves (older than
+    KTPU_GANG_PERMIT_TIMEOUT, or with members gone/bound in the store)
+    roll back whole with reason=reconcile;
+  * mutually-stalled gangs converge through the deadlock breaker (the
+    youngest backs off whole; the elder completes) — never a torn gang;
+  * the Permit gate only GATES, it never re-places: a mixed
+    gang+singleton stream binds bit-identically with the gate on or
+    off, at pipeline depth 0 or 2.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as v1
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.clientset import Clientset
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.scheduler import metrics
+from kubernetes_tpu.scheduler.framework.runtime import Framework, WaitingPod
+from kubernetes_tpu.scheduler.internal import queue as queue_mod
+from kubernetes_tpu.scheduler.plugins.coscheduling import (
+    GROUP_LABEL,
+    MIN_AVAILABLE_LABEL,
+    GangGate,
+)
+from kubernetes_tpu.scheduler.plugins.registry import (
+    default_plugins_without,
+    new_in_tree_registry,
+)
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.scheduler.tpu_backend import TPUBackend
+from kubernetes_tpu.testing.faults import GangIntegrityChecker
+
+from .test_coscheduling import _bound_count, _gang_scheduler, gang_pod
+from .test_pipeline_parity import _cluster, _drive
+from .util import make_node, make_pod, wait_until
+
+
+# -- the timeout-vs-completion race, deterministic under the gate ------------
+
+
+def _parked_waiting_pod(name="m-0", timeout=0.01):
+    pod = gang_pod(name, "race", 2)
+    wp = WaitingPod(pod, {"Coscheduling": timeout})
+    return pod, wp
+
+
+class TestGateArbitration:
+    """Directed two-thread coverage for the documented pre-gate race:
+    a permit timeout firing while the completing member's allow() is in
+    flight. The gate makes the outcome deterministic — exactly one side
+    flips it, and the loser observes the flip and stands down."""
+
+    def test_timeout_yields_to_completed_gate(self):
+        """Completion flips the gate first; the due timeout must NOT
+        resolve the pod (the completing thread's allow() is in flight)
+        — the pre-gate bug resolved it unschedulable here and relied
+        on the retry loop to self-heal."""
+        pod, wp = _parked_waiting_pod()
+        fails = []
+        gate = GangGate("default", "race", 2,
+                        on_fail=lambda g: fails.append(g.reason))
+        gate.note_parked(v1.pod_key(pod), time.monotonic())
+        wp.set_gate(gate)
+        assert gate.complete()
+        time.sleep(0.02)  # deadline passes
+        # timeout arbitration: gate.fail() loses, pod stays unresolved
+        assert wp.timeout_if_due(time.monotonic()) is False
+        assert not fails
+        wp.allow("Coscheduling")  # the in-flight allow lands
+        assert wp.wait() is None  # success, never unschedulable
+
+    def test_timeout_flips_gate_then_completion_bounces(self):
+        pod, wp = _parked_waiting_pod()
+        fails = []
+        gate = GangGate("default", "race", 2,
+                        on_fail=lambda g: fails.append(g.reason))
+        gate.note_parked(v1.pod_key(pod), time.monotonic())
+        wp.set_gate(gate)
+        time.sleep(0.02)
+        assert wp.timeout_if_due(time.monotonic()) is True
+        st = wp.wait()
+        assert st is not None and st.is_unschedulable()
+        assert fails == ["timeout"]
+        # the completing member loses the race and must not bind
+        assert gate.complete() is False
+
+    def test_two_thread_race_is_all_or_nothing(self):
+        """Barrier-aligned complete() vs timeout_if_due() over many
+        trials: whatever the interleaving, exactly one side wins, the
+        on_fail cascade fires at most once, and the pod's resolution
+        matches the winner — never a half-resolved state."""
+        outcomes = {"completed": 0, "failed": 0}
+        for trial in range(300):
+            pod, wp = _parked_waiting_pod(timeout=0.0001)
+            fails = []
+            gate = GangGate("default", "race", 2,
+                            on_fail=lambda g: fails.append(g.reason))
+            gate.note_parked(v1.pod_key(pod), time.monotonic())
+            wp.set_gate(gate)
+            time.sleep(0.001)  # deadline due before either thread runs
+            barrier = threading.Barrier(2)
+            complete_won = []
+
+            def completer():
+                barrier.wait()
+                won = gate.complete()
+                complete_won.append(won)
+                if won:
+                    wp.allow("Coscheduling")
+
+            def timeouter():
+                barrier.wait()
+                wp.timeout_if_due(time.monotonic())
+
+            threads = [threading.Thread(target=completer),
+                       threading.Thread(target=timeouter)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            st = wp.wait()
+            if complete_won[0]:
+                assert gate.state == GangGate.COMPLETED, trial
+                assert st is None, (trial, st)
+                assert fails == [], trial
+                outcomes["completed"] += 1
+            else:
+                assert gate.state == GangGate.FAILED, trial
+                assert st is not None and st.is_unschedulable(), trial
+                assert fails == ["timeout"], trial
+                outcomes["failed"] += 1
+        assert sum(outcomes.values()) == 300
+
+    def test_concurrent_fails_fire_cascade_once(self):
+        """Timeout, unreserve, and the deadlock breaker may all call
+        fail() on the same wave concurrently — the rollback cascade
+        (requeue members, count the rollback) must fire exactly once."""
+        fired = []
+        gate = GangGate("default", "g", 3, on_fail=lambda g: fired.append(1))
+        barrier = threading.Barrier(4)
+
+        def failer(reason):
+            barrier.wait()
+            assert gate.fail(reason=reason) is True  # wave IS failed
+
+        threads = [
+            threading.Thread(target=failer, args=(r,))
+            for r in ("timeout", "member-rejected", "deadlock", "reconcile")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(fired) == 1
+        assert gate.state == GangGate.FAILED
+
+
+# -- crash/promotion mid-permit: reconcile_from_store rollback ---------------
+
+
+class TestReconcileRollback:
+    def test_orphaned_wave_rolls_back_and_gang_heals(self):
+        """A wave older than KTPU_GANG_PERMIT_TIMEOUT at promotion is an
+        orphaned transaction (the leader that parked it died): the
+        reconcile must roll it back whole (reason=reconcile), requeue
+        the members, and the gang must still admit later — all-bound,
+        never torn."""
+        api = APIServer()
+        cs = Clientset(api)
+        for i in range(4):
+            cs.nodes.create(make_node(
+                f"node-{i}", labels={v1.LABEL_HOSTNAME: f"node-{i}"}))
+        factory, sched = _gang_scheduler(cs, permit_timeout=60.0)
+        checker = GangIntegrityChecker(grace=5.0).attach(factory.pods())
+        try:
+            sched.start()
+            cs.pods.create(gang_pod("g-0", "job-r", 3))
+            cs.pods.create(gang_pod("g-1", "job-r", 3))
+            pl = sched._gang_plugin()
+            assert pl is not None
+            assert wait_until(
+                lambda: any(len(g.members()) == 2
+                            for g in pl.waiting_gangs()), 10)
+            (gate,) = pl.waiting_gangs()
+            v0 = metrics.gang_rollbacks.value(reason="reconcile")
+            # age the wave past the knob: the crashed-leader signature
+            with gate._lock:
+                gate.first_park -= 120.0
+            sched.reconcile_from_store()
+            assert metrics.gang_rollbacks.value(reason="reconcile") == v0 + 1
+            assert gate.state == GangGate.FAILED
+            # the members requeued (exactly once) and re-drive; the
+            # late third member completes the healed wave
+            cs.pods.create(gang_pod("g-2", "job-r", 3))
+            assert wait_until(lambda: _bound_count(cs) == 3, 20)
+            assert checker.violations == []
+            assert checker.partial_gangs() == {}
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_wave_with_member_bound_elsewhere_rolls_back(self):
+        """A waiting member that the STORE says is bound (a prior
+        leader's late bind landed) poisons the wave: the member can
+        never re-drive through Permit here, so reconcile rolls the
+        wave back instead of letting it camp until timeout."""
+        api = APIServer()
+        cs = Clientset(api)
+        for i in range(4):
+            cs.nodes.create(make_node(
+                f"node-{i}", labels={v1.LABEL_HOSTNAME: f"node-{i}"}))
+        factory, sched = _gang_scheduler(cs, permit_timeout=60.0)
+        try:
+            sched.start()
+            cs.pods.create(gang_pod("g-0", "job-s", 3))
+            cs.pods.create(gang_pod("g-1", "job-s", 3))
+            pl = sched._gang_plugin()
+            assert wait_until(
+                lambda: any(len(g.members()) == 2
+                            for g in pl.waiting_gangs()), 10)
+            (gate,) = pl.waiting_gangs()
+            v0 = metrics.gang_rollbacks.value(reason="reconcile")
+            # the old leader's bind lands directly in the store
+            cs.pods.bind("default", "g-0", "node-3")
+            sched.reconcile_from_store()
+            assert metrics.gang_rollbacks.value(reason="reconcile") == v0 + 1
+            assert gate.state == GangGate.FAILED
+        finally:
+            sched.stop()
+            factory.stop()
+
+
+# -- deadlock breaker convergence --------------------------------------------
+
+
+class TestDeadlockBreaker:
+    def test_mutually_stalled_gangs_converge(self, monkeypatch):
+        """Two gangs of 3 on four one-pod nodes: each parks two members
+        and stalls (the remaining member cannot fit). The breaker must
+        back off one gang WHOLE so the other completes — the end state
+        is one gang fully bound and the other fully unbound, never a
+        torn prefix on either side."""
+        monkeypatch.setenv("KTPU_GANG_DEADLOCK_TICKS", "2")
+        monkeypatch.setenv("KTPU_GANG_DEADLOCK_INTERVAL", "0.1")
+        # flush unschedulable members fast: the freed capacity after a
+        # back-off must reach the parked sibling within the test window
+        monkeypatch.setattr(queue_mod, "UNSCHEDULABLE_Q_TIME_INTERVAL", 0.3)
+        api = APIServer()
+        cs = Clientset(api)
+        for i in range(4):
+            cs.nodes.create(make_node(
+                f"node-{i}", pods=1,
+                labels={v1.LABEL_HOSTNAME: f"node-{i}"}))
+        factory, sched = _gang_scheduler(cs, permit_timeout=30.0)
+        checker = GangIntegrityChecker(grace=5.0).attach(factory.pods())
+        try:
+            sched.start()
+            v0 = metrics.gang_rollbacks.value(reason="deadlock")
+            for i in range(3):
+                cs.pods.create(gang_pod(f"a-{i}", "gang-a", 3))
+                cs.pods.create(gang_pod(f"b-{i}", "gang-b", 3))
+
+            def bound_by_group():
+                pods, _ = cs.pods.list(namespace="default")
+                counts = {"gang-a": 0, "gang-b": 0}
+                for p in pods:
+                    if p.spec.node_name:
+                        counts[(p.metadata.labels or {})[GROUP_LABEL]] += 1
+                return counts
+
+            assert wait_until(lambda: 3 in bound_by_group().values(), 25), (
+                f"no gang converged: {bound_by_group()}"
+            )
+            assert metrics.gang_rollbacks.value(reason="deadlock") > v0
+            counts = bound_by_group()
+            # whole-or-none on BOTH sides: winner fully bound, loser
+            # fully unbound (capacity 4 can never host the second gang)
+            assert sorted(counts.values()) == [0, 3], counts
+            assert checker.violations == []
+            assert checker.partial_gangs() == {}
+        finally:
+            sched.stop()
+            factory.stop()
+
+
+# -- joint co-placement feasibility (gang_fits) ------------------------------
+
+
+class TestGangFeasible:
+    def _backend(self, nodes, pods=()):
+        b = TPUBackend()
+        b.whatif = True  # CPU default is off (platform-gated)
+        for n in nodes:
+            b.on_add_node(n)
+        for p in pods:
+            b.on_add_pod(p, p.spec.node_name)
+        return b
+
+    def test_definitive_verdicts(self):
+        nodes = [make_node(f"n{i}", cpu="4", memory="16Gi", pods=110)
+                 for i in range(3)]
+        b = self._backend(nodes)
+        probe = make_pod("probe", cpu="1", memory="1Gi")
+        # 3 nodes x 4 cpu: 3 of these co-place, 100 never can
+        assert b.gang_feasible(probe, 3) is True
+        assert b.gang_feasible(probe, 100) is False
+
+    def test_feasibility_sees_existing_load(self):
+        nodes = [make_node(f"n{i}", cpu="4", memory="16Gi", pods=110)
+                 for i in range(2)]
+        fill = [make_pod(f"f{i}", cpu="3500m", memory="1Gi",
+                         node_name=f"n{i}") for i in range(2)]
+        b = self._backend(nodes, fill)
+        probe = make_pod("probe", cpu="1", memory="1Gi")
+        # 500m headroom per node: zero slots for a 1-cpu member
+        assert b.gang_feasible(probe, 1) is False
+
+    def test_advisory_none_when_whatif_off(self):
+        b = TPUBackend()  # whatif stays platform-gated off on CPU
+        b.on_add_node(make_node("n0", cpu="4", memory="16Gi"))
+        probe = make_pod("probe", cpu="1", memory="1Gi")
+        assert b.gang_feasible(probe, 1) is None
+
+
+# -- gang+singleton stream parity vs depth-0 ---------------------------------
+
+
+def _mk_parity_scheduler(cs, depth, gate_on):
+    factory = SharedInformerFactory(cs)
+    sched = Scheduler(cs, factory, backend="tpu", pipeline_depth=depth)
+    plugins = default_plugins_without("DefaultPreemption")
+    if gate_on:
+        plugins["permit"] = [("Coscheduling", 1)]
+        plugins["reserve"] = plugins.get("reserve", []) + [("Coscheduling", 1)]
+    sched.framework = Framework(
+        new_in_tree_registry(),
+        plugins=plugins,
+        plugin_config={"Coscheduling": {"permit_timeout_seconds": 60.0}},
+        snapshot_fn=lambda: sched.snapshot,
+        handle_extras={"cache": sched.cache},
+    )
+    sched.framework.nominator = sched.nominator
+    sched.framework.pdb_lister = sched._list_pdbs
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    return sched
+
+
+def _gang_stream(n_gangs=4, gang_size=3, n_singles=12):
+    """Deterministic mixed stream: whole gangs interleaved with plain
+    singletons and a few permanently-unschedulable churn pods. Gang
+    identity rides ANNOTATIONS (the template-hoisting form: every gang
+    shares one encoded template)."""
+    pods = []
+    for g in range(n_gangs):
+        for m in range(gang_size):
+            p = make_pod(f"p-g{g}-{m}", namespace="default", cpu="200m",
+                         memory="128Mi", labels={"app": "gang"})
+            p.metadata.annotations = {
+                GROUP_LABEL: f"gang-{g}",
+                MIN_AVAILABLE_LABEL: str(gang_size),
+            }
+            pods.append(p)
+        for s in range(n_singles // n_gangs):
+            if (g + s) % 5 == 4:
+                pods.append(make_pod(
+                    f"p-s{g}-{s}", namespace="default", cpu="64",
+                    memory="1Gi", labels={"app": "hungry"}))
+            else:
+                pods.append(make_pod(
+                    f"p-s{g}-{s}", namespace="default", cpu="500m",
+                    memory="256Mi", labels={"app": "plain"}))
+    return pods
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_gang_stream_parity_with_depth0_and_no_gate(seed):
+    """The Permit gate GATES, it never re-places: the same mixed
+    gang+singleton stream, driven through identical batch boundaries,
+    must bind bit-identically (a) without Coscheduling at depth 0 —
+    the no-gang-regression reference, (b) with the gate at depth 0,
+    and (c) with the gate at depth 2 (parked waves resolving under
+    pipelined completions)."""
+    import random as _random
+
+    rng = _random.Random(seed)
+    batch_sizes = [rng.choice([1, 2, 3, 5, 8]) for _ in range(64)]
+    maps = {}
+    for label, depth, gate_on in (
+        ("off-d0", 0, False), ("on-d0", 0, True), ("on-d2", 2, True),
+    ):
+        _, cs = _cluster()
+        sched = _mk_parity_scheduler(cs, depth, gate_on)
+        try:
+            _drive(sched, cs, _gang_stream(), list(batch_sizes))
+            pods, _ = cs.pods.list(namespace="default")
+            maps[label] = {
+                p.metadata.name: p.spec.node_name for p in pods
+            }
+        finally:
+            sched.stop()
+            sched.informers.stop()
+    assert maps["off-d0"] == maps["on-d0"], (
+        "the gang gate changed placement decisions"
+    )
+    assert maps["on-d0"] == maps["on-d2"], (
+        "pipelined gang waves diverged from the sequential path"
+    )
+    # every gang admitted whole (the stream is satisfiable by design)
+    unbound_gang = [k for k, nd in maps["on-d2"].items()
+                    if k.startswith("p-g") and not nd]
+    assert not unbound_gang, f"gang members left unbound: {unbound_gang}"
+    # churn was actually exercised
+    assert any(not nd for nd in maps["on-d2"].values())

@@ -1171,3 +1171,187 @@ class TestDeviceLadder:
         assert sorted(p.metadata.name for p in dc.victims) == sorted(
             p.metadata.name for p in result.victims
         )
+
+
+# -- gang-aware preemption: whole gangs or none ------------------------------
+
+
+class TestGangVictimParity:
+    """Gang-aware victim selection across all three planner rungs:
+    co-located gang members are one indivisible eviction unit (whole
+    gangs or none), a gang with any member at-or-above the preemptor's
+    priority is untouchable (never loses a prefix), and the fast and
+    device rungs stay bit-identical to the oracle with gang units in
+    the victim pool."""
+
+    @staticmethod
+    def _stamp(pod, group, size):
+        from kubernetes_tpu.scheduler.plugins.coscheduling import (
+            GROUP_LABEL,
+            MIN_AVAILABLE_LABEL,
+        )
+
+        pod.metadata.annotations = {
+            GROUP_LABEL: group,
+            MIN_AVAILABLE_LABEL: str(size),
+        }
+
+    def _random_gang_cluster(self, rng: random.Random, n_nodes: int):
+        """Mostly-saturated nodes where part of the load is co-located
+        gangs: evictable gangs (every member below the preemptor),
+        MIXED gangs (one member outranks it — untouchable whole), and
+        plain singletons, never oversubscribing a node."""
+        nodes, pods = [], []
+        gangs = {}
+        for i in range(n_nodes):
+            cap = rng.choice([4000, 8000])
+            nodes.append(make_node(
+                f"n{i}", cpu=f"{cap}m", memory="16Gi", pods=110))
+            used = 0
+            if rng.random() < 0.7:
+                size = rng.randint(2, 3)
+                group = f"gang-n{i}"
+                mixed = rng.random() < 0.3
+                members = []
+                for j in range(size):
+                    prio = 200 if (mixed and j == 0) else \
+                        rng.choice([0, 1, 5, 50])
+                    p = make_pod(
+                        f"g{i}-{j}", cpu="900m", memory="256Mi",
+                        node_name=f"n{i}", priority=prio,
+                    )
+                    self._stamp(p, group, size)
+                    pods.append(p)
+                    members.append(p.metadata.name)
+                    used += 900
+                gangs[group] = (members, mixed)
+            while True:
+                req = rng.choice([900, 1500, 2000])
+                if used + req > cap - 500:
+                    break
+                pods.append(make_pod(
+                    f"p{i}-{used}", cpu=f"{req}m",
+                    memory=rng.choice(["64Mi", "512Mi"]),
+                    node_name=f"n{i}",
+                    priority=rng.choice([0, 1, 5, 50]),
+                ))
+                used += req
+        return nodes, pods, gangs
+
+    @staticmethod
+    def _assert_whole_gangs(victims, gangs, trial):
+        names = {p.metadata.name for p in victims}
+        whole = 0
+        for group, (members, mixed) in gangs.items():
+            took = names & set(members)
+            if mixed:
+                assert not took, (
+                    f"trial {trial}: mixed gang {group} lost members "
+                    f"{sorted(took)}"
+                )
+            else:
+                assert took in (set(), set(members)), (
+                    f"trial {trial}: gang {group} torn — evicted "
+                    f"{sorted(took)} of {members}"
+                )
+                if took:
+                    whole += 1
+        return whole
+
+    def test_three_way_whole_gang_or_none_fuzz(self):
+        rng = random.Random(19)
+        agree = none = gang_evictions = 0
+        for trial in range(30):
+            nodes, pods, gangs = self._random_gang_cluster(
+                rng, rng.randint(3, 9))
+            snapshot = Snapshot.from_objects(pods, nodes)
+            backend = _mk_backend(nodes, pods)
+            pending = make_pod(
+                "high",
+                cpu=f"{rng.choice([2500, 3500, 9000])}m",
+                memory="1Gi", priority=100,
+            )
+            dp, (dc,) = _device_plan(
+                snapshot, [pending], backend, nominator=PodNominator())
+            assert dp.planner_paths == ["device"], (trial, dp.planner_paths)
+            fp = FastPreemptionPlanner(snapshot, PodNominator())
+            (fc,) = fp.plan([pending])
+            assert dp.fits_now == fp.fits_now, trial
+            if dp.fits_now[0]:
+                continue
+            result, _ = _post_filter(snapshot, pending)
+            if dc is None:
+                assert fc is None and result is None, trial
+                none += 1
+                continue
+            assert fc is not None and result is not None, trial
+            assert dc.node_name == fc.node_name \
+                == result.nominated_node_name, trial
+            assert [p.metadata.name for p in dc.victims] == [
+                p.metadata.name for p in fc.victims
+            ], trial
+            assert sorted(p.metadata.name for p in dc.victims) == sorted(
+                p.metadata.name for p in result.victims
+            ), trial
+            agree += 1
+            for plan_victims in (dc.victims, fc.victims, result.victims):
+                whole = self._assert_whole_gangs(plan_victims, gangs, trial)
+            gang_evictions += whole
+        # the fuzz must exercise agreement, no-candidate clusters, AND
+        # actual whole-gang evictions
+        assert agree >= 5, agree
+        assert none >= 1, none
+        assert gang_evictions >= 2, gang_evictions
+
+    def test_mixed_gang_never_loses_a_prefix(self):
+        """Directed: the only way to fit the preemptor is through a
+        gang with one protected member — every rung must refuse (the
+        pre-unit planners evicted the two low members: a torn gang)."""
+        nodes = [make_node("n0", cpu="4", memory="16Gi", pods=110)]
+        pods = []
+        for j, prio in enumerate([200, 1, 1]):
+            p = make_pod(f"g0-{j}", cpu="1200m", memory="256Mi",
+                         node_name="n0", priority=prio)
+            self._stamp(p, "gang-x", 3)
+            pods.append(p)
+        snapshot = Snapshot.from_objects(pods, nodes)
+        pending = make_pod("high", cpu="2", memory="1Gi", priority=100)
+        (fc,) = FastPreemptionPlanner(snapshot, PodNominator()).plan(
+            [pending])
+        assert fc is None
+        dp, (dc,) = _device_plan(
+            snapshot, [pending], _mk_backend(nodes, pods),
+            nominator=PodNominator())
+        assert dc is None
+        result, _ = _post_filter(snapshot, pending)
+        assert result is None
+
+    def test_gang_unit_evicts_whole_even_when_one_member_suffices(self):
+        """Directed: capacity-wise one gang member would be enough, but
+        the unit is indivisible — all rungs evict the whole gang, and
+        agree."""
+        nodes = [make_node("n0", cpu="4", memory="16Gi", pods=110)]
+        pods = []
+        for j in range(2):
+            p = make_pod(f"g0-{j}", cpu="1500m", memory="256Mi",
+                         node_name="n0", priority=1)
+            self._stamp(p, "gang-y", 2)
+            pods.append(p)
+        snapshot = Snapshot.from_objects(pods, nodes)
+        pending = make_pod("high", cpu="2", memory="1Gi", priority=100)
+        (fc,) = FastPreemptionPlanner(snapshot, PodNominator()).plan(
+            [pending])
+        assert fc is not None
+        assert sorted(p.metadata.name for p in fc.victims) == \
+            ["g0-0", "g0-1"]
+        dp, (dc,) = _device_plan(
+            snapshot, [pending], _mk_backend(nodes, pods),
+            nominator=PodNominator())
+        assert dc is not None
+        assert [p.metadata.name for p in dc.victims] == [
+            p.metadata.name for p in fc.victims
+        ]
+        result, _ = _post_filter(snapshot, pending)
+        assert result is not None
+        assert sorted(p.metadata.name for p in result.victims) == \
+            ["g0-0", "g0-1"]
